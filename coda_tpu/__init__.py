@@ -1,0 +1,32 @@
+"""coda_tpu — TPU-native active model selection.
+
+A brand-new JAX/XLA framework with the capabilities of the PyTorch reference
+``justinkay/coda`` (CODA: Consensus-Driven Active Model Selection, ICCV 2025).
+
+Given an ``(H, N, C)`` tensor of post-softmax predictions from ``H`` candidate
+models on ``N`` unlabeled points over ``C`` classes, an active model selection
+method repeatedly picks a point to label, queries an oracle, updates its
+beliefs, and reports its current guess of the best model.
+
+Design stance (TPU-first, not a port):
+  * selector state is a fixed-shape pytree (boolean masks, not Python lists),
+  * every per-round computation is a pure jit-able function,
+  * the whole labeling loop compiles to a single ``lax.scan``,
+  * seeds batch under ``vmap``; the ``(H, N, C)`` tensor shards over a
+    ``jax.sharding.Mesh`` (N and/or H axes) with XLA collectives over ICI.
+"""
+
+from coda_tpu.data import Dataset, make_synthetic_task
+from coda_tpu.oracle import Oracle, true_losses
+from coda_tpu.losses import LOSS_FNS, accuracy_loss
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset",
+    "make_synthetic_task",
+    "Oracle",
+    "true_losses",
+    "LOSS_FNS",
+    "accuracy_loss",
+]

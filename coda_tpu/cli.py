@@ -1,0 +1,198 @@
+"""Benchmark CLI: ``python main.py --task T --method M ...``.
+
+Argument-compatible with the reference experiment driver (reference
+``main.py:28-53``): same task/method/seed/loss/CODA hyperparameter flags, the
+same regret/cumulative-regret metrics per labeling round, and the same
+experiment -> parent-run -> seed-child-run tracking layout.
+
+TPU-native execution model: instead of a Python loop calling the selector
+per round per seed, every seed's full 100-round experiment is one compiled
+``lax.scan`` and all seeds run batched under ``vmap`` in a single device
+program (reference: one host loop per seed, ``main.py:89-103``). Metrics
+stream to the tracking store *after* the compiled run, in one batch per seed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="TPU-native active model selection")
+    # dataset settings
+    p.add_argument("--task", default=None, help="task name, e.g. cifar10_5592")
+    p.add_argument("--data-dir", default="data")
+    p.add_argument(
+        "--synthetic", default=None, metavar="H,N,C",
+        help="run on a seeded synthetic task of this shape instead of files",
+    )
+
+    # benchmarking settings
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--seeds", type=int, default=5)
+    p.add_argument("--force-rerun", action="store_true",
+                   help="Overwrite existing finished runs.")
+    p.add_argument("--experiment-name", default=None)
+    p.add_argument("--no-mlflow", action="store_true",
+                   help="Disable tracking-store logging.")
+    p.add_argument("--tracking-db", default="coda.sqlite",
+                   help="Path of the sqlite tracking database.")
+
+    # general method settings
+    p.add_argument("--loss", default="acc", help="{acc, ce}")
+    p.add_argument("--method", default="iid",
+                   help="{iid, uncertainty, coda*, activetesting, vma, model_picker}")
+
+    # CODA settings (same flags/defaults as the reference)
+    p.add_argument("--alpha", default=0.9, type=float)
+    p.add_argument("--learning-rate", default=0.01, type=float)
+    p.add_argument("--multiplier", default=2.0, type=float)
+    p.add_argument("--prefilter-n", type=int, default=0,
+                   help="Randomly subsample n candidates per iteration.")
+    p.add_argument("--no-diag-prior", action="store_true",
+                   help="Disable diagonal prior (ablation 1).")
+    p.add_argument("--q", default="eig",
+                   help="Acquisition function {eig, iid, uncertainty} (ablation 2).")
+
+    # TPU execution settings (no reference equivalent)
+    p.add_argument("--eig-chunk", type=int, default=1024,
+                   help="lax.map batch size for the EIG scoring pass.")
+    p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
+                   help="shard the (H,N,C) tensor, e.g. 'data=4' or 'data=4,model=2'")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (cpu/tpu), e.g. for local runs")
+    return p.parse_args(argv)
+
+
+def load_dataset(args):
+    from coda_tpu.data import Dataset, make_synthetic_task
+
+    if args.synthetic:
+        H, N, C = (int(x) for x in args.synthetic.split(","))
+        return make_synthetic_task(seed=0, H=H, N=N, C=C,
+                                   name=args.task or f"synthetic_{H}x{N}x{C}")
+    if args.task is None:
+        raise SystemExit("--task or --synthetic is required")
+    for ext in (".npy", ".npz", ".pt"):
+        fp = os.path.join(args.data_dir, args.task + ext)
+        if os.path.exists(fp):
+            sharding = None
+            if args.mesh:
+                from coda_tpu.parallel import mesh_from_spec, preds_sharding
+
+                sharding = preds_sharding(mesh_from_spec(args.mesh))
+            return Dataset.from_file(fp, sharding=sharding, name=args.task)
+    raise SystemExit(f"No data file for task '{args.task}' under {args.data_dir}/")
+
+
+def build_selector(args, dataset):
+    from coda_tpu.selectors import (
+        CODAHyperparams,
+        SELECTOR_FACTORIES,
+        TASK_EPS,
+        make_coda,
+        make_modelpicker,
+    )
+    from coda_tpu.losses import LOSS_FNS
+
+    loss_fn = LOSS_FNS[args.loss]
+    method = args.method
+    if method.startswith("coda"):
+        hp = CODAHyperparams(
+            prefilter_n=args.prefilter_n,
+            alpha=args.alpha,
+            learning_rate=args.learning_rate,
+            multiplier=args.multiplier,
+            disable_diag_prior=args.no_diag_prior,
+            q=args.q,
+            eig_chunk=args.eig_chunk,
+        )
+        return make_coda(dataset.preds, hp, name=method)
+    if method == "model_picker":
+        eps = TASK_EPS.get(dataset.name)
+        if eps is None:
+            print(f"{dataset.name} not in TASK_EPS; using default")
+            return make_modelpicker(dataset.preds)
+        return make_modelpicker(dataset.preds, epsilon=eps)
+    if method in ("activetesting", "vma"):
+        return SELECTOR_FACTORIES[method](dataset.preds, loss_fn=loss_fn,
+                                          budget=args.iters)
+    if method in SELECTOR_FACTORIES:
+        return SELECTOR_FACTORIES[method](dataset.preds, loss_fn=loss_fn)
+    raise SystemExit(f"{method} is not a supported method.")
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax
+
+    from coda_tpu.engine import run_seeds
+    from coda_tpu.losses import LOSS_FNS
+    from coda_tpu.oracle import true_losses
+
+    print("devices:", jax.devices())
+    dataset = load_dataset(args)
+    H, N, C = dataset.shape
+    print(f"Loaded preds of shape ({H}, {N}, {C})")
+    if dataset.labels is None:
+        raise SystemExit("Oracle needs labels!")
+
+    loss_fn = LOSS_FNS[args.loss]
+    model_losses = true_losses(dataset.preds, dataset.labels, loss_fn)
+    best_loss = float(np.asarray(model_losses).min())
+    print("Best possible loss is", best_loss)
+
+    selector = build_selector(args, dataset)
+
+    t0 = time.perf_counter()
+    result = run_seeds(selector, dataset, iters=args.iters, seeds=args.seeds,
+                       loss_fn=loss_fn, model_losses=model_losses)
+    result.regret.block_until_ready()
+    wall = time.perf_counter() - t0
+    steps = args.iters * args.seeds
+    print(f"{steps} selection steps in {wall:.2f}s "
+          f"({steps / wall:.2f} steps/s, all seeds batched)")
+
+    regrets = np.asarray(result.regret)          # (seeds, iters)
+    cums = np.asarray(result.cumulative_regret)  # (seeds, iters)
+    stoch = np.asarray(result.stochastic)        # (seeds,)
+    for s in range(args.seeds):
+        print(f"seed {s}: regret@{args.iters}={regrets[s, -1]:.4f} "
+              f"cumulative={cums[s, -1]:.4f} stochastic={bool(stoch[s])}")
+
+    if not args.no_mlflow:
+        from coda_tpu.tracking import TrackingStore
+
+        store = TrackingStore(args.tracking_db)
+        experiment = args.experiment_name or dataset.name
+        run_name = f"{experiment}-{args.method}"
+        with store.run(experiment, run_name, params=vars(args)) as parent:
+            for s in range(args.seeds):
+                seed_run = f"{experiment}-{args.method}-{s}"
+                if store.is_finished(experiment, seed_run) and not args.force_rerun:
+                    print("Seed", s, "finished. Skipping.")
+                    continue
+                with store.run(experiment, seed_run, parent=parent,
+                               params={"seed": s, "stochastic": bool(stoch[s])}) as r:
+                    r.log_metric_series("regret", regrets[s], start_step=1)
+                    r.log_metric_series("cumulative regret", cums[s], start_step=1)
+                if not stoch[s]:
+                    print("Method is not stochastic for this task. "
+                          "Remaining seeds are identical.")
+                    break
+        print(f"Logged to {args.tracking_db}")
+
+    return result
+
+
+if __name__ == "__main__":
+    main()

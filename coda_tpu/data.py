@@ -1,0 +1,144 @@
+"""Model-selection datasets: the ``(H, N, C)`` prediction tensor.
+
+Capability parity with the reference ``Dataset`` (reference
+``coda/datasets.py:4-23``): load a dense tensor of post-softmax prediction
+scores — H models x N data points x C classes — plus an optional ``(N,)``
+ground-truth label vector stored alongside it (``<task>_labels``).
+
+TPU-native differences:
+  * arrays are ``jax.numpy`` float32 (the reference casts to fp32 at
+    ``coda/datasets.py:14`` to "avoid fp16 precision errors"; the same concern
+    applies to bf16 on TPU, so fp32 is kept mandatory),
+  * ``.npy``/``.npz`` are first-class formats (no torch required); ``.pt``
+    files are still readable when torch is importable, for drop-in use of
+    existing benchmark data,
+  * a seeded synthetic task generator for tests and benchmarks, and
+  * optional device placement with a ``NamedSharding`` so large tensors
+    (e.g. ImageNet-scale M=500 x N=50k x C=1000 ~ 100 GB fp32) land sharded
+    in HBM across the mesh instead of on one chip.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _load_array(filepath: str) -> np.ndarray:
+    """Load a dense array from .npy/.npz/.pt into host memory (numpy)."""
+    if filepath.endswith(".npy"):
+        return np.load(filepath)
+    if filepath.endswith(".npz"):
+        with np.load(filepath) as z:
+            return z[z.files[0]]
+    if filepath.endswith(".pt"):
+        try:
+            import torch
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                ".pt files require torch; convert to .npy with "
+                "scripts/convert_pt.py or install torch"
+            ) from e
+        t = torch.load(filepath, map_location="cpu", weights_only=True)
+        return t.detach().cpu().numpy()
+    raise ValueError(f"Unsupported dataset file format: {filepath}")
+
+
+def _labels_path(filepath: str) -> str:
+    root, ext = os.path.splitext(filepath)
+    return f"{root}_labels{ext}"
+
+
+@dataclass
+class Dataset:
+    """A model-selection dataset.
+
+    Attributes:
+      preds: ``(H, N, C)`` float32 post-softmax scores.
+      labels: optional ``(N,)`` int32 ground-truth classes.
+      name: task name (used as the tracking experiment name).
+    """
+
+    preds: jax.Array
+    labels: Optional[jax.Array] = None
+    name: str = "task"
+
+    @property
+    def shape(self) -> tuple[int, int, int]:
+        return tuple(self.preds.shape)  # (H, N, C)
+
+    @classmethod
+    def from_file(
+        cls,
+        filepath: str,
+        sharding: Optional[jax.sharding.Sharding] = None,
+        name: Optional[str] = None,
+    ) -> "Dataset":
+        """Load ``<task>.{npy,npz,pt}`` (+ optional ``<task>_labels.*``).
+
+        If ``sharding`` is given the prediction tensor is placed with it
+        (sharded across the mesh) instead of committed to the default device.
+        """
+        preds_np = _load_array(filepath).astype(np.float32)  # fp32 mandatory
+        if preds_np.ndim != 3:
+            raise ValueError(f"preds must be (H, N, C); got {preds_np.shape}")
+        if sharding is not None:
+            preds = jax.device_put(jnp.asarray(preds_np), sharding)
+        else:
+            preds = jnp.asarray(preds_np)
+
+        labels = None
+        lp = _labels_path(filepath)
+        if os.path.exists(lp):
+            labels = jnp.asarray(_load_array(lp).astype(np.int32))
+        task = name or os.path.splitext(os.path.basename(filepath))[0]
+        return cls(preds=preds, labels=labels, name=task)
+
+
+def make_synthetic_task(
+    seed: int,
+    H: int = 8,
+    N: int = 200,
+    C: int = 4,
+    acc_lo: float = 0.35,
+    acc_hi: float = 0.9,
+    sharpness: float = 4.0,
+    name: Optional[str] = None,
+) -> Dataset:
+    """Seeded synthetic model-selection task.
+
+    Models span a range of true accuracies in ``[acc_lo, acc_hi]``; each
+    model's per-point prediction is a peaked softmax distribution over C
+    classes whose argmax equals the true label with that model's accuracy.
+    Built with numpy (host) so tests/benches don't pay a device round-trip
+    and traces are reproducible independent of the JAX backend.
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, C, size=N).astype(np.int32)
+    accs = np.linspace(acc_lo, acc_hi, H)
+    # shuffle so the best model isn't always index H-1
+    rng.shuffle(accs)
+
+    logits = rng.normal(0.0, 1.0, size=(H, N, C)).astype(np.float32)
+    correct = rng.random((H, N)) < accs[:, None]
+    # wrong predicted class: shift true label by a random non-zero offset
+    offsets = rng.integers(1, C, size=(H, N))
+    wrong_cls = (labels[None, :] + offsets) % C
+    pred_cls = np.where(correct, labels[None, :], wrong_cls)
+    idx_h, idx_n = np.meshgrid(np.arange(H), np.arange(N), indexing="ij")
+    logits[idx_h, idx_n, pred_cls] += sharpness
+    # softmax
+    logits -= logits.max(-1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(-1, keepdims=True)
+
+    return Dataset(
+        preds=jnp.asarray(p.astype(np.float32)),
+        labels=jnp.asarray(labels),
+        name=name or f"synthetic_h{H}_n{N}_c{C}_s{seed}",
+    )

@@ -1,0 +1,3 @@
+from coda_tpu.engine.loop import ExperimentResult, run_experiment, run_seeds
+
+__all__ = ["ExperimentResult", "run_experiment", "run_seeds"]

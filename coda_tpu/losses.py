@@ -1,0 +1,40 @@
+"""Loss registry.
+
+Capability parity with the reference loss registry (reference
+``coda/options.py:3-19``): ``'acc'`` is 1 - accuracy. The reference leaves
+``'ce'`` as a TODO ("we don't have logits"); here cross-entropy on
+post-softmax scores is implemented directly as ``-log p[label]`` with a
+floor clamp, since the prediction tensor rows are probability vectors.
+
+All loss fns are pure, elementwise-over-the-leading-axes, and jit-safe:
+``loss_fn(preds (..., C), labels (...)) -> (...)`` float32.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy_loss(preds: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """1 - accuracy, unreduced. ``labels`` may be int classes or one-hot."""
+    pred_cls = jnp.argmax(preds, axis=-1)
+    if labels.ndim == preds.ndim:  # one-hot / soft labels
+        label_cls = jnp.argmax(labels, axis=-1)
+    else:
+        label_cls = labels
+    return 1.0 - (pred_cls == label_cls).astype(jnp.float32)
+
+
+def cross_entropy_loss(preds: jnp.ndarray, labels: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    """-log p[label] on post-softmax scores, unreduced."""
+    if labels.ndim == preds.ndim:
+        p = jnp.sum(preds * labels, axis=-1)
+    else:
+        p = jnp.take_along_axis(preds, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return -jnp.log(jnp.clip(p, eps, None))
+
+
+LOSS_FNS = {
+    "acc": accuracy_loss,
+    "ce": cross_entropy_loss,
+}

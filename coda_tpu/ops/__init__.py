@@ -1,0 +1,28 @@
+from coda_tpu.ops.beta import beta_log_pdf, cumtrapz_uniform, dirichlet_to_beta
+from coda_tpu.ops.pbest import compute_pbest, pbest_row_mixture
+from coda_tpu.ops.confusion import (
+    create_confusion_matrices,
+    ensemble_preds,
+    initialize_dirichlets,
+)
+from coda_tpu.ops.masked import (
+    entropy2,
+    masked_argmax_tiebreak,
+    masked_argmin_tiebreak,
+    masked_categorical,
+)
+
+__all__ = [
+    "beta_log_pdf",
+    "cumtrapz_uniform",
+    "dirichlet_to_beta",
+    "compute_pbest",
+    "pbest_row_mixture",
+    "create_confusion_matrices",
+    "ensemble_preds",
+    "initialize_dirichlets",
+    "entropy2",
+    "masked_argmax_tiebreak",
+    "masked_argmin_tiebreak",
+    "masked_categorical",
+]

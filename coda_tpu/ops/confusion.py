@@ -1,0 +1,65 @@
+"""Consensus (ensemble) pseudo-labels and Dirichlet confusion-matrix priors.
+
+Capability parity with reference ``coda/util.py:7-14`` (mean ensemble) and
+``coda/coda.py:28-63`` (soft confusion vs. pseudo-labels; diag-favoring
+prior). The confusion einsum is a batched matmul — on TPU it runs on the MXU;
+precision is pinned to HIGHEST because the downstream EIG argmax ordering is
+sensitive to low-precision accumulation (bf16 passes would perturb it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def ensemble_preds(preds: jnp.ndarray) -> jnp.ndarray:
+    """Mean prediction over models: ``(H, N, C) -> (N, C)``."""
+    return preds.mean(axis=0)
+
+
+def create_confusion_matrices(
+    true_labels: jnp.ndarray,
+    model_predictions: jnp.ndarray,
+    mode: str = "hard",
+) -> jnp.ndarray:
+    """Row-normalized confusion matrices vs. (pseudo-)labels.
+
+    Args:
+      true_labels: ``(N,)`` int class labels (typically ensemble pseudo-labels).
+      model_predictions: ``(H, N, C)`` post-softmax scores.
+      mode: 'hard' uses one-hot argmax predictions; 'soft' uses the scores.
+    Returns:
+      ``(H, C, C)`` confusion matrices, rows normalized (floor 1e-6).
+    """
+    H, N, C = model_predictions.shape
+    true_one_hot = jax.nn.one_hot(true_labels, C, dtype=jnp.float32)
+    if mode == "hard":
+        p = jax.nn.one_hot(model_predictions.argmax(-1), C, dtype=jnp.float32)
+    elif mode == "soft":
+        p = model_predictions
+    else:
+        raise ValueError(mode)
+    conf = jnp.einsum("nc,hnj->hcj", true_one_hot, p, precision=_PRECISION)
+    return conf / jnp.clip(conf.sum(-1, keepdims=True), 1e-6, None)
+
+
+def initialize_dirichlets(
+    soft_confusion: jnp.ndarray,
+    prior_strength: float,
+    disable_diag_prior: bool = False,
+) -> jnp.ndarray:
+    """Prior + evidence: diag-favoring base plus scaled soft confusion.
+
+    Base is diag=1.0 / off-diag=1/(C-1), or the uniform 2/C ablation variant
+    (2 pseudo-counts per row either way).
+    """
+    H, C, _ = soft_confusion.shape
+    if disable_diag_prior:
+        base = jnp.full((C, C), 2.0 / C, dtype=soft_confusion.dtype)
+    else:
+        base = jnp.full((C, C), 1.0 / (C - 1), dtype=soft_confusion.dtype)
+        base = jnp.fill_diagonal(base, 1.0, inplace=False)
+    return base[None] + prior_strength * soft_confusion

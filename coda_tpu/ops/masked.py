@@ -1,0 +1,88 @@
+"""Fixed-shape selection primitives: masked argmax/argmin with random
+tie-breaking, masked categorical sampling, and base-2 entropy.
+
+The reference mutates Python lists (``unlabeled_idxs.remove``) and tie-breaks
+with the host RNG (e.g. ``coda/coda.py:306-311``); under jit those become
+boolean masks and JAX PRNG keys. Tie-break semantics are preserved: when a
+unique extremum exists the result is its (first) index, matching
+``torch.argmax``; among ties the choice is uniform.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -jnp.inf
+
+
+def entropy2(p: jnp.ndarray, axis: int = -1, floor: float = 1e-12) -> jnp.ndarray:
+    """Shannon entropy in bits with the reference's 1e-12 floor clamp."""
+    pc = jnp.clip(p, floor, None)
+    return -(pc * jnp.log2(pc)).sum(axis=axis)
+
+
+def _uniform_tiebreak(key: jax.Array, ties: jnp.ndarray) -> jnp.ndarray:
+    """Uniformly pick one True position of ``ties``; returns scalar int index."""
+    u = jax.random.uniform(key, ties.shape)
+    return jnp.argmax(jnp.where(ties, u, -1.0))
+
+
+def masked_argmax_tiebreak(
+    key: jax.Array,
+    scores: jnp.ndarray,
+    mask: jnp.ndarray,
+    rtol: float = 0.0,
+    atol: float = 0.0,
+):
+    """Argmax of ``scores`` over positions where ``mask``; uniform among ties.
+
+    Ties are positions with ``isclose(score, max, rtol, atol)`` when a
+    tolerance is given (the reference's EIG tie rule is rtol=1e-8 with
+    torch's default atol=1e-8 — atol dominates for tiny EIG deltas), else
+    exact equality.
+
+    Returns ``(idx, tie_count)`` — ``tie_count > 1`` means the choice was
+    stochastic (drives the reference's ``stochastic`` early-stop flag).
+    """
+    masked = jnp.where(mask, scores, _NEG_INF)
+    best = masked.max()
+    if rtol > 0 or atol > 0:
+        ties = jnp.isclose(masked, best, rtol=rtol, atol=atol) & mask
+    else:
+        ties = (masked == best) & mask
+    n_ties = ties.sum()
+    idx_first = jnp.argmax(masked)
+    idx_rand = _uniform_tiebreak(key, ties)
+    idx = jnp.where(n_ties > 1, idx_rand, idx_first)
+    return idx, n_ties
+
+
+def masked_argmin_tiebreak(key, scores, mask, rtol: float = 0.0,
+                           atol: float = 0.0):
+    """Argmin counterpart of :func:`masked_argmax_tiebreak`."""
+    idx, n_ties = masked_argmax_tiebreak(key, -scores, mask, rtol=rtol,
+                                         atol=atol)
+    return idx, n_ties
+
+
+def masked_categorical(
+    key: jax.Array,
+    weights: jnp.ndarray,
+    mask: jnp.ndarray,
+):
+    """Sample an index proportionally to ``weights`` restricted to ``mask``.
+
+    Returns ``(idx, prob)`` where ``prob`` is the normalized probability of
+    the sampled index (the selection probability the LURE estimator needs).
+    """
+    w = jnp.where(mask, jnp.clip(weights, 0.0, None), 0.0)
+    total = w.sum()
+    # degenerate fallback: uniform over the mask (reference vma.py:46-49)
+    n_mask = jnp.clip(mask.sum(), 1, None)
+    probs = jnp.where(total > 1e-12, w / jnp.clip(total, 1e-30, None),
+                      mask.astype(w.dtype) / n_mask)
+    logits = jnp.log(jnp.clip(probs, 1e-38, None))
+    logits = jnp.where(probs > 0, logits, _NEG_INF)
+    idx = jax.random.categorical(key, logits)
+    return idx, probs[idx]

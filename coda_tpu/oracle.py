@@ -1,0 +1,46 @@
+"""Ground-truth oracle: label server + per-model true mean losses.
+
+Capability parity with the reference ``Oracle`` (reference
+``coda/oracle.py:2-24``): ``true_losses`` gives each model's mean loss over
+the full labeled dataset; calling the oracle with an index returns the true
+class of that point.
+
+TPU-native shape: ``true_losses`` is a pure function (H, N, C) x (N,) -> (H,)
+usable inside jit/scan; the class wrapper exists for the interactive
+(host-driven) demo path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from coda_tpu.losses import accuracy_loss
+
+
+def true_losses(
+    preds: jnp.ndarray,
+    labels: jnp.ndarray,
+    loss_fn: Callable = accuracy_loss,
+) -> jnp.ndarray:
+    """Mean loss of every model over all N points. Returns (H,) float32."""
+    # loss_fn broadcasts over (H, N, C) x (N,) -> (H, N)
+    return loss_fn(preds, labels[None, :]).mean(axis=1)
+
+
+class Oracle:
+    """Label server over a dataset with known ground truth."""
+
+    def __init__(self, dataset, loss_fn: Callable = accuracy_loss):
+        if dataset.labels is None:
+            raise ValueError("Oracle needs labels!")
+        self.dataset = dataset
+        self.labels = dataset.labels
+        self.loss_fn = loss_fn
+
+    def true_losses(self, preds: jnp.ndarray) -> jnp.ndarray:
+        return true_losses(preds, self.labels, self.loss_fn)
+
+    def __call__(self, idx) -> int:
+        return int(self.labels[idx])

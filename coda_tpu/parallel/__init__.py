@@ -1,0 +1,17 @@
+from coda_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    DATA_AXIS,
+    make_mesh,
+    mesh_from_spec,
+    preds_sharding,
+    replicated,
+)
+
+__all__ = [
+    "MODEL_AXIS",
+    "DATA_AXIS",
+    "make_mesh",
+    "mesh_from_spec",
+    "preds_sharding",
+    "replicated",
+]

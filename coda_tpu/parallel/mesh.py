@@ -1,0 +1,70 @@
+"""Device meshes and shardings for the ``(H, N, C)`` prediction tensor.
+
+The reference has no intra-process parallelism at all — its only concurrency
+is SLURM job fan-out (reference ``scripts/launch_all_methods.py:135-153``).
+The TPU-native scale story instead shards the prediction tensor itself over a
+``jax.sharding.Mesh``:
+
+  * ``data`` axis (shards N): the EIG / acquisition scoring — the hot loop —
+    is embarrassingly parallel over points; each chip scores its N-shard and
+    the selection argmax reduces over ICI. This is the moral analog of
+    context parallelism: the "long axis" of this workload is N (up to 50k+).
+  * ``model`` axis (shards H): the P(best) integral compares H Beta
+    distributions through an exclusive log-CDF product — a ``psum`` of
+    per-model log-CDF grids recovers the product exactly, so H can scale to
+    1000+ models (the HF zero-shot pool) without replicating the tensor.
+
+At ImageNet scale (M=500 x N=50k x C=1000 fp32 ~ 100 GB) sharding is
+mandatory: no single chip's HBM can hold the tensor. All shardings are
+``NamedSharding`` so the same jitted program runs on 1 chip or a full pod
+with XLA inserting collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"  # shards H (the candidate-model pool)
+DATA_AXIS = "data"    # shards N (the unlabeled data points)
+
+
+def make_mesh(
+    data: int = 1,
+    model: int = 1,
+    devices: Optional[list] = None,
+) -> Mesh:
+    """A ``(data, model)`` mesh over the first ``data*model`` devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = data * model
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {data}x{model} needs {n} devices, have {len(devices)}"
+        )
+    dev_array = np.asarray(devices[:n]).reshape(data, model)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def mesh_from_spec(spec: str, devices: Optional[list] = None) -> Mesh:
+    """Parse ``'data=4'`` / ``'data=4,model=2'`` into a mesh."""
+    sizes = {DATA_AXIS: 1, MODEL_AXIS: 1}
+    for part in spec.split(","):
+        k, v = part.split("=")
+        k = k.strip()
+        if k not in sizes:
+            raise ValueError(f"unknown mesh axis {k!r} (use data/model)")
+        sizes[k] = int(v)
+    return make_mesh(data=sizes[DATA_AXIS], model=sizes[MODEL_AXIS],
+                     devices=devices)
+
+
+def preds_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of the ``(H, N, C)`` tensor: H over model, N over data."""
+    return NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,30 @@
+from coda_tpu.selectors.protocol import Selector, SelectResult
+from coda_tpu.selectors.coda import make_coda, CODAHyperparams
+from coda_tpu.selectors.iid import make_iid
+from coda_tpu.selectors.uncertainty import make_uncertainty
+from coda_tpu.selectors.activetesting import make_activetesting
+from coda_tpu.selectors.vma import make_vma
+from coda_tpu.selectors.modelpicker import make_modelpicker, TASK_EPS
+
+SELECTOR_FACTORIES = {
+    "iid": make_iid,
+    "uncertainty": make_uncertainty,
+    "coda": make_coda,
+    "activetesting": make_activetesting,
+    "vma": make_vma,
+    "model_picker": make_modelpicker,
+}
+
+__all__ = [
+    "Selector",
+    "SelectResult",
+    "make_coda",
+    "CODAHyperparams",
+    "make_iid",
+    "make_uncertainty",
+    "make_activetesting",
+    "make_vma",
+    "make_modelpicker",
+    "TASK_EPS",
+    "SELECTOR_FACTORIES",
+]

@@ -1,0 +1,119 @@
+"""ActiveTesting (Kossen et al. 2021) with LURE risk estimation.
+
+Capability parity with reference ``coda/baselines/activetesting.py``:
+  * surrogate = mean ensemble of all candidates; acquisition score of a point
+    is the summed expected loss ``Σ_h (1 - π_ens(ŷ_h))``, sampled
+    proportionally over unlabeled points;
+  * best model = argmin of the LURE importance-weighted risk
+    (Farquhar et al. 2021): ``v_m = 1 + (N-M)/(N-m) * (1/((N-m+1) q_m) - 1)``.
+
+TPU shape: the acquisition base scores are a static ``(N,)`` vector (the
+surrogate never changes), so each round only renormalizes over the unlabeled
+mask and draws one categorical sample. The per-round loss vectors and
+selection probabilities live in fixed ``(H, T)`` / ``(T,)`` ring buffers
+(T = label budget), making the LURE readout a masked reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from coda_tpu.losses import accuracy_loss
+from coda_tpu.ops.masked import masked_argmin_tiebreak, masked_categorical
+from coda_tpu.selectors.protocol import Selector, SelectResult
+
+
+class LUREState(NamedTuple):
+    unlabeled: jnp.ndarray   # (N,) bool
+    losses: jnp.ndarray      # (H, T) per-step losses of each model at picks
+    qs: jnp.ndarray          # (T,) selection probabilities
+    n_labeled: jnp.ndarray   # scalar int32 (M)
+
+
+def surrogate_expected_losses(preds: jnp.ndarray) -> jnp.ndarray:
+    """(H, N): surrogate prob that model h is wrong on point n."""
+    pi_y = preds.mean(axis=0)                       # (N, C) ensemble surrogate
+    pred_cls = preds.argmax(axis=2)                 # (H, N)
+    y_star = jnp.take_along_axis(
+        pi_y[None, :, :].repeat(preds.shape[0], 0), pred_cls[..., None], axis=2
+    )[..., 0]
+    return 1.0 - y_star
+
+
+def lure_risks(
+    losses: jnp.ndarray,   # (H, T)
+    qs: jnp.ndarray,       # (T,)
+    M: jnp.ndarray,        # scalar int
+    N: int,
+) -> jnp.ndarray:
+    """LURE risk estimates (H,); masked over the first M buffer slots."""
+    T = qs.shape[0]
+    m_idx = jnp.arange(1, T + 1, dtype=jnp.float32)     # 1-indexed m
+    Mf = M.astype(jnp.float32)
+    valid = (m_idx <= Mf)
+    v = 1.0 + ((N - Mf) / (N - m_idx)) * (
+        1.0 / ((N - m_idx + 1.0) * jnp.clip(qs, 1e-30, None)) - 1.0
+    )
+    v = jnp.where(valid, v, 0.0)
+    weighted = v[None, :] * losses                      # (H, T)
+    return weighted.sum(axis=1) / jnp.clip(Mf, 1.0, None)
+
+
+def make_activetesting(
+    preds: jnp.ndarray,
+    loss_fn: Callable = accuracy_loss,
+    budget: int = 128,
+    name: str = "activetesting",
+    acquisition_scores: jnp.ndarray | None = None,
+) -> Selector:
+    H, N, C = preds.shape
+    if acquisition_scores is None:
+        acquisition_scores = surrogate_expected_losses(preds).sum(axis=0)  # (N,)
+
+    def init(key):
+        del key
+        return LUREState(
+            unlabeled=jnp.ones((N,), dtype=bool),
+            losses=jnp.zeros((H, budget), dtype=jnp.float32),
+            qs=jnp.zeros((budget,), dtype=jnp.float32),
+            n_labeled=jnp.asarray(0, jnp.int32),
+        )
+
+    def select(state, key) -> SelectResult:
+        idx, prob = masked_categorical(key, acquisition_scores, state.unlabeled)
+        return SelectResult(
+            idx=idx.astype(jnp.int32),
+            prob=prob,
+            stochastic=jnp.asarray(True),
+        )
+
+    def update(state, idx, true_class, prob):
+        loss_vec = loss_fn(preds[:, idx, :], jnp.full((H,), true_class))
+        m = state.n_labeled
+        return LUREState(
+            unlabeled=state.unlabeled.at[idx].set(False),
+            losses=state.losses.at[:, m].set(loss_vec),
+            qs=state.qs.at[m].set(prob),
+            n_labeled=m + 1,
+        )
+
+    def best(state, key):
+        risk = lure_risks(state.losses, state.qs, state.n_labeled, N)
+        k_tie, k_rand = jax.random.split(key)
+        idx, n_ties = masked_argmin_tiebreak(k_tie, risk,
+                                             jnp.ones((H,), dtype=bool))
+        # no labels yet -> uniformly random model (reference behavior)
+        rand_idx = jax.random.randint(k_rand, (), 0, H)
+        chose_random = (state.n_labeled == 0) | (n_ties > 1)
+        return (jnp.where(state.n_labeled > 0, idx, rand_idx).astype(jnp.int32),
+                chose_random)
+
+    return Selector(
+        name=name, init=init, select=select, update=update, best=best,
+        always_stochastic=True,
+        hyperparams={"budget": budget},
+        extras={"lure_risks": lambda s: lure_risks(s.losses, s.qs, s.n_labeled, N)},
+    )

@@ -1,0 +1,90 @@
+"""IID random-sampling baseline.
+
+Capability parity with reference ``coda/baselines/iid.py``: uniform random
+acquisition over unlabeled points; best model = argmin of empirical mean loss
+on the labeled set, ties broken uniformly at random.
+
+TPU shape: labeled set is a boolean mask + an ``(N,)`` acquired-label array;
+the risk readout is a masked mean over a per-point loss table evaluated on
+the fly, so state stays O(N) and every function is jit/scan-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from coda_tpu.losses import accuracy_loss
+from coda_tpu.ops.masked import masked_argmin_tiebreak
+from coda_tpu.selectors.protocol import Selector, SelectResult
+
+
+class RiskState(NamedTuple):
+    """Shared state for risk-readout selectors (IID, Uncertainty)."""
+
+    unlabeled: jnp.ndarray    # (N,) bool
+    labels_acq: jnp.ndarray   # (N,) int32; meaningful only where ~unlabeled
+    n_labeled: jnp.ndarray    # scalar int32
+
+
+def make_risk_readout(preds: jnp.ndarray, loss_fn: Callable):
+    """Returns (risk, best) pure fns over RiskState-compatible states."""
+    H, N, C = preds.shape
+
+    def risk(state) -> jnp.ndarray:
+        # (H, N) losses against acquired labels; unlabeled columns masked out
+        losses = loss_fn(preds, state.labels_acq[None, :])
+        labeled = (~state.unlabeled).astype(losses.dtype)
+        total = (losses * labeled[None, :]).sum(axis=1)
+        return total / jnp.clip(state.n_labeled.astype(losses.dtype), 1.0, None)
+
+    def best(state, key):
+        r = risk(state)
+        idx, n_ties = masked_argmin_tiebreak(key, r, jnp.ones((H,), dtype=bool))
+        # risk ties (common early on with few labels) are broken randomly and
+        # make the run stochastic (reference iid.py get_best_model_prediction)
+        return idx.astype(jnp.int32), n_ties > 1
+
+    return risk, best
+
+
+def make_iid(
+    preds: jnp.ndarray,
+    loss_fn: Callable = accuracy_loss,
+    name: str = "iid",
+) -> Selector:
+    H, N, C = preds.shape
+    risk, best = make_risk_readout(preds, loss_fn)
+
+    def init(key):
+        del key
+        return RiskState(
+            unlabeled=jnp.ones((N,), dtype=bool),
+            labels_acq=jnp.zeros((N,), dtype=jnp.int32),
+            n_labeled=jnp.asarray(0, jnp.int32),
+        )
+
+    def select(state, key) -> SelectResult:
+        n_u = state.unlabeled.sum()
+        logits = jnp.where(state.unlabeled, 0.0, -jnp.inf)
+        idx = jax.random.categorical(key, logits)
+        return SelectResult(
+            idx=idx.astype(jnp.int32),
+            prob=1.0 / n_u.astype(jnp.float32),
+            stochastic=jnp.asarray(True),
+        )
+
+    def update(state, idx, true_class, prob):
+        del prob
+        return RiskState(
+            unlabeled=state.unlabeled.at[idx].set(False),
+            labels_acq=state.labels_acq.at[idx].set(true_class),
+            n_labeled=state.n_labeled + 1,
+        )
+
+    return Selector(
+        name=name, init=init, select=select, update=update, best=best,
+        always_stochastic=True, extras={"risk": risk},
+    )

@@ -1,0 +1,149 @@
+"""ModelPicker (Karimi et al.): multiplicative-weights posterior over models.
+
+Capability parity with reference ``coda/baselines/modelpicker.py``:
+  * posterior over models updated multiplicatively by ``γ^agreement`` with
+    ``γ = (1-ε)/ε`` and per-task tuned ε (TASK_EPS table);
+  * acquisition = the unlabeled *disagreement* point minimizing the expected
+    posterior entropy over hypothetical labels (uniform over classes);
+  * best model = argmax of correct-prediction counts, random tie-break.
+
+TPU shape: the per-point expected-entropy scan is a vmapped log-space kernel
+chunked with ``lax.map`` (the reference loops classes in Python and keeps an
+``(N_u, H)`` float tensor per class). Disagreement-vs-first-model mask is
+static, computed once.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from coda_tpu.ops.masked import entropy2, masked_argmin_tiebreak
+from coda_tpu.selectors.protocol import Selector, SelectResult
+
+# Per-task tuned epsilons (reference coda/baselines/modelpicker.py:5-35).
+TASK_EPS = {
+    "imagenet_v2_matched-frequency": 0.48,
+    "cifar10_4070": 0.47,
+    "cifar10_5592": 0.47,
+    "pacs": 0.45,
+    "glue/cola": 0.45,
+    "glue/mnli": 0.43,
+    "glue/qnli": 0.44,
+    "glue/qqp": 0.47,
+    "glue/rte": 0.39,
+    "glue/sst2": 0.36,
+    "real_clipart": 0.42,
+    "real_painting": 0.35,
+    "real_sketch": 0.45,
+    "sketch_real": 0.35,
+    "sketch_clipart": 0.35,
+    "sketch_painting": 0.37,
+    "clipart_painting": 0.45,
+    "clipart_real": 0.45,
+    "clipart_sketch": 0.43,
+    "painting_sketch": 0.39,
+    "painting_real": 0.44,
+    "painting_clipart": 0.39,
+    "iwildcam": 0.49,
+    "civilcomments": 0.46,
+    "fmow": 0.44,
+    "camelyon": 0.47,
+}
+DEFAULT_EPS = 0.46
+
+
+class ModelPickerState(NamedTuple):
+    unlabeled: jnp.ndarray       # (N,) bool
+    posterior: jnp.ndarray       # (H,)
+    correct_counts: jnp.ndarray  # (H,) int32
+    n_labeled: jnp.ndarray       # scalar int32
+
+
+def expected_entropies(
+    hard_preds: jnp.ndarray,  # (N, H) int32
+    posterior: jnp.ndarray,   # (H,)
+    gamma: float,
+    C: int,
+    chunk: int = 4096,
+) -> jnp.ndarray:
+    """Mean posterior entropy over hypothetical class labels, per point. (N,)"""
+    log_gamma = jnp.log(jnp.asarray(gamma, jnp.float32))
+    log_post = jnp.log(jnp.clip(posterior, 1e-38, None))
+
+    def per_point(pred_n):  # (H,) int32
+        # (C, H) agreement indicator for each hypothetical class
+        agree = (pred_n[None, :] == jnp.arange(C)[:, None]).astype(jnp.float32)
+        logits = log_post[None, :] + log_gamma * agree
+        p = jax.nn.softmax(logits, axis=-1)
+        return entropy2(p, axis=-1).mean()
+
+    return lax.map(per_point, hard_preds, batch_size=min(chunk, hard_preds.shape[0]))
+
+
+def make_modelpicker(
+    preds: jnp.ndarray,
+    epsilon: float = DEFAULT_EPS,
+    name: str = "model_picker",
+) -> Selector:
+    H, N, C = preds.shape
+    epsilon = float(epsilon)
+    gamma = (1.0 - epsilon) / epsilon
+    hard_preds = preds.argmax(-1).T.astype(jnp.int32)  # (N, H)
+    # points where any model disagrees with model 0 (reference :46-48)
+    disagree = (hard_preds != hard_preds[:, :1]).any(axis=1)
+
+    def init(key):
+        del key
+        return ModelPickerState(
+            unlabeled=jnp.ones((N,), dtype=bool),
+            posterior=jnp.full((H,), 1.0 / H, dtype=jnp.float32),
+            correct_counts=jnp.zeros((H,), dtype=jnp.int32),
+            n_labeled=jnp.asarray(0, jnp.int32),
+        )
+
+    def select(state, key) -> SelectResult:
+        ent = expected_entropies(hard_preds, state.posterior, gamma, C)
+        # restrict to disagreement points when any remain unlabeled
+        # (reference sets agreement entropies to +inf only if mask.any())
+        cand = disagree & state.unlabeled
+        cand = jnp.where(cand.any(), cand, state.unlabeled)
+        idx, _ = masked_argmin_tiebreak(key, ent, cand)
+        return SelectResult(
+            idx=idx.astype(jnp.int32),
+            prob=1.0 / state.unlabeled.sum().astype(jnp.float32),
+            stochastic=jnp.asarray(True),
+        )
+
+    def update(state, idx, true_class, prob):
+        del prob
+        pred_i = hard_preds[idx]                      # (H,)
+        agree = (pred_i == true_class).astype(jnp.float32)
+        post = state.posterior * jnp.power(gamma, agree)
+        post = post / post.sum()
+        return ModelPickerState(
+            unlabeled=state.unlabeled.at[idx].set(False),
+            posterior=post,
+            correct_counts=state.correct_counts + agree.astype(jnp.int32),
+            n_labeled=state.n_labeled + 1,
+        )
+
+    def best(state, key):
+        k_tie, k_rand = jax.random.split(key)
+        idx, n_ties = masked_argmin_tiebreak(
+            k_tie, -state.correct_counts.astype(jnp.float32),
+            jnp.ones((H,), dtype=bool),
+        )
+        rand_idx = jax.random.randint(k_rand, (), 0, H)
+        chose_random = (state.n_labeled == 0) | (n_ties > 1)
+        return (jnp.where(state.n_labeled > 0, idx, rand_idx).astype(jnp.int32),
+                chose_random)
+
+    return Selector(
+        name=name, init=init, select=select, update=update, best=best,
+        always_stochastic=True,
+        hyperparams={"epsilon": epsilon},
+    )

@@ -1,0 +1,103 @@
+"""The functional selector protocol.
+
+The reference defines a 3-method OO protocol with mutable state (reference
+``coda/base.py:1-16``: ``get_next_item_to_label`` / ``add_label`` /
+``get_best_model_prediction``). For TPU execution the same capability is
+recast as four *pure functions over a fixed-shape state pytree*, so a whole
+labeling experiment compiles into one ``lax.scan`` and seeds batch under
+``vmap``:
+
+    init(key)                          -> state
+    select(state, key)                 -> SelectResult(idx, prob, stochastic)
+    update(state, idx, true_class, p)  -> state
+    best(state, key)                   -> (best model index, stochastic)
+
+``stochastic`` reports whether randomness affected that call (tie-breaks,
+random sampling) — the reference's per-selector ``stochastic`` flag that
+lets the driver skip redundant seeds of deterministic methods.
+
+A factory ``make_<method>(preds, hp...) -> Selector`` closes each function
+over the prediction tensor and any precomputed statics (hard argmax preds,
+disagreement masks, per-point losses), which keeps ``state`` small — that is
+what gets carried through scan and checkpointed.
+
+Host-driven consumers (the Gradio demo, step-by-step debugging) use the
+``InteractiveSelector`` wrapper, which exposes the reference's original
+mutable 3-method API on top of the pure functions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectResult(NamedTuple):
+    idx: jnp.ndarray        # scalar int32 — chosen data point
+    prob: jnp.ndarray       # scalar float32 — selection probability / q-value
+    stochastic: jnp.ndarray  # scalar bool — did randomness affect this choice?
+
+
+@dataclass(frozen=True)
+class Selector:
+    """A bundle of pure functions implementing one selection method."""
+
+    name: str
+    init: Callable[[jax.Array], Any]
+    select: Callable[[Any, jax.Array], SelectResult]
+    update: Callable[[Any, jnp.ndarray, jnp.ndarray, jnp.ndarray], Any]
+    best: Callable[[Any, jax.Array], jnp.ndarray]
+    # True when the method is stochastic by construction (e.g. IID sampling);
+    # deterministic methods let the driver skip redundant seeds, mirroring the
+    # reference's `stochastic` early-stop (reference main.py:128-130).
+    always_stochastic: bool = False
+    hyperparams: dict = field(default_factory=dict)
+    # extra method-specific pure functions (e.g. CODA's get_pbest) for demos
+    # and diagnostics; not part of the scan loop
+    extras: dict = field(default_factory=dict)
+
+
+class InteractiveSelector:
+    """Mutable, host-driven wrapper with the reference's 3-method API."""
+
+    def __init__(self, selector: Selector, seed: int = 0):
+        self.selector = selector
+        self._key = jax.random.PRNGKey(seed)
+        self.state = jax.jit(selector.init)(self._next_key())
+        self._select = jax.jit(selector.select)
+        self._update = jax.jit(selector.update)
+        self._best = jax.jit(selector.best)
+        self.stochastic = selector.always_stochastic
+        self.labeled_idxs: list[int] = []
+        self.labels: list[int] = []
+        self.q_vals: list[float] = []
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_next_item_to_label(self):
+        res = self._select(self.state, self._next_key())
+        if bool(res.stochastic):
+            self.stochastic = True
+        return int(res.idx), float(res.prob)
+
+    def add_label(self, idx: int, true_class: int, selection_prob: float = 0.0):
+        self.state = self._update(
+            self.state,
+            jnp.asarray(idx, jnp.int32),
+            jnp.asarray(true_class, jnp.int32),
+            jnp.asarray(selection_prob, jnp.float32),
+        )
+        self.labeled_idxs.append(int(idx))
+        self.labels.append(int(true_class))
+        self.q_vals.append(float(selection_prob))
+
+    def get_best_model_prediction(self) -> int:
+        idx, stochastic = self._best(self.state, self._next_key())
+        if bool(stochastic):
+            self.stochastic = True
+        return int(idx)

@@ -1,0 +1,64 @@
+"""Committee-uncertainty baseline.
+
+Capability parity with reference ``coda/baselines/uncertainty.py``: select
+the unlabeled point with the highest entropy of the ensemble-mean prediction
+(natural log, 1e-8 epsilon); risk-based best-model readout as IID. The
+acquisition is non-adaptive, so the per-point scores are computed once in the
+factory and reused every round.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from coda_tpu.losses import accuracy_loss
+from coda_tpu.ops.masked import masked_argmax_tiebreak
+from coda_tpu.selectors.iid import RiskState, make_risk_readout
+from coda_tpu.selectors.protocol import Selector, SelectResult
+
+
+def uncertainty_scores(preds: jnp.ndarray, epsilon: float = 1e-8) -> jnp.ndarray:
+    """Entropy (nats) of the mean-over-models prediction, per point. (N,)"""
+    mean_p = preds.mean(axis=0)
+    return -(mean_p * jnp.log(mean_p + epsilon)).sum(axis=-1)
+
+
+def make_uncertainty(
+    preds: jnp.ndarray,
+    loss_fn: Callable = accuracy_loss,
+    name: str = "uncertainty",
+) -> Selector:
+    H, N, C = preds.shape
+    scores = uncertainty_scores(preds)  # static: non-adaptive acquisition
+    risk, best = make_risk_readout(preds, loss_fn)
+
+    def init(key):
+        del key
+        return RiskState(
+            unlabeled=jnp.ones((N,), dtype=bool),
+            labels_acq=jnp.zeros((N,), dtype=jnp.int32),
+            n_labeled=jnp.asarray(0, jnp.int32),
+        )
+
+    def select(state, key) -> SelectResult:
+        idx, n_ties = masked_argmax_tiebreak(key, scores, state.unlabeled)
+        return SelectResult(
+            idx=idx.astype(jnp.int32),
+            prob=scores[idx],
+            stochastic=n_ties > 1,
+        )
+
+    def update(state, idx, true_class, prob):
+        del prob
+        return RiskState(
+            unlabeled=state.unlabeled.at[idx].set(False),
+            labels_acq=state.labels_acq.at[idx].set(true_class),
+            n_labeled=state.n_labeled + 1,
+        )
+
+    return Selector(
+        name=name, init=init, select=select, update=update, best=best,
+        always_stochastic=False, extras={"risk": risk},
+    )

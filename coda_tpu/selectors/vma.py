@@ -1,0 +1,57 @@
+"""VMA: Variance Minimization for Active Model Selection (Matsuura & Hara 2023).
+
+Capability parity with reference ``coda/baselines/vma.py``: acquisition
+weight of a point is the summed pairwise loss disagreement
+``Σ_{h'>h} |loss_h(x) - loss_h'(x)|`` (losses under the ensemble surrogate),
+sampled proportionally; LURE risk readout inherited from ActiveTesting.
+
+TPU-native kernel: the reference materializes an ``(H, H, N)`` broadcast and
+an upper-triangular mask — O(H²N) memory and FLOPs, hopeless at M=1000.
+The identical scores come from the classic sorted-values identity
+
+    Σ_{i<j} |a_i - a_j| = Σ_k (2k - H + 1) · a_(k)   (a_(k) ascending)
+
+which is one sort over H per point: O(N·H log H), no H² tensor. The scores
+are static (surrogate fixed), computed once in the factory.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from coda_tpu.losses import accuracy_loss
+from coda_tpu.selectors.activetesting import (
+    make_activetesting,
+    surrogate_expected_losses,
+)
+from coda_tpu.selectors.protocol import Selector
+
+
+def pairwise_absdiff_sum(values: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """``Σ_{i<j} |v_i - v_j|`` along ``axis`` via the sorted identity."""
+    v = jnp.moveaxis(values, axis, -1)
+    H = v.shape[-1]
+    v_sorted = jnp.sort(v, axis=-1)
+    coeff = (2.0 * jnp.arange(H, dtype=v.dtype) - (H - 1.0))
+    return (coeff * v_sorted).sum(axis=-1)
+
+
+def vma_scores(preds: jnp.ndarray) -> jnp.ndarray:
+    """(N,) pairwise-disagreement acquisition scores."""
+    losses_all = surrogate_expected_losses(preds)  # (H, N)
+    return pairwise_absdiff_sum(losses_all, axis=0)
+
+
+def make_vma(
+    preds: jnp.ndarray,
+    loss_fn: Callable = accuracy_loss,
+    budget: int = 128,
+    name: str = "vma",
+) -> Selector:
+    sel = make_activetesting(
+        preds, loss_fn=loss_fn, budget=budget, name=name,
+        acquisition_scores=vma_scores(preds),
+    )
+    return sel

@@ -1,0 +1,3 @@
+from coda_tpu.tracking.store import Run, TrackingStore
+
+__all__ = ["TrackingStore", "Run"]

@@ -1,0 +1,67 @@
+"""Numeric sanity checks (the framework's always-available "numeric sanitizer").
+
+The reference enforces correctness at runtime with NaN/Inf and
+probability-validity asserts on every intermediate (reference
+``coda/util.py:17-39``, gated by ``_DEBUG`` at ``coda/coda.py:10``). Those are
+host-side asserts; under jit they would force a device sync per intermediate.
+
+Here the same invariants exist in two forms:
+  * eager checks (``check_finite`` / ``check_prob``) for tests and the
+    host-driven demo path, raising like the reference, and
+  * ``jit_check_finite`` — a jit-safe variant using ``jax.debug.callback``,
+    wired into the P(best) kernel (``coda_tpu/ops/pbest.py``) and enabled
+    with ``CODA_TPU_DEBUG_CHECKS=1``; a no-op (zero trace cost) otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Mirrors the reference's module-level _DEBUG switch; off by default in the
+# compiled path because every check is a host callback.
+DEBUG_CHECKS = os.environ.get("CODA_TPU_DEBUG_CHECKS", "0") == "1"
+
+
+def check_finite(t, name: str = "tensor", raise_err: bool = True) -> None:
+    """Raise (or warn) if ``t`` contains NaN/Inf."""
+    arr = np.asarray(t)
+    bad = ~np.isfinite(arr)
+    if bad.any():
+        msg = (
+            f"[NUMERIC ERROR] {name} has {int(bad.sum())} bad values "
+            f"(NaN/Inf) out of {arr.size} "
+            f"min={np.nanmin(arr):.3g}, max={np.nanmax(arr):.3g}"
+        )
+        if raise_err:
+            raise FloatingPointError(msg)
+        print(msg)
+
+
+def check_prob(p, name: str = "prob", eps: float = 1e-12) -> None:
+    """Raise if ``p`` is not a valid probability distribution over its last axis."""
+    check_finite(p, name)
+    arr = np.asarray(p)
+    if (arr < -eps).any():
+        raise FloatingPointError(f"{name} has negatives")
+    s = arr.sum(-1)
+    if not np.isfinite(s).all():
+        raise FloatingPointError(f"{name} sum is nan/inf")
+    if (np.abs(s - 1) > 1e-4).any():
+        print(
+            f"[WARN] {name} rows not normalised: min sum={s.min():.4f}, "
+            f"max sum={s.max():.4f}"
+        )
+
+
+def _host_check(arr: np.ndarray, name: str) -> None:
+    check_finite(arr, str(name))
+
+
+def jit_check_finite(t: jnp.ndarray, name: str) -> None:
+    """jit-safe finite check via host callback; no-op unless DEBUG_CHECKS."""
+    if DEBUG_CHECKS:
+        jax.debug.callback(_host_check, t, name)

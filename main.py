@@ -1,0 +1,10 @@
+"""Benchmark entry point — same CLI surface as the reference's ``main.py``.
+
+Usage: ``python main.py --task cifar10_5592 --method coda`` (or
+``--synthetic H,N,C`` for a seeded synthetic task). See ``coda_tpu/cli.py``.
+"""
+
+from coda_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

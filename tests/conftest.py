@@ -1,0 +1,34 @@
+"""Test env: CPU backend with 8 virtual devices so multi-chip sharding tests
+run without TPUs (same trick the driver's dryrun uses). Must run before any
+jax import."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The environment's site hook may force-register a TPU platform and override
+# JAX_PLATFORMS; pinning the config (before any backend is initialized) keeps
+# tests on the virtual-device CPU backend regardless.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def tiny_task():
+    from coda_tpu.data import make_synthetic_task
+
+    return make_synthetic_task(seed=0, H=5, N=48, C=4)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,96 @@
+import numpy as np
+import jax.numpy as jnp
+
+from coda_tpu.data import Dataset, make_synthetic_task
+from coda_tpu.losses import LOSS_FNS, accuracy_loss, cross_entropy_loss
+from coda_tpu.oracle import Oracle, true_losses
+
+
+def test_synthetic_task_shapes_and_validity():
+    ds = make_synthetic_task(seed=3, H=6, N=100, C=5)
+    H, N, C = ds.shape
+    assert (H, N, C) == (6, 100, 5)
+    assert ds.preds.dtype == jnp.float32
+    p = np.asarray(ds.preds)
+    assert np.all(p >= 0)
+    np.testing.assert_allclose(p.sum(-1), 1.0, atol=1e-5)
+    labels = np.asarray(ds.labels)
+    assert labels.min() >= 0 and labels.max() < C
+
+
+def test_synthetic_task_deterministic():
+    a = make_synthetic_task(seed=7, H=3, N=20, C=3)
+    b = make_synthetic_task(seed=7, H=3, N=20, C=3)
+    np.testing.assert_array_equal(np.asarray(a.preds), np.asarray(b.preds))
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
+
+
+def test_synthetic_accuracies_spread():
+    ds = make_synthetic_task(seed=0, H=8, N=2000, C=4, acc_lo=0.3, acc_hi=0.9)
+    losses = np.asarray(true_losses(ds.preds, ds.labels))
+    # spread of model qualities: the best clearly beats the worst
+    assert losses.min() < 0.2
+    assert losses.max() > 0.55
+
+
+def test_npy_roundtrip(tmp_path):
+    ds = make_synthetic_task(seed=1, H=4, N=30, C=3)
+    fp = tmp_path / "toy.npy"
+    np.save(fp, np.asarray(ds.preds))
+    np.save(tmp_path / "toy_labels.npy", np.asarray(ds.labels))
+    loaded = Dataset.from_file(str(fp))
+    assert loaded.name == "toy"
+    np.testing.assert_array_equal(np.asarray(loaded.preds), np.asarray(ds.preds))
+    np.testing.assert_array_equal(np.asarray(loaded.labels), np.asarray(ds.labels))
+
+
+def test_pt_roundtrip(tmp_path):
+    torch = __import__("torch")
+    ds = make_synthetic_task(seed=2, H=3, N=10, C=3)
+    fp = tmp_path / "toy.pt"
+    torch.save(torch.from_numpy(np.asarray(ds.preds)), fp)
+    torch.save(torch.from_numpy(np.asarray(ds.labels)), tmp_path / "toy_labels.pt")
+    loaded = Dataset.from_file(str(fp))
+    np.testing.assert_allclose(
+        np.asarray(loaded.preds), np.asarray(ds.preds), rtol=1e-6
+    )
+
+
+def test_accuracy_loss_matches_manual(tiny_task):
+    losses = accuracy_loss(tiny_task.preds, tiny_task.labels[None, :])
+    p = np.asarray(tiny_task.preds)
+    lab = np.asarray(tiny_task.labels)
+    manual = 1.0 - (p.argmax(-1) == lab[None, :]).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(losses), manual)
+
+
+def test_accuracy_loss_onehot_labels(tiny_task):
+    onehot = np.eye(tiny_task.shape[2], dtype=np.float32)[np.asarray(tiny_task.labels)]
+    losses = accuracy_loss(tiny_task.preds, jnp.asarray(onehot[None]))
+    manual = accuracy_loss(tiny_task.preds, tiny_task.labels[None, :])
+    np.testing.assert_array_equal(np.asarray(losses), np.asarray(manual))
+
+
+def test_cross_entropy_loss():
+    preds = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], jnp.float32)
+    labels = jnp.asarray([0, 1])
+    ce = np.asarray(cross_entropy_loss(preds, labels))
+    np.testing.assert_allclose(ce, -np.log([0.7, 0.8]), rtol=1e-3)
+    assert set(LOSS_FNS) >= {"acc", "ce"}
+
+
+def test_oracle(tiny_task):
+    oracle = Oracle(tiny_task)
+    losses = np.asarray(oracle.true_losses(tiny_task.preds))
+    assert losses.shape == (tiny_task.shape[0],)
+    assert np.all((0 <= losses) & (losses <= 1))
+    idx = 5
+    assert oracle(idx) == int(tiny_task.labels[idx])
+
+
+def test_oracle_requires_labels(tiny_task):
+    import pytest
+
+    ds = Dataset(preds=tiny_task.preds, labels=None)
+    with pytest.raises(ValueError):
+        Oracle(ds)

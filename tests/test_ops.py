@@ -1,0 +1,232 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from scipy import stats
+
+from coda_tpu.ops.beta import beta_log_pdf, cumtrapz_uniform, dirichlet_to_beta
+from coda_tpu.ops.confusion import (
+    create_confusion_matrices,
+    ensemble_preds,
+    initialize_dirichlets,
+)
+from coda_tpu.ops.masked import (
+    entropy2,
+    masked_argmax_tiebreak,
+    masked_categorical,
+)
+from coda_tpu.ops.pbest import compute_pbest, pbest_grid, pbest_row_mixture
+
+
+def test_dirichlet_to_beta():
+    rng = np.random.default_rng(0)
+    d = rng.uniform(0.5, 5.0, size=(3, 4, 4)).astype(np.float32)
+    a, b = dirichlet_to_beta(jnp.asarray(d))
+    a, b = np.asarray(a), np.asarray(b)
+    for h in range(3):
+        for c in range(4):
+            assert a[h, c] == pytest.approx(d[h, c, c], rel=1e-6)
+            assert b[h, c] == pytest.approx(d[h, c].sum() - d[h, c, c], rel=1e-5)
+
+
+def test_beta_log_pdf_matches_scipy():
+    x = np.linspace(0.01, 0.99, 50)
+    for a, b in [(2.0, 3.0), (0.5, 0.5), (10.0, 1.5)]:
+        ours = np.asarray(beta_log_pdf(jnp.asarray(x, jnp.float32),
+                                       jnp.float32(a), jnp.float32(b)))
+        ref = stats.beta.logpdf(x, a, b)
+        # fp32 lgamma: small absolute error, looser near zero crossings
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=2e-3)
+
+
+def test_cumtrapz_matches_serial_reference():
+    """The parallel cumsum CDF must equal the reference's sequential loop."""
+    rng = np.random.default_rng(1)
+    pdf = rng.uniform(0.0, 3.0, size=(4, 5, 64)).astype(np.float32)
+    x = np.linspace(1e-6, 1 - 1e-6, 64, dtype=np.float32)
+    dx = x[1] - x[0]
+    # serial accumulation exactly as reference coda/coda.py:98-101
+    serial = np.zeros_like(pdf)
+    for j in range(1, 64):
+        serial[..., j] = serial[..., j - 1] + 0.5 * (pdf[..., j] + pdf[..., j - 1]) * dx
+    ours = np.asarray(cumtrapz_uniform(jnp.asarray(pdf), dx))
+    np.testing.assert_allclose(ours, serial, rtol=1e-5, atol=1e-6)
+
+
+def test_cumtrapz_axis():
+    y = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    out0 = cumtrapz_uniform(y, 0.5, axis=0)
+    out_last = cumtrapz_uniform(y.T, 0.5, axis=-1).T
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out_last), rtol=1e-6)
+
+
+def test_pbest_symmetric_models():
+    """Identical Betas => equal P(best)."""
+    a = jnp.full((4,), 5.0)
+    b = jnp.full((4,), 3.0)
+    p = np.asarray(compute_pbest(a, b))
+    np.testing.assert_allclose(p, 0.25, atol=1e-3)
+    assert p.sum() == pytest.approx(1.0, abs=1e-5)
+
+
+def test_pbest_dominant_model():
+    """A clearly better Beta gets almost all the mass."""
+    a = jnp.asarray([50.0, 5.0, 5.0])
+    b = jnp.asarray([5.0, 50.0, 50.0])
+    p = np.asarray(compute_pbest(a, b))
+    assert p[0] > 0.99
+
+
+def test_pbest_two_models_vs_closed_form():
+    """For H=2, P(best) = P(X > Y), computable by 1-D quadrature with scipy."""
+    cases = [(6.0, 4.0, 3.0, 7.0), (2.5, 2.5, 2.0, 3.0), (12.0, 3.0, 11.0, 4.0)]
+    for a1, b1, a2, b2 in cases:
+        p = np.asarray(compute_pbest(jnp.asarray([a1, a2]), jnp.asarray([b1, b2])))
+        # P(X > Y) = ∫ pdf_X(x) * cdf_Y(x) dx on a fine grid
+        x = np.linspace(1e-8, 1 - 1e-8, 20001)
+        ref = np.trapezoid(stats.beta.pdf(x, a1, b1) * stats.beta.cdf(x, a2, b2), x)
+        ref_norm = ref / (ref + (1 - ref))
+        assert p[0] == pytest.approx(ref_norm, abs=2e-3)
+
+
+def test_pbest_monte_carlo():
+    rng = np.random.default_rng(7)
+    a = np.array([8.0, 6.0, 3.0, 9.5], np.float32)
+    b = np.array([4.0, 2.0, 3.0, 6.0], np.float32)
+    p = np.asarray(compute_pbest(jnp.asarray(a), jnp.asarray(b)))
+    samples = rng.beta(a[:, None], b[:, None], size=(4, 200_000))
+    mc = np.bincount(samples.argmax(0), minlength=4) / samples.shape[1]
+    np.testing.assert_allclose(p, mc, atol=5e-3)
+
+
+def test_pbest_batched_matches_unbatched():
+    rng = np.random.default_rng(3)
+    a = rng.uniform(1.0, 10.0, size=(6, 4, 5)).astype(np.float32)
+    b = rng.uniform(1.0, 10.0, size=(6, 4, 5)).astype(np.float32)
+    batched = np.asarray(compute_pbest(jnp.asarray(a), jnp.asarray(b)))
+    for i in range(6):
+        for j in range(4):
+            single = np.asarray(compute_pbest(jnp.asarray(a[i, j]), jnp.asarray(b[i, j])))
+            np.testing.assert_allclose(batched[i, j], single, rtol=1e-5, atol=1e-7)
+
+
+def test_pbest_row_mixture_uniform_pi():
+    rng = np.random.default_rng(5)
+    d = jnp.asarray(rng.uniform(1.0, 6.0, size=(3, 4, 4)).astype(np.float32))
+    pi = jnp.full((4,), 0.25)
+    mix = np.asarray(pbest_row_mixture(d, pi))
+    assert mix.shape == (3,)
+    # mixture of normalized distributions stays normalized
+    assert mix.sum() == pytest.approx(1.0, abs=1e-4)
+
+
+def test_grid_matches_reference_spec():
+    x = np.asarray(pbest_grid())
+    assert x.shape == (256,)
+    assert x[0] == pytest.approx(1e-6)
+    assert x[-1] == pytest.approx(1 - 1e-6)
+
+
+def test_ensemble_and_confusion(tiny_task):
+    ens = np.asarray(ensemble_preds(tiny_task.preds))
+    np.testing.assert_allclose(
+        ens, np.asarray(tiny_task.preds).mean(0), rtol=1e-6
+    )
+    pseudo = ens.argmax(-1)
+    conf = np.asarray(
+        create_confusion_matrices(jnp.asarray(pseudo), tiny_task.preds, mode="soft")
+    )
+    H, N, C = tiny_task.shape
+    assert conf.shape == (H, C, C)
+    np.testing.assert_allclose(conf.sum(-1), 1.0, atol=1e-4)
+    hard = np.asarray(
+        create_confusion_matrices(jnp.asarray(pseudo), tiny_task.preds, mode="hard")
+    )
+    np.testing.assert_allclose(hard.sum(-1), 1.0, atol=1e-4)
+
+
+def test_confusion_hard_manual():
+    # 1 model, 3 points, 2 classes: preds = [0, 1, 1], labels = [0, 1, 0]
+    preds = jnp.asarray(
+        [[[0.9, 0.1], [0.2, 0.8], [0.3, 0.7]]], jnp.float32
+    )
+    labels = jnp.asarray([0, 1, 0])
+    conf = np.asarray(create_confusion_matrices(labels, preds, mode="hard"))
+    # row 0 (true class 0): predictions 0 and 1 -> [0.5, 0.5]
+    np.testing.assert_allclose(conf[0, 0], [0.5, 0.5], atol=1e-6)
+    # row 1 (true class 1): prediction 1 -> [0, 1]
+    np.testing.assert_allclose(conf[0, 1], [0.0, 1.0], atol=1e-6)
+
+
+def test_initialize_dirichlets_diag_prior():
+    soft = jnp.asarray(np.full((2, 4, 4), 0.25, np.float32))
+    d = np.asarray(initialize_dirichlets(soft, prior_strength=0.1))
+    # diag: 1.0 + 0.1*0.25 ; off-diag: 1/3 + 0.1*0.25
+    np.testing.assert_allclose(np.diagonal(d, axis1=-2, axis2=-1), 1.025, rtol=1e-6)
+    off = d[0, 0, 1]
+    assert off == pytest.approx(1 / 3 + 0.025, rel=1e-5)
+    uniform = np.asarray(initialize_dirichlets(soft, 0.1, disable_diag_prior=True))
+    np.testing.assert_allclose(uniform, 2 / 4 + 0.025, rtol=1e-5)
+
+
+def test_entropy2():
+    p = jnp.asarray([0.5, 0.5])
+    assert float(entropy2(p)) == pytest.approx(1.0, abs=1e-6)
+    p = jnp.asarray([1.0, 0.0])
+    assert float(entropy2(p)) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_masked_argmax_unique_max_deterministic():
+    scores = jnp.asarray([0.1, 5.0, 3.0, 5.0])
+    mask = jnp.asarray([True, True, True, False])  # the tied 5.0 is masked out
+    for s in range(5):
+        idx, n_ties = masked_argmax_tiebreak(jax.random.PRNGKey(s), scores, mask)
+        assert int(idx) == 1
+        assert int(n_ties) == 1
+
+
+def test_masked_argmax_ties_uniform():
+    scores = jnp.asarray([1.0, 1.0, 0.0, 1.0])
+    mask = jnp.ones(4, dtype=bool)
+    picks = {
+        int(masked_argmax_tiebreak(jax.random.PRNGKey(s), scores, mask)[0])
+        for s in range(64)
+    }
+    assert picks == {0, 1, 3}
+
+
+def test_masked_argmax_rtol_ties():
+    # in fp32, rtol=1e-8 ties exactly-equal values (adjacent floats are
+    # ~1.2e-7 apart relatively) — same effective semantics as the reference,
+    # which runs isclose(rtol=1e-8) on fp32 tensors
+    scores = jnp.asarray([1.0, 1.0, 0.5])
+    mask = jnp.ones(3, dtype=bool)
+    _, n_ties = masked_argmax_tiebreak(jax.random.PRNGKey(0), scores, mask, rtol=1e-8)
+    assert int(n_ties) == 2
+    scores2 = jnp.asarray([1.0, 0.9999, 0.5])
+    _, n2 = masked_argmax_tiebreak(jax.random.PRNGKey(0), scores2, mask, rtol=1e-8)
+    assert int(n2) == 1
+
+
+def test_masked_categorical_respects_mask_and_weights():
+    w = jnp.asarray([10.0, 1.0, 100.0, 1.0])
+    mask = jnp.asarray([True, True, False, True])
+    counts = np.zeros(4)
+    for s in range(300):
+        idx, prob = masked_categorical(jax.random.PRNGKey(s), w, mask)
+        counts[int(idx)] += 1
+    assert counts[2] == 0
+    assert counts[0] > counts[1]
+    # reported prob is the normalized masked weight
+    idx, prob = masked_categorical(jax.random.PRNGKey(0), w, mask)
+    expected = np.asarray([10, 1, 0, 1], np.float32) / 12.0
+    assert float(prob) == pytest.approx(expected[int(idx)], rel=1e-5)
+
+
+def test_masked_categorical_degenerate_uniform_fallback():
+    w = jnp.zeros(5)
+    mask = jnp.asarray([True, False, True, True, False])
+    for s in range(20):
+        idx, prob = masked_categorical(jax.random.PRNGKey(s), w, mask)
+        assert int(idx) in {0, 2, 3}
+        assert float(prob) == pytest.approx(1 / 3, rel=1e-5)

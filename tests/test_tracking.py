@@ -1,0 +1,92 @@
+import numpy as np
+
+from coda_tpu.tracking import TrackingStore
+
+
+def test_store_schema_and_hierarchy(tmp_path):
+    db = str(tmp_path / "test.sqlite")
+    store = TrackingStore(db)
+    with store.run("taskA", "taskA-coda", params={"method": "coda"}) as parent:
+        with store.run("taskA", "taskA-coda-0", parent=parent,
+                       params={"seed": 0}) as child:
+            child.log_metric_series("regret", [0.5, 0.3, 0.1], start_step=1)
+            child.log_metric_series("cumulative regret", [0.5, 0.8, 0.9],
+                                    start_step=1)
+    # finished statuses
+    assert store.is_finished("taskA", "taskA-coda")
+    assert store.is_finished("taskA", "taskA-coda-0")
+    assert not store.is_finished("taskA", "nope")
+
+    # child lookup via the parentRunId tag
+    parent_uuid = store.find_run("taskA", "taskA-coda")[0]
+    children = store.child_runs(parent_uuid)
+    assert len(children) == 1
+    series = store.metric_series(children[0], "regret")
+    assert series == [(1, 0.5), (2, 0.3), (3, 0.1)]
+    store.close()
+
+
+def test_reference_analysis_sql_runs_unchanged(tmp_path):
+    """The exact join shape of the reference's paper SQL must work."""
+    db = str(tmp_path / "coda.sqlite")
+    store = TrackingStore(db)
+    for seed, final in [(0, 1.25), (1, 0.75)]:
+        with store.run("cifar10_5592", "cifar10_5592-coda") as parent:
+            with store.run("cifar10_5592", f"cifar10_5592-coda-{seed}",
+                           parent=parent) as child:
+                child.log_metric_series(
+                    "cumulative regret",
+                    np.linspace(0.0, final, 100), start_step=1,
+                )
+    rows = store.query(
+        """
+        SELECT  e.name AS task, rn.value AS run_name, m.value, m.step
+        FROM    metrics m
+        JOIN    runs r ON m.run_uuid = r.run_uuid
+        JOIN    experiments e ON r.experiment_id = e.experiment_id
+        JOIN    tags t_parent
+               ON r.run_uuid = t_parent.run_uuid
+              AND t_parent.key = 'mlflow.parentRunId'
+        LEFT JOIN tags rn
+               ON r.run_uuid = rn.run_uuid
+              AND rn.key = 'mlflow.runName'
+        WHERE   m.key = 'cumulative regret'
+          AND   m.step = 100
+          AND   r.lifecycle_stage = 'active'
+          AND   e.lifecycle_stage = 'active'
+        """
+    )
+    assert len(rows) == 2
+    tasks = {r[0] for r in rows}
+    names = {r[1] for r in rows}
+    assert tasks == {"cifar10_5592"}
+    assert names == {"cifar10_5592-coda-0", "cifar10_5592-coda-1"}
+    vals = sorted(r[2] for r in rows)
+    assert vals[0] == 0.75 and vals[1] == 1.25
+    store.close()
+
+
+def test_resume_skips_finished(tmp_path):
+    db = str(tmp_path / "r.sqlite")
+    store = TrackingStore(db)
+    with store.run("t", "t-iid-0") as r:
+        r.log_metric("regret", 0.1, step=1)
+    assert store.is_finished("t", "t-iid-0")
+    # reopening reuses the same run_uuid
+    first = store.find_run("t", "t-iid-0")[0]
+    with store.run("t", "t-iid-0") as r2:
+        assert r2.run_uuid == first
+    store.close()
+
+
+def test_failed_status(tmp_path):
+    store = TrackingStore(str(tmp_path / "f.sqlite"))
+    try:
+        with store.run("t", "t-x-0"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    found = store.find_run("t", "t-x-0")
+    assert found[1] == "FAILED"
+    assert not store.is_finished("t", "t-x-0")
+    store.close()
