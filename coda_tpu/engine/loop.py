@@ -46,6 +46,12 @@ def build_experiment_fn(
 ) -> Callable[[jax.Array], ExperimentResult]:
     """Pure function key -> ExperimentResult for one seed."""
     best_loss = model_losses.min()
+    N = labels.shape[0]
+    if iters > N:
+        raise ValueError(
+            f"iters={iters} exceeds the {N} labelable points; the unlabeled "
+            "set would be exhausted mid-run"
+        )
     budget = selector.hyperparams.get("budget")
     if budget is not None and iters > budget:
         raise ValueError(

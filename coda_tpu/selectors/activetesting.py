@@ -37,8 +37,9 @@ def surrogate_expected_losses(preds: jnp.ndarray) -> jnp.ndarray:
     """(H, N): surrogate prob that model h is wrong on point n."""
     pi_y = preds.mean(axis=0)                       # (N, C) ensemble surrogate
     pred_cls = preds.argmax(axis=2)                 # (H, N)
+    # size-1 leading dim broadcasts — no (H, N, C) copy of the surrogate
     y_star = jnp.take_along_axis(
-        pi_y[None, :, :].repeat(preds.shape[0], 0), pred_cls[..., None], axis=2
+        pi_y[None, :, :], pred_cls[..., None], axis=2
     )[..., 0]
     return 1.0 - y_star
 

@@ -179,56 +179,83 @@ def make_coda(
         cand = jnp.where(empty, state.unlabeled, cand0)
         return cand, ~empty
 
+    def _eig_select_full(state: CODAState, cand, k_tie) -> SelectResult:
+        """Score every point, mask to the candidate set at argmax time."""
+        scores = eig_scores(
+            state.dirichlets, state.pi_hat, state.pi_hat_xi, hard_preds,
+            num_points=hp.num_points, chunk=hp.eig_chunk,
+        )
+        idx, n_ties = masked_argmax_tiebreak(k_tie, scores, cand,
+                                             rtol=_TIE_RTOL, atol=_TIE_ATOL)
+        return SelectResult(
+            idx=idx.astype(jnp.int32),
+            prob=scores[idx],
+            stochastic=n_ties > 1,
+        )
+
+    def _eig_select_prefiltered(state: CODAState, cand, k_sub,
+                                k_tie) -> SelectResult:
+        """Fixed-budget random subsample of the candidates (the speed valve:
+        EIG runs on prefilter_n points, not N). top-k of masked uniforms = a
+        uniform random subset; when fewer than prefilter_n candidates exist,
+        the invalid (masked) slots are excluded again at argmax time, so the
+        pool is exactly the candidate set and no subsampling happened."""
+        u = jnp.where(cand, jax.random.uniform(k_sub, (N,)), -1.0)
+        _, cand_idx = jax.lax.top_k(u, hp.prefilter_n)   # (K,)
+        valid = u[cand_idx] >= 0.0
+        scores_sub = eig_scores(
+            state.dirichlets, state.pi_hat, state.pi_hat_xi[cand_idx],
+            hard_preds[cand_idx],
+            num_points=hp.num_points,
+            chunk=min(hp.eig_chunk, hp.prefilter_n),
+        )
+        local, n_ties = masked_argmax_tiebreak(
+            k_tie, scores_sub, valid, rtol=_TIE_RTOL, atol=_TIE_ATOL
+        )
+        subsampled = cand.sum() > hp.prefilter_n
+        return SelectResult(
+            idx=cand_idx[local].astype(jnp.int32),
+            prob=scores_sub[local],
+            stochastic=(n_ties > 1) | subsampled,
+        )
+
     def select(state: CODAState, key) -> SelectResult:
         k_sub, k_tie = jax.random.split(key)
         cand, may_subsample = _candidates(state)
         use_prefilter = hp.q == "eig" and hp.prefilter_n and hp.prefilter_n < N
 
         if hp.q == "eig" and not use_prefilter:
-            scores = eig_scores(
-                state.dirichlets, state.pi_hat, state.pi_hat_xi, hard_preds,
-                num_points=hp.num_points, chunk=hp.eig_chunk,
+            return _eig_select_full(state, cand, k_tie)
+        if use_prefilter:
+            # only a non-empty *disagreement* set may be subsampled; the
+            # all-agreement fallback scores every unlabeled point, exactly
+            # like the reference (`_prefilter(...) or self.unlabeled_idxs`,
+            # coda/coda.py:239 — the fallback never passes through the
+            # random.sample branch)
+            return lax.cond(
+                may_subsample,
+                lambda s: _eig_select_prefiltered(s, cand, k_sub, k_tie),
+                lambda s: _eig_select_full(s, cand, k_tie),
+                state,
             )
-        elif use_prefilter:
-            # fixed-budget random subsample of the candidates (the speed
-            # valve: EIG runs on prefilter_n points, not N). top-k of masked
-            # uniforms = a uniform random subset; when fewer than
-            # prefilter_n candidates exist, the invalid (masked) slots are
-            # excluded again at argmax time, so the pool is exactly the
-            # candidate set and no subsampling happened.
-            u = jnp.where(cand, jax.random.uniform(k_sub, (N,)), -1.0)
-            _, cand_idx = jax.lax.top_k(u, hp.prefilter_n)   # (K,)
-            valid = u[cand_idx] >= 0.0
-            scores_sub = eig_scores(
-                state.dirichlets, state.pi_hat, state.pi_hat_xi[cand_idx],
-                hard_preds[cand_idx],
-                num_points=hp.num_points,
-                chunk=min(hp.eig_chunk, hp.prefilter_n),
-            )
-            local, n_ties = masked_argmax_tiebreak(
-                k_tie, scores_sub, valid, rtol=_TIE_RTOL, atol=_TIE_ATOL
-            )
-            subsampled = may_subsample & (cand.sum() > hp.prefilter_n)
-            return SelectResult(
-                idx=cand_idx[local].astype(jnp.int32),
-                prob=scores_sub[local],
-                stochastic=(n_ties > 1) | subsampled,
-            )
-        elif hp.q == "iid":
-            scores = jnp.full((N,), 1.0) / jnp.clip(cand.sum(), 1, None)
-        elif hp.q == "uncertainty":
-            scores = unc_scores
-        else:
-            raise NotImplementedError(hp.q)
 
         # the ablation acquisitions (cheap scores) subsample via the mask
+        # *before* scores are computed, so the iid probability is 1/|pool|
+        # of the subsampled pool (reference computes cand first, then q_vals)
         subsampled = jnp.asarray(False)
-        if hp.q != "eig" and hp.prefilter_n and hp.prefilter_n < N:
+        if hp.prefilter_n and hp.prefilter_n < N:
             u = jnp.where(cand, jax.random.uniform(k_sub, (N,)), -1.0)
             kth = jnp.sort(u)[N - hp.prefilter_n]
             take = may_subsample & (cand.sum() > hp.prefilter_n)
             cand = jnp.where(take, cand & (u >= kth), cand)
             subsampled = take
+
+        if hp.q == "iid":
+            scores = jnp.full((N,), 1.0) / jnp.clip(cand.sum(), 1, None)
+        elif hp.q == "uncertainty":
+            scores = unc_scores
+        else:
+            raise NotImplementedError(hp.q)
 
         idx, n_ties = masked_argmax_tiebreak(k_tie, scores, cand,
                                              rtol=_TIE_RTOL, atol=_TIE_ATOL)

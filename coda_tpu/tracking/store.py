@@ -115,11 +115,14 @@ class Run:
     ) -> None:
         """Batch-insert a whole per-step series (one executemany)."""
         ts = _now_ms()
-        rows = [
-            (key, float(v), ts + i, self.run_uuid, start_step + i,
-             int(float(v) != float(v)))
-            for i, v in enumerate(values)
-        ]
+        # sqlite binds float('nan') as NULL which violates NOT NULL; store
+        # 0.0 with is_nan=1 instead (MLflow's own convention)
+        rows = []
+        for i, v in enumerate(values):
+            v = float(v)
+            is_nan = v != v
+            rows.append((key, 0.0 if is_nan else v, ts + i, self.run_uuid,
+                         start_step + i, int(is_nan)))
         self.store._conn.executemany(
             "INSERT OR REPLACE INTO metrics (key, value, timestamp, run_uuid,"
             " step, is_nan) VALUES (?,?,?,?,?,?)",

@@ -1,0 +1,347 @@
+"""Golden-trace parity vs. the PyTorch reference implementation.
+
+Runs the reference package (pure Python + torch CPU, mounted read-only at
+/root/reference) in-process on the same tiny synthetic task and compares,
+selector by selector, the quantities that determine behavior:
+
+  * CODA: Dirichlet init, pi-hat, P(best), EIG score vectors (lockstep on
+    identical label sequences) and the full independent selection trace;
+  * Uncertainty / VMA / ActiveTesting / ModelPicker / IID: acquisition
+    scores, LURE risks, posteriors, risk estimates (lockstep).
+
+This is SURVEY.md section 4(b): the reference has no tests of its own, so
+statistical/trace parity against it *is* the integration test. Skipped when
+the reference checkout is unavailable.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+
+import numpy as np
+import pytest
+
+REF_PATH = "/root/reference"
+
+torch = pytest.importorskip("torch")
+
+try:
+    sys.path.insert(0, REF_PATH)
+    from coda.coda import CODA as RefCODA  # noqa: E402
+    from coda.baselines.iid import IID as RefIID  # noqa: E402
+    from coda.baselines.uncertainty import (  # noqa: E402
+        Uncertainty as RefUncertainty,
+        uncertainty as ref_uncertainty_scores,
+    )
+    from coda.baselines.activetesting import ActiveTesting as RefAT  # noqa: E402
+    from coda.baselines.vma import VMA as RefVMA  # noqa: E402
+    from coda.baselines.modelpicker import ModelPicker as RefMP  # noqa: E402
+    from coda.options import LOSS_FNS as REF_LOSS_FNS  # noqa: E402
+
+    HAVE_REF = True
+except Exception:  # pragma: no cover
+    HAVE_REF = False
+
+pytestmark = pytest.mark.skipif(not HAVE_REF, reason="reference not available")
+
+
+class RefDS:
+    """Minimal stand-in for the reference Dataset (preds + labels on CPU)."""
+
+    def __init__(self, task):
+        self.preds = torch.from_numpy(np.asarray(task.preds)).float()
+        self.labels = torch.from_numpy(np.asarray(task.labels)).long()
+        self.device = self.preds.device
+
+
+@pytest.fixture(scope="module")
+def task():
+    from coda_tpu.data import make_synthetic_task
+
+    # C>=3 so the diag prior differs from uniform; small enough that the
+    # reference's per-step Python loops stay fast
+    return make_synthetic_task(seed=3, H=4, N=40, C=3)
+
+
+@pytest.fixture(scope="module")
+def ref_ds(task):
+    return RefDS(task)
+
+
+def _fresh_ref_coda(ref_ds, **kw):
+    random.seed(0)
+    torch.manual_seed(0)
+    return RefCODA(ref_ds, **kw)
+
+
+def _ours_coda(task, **kw):
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    hp = CODAHyperparams(**kw) if kw else CODAHyperparams()
+    return make_coda(task.preds, hp)
+
+
+# ---------------------------------------------------------------- CODA core
+
+
+def test_coda_init_parity(task, ref_ds):
+    import jax
+
+    ref = _fresh_ref_coda(ref_ds)
+    sel = _ours_coda(task)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+
+    np.testing.assert_allclose(
+        np.asarray(state.dirichlets), ref.dirichlets.numpy(), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(state.pi_hat_xi), ref.pi_hat_xi.numpy(), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(state.pi_hat), ref.pi_hat.numpy(), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_coda_init_parity_ablation_no_diag(task, ref_ds):
+    import jax
+
+    ref = _fresh_ref_coda(ref_ds, disable_diag_prior=True)
+    sel = _ours_coda(task, disable_diag_prior=True)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        np.asarray(state.dirichlets), ref.dirichlets.numpy(), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_coda_pbest_parity(task, ref_ds):
+    import jax
+
+    ref = _fresh_ref_coda(ref_ds)
+    sel = _ours_coda(task)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+
+    ours = np.asarray(sel.extras["get_pbest"](state))
+    theirs = ref.get_pbest().numpy().squeeze()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-6)
+
+
+def test_coda_lockstep_trace_parity(task, ref_ds):
+    """Drive both implementations with the REFERENCE's label choices and
+    compare EIG vectors, selections, posteriors and P(best) every round."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.coda import eig_scores
+
+    labels_np = np.asarray(task.labels)
+    ref = _fresh_ref_coda(ref_ds)
+    sel = _ours_coda(task)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    hard_preds = jnp.argmax(task.preds, -1).T.astype(jnp.int32)
+
+    eig_jit = jax.jit(
+        lambda s: eig_scores(
+            s.dirichlets, s.pi_hat, s.pi_hat_xi, hard_preds, chunk=64
+        )
+    )
+    update_jit = jax.jit(sel.update)
+
+    for rnd in range(6):
+        ref_q, ref_cand = ref.eig_batched()
+        ref_q = ref_q.numpy()
+        ours_q = np.asarray(eig_jit(state))[np.asarray(ref_cand)]
+
+        np.testing.assert_allclose(ours_q, ref_q, rtol=5e-4, atol=1e-5,
+                                   err_msg=f"EIG mismatch at round {rnd}")
+        assert int(np.argmax(ours_q)) == int(np.argmax(ref_q)), rnd
+
+        # drive both with the reference's greedy choice
+        idx = int(ref_cand[int(np.argmax(ref_q))])
+        tc = int(labels_np[idx])
+        ref.add_label(idx, tc, float(ref_q.max()))
+        state = update_jit(state, jnp.asarray(idx), jnp.asarray(tc),
+                           jnp.asarray(0.0))
+
+        np.testing.assert_allclose(
+            np.asarray(state.dirichlets), ref.dirichlets.numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(state.pi_hat), ref.pi_hat.numpy(), rtol=1e-5, atol=1e-6
+        )
+        ours_pbest = np.asarray(sel.extras["get_pbest"](state))
+        np.testing.assert_allclose(ours_pbest, ref.get_pbest().numpy().squeeze(),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_coda_independent_trace_parity(task, ref_ds):
+    """Full independent runs must produce the same selection + best-model
+    sequence (both greedy; the task has no EIG ties)."""
+    from coda_tpu.engine import run_experiment
+    from coda_tpu.oracle import true_losses
+
+    labels_np = np.asarray(task.labels)
+    iters = 10
+
+    ref = _fresh_ref_coda(ref_ds)
+    ref_losses = []
+    ref_idxs, ref_bests = [], []
+    for _ in range(iters):
+        idx, prob = ref.get_next_item_to_label()
+        idx = int(idx)
+        ref.add_label(idx, int(labels_np[idx]), prob)
+        ref_idxs.append(idx)
+        ref_bests.append(int(ref.get_best_model_prediction()))
+    assert not ref.stochastic  # no ties: the greedy trace is deterministic
+
+    sel = _ours_coda(task)
+    res = run_experiment(sel, task, iters=iters, seed=0)
+    assert not bool(res.stochastic)
+    assert np.asarray(res.chosen_idx).tolist() == ref_idxs
+    assert np.asarray(res.best_model).tolist() == ref_bests
+
+
+# ------------------------------------------------------------- baselines
+
+
+def test_uncertainty_scores_parity(task, ref_ds):
+    from coda_tpu.selectors.uncertainty import uncertainty_scores
+
+    all_idxs = list(range(task.preds.shape[1]))
+    theirs = ref_uncertainty_scores(ref_ds.preds, all_idxs).numpy()
+    ours = np.asarray(uncertainty_scores(task.preds))
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-7)
+
+
+def test_iid_risk_lockstep_parity(task, ref_ds):
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.iid import make_iid
+
+    labels_np = np.asarray(task.labels)
+    random.seed(0)
+    ref = RefIID(ref_ds, REF_LOSS_FNS["acc"])
+    sel = make_iid(task.preds)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    update_jit = jax.jit(sel.update)
+    risk_jit = jax.jit(sel.extras["risk"])
+
+    for idx in [3, 17, 29, 5, 11]:
+        tc = int(labels_np[idx])
+        ref.add_label(idx, tc)
+        state = update_jit(state, jnp.asarray(idx), jnp.asarray(tc),
+                           jnp.asarray(0.0))
+        np.testing.assert_allclose(
+            np.asarray(risk_jit(state)), ref.get_risk_estimates().numpy(),
+            rtol=1e-6, atol=1e-7,
+        )
+
+
+def test_activetesting_lockstep_parity(task, ref_ds):
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.activetesting import (
+        make_activetesting,
+        surrogate_expected_losses,
+    )
+
+    H, N, C = task.preds.shape
+    labels_np = np.asarray(task.labels)
+    random.seed(0)
+    ref = RefAT(ref_ds, REF_LOSS_FNS["acc"])
+    sel = make_activetesting(task.preds, budget=8)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    update_jit = jax.jit(sel.update)
+
+    base_scores = np.asarray(surrogate_expected_losses(task.preds).sum(0))
+
+    for step, idx in enumerate([7, 21, 33, 2, 18]):
+        # both sides' selection probability of `idx`, normalized over the
+        # current unlabeled set — must agree before we feed it to LURE
+        unlabeled = np.asarray(state.unlabeled)
+        ours_prob = base_scores[idx] / base_scores[unlabeled].sum()
+
+        pi_y = ref.surrogate.get_preds()
+        pred_classes = ref_ds.preds.argmax(dim=2)
+        y_star = pi_y[torch.arange(N), pred_classes]
+        ref_scores = (1 - y_star).sum(0)[ref.d_u_idxs]
+        ref_scores = ref_scores / ref_scores.sum()
+        ref_prob = float(ref_scores[ref.d_u_idxs.index(idx)])
+        np.testing.assert_allclose(ours_prob, ref_prob, rtol=1e-5)
+
+        tc = int(labels_np[idx])
+        ref.add_label(idx, tc, ref_prob)
+        state = update_jit(state, jnp.asarray(idx), jnp.asarray(tc),
+                           jnp.asarray(ours_prob, jnp.float32))
+
+        ours_risk = np.asarray(sel.extras["lure_risks"](state))
+        theirs_risk = ref.get_risk_estimates().numpy()
+        np.testing.assert_allclose(ours_risk, theirs_risk, rtol=1e-4,
+                                   atol=1e-6, err_msg=f"LURE step {step}")
+
+
+def test_vma_scores_parity(task, ref_ds):
+    from coda_tpu.selectors.vma import vma_scores
+
+    H, N, C = task.preds.shape
+    random.seed(0)
+    ref = RefVMA(ref_ds, REF_LOSS_FNS["acc"])
+
+    # reproduce the reference's acquisition internals on the full set
+    pi_y = ref.surrogate.get_preds()
+    pred_classes = ref_ds.preds.argmax(dim=2)
+    cols = torch.arange(N).unsqueeze(0).expand(H, N)
+    losses_all = 1.0 - pi_y[cols, pred_classes]
+    diff = (losses_all.unsqueeze(0) - losses_all.unsqueeze(1)).abs()
+    mask = torch.triu(torch.ones(H, H, dtype=torch.bool), diagonal=1)
+    theirs = diff[mask].sum(0).numpy()
+
+    ours = np.asarray(vma_scores(task.preds))
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-6)
+
+
+def test_modelpicker_lockstep_parity(task, ref_ds):
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.modelpicker import (
+        expected_entropies,
+        make_modelpicker,
+    )
+
+    H, N, C = task.preds.shape
+    labels_np = np.asarray(task.labels)
+    eps = 0.46
+    ref = RefMP(ref_ds, epsilon=eps)
+    sel = make_modelpicker(task.preds, epsilon=eps)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    update_jit = jax.jit(sel.update)
+    hard_preds = jnp.argmax(task.preds, -1).T.astype(jnp.int32)
+
+    for idx in [1, 14, 26, 38, 9]:
+        preds_unlabeled = ref_ds.preds.argmax(dim=2).transpose(0, 1)[ref.d_u_idxs]
+        theirs_ent = ref.compute_entropies(
+            preds_unlabeled, ref.posterior, H, C, ref.gamma
+        ).numpy()
+        ours_ent = np.asarray(
+            expected_entropies(hard_preds, state.posterior, sel_gamma(eps), C)
+        )[np.asarray(ref.d_u_idxs)]
+        np.testing.assert_allclose(ours_ent, theirs_ent, rtol=1e-5, atol=1e-6)
+
+        tc = int(labels_np[idx])
+        ref.add_label(idx, tc)
+        state = update_jit(state, jnp.asarray(idx), jnp.asarray(tc),
+                           jnp.asarray(0.0))
+        np.testing.assert_allclose(
+            np.asarray(state.posterior), ref.posterior.numpy(),
+            rtol=1e-5, atol=1e-7,
+        )
+        assert (np.asarray(state.correct_counts)
+                == ref.correct_counts.numpy()).all()
+
+
+def sel_gamma(eps: float) -> float:
+    return (1.0 - eps) / eps

@@ -259,3 +259,44 @@ def test_best_model_tie_randomness_marks_stochastic():
     dup = Dataset(preds=jnp.asarray(preds), labels=base.labels, name="dup")
     res = run_experiment(make_uncertainty(dup.preds), dup, iters=4, seed=0)
     assert bool(res.stochastic)
+
+
+def test_iters_exceeding_n_raises(task):
+    from coda_tpu.engine import run_experiment
+    from coda_tpu.selectors import make_iid
+
+    sel = make_iid(task.preds)
+    with pytest.raises(ValueError, match="exceeds"):
+        run_experiment(sel, task, iters=task.preds.shape[1] + 1)
+
+
+def test_coda_prefilter_fallback_scores_all_unlabeled():
+    """Once every disagreement point is labeled, the prefilter must NOT
+    subsample the all-agreement fallback pool (reference coda/coda.py:239)."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.coda import CODAState, _disagreement_mask
+
+    t = make_synthetic_task(seed=5, H=4, N=24, C=3)
+    sel = make_coda(t.preds, CODAHyperparams(prefilter_n=4, eig_chunk=24))
+    state = sel.init(jax.random.PRNGKey(0))
+    # label every disagreement point -> fallback pool = remaining unlabeled
+    hard = jnp.argmax(t.preds, -1).T
+    disagree = _disagreement_mask(hard, 3)
+    state = CODAState(
+        dirichlets=state.dirichlets,
+        pi_hat_xi=state.pi_hat_xi,
+        pi_hat=state.pi_hat,
+        unlabeled=state.unlabeled & ~disagree,
+    )
+    n_pool = int(state.unlabeled.sum())
+    assert n_pool > 4  # bigger than prefilter_n: would be subsampled if buggy
+    picks = set()
+    for s in range(12):
+        res = sel.select(state, jax.random.PRNGKey(s))
+        assert not bool(res.stochastic)  # fallback is deterministic greedy
+        picks.add(int(res.idx))
+    assert len(picks) == 1  # greedy over the full pool: always the same point

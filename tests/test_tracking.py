@@ -90,3 +90,14 @@ def test_failed_status(tmp_path):
     assert found[1] == "FAILED"
     assert not store.is_finished("t", "t-x-0")
     store.close()
+
+
+def test_nan_metric_stored_as_is_nan(tmp_path):
+    from coda_tpu.tracking import TrackingStore
+
+    store = TrackingStore(str(tmp_path / "db.sqlite"))
+    with store.run("exp", "run") as r:
+        r.log_metric_series("m", [1.0, float("nan"), 3.0])
+    rows = store.query(
+        "SELECT value, is_nan FROM metrics ORDER BY step")
+    assert rows == [(1.0, 0), (0.0, 1), (3.0, 0)]
