@@ -114,17 +114,31 @@ class Run:
         self, key: str, values: Iterable[float], start_step: int = 1
     ) -> None:
         """Batch-insert a whole per-step series (one executemany)."""
+        self.log_metric_points(
+            key, [(start_step + i, v) for i, v in enumerate(values)])
+
+    def log_metric_points(self, key: str, points: Iterable[tuple]) -> None:
+        """Batch-insert explicit ``(step, value)`` points.
+
+        Re-logging a step replaces the old row (the PRIMARY KEY includes the
+        timestamp, so INSERT OR REPLACE alone would duplicate on rerun —
+        e.g. ``--force-rerun`` of a reused seed run).
+        """
         ts = _now_ms()
         # sqlite binds float('nan') as NULL which violates NOT NULL; store
         # 0.0 with is_nan=1 instead (MLflow's own convention)
         rows = []
-        for i, v in enumerate(values):
+        for i, (step, v) in enumerate(points):
             v = float(v)
             is_nan = v != v
             rows.append((key, 0.0 if is_nan else v, ts + i, self.run_uuid,
-                         start_step + i, int(is_nan)))
+                         int(step), int(is_nan)))
         self.store._conn.executemany(
-            "INSERT OR REPLACE INTO metrics (key, value, timestamp, run_uuid,"
+            "DELETE FROM metrics WHERE run_uuid=? AND key=? AND step=?",
+            [(self.run_uuid, key, r[4]) for r in rows],
+        )
+        self.store._conn.executemany(
+            "INSERT INTO metrics (key, value, timestamp, run_uuid,"
             " step, is_nan) VALUES (?,?,?,?,?,?)",
             rows,
         )
@@ -233,11 +247,11 @@ class TrackingStore:
 
     def metric_series(self, run_uuid: str, key: str) -> list[tuple[int, float]]:
         rows = self._conn.execute(
-            "SELECT step, value FROM metrics WHERE run_uuid=? AND key=?"
-            " ORDER BY step",
+            "SELECT step, value, is_nan FROM metrics WHERE run_uuid=? AND"
+            " key=? ORDER BY step",
             (run_uuid, key),
         ).fetchall()
-        return [(int(s), float(v)) for s, v in rows]
+        return [(int(s), float("nan") if n else float(v)) for s, v, n in rows]
 
     def query(self, sql: str, params: tuple = ()) -> list[tuple]:
         return self._conn.execute(sql, params).fetchall()

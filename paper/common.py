@@ -1,0 +1,113 @@
+"""Shared loaders for the paper analysis scripts.
+
+The reference's figure/table scripts bypass MLflow and issue raw SQL over
+the sqlite schema, joining metrics x runs x experiments x tags and keeping
+child runs only (reference ``paper/tab1.py:28-51``, ``paper/fig1.py:31-53``).
+The tracking store here implements the same schema, so the same join works
+verbatim; this module centralizes it plus the method-name canonicalization
+every script repeats.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import sys
+
+import pandas as pd
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# canonical CODA config used in every reference figure (paper/tab1.py:60)
+CODA_NAME = "coda-lr=0.01-mult=2.0-no-prefilter"
+
+METHOD_LABELS = {
+    "activetesting": "Active Testing",
+    "iid": "Random Sampling",
+    "model_picker": "ModelSelector",
+    "uncertainty": "Uncertainty",
+    "vma": "VMA",
+}
+
+GLOBAL_METHODS = ["Random Sampling", "Uncertainty", "Active Testing", "VMA",
+                  "ModelSelector", "CODA (Ours)"]
+
+# the reference's 26-task benchmark grouping (paper/tab1.py:113-121)
+TASK_GROUPS = {
+    "DomainNet126": [
+        "real_sketch", "real_painting", "real_clipart",
+        "sketch_real", "sketch_painting", "sketch_clipart",
+        "painting_real", "painting_sketch", "painting_clipart",
+        "clipart_real", "clipart_sketch", "clipart_painting",
+    ],
+    "WILDS": ["iwildcam", "camelyon", "fmow", "civilcomments"],
+    "MSV": ["cifar10_4070", "cifar10_5592", "pacs"],
+    "GLUE": ["glue/cola", "glue/mnli", "glue/qnli", "glue/qqp", "glue/rte",
+             "glue/sst2"],
+}
+
+_SQL = """
+SELECT  e.name   AS task,
+        rn.value AS run_name,
+        m.value  AS value,
+        m.step   AS step
+FROM    metrics   m
+JOIN    runs      r   ON m.run_uuid      = r.run_uuid
+JOIN    experiments e ON r.experiment_id = e.experiment_id
+JOIN    tags t_parent
+       ON r.run_uuid = t_parent.run_uuid
+      AND t_parent.key = 'mlflow.parentRunId'
+LEFT JOIN tags rn
+       ON r.run_uuid = rn.run_uuid
+      AND rn.key     = 'mlflow.runName'
+WHERE   m.key  = ?
+  AND   m.is_nan = 0
+  AND   r.lifecycle_stage = 'active'
+  AND   e.lifecycle_stage = 'active'
+"""
+
+
+def extract_method_from_run_name(run_name: str) -> str:
+    """``<task>-<method>-<seed>`` -> ``<method>`` (reference fig1.py:24-29)."""
+    parts = run_name.split("-")
+    if len(parts) >= 2 and parts[-1].isdigit():
+        parts = parts[:-1]
+    return "-".join(parts[1:]) if len(parts) > 1 else run_name
+
+
+def load_metric(db_path: str, metric: str, coda_name: str = CODA_NAME,
+                step: int | None = None) -> pd.DataFrame:
+    """Child-run metric rows with canonical method labels, x100 like the
+    paper. Columns: task, method, step, value (seed-mean), std."""
+    if not os.path.exists(db_path):
+        raise FileNotFoundError(f"Tracking DB not found: {db_path}")
+    with sqlite3.connect(db_path) as conn:
+        sql, params = _SQL, [metric]
+        if step is not None:
+            sql += "  AND m.step = ?"
+            params.append(step)
+        df = pd.read_sql_query(sql, conn, params=params)
+    if df.empty:
+        return df.assign(method=[])
+    df["method"] = df["run_name"].apply(extract_method_from_run_name)
+    # keep baselines + the one canonical coda config; a bare "coda" run IS
+    # the canonical config (those are the CLI defaults), so accept it too
+    canonical = {coda_name, "coda"}
+    df = df[(~df.method.str.contains("coda")) | df.method.isin(canonical)]
+    df["method"] = df["method"].map(
+        lambda m: "CODA (Ours)" if m in canonical
+        else METHOD_LABELS.get(m, m))
+    g = df.groupby(["task", "method", "step"], as_index=False)["value"]
+    mean = g.mean()
+    mean["std"] = g.std()["value"].fillna(0.0)
+    mean["value"] *= 100
+    mean["std"] *= 100
+    return mean
+
+
+def tasks_in(df: pd.DataFrame, preferred_order=None) -> list[str]:
+    present = list(df.task.unique())
+    if preferred_order:
+        ordered = [t for t in preferred_order if t in present]
+        return ordered + sorted(set(present) - set(ordered))
+    return sorted(present)

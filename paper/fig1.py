@@ -1,0 +1,85 @@
+"""Figure 1: fraction of tasks converged (regret < 1% sustained) vs. label
+budget, per method (capability parity with reference ``paper/fig1.py``:
+convergence = the first step after which mean regret stays below threshold
+for the rest of the run).
+
+Usage: python paper/fig1.py [--db coda.sqlite] [--out fig1.pdf]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+import seaborn as sns
+
+from common import CODA_NAME, GLOBAL_METHODS, load_metric, tasks_in
+
+NO_CONVERGENCE = 999
+
+
+def convergence_steps(df, methods, tasks, threshold=1.0, max_steps=100):
+    """{method: {task: first step with regret < threshold sustained}}."""
+    out = {m: {} for m in methods}
+    for m in methods:
+        for t in tasks:
+            series = (df[(df.task == t) & (df.method == m)]
+                      .sort_values("step")["value"].to_list())
+            step = NO_CONVERGENCE
+            for start in range(min(len(series), max_steps)):
+                if all(v < threshold for v in series[start:]):
+                    step = start + 1
+                    break
+            out[m][t] = step
+    return out
+
+
+def proportions(conv, methods, tasks, max_steps=100):
+    prop = {m: np.zeros(max_steps) for m in methods}
+    for m in methods:
+        for s in range(1, max_steps + 1):
+            prop[m][s - 1] = sum(
+                conv[m][t] <= s for t in tasks if conv[m][t] != NO_CONVERGENCE
+            ) / max(len(tasks), 1)
+    return prop
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--db", default="coda.sqlite")
+    p.add_argument("--threshold", type=float, default=1.0)
+    p.add_argument("--max-steps", type=int, default=100)
+    p.add_argument("--coda-name", default=CODA_NAME)
+    p.add_argument("--out", default="fig1.pdf")
+    args = p.parse_args(argv)
+
+    df = load_metric(args.db, "regret", coda_name=args.coda_name)
+    if df.empty:
+        raise SystemExit(f"No regret rows in {args.db}")
+    methods = [m for m in GLOBAL_METHODS if m in set(df.method)]
+    tasks = tasks_in(df)
+    conv = convergence_steps(df, methods, tasks, args.threshold,
+                             args.max_steps)
+    prop = proportions(conv, methods, tasks, args.max_steps)
+
+    palette = sns.color_palette("colorblind", n_colors=len(methods))
+    fig, ax = plt.subplots(figsize=(5, 3.2))
+    xs = np.arange(1, args.max_steps + 1)
+    for m, color in zip(methods, palette[::-1]):
+        lw = 2.5 if m.startswith("CODA") else 1.5
+        ax.plot(xs, prop[m], label=m, color=color, linewidth=lw)
+    ax.set_xlabel("Number of labels")
+    ax.set_ylabel(f"Fraction of tasks with\nregret < {args.threshold:g}%")
+    ax.set_ylim(0, 1)
+    ax.legend(fontsize=8, loc="upper left")
+    fig.tight_layout()
+    fig.savefig(args.out)
+    print("Wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()

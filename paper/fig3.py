@@ -1,0 +1,112 @@
+"""Figure 3: cumulative-regret curves per task group, with per-task
+prediction-tensor memory footprints (capability parity with reference
+``paper/fig3.py``: same groups and the same hard-coded per-task fp32 GB
+table; groups with no data in the DB are skipped).
+
+Usage: python paper/fig3.py [--db coda.sqlite] [--out fig3.pdf]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import seaborn as sns
+
+from common import CODA_NAME, GLOBAL_METHODS, load_metric
+
+# fp32 (H, N, C) bytes per task (reference paper/fig3.py:129-193)
+MEMORY_USE_GB = {
+    "MSV\n7-10 class": {
+        "cifar10_4070": 0.04063744,
+        "cifar10_5592": 0.04063744,
+        "pacs": 0.016964096,
+    },
+    "GLUE\n2-3 class": {
+        "glue/cola": 0.009445376,
+        "glue/mnli": 0.018265088,
+        "glue/qnli": 0.012504064,
+        "glue/qqp": 0.042404864,
+        "glue/rte": 0.00872192,
+        "glue/sst2": 0.00921088,
+        "glue/mrpc": 0.008840192,
+    },
+    "WILDS Multiclass\n62-182 class": {
+        "fmow": 1.32826112,
+        "iwildcam": 1.510516736,
+    },
+    "WILDS Binary\n2-class": {
+        "civilcomments": 0.031593984,
+        "camelyon": 0.036469248,
+    },
+    "DomainNet\n126-class": {
+        "real_sketch": 3.758885376,
+        "real_clipart": 2.900022784,
+        "real_painting": 1.628145152,
+        "sketch_real": 9.98845184,
+        "sketch_clipart": 2.900022784,
+        "sketch_painting": 1.628145152,
+        "clipart_real": 6.378751488,
+        "clipart_sketch": 3.232947712,
+        "clipart_painting": 1.628145152,
+        "painting_real": 9.98845184,
+        "painting_sketch": 3.157962752,
+        "painting_clipart": 2.900022784,
+    },
+}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--db", default="coda.sqlite")
+    p.add_argument("--metric", default="cumulative regret")
+    p.add_argument("--coda-name", default=CODA_NAME)
+    p.add_argument("--out", default="fig3.pdf")
+    args = p.parse_args(argv)
+
+    df = load_metric(args.db, args.metric, coda_name=args.coda_name)
+    if df.empty:
+        raise SystemExit(f"No '{args.metric}' rows in {args.db}")
+    methods = [m for m in GLOBAL_METHODS if m in set(df.method)]
+    present = set(df.task)
+    groups = {g: [t for t in ts if t in present]
+              for g, ts in MEMORY_USE_GB.items()}
+    groups = {g: ts for g, ts in groups.items() if ts}
+    other = sorted(present - {t for ts in groups.values() for t in ts})
+    if other:
+        groups["Other"] = other
+    if not groups:
+        raise SystemExit("No known tasks in the DB")
+
+    palette = sns.color_palette("colorblind", n_colors=len(methods))
+    colors = dict(zip(methods, palette[::-1]))
+    fig, axes = plt.subplots(1, len(groups),
+                             figsize=(3.2 * len(groups), 3), squeeze=False)
+    for ax, (g_name, g_tasks) in zip(axes[0], groups.items()):
+        sub = df[df.task.isin(g_tasks)]
+        # group curve = mean over the group's tasks of seed-mean regret
+        for m in methods:
+            curve = (sub[sub.method == m].groupby("step")["value"]
+                     .mean().sort_index())
+            if curve.empty:
+                continue
+            lw = 2.5 if m.startswith("CODA") else 1.5
+            ax.plot(curve.index, curve.values, label=m,
+                    color=colors[m], linewidth=lw)
+        mem = MEMORY_USE_GB.get(g_name, {})
+        gb = sum(mem.get(t, 0.0) for t in g_tasks)
+        title = g_name + (f"\n{gb:.2f} GB" if gb else "")
+        ax.set_title(title, fontsize=9)
+        ax.set_xlabel("Number of labels")
+    axes[0][0].set_ylabel(f"{args.metric} (x100)")
+    axes[0][0].legend(fontsize=7)
+    fig.tight_layout()
+    fig.savefig(args.out)
+    print("Wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Figure 4: failure-case analysis — runs CODA step-0 in-process on chosen
+tasks and plots the true best model's confusion matrix plus the true vs.
+estimated class marginal (capability parity with reference ``paper/fig4.py``,
+which probes civilcomments and glue_cola to show where the consensus prior
+misleads the class-marginal estimate).
+
+Usage: python paper/fig4.py --tasks civilcomments,glue_cola [--data-dir data]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def probe_task(path: str, ax_cm, ax_marginal, title: str):
+    import jax
+
+    from coda_tpu.data import Dataset
+    from coda_tpu.losses import accuracy_loss
+    from coda_tpu.oracle import true_losses
+    from coda_tpu.selectors import make_coda
+
+    ds = Dataset.from_file(path)
+    if ds.labels is None:
+        raise SystemExit(f"{path} has no labels")
+    losses = np.asarray(true_losses(ds.preds, ds.labels, accuracy_loss))
+    best_idx = int(losses.argmin())
+
+    sel = make_coda(ds.preds)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    pi_hat = np.asarray(state.pi_hat)
+
+    labels = np.asarray(ds.labels)
+    best_preds = np.asarray(ds.preds[best_idx]).argmax(-1)
+    C = ds.preds.shape[-1]
+
+    # row-normalized confusion of the true best model
+    cm = np.zeros((C, C))
+    np.add.at(cm, (labels, best_preds), 1.0)
+    cm /= np.clip(cm.sum(axis=1, keepdims=True), 1, None)
+    im = ax_cm.imshow(cm, cmap="viridis", vmin=0, vmax=1)
+    ax_cm.set_title(f"{title}: true best model")
+    ax_cm.set_xlabel("Predicted label")
+    ax_cm.set_ylabel("True label")
+    plt.colorbar(im, ax=ax_cm, fraction=0.046)
+
+    true_marginal = np.bincount(labels, minlength=C).astype(float)
+    true_marginal /= true_marginal.sum()
+    xs = np.arange(C)
+    ax_marginal.bar(xs - 0.2, true_marginal, width=0.4, label="True")
+    ax_marginal.bar(xs + 0.2, pi_hat, width=0.4, label="Est.")
+    ax_marginal.set_title(f"{title}: class dist.")
+    ax_marginal.set_xlabel("Class idx")
+    ax_marginal.set_ylabel("Class proportion")
+    ax_marginal.legend(fontsize=8)
+
+
+def _find(data_dir: str, task: str):
+    for ext in (".npy", ".npz", ".pt"):
+        fp = os.path.join(data_dir, task + ext)
+        if os.path.exists(fp):
+            return fp
+    return None
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tasks", default="civilcomments,glue_cola")
+    p.add_argument("--data-dir", default="data")
+    p.add_argument("--out", default="fig4.pdf")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    tasks = args.tasks.split(",")
+    paths = []
+    for t in tasks:
+        fp = _find(args.data_dir, t)
+        if fp is None:
+            print(f"skipping {t}: no data file in {args.data_dir}")
+            continue
+        paths.append((t, fp))
+    if not paths:
+        raise SystemExit("No tasks with data found")
+
+    fig, axes = plt.subplots(1, 2 * len(paths),
+                             figsize=(5 * len(paths), 2.6), squeeze=False)
+    for i, (t, fp) in enumerate(paths):
+        probe_task(fp, axes[0][2 * i], axes[0][2 * i + 1], t)
+    fig.tight_layout()
+    fig.savefig(args.out)
+    print("Wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Figure 5: per-task cumulative-regret curves for every benchmark task in
+a grid (capability parity with reference ``paper/fig5.py``: same 4-row task
+layout; tasks missing from the DB are skipped).
+
+Usage: python paper/fig5.py [--db coda.sqlite] [--out fig5.pdf]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import seaborn as sns
+
+from common import CODA_NAME, GLOBAL_METHODS, load_metric, tasks_in
+
+TASK_LAYOUT = [
+    ["painting_real", "painting_sketch", "painting_clipart",
+     "sketch_painting", "sketch_real", "sketch_clipart"],
+    ["clipart_real", "clipart_sketch", "clipart_painting",
+     "real_painting", "real_sketch", "real_clipart"],
+    ["iwildcam", "fmow", "civilcomments", "camelyon",
+     "cifar10_4070", "cifar10_5592", "pacs"],
+    ["glue/cola", "glue/mnli", "glue/qnli", "glue/qqp",
+     "glue/rte", "glue/sst2", "glue/mrpc"],
+]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--db", default="coda.sqlite")
+    p.add_argument("--metric", default="cumulative regret")
+    p.add_argument("--coda-name", default=CODA_NAME)
+    p.add_argument("--out", default="fig5.pdf")
+    args = p.parse_args(argv)
+
+    df = load_metric(args.db, args.metric, coda_name=args.coda_name)
+    if df.empty:
+        raise SystemExit(f"No '{args.metric}' rows in {args.db}")
+    methods = [m for m in GLOBAL_METHODS if m in set(df.method)]
+    present = set(df.task)
+    layout = [[t for t in row if t in present] for row in TASK_LAYOUT]
+    layout = [row for row in layout if row]
+    known = {t for row in layout for t in row}
+    extra = [t for t in tasks_in(df) if t not in known]
+    if extra:
+        layout.append(extra)
+    if not layout:
+        raise SystemExit("No tasks in the DB")
+
+    ncols = max(len(r) for r in layout)
+    palette = sns.color_palette("colorblind", n_colors=len(methods))
+    colors = dict(zip(methods, palette[::-1]))
+    fig, axes = plt.subplots(len(layout), ncols,
+                             figsize=(2.4 * ncols, 2.2 * len(layout)),
+                             squeeze=False)
+    for r, row in enumerate(layout):
+        for c in range(ncols):
+            ax = axes[r][c]
+            if c >= len(row):
+                ax.axis("off")
+                continue
+            t = row[c]
+            sub = df[df.task == t]
+            for m in methods:
+                curve = (sub[sub.method == m].sort_values("step"))
+                if curve.empty:
+                    continue
+                lw = 2.0 if m.startswith("CODA") else 1.2
+                ax.plot(curve["step"], curve["value"], label=m,
+                        color=colors[m], linewidth=lw)
+            ax.set_title(t, fontsize=8)
+    axes[0][0].legend(fontsize=6)
+    fig.supxlabel("Number of labels")
+    fig.supylabel(f"{args.metric} (x100)")
+    fig.tight_layout()
+    fig.savefig(args.out)
+    print("Wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Table 1: cumulative regret (x100) at a given step, LaTeX with best bold /
+second-best underlined per task (capability parity with reference
+``paper/tab1.py``: same SQL shape, same method set and canonical CODA
+config, same grouped row layout and highlighting).
+
+Usage: python paper/tab1.py [--db coda.sqlite] [--step 100] [--out tab1.tex]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from common import (CODA_NAME, GLOBAL_METHODS, TASK_GROUPS, load_metric,
+                    tasks_in)
+
+
+def pretty_task(t: str) -> str:
+    if "_" in t and not t.startswith(("glue", "cifar10")):
+        src, tgt = t.split("_", 1)
+        return f"{src}$\\rightarrow${tgt}"
+    if t.startswith("glue/"):
+        return t.split("/", 1)[1]
+    return {"cifar10_4070": "cifar10-low", "cifar10_5592": "cifar10-high"}.get(t, t)
+
+
+def build_table(df, methods=GLOBAL_METHODS, groups=None) -> str:
+    present_tasks = tasks_in(
+        df, [t for g in TASK_GROUPS.values() for t in g])
+    if groups is None:
+        groups = {g: [t for t in ts if t in present_tasks]
+                  for g, ts in TASK_GROUPS.items()}
+        groups = {g: ts for g, ts in groups.items() if ts}
+        leftover = [t for t in present_tasks
+                    if all(t not in ts for ts in groups.values())]
+        if leftover:
+            groups["Other"] = leftover
+    tasks = [t for ts in groups.values() for t in ts]
+    methods = [m for m in methods if m in set(df.method)]
+
+    piv = (df.pivot(index="method", columns="task", values="value")
+             .reindex(index=methods, columns=tasks))
+    vals = piv.to_numpy()
+    best = np.nanargmin(vals, axis=0)
+    order = np.argsort(vals, axis=0)
+    second = order[1] if len(methods) > 1 else best
+
+    lines = [r"\begin{tabular}{cl" + "r" * len(methods) + "}", r"\toprule"]
+    header = [r"\textbf{CODA (Ours)}" if m.startswith("CODA") else m
+              for m in methods]
+    lines.append(r"& Task & " + " & ".join(header) + r" \\")
+    lines.append(r"\midrule")
+    col = {t: j for j, t in enumerate(tasks)}
+    for g_name, g_tasks in groups.items():
+        rot = (rf"\parbox[t]{{}}{{\multirow{{{len(g_tasks)}}}{{*}}"
+               rf"{{\rotatebox[origin=c]{{90}}{{{g_name}}}}}}}")
+        for r_i, t in enumerate(g_tasks):
+            cells = []
+            j = col[t]
+            for i, m in enumerate(methods):
+                v = vals[i, j]
+                s = "--" if np.isnan(v) else f"{v:.1f}"
+                if best[j] == i:
+                    s = rf"\textbf{{{s}}}"
+                elif second[j] == i:
+                    s = rf"\underline{{{s}}}"
+                if m.startswith("CODA"):
+                    s = rf"\cellcolor{{gray!15}}{s}"
+                cells.append(s)
+            start = f"{rot} & " if r_i == 0 else "& "
+            lines.append(start + pretty_task(t) + " & "
+                         + " & ".join(cells) + r" \\ ")
+        lines.append(r"\midrule")
+    lines[-1] = r"\bottomrule"
+    lines.append(r"\end{tabular}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--db", default="coda.sqlite")
+    p.add_argument("--metric", default="cumulative regret")
+    p.add_argument("--step", type=int, default=100)
+    p.add_argument("--coda-name", default=CODA_NAME)
+    p.add_argument("--out", default=None, help="write LaTeX here (else stdout)")
+    args = p.parse_args(argv)
+
+    df = load_metric(args.db, args.metric, coda_name=args.coda_name,
+                     step=args.step)
+    if df.empty:
+        raise SystemExit(f"No '{args.metric}' rows at step {args.step} "
+                         f"in {args.db}")
+    latex = build_table(df)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(latex + "\n")
+        print("Wrote", args.out)
+    else:
+        print(latex)
+
+
+if __name__ == "__main__":
+    main()

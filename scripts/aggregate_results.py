@@ -1,0 +1,79 @@
+"""Aggregate child-run metrics into their parent runs.
+
+For every experiment and each *parent* run (a run without the
+``mlflow.parentRunId`` tag), compute the step-wise mean of the chosen
+metrics across all of its child runs and write those means back onto the
+parent run as ``mean_<metric>`` (capability parity with reference
+``scripts/aggregate_results.py:30-94``, which does the same through the
+MLflow client; here it is three SQL statements against the same schema).
+
+Usage:
+    python scripts/aggregate_results.py                    # regret metrics
+    python scripts/aggregate_results.py m1 m2 --db x.sqlite
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from coda_tpu.tracking import TrackingStore  # noqa: E402
+
+DEFAULT_METRICS = ["regret", "cumulative regret"]
+
+
+def aggregate_metrics(store: TrackingStore, metric_keys=None, quiet=False):
+    """Step-wise mean of each metric over child runs, logged to parents."""
+    metric_keys = metric_keys or DEFAULT_METRICS
+    parents = store.query(
+        """SELECT r.run_uuid, e.name FROM runs r
+           JOIN experiments e ON r.experiment_id = e.experiment_id
+           WHERE r.lifecycle_stage='active' AND r.run_uuid NOT IN
+             (SELECT run_uuid FROM tags WHERE key='mlflow.parentRunId')"""
+    )
+    n_written = 0
+    for parent_uuid, exp_name in parents:
+        children = store.child_runs(parent_uuid)
+        if not children:
+            continue
+        placeholders = ",".join("?" * len(children))
+        parent_run = store._conn  # direct batch write below
+        for metric in metric_keys:
+            rows = store.query(
+                f"""SELECT step, AVG(value) FROM metrics
+                    WHERE run_uuid IN ({placeholders}) AND key=? AND is_nan=0
+                    GROUP BY step ORDER BY step""",
+                (*children, metric),
+            )
+            if not rows:
+                continue
+            from coda_tpu.tracking.store import Run
+
+            r = Run(store, parent_uuid)
+            # write each mean at its actual step (the GROUP BY rows may have
+            # gaps where every child logged NaN)
+            r.log_metric_points(f"mean_{metric}", rows)
+            n_written += len(rows)
+            if not quiet:
+                for step, v in rows:
+                    print(f"[Exp {exp_name}] parent {parent_uuid[:8]} | "
+                          f"step {step} mean_{metric} = {v:.6f}")
+        parent_run.commit()
+    return n_written
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("metrics", nargs="*", default=None)
+    p.add_argument("--db", default="coda.sqlite")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+    store = TrackingStore(args.db)
+    n = aggregate_metrics(store, args.metrics or None, quiet=args.quiet)
+    print(f"Wrote {n} aggregated metric points.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Tracking-DB janitor: delete everything, or selected tasks/methods.
+
+Capability parity with reference ``scripts/clear_db.py``: ``--all`` removes
+the DB file after confirmation; ``--tasks``/``--methods`` delete matching
+runs (methods match parent-run names ``<task>-<method>`` and their children)
+and empty experiments.
+
+Usage:
+    python scripts/clear_db.py --all
+    python scripts/clear_db.py --tasks cifar10_5592 --methods coda,iid -y
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from coda_tpu.tracking import TrackingStore  # noqa: E402
+
+
+def confirm(prompt: str) -> bool:
+    return input(prompt + " [y/N] ").lower() in {"y", "yes"}
+
+
+def delete_all(db_path: str, skip_confirm=False):
+    if not os.path.exists(db_path):
+        print("Database already empty.")
+        return
+    targets = [db_path] + [db_path + sfx for sfx in ("-wal", "-shm")
+                           if os.path.exists(db_path + sfx)]
+    if not skip_confirm and not confirm(
+        f"Are you sure you want to delete {', '.join(targets)}?"
+    ):
+        print("Aborted.")
+        return
+    for path in targets:
+        os.remove(path)
+    print("Deleted", ", ".join(targets))
+
+
+def delete_selected(db_path: str, tasks, methods, skip_confirm=False):
+    store = TrackingStore(db_path)
+    clauses, params = [], []
+    if tasks:
+        clauses.append(
+            "e.name IN (%s)" % ",".join("?" * len(tasks)))
+        params += tasks
+    if methods:
+        method_clause = " OR ".join(
+            ["t.value LIKE ?"] * len(methods))
+        clauses.append(f"({method_clause})")
+        params += [f"%-{m}" for m in methods]
+    where = " AND ".join(clauses) if clauses else "1=1"
+
+    parents = store.query(
+        f"""SELECT r.run_uuid, e.name, t.value FROM runs r
+            JOIN experiments e ON r.experiment_id = e.experiment_id
+            JOIN tags t ON t.run_uuid = r.run_uuid AND t.key='mlflow.runName'
+            WHERE r.run_uuid NOT IN
+              (SELECT run_uuid FROM tags WHERE key='mlflow.parentRunId')
+            AND {where}""",
+        tuple(params),
+    )
+    doomed = []
+    for parent_uuid, exp, run_name in parents:
+        doomed.append((parent_uuid, exp, run_name))
+        doomed += [(c, exp, f"{run_name} (child)")
+                   for c in store.child_runs(parent_uuid)]
+    if not doomed:
+        print("Nothing matches.")
+        return
+    print(f"Will delete {len(doomed)} runs:")
+    for _, exp, name in doomed[:20]:
+        print(f"  {exp} / {name}")
+    if len(doomed) > 20:
+        print(f"  ... and {len(doomed) - 20} more")
+    if not skip_confirm and not confirm("Proceed?"):
+        print("Aborted.")
+        return
+    uuids = [d[0] for d in doomed]
+    ph = ",".join("?" * len(uuids))
+    for table in ("metrics", "params", "tags"):
+        store._conn.execute(
+            f"DELETE FROM {table} WHERE run_uuid IN ({ph})", uuids)
+    store._conn.execute(f"DELETE FROM runs WHERE run_uuid IN ({ph})", uuids)
+    store._conn.execute(
+        "DELETE FROM experiments WHERE experiment_id NOT IN "
+        "(SELECT DISTINCT experiment_id FROM runs)")
+    store._conn.commit()
+    print(f"Deleted {len(doomed)} runs.")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--db", default="coda.sqlite")
+    p.add_argument("--all", action="store_true", dest="all_")
+    p.add_argument("--tasks", default=None, help="comma-separated task names")
+    p.add_argument("--methods", default=None, help="comma-separated methods")
+    p.add_argument("-y", "--yes", action="store_true", help="skip confirm")
+    args = p.parse_args(argv)
+
+    if args.all_:
+        delete_all(args.db, skip_confirm=args.yes)
+    elif args.tasks or args.methods:
+        tasks = args.tasks.split(",") if args.tasks else None
+        methods = args.methods.split(",") if args.methods else None
+        delete_selected(args.db, tasks, methods, skip_confirm=args.yes)
+    else:
+        p.error("Specify --all or --tasks/--methods")
+
+
+if __name__ == "__main__":
+    main()

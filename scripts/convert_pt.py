@@ -1,0 +1,56 @@
+"""Convert reference ``.pt`` prediction tensors to ``.npy`` (torch-free IO).
+
+The benchmark data for the reference ships as torch-saved tensors
+(``<task>.pt`` + ``<task>_labels.pt``); converting once to ``.npy`` lets the
+TPU framework load them with plain numpy on hosts without torch.
+
+Usage:
+    python scripts/convert_pt.py data/cifar10_5592.pt            # one file
+    python scripts/convert_pt.py --data-dir data --out-dir npy/  # whole dir
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def convert(pt_path: str, out_dir: str | None = None) -> str:
+    import torch
+
+    out_dir = out_dir or os.path.dirname(pt_path)
+    os.makedirs(out_dir, exist_ok=True)
+    base = os.path.splitext(os.path.basename(pt_path))[0]
+    out = os.path.join(out_dir, base + ".npy")
+    t = torch.load(pt_path, map_location="cpu", weights_only=True)
+    arr = t.detach().cpu().numpy()
+    # prediction tensors to fp32, label vectors to int32
+    arr = arr.astype(np.int32) if arr.ndim == 1 else arr.astype(np.float32)
+    np.save(out, arr)
+    print(f"{pt_path} -> {out}  shape={arr.shape} dtype={arr.dtype}")
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("files", nargs="*", help=".pt files to convert")
+    p.add_argument("--data-dir", default=None, help="convert every .pt here")
+    p.add_argument("--out-dir", default=None)
+    args = p.parse_args(argv)
+
+    files = list(args.files)
+    if args.data_dir:
+        files += sorted(
+            os.path.join(args.data_dir, f)
+            for f in os.listdir(args.data_dir) if f.endswith(".pt")
+        )
+    if not files:
+        p.error("no input files (pass paths or --data-dir)")
+    for f in files:
+        convert(f, args.out_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""Benchmark sweep: every task x method, skipping already-finished runs.
+
+Capability parity with reference ``scripts/launch_all_methods.py`` — the
+SLURM job fan-out (one srun per task-method, <=32 concurrent, DB-checked
+resume, hyperparams regex-decoded from the method *name*) — re-architected
+for the TPU execution model:
+
+  * seeds are already data-parallel inside one process (``vmap`` in the
+    engine), so the unit of work stays one task-method *process*;
+  * fan-out is a local process pool by default (``--max-concurrent``), with
+    ``--launcher srun ...`` available to prefix an arbitrary cluster
+    launcher, subsuming the reference's hard-coded srun invocation;
+  * resume discipline is identical: a task-method is skipped when every
+    needed seed-child run is FINISHED in the tracking DB (reference
+    ``run_needed``/``seed_run_status``, ``:13-43``) — a deterministic
+    (non-``stochastic``) seed-0 child also marks the run complete, mirroring
+    the reference driver's early stop (reference ``main.py:128-130``).
+
+Method-name hyperparameter encoding (reference ``:155-182``), e.g.
+``coda-lr=0.01-mult=2.0-no-prefilter`` decodes to
+``--learning-rate 0.01 --multiplier 2.0 --prefilter-n 0``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+DATA_EXTS = (".npy", ".npz", ".pt")
+
+
+def decode_method_hparams(method: str) -> list[str]:
+    """Decode hyperparameters embedded in the method name into CLI flags."""
+    flags: list[str] = []
+    for pattern, flag in [
+        (r"-lr=([0-9.]+)", "--learning-rate"),
+        (r"-alpha=([0-9.]+)", "--alpha"),
+        (r"-mult=([0-9.]+)", "--multiplier"),
+        (r"-q=([a-z]+)", "--q"),
+        (r"-prefilter=([0-9]+)", "--prefilter-n"),
+    ]:
+        m = re.search(pattern, method)
+        if m:
+            flags += [flag, m.group(1)]
+    if "-no-prefilter" in method:
+        flags += ["--prefilter-n", "0"]
+    if "-no-diag" in method:
+        flags += ["--no-diag-prior"]
+    return flags
+
+
+def list_tasks(pred_dir: str) -> list[str]:
+    tasks = set()
+    for f in os.listdir(pred_dir):
+        base, ext = os.path.splitext(f)
+        if ext in DATA_EXTS and not base.endswith("_labels"):
+            tasks.add(base)
+    return sorted(tasks)
+
+
+def run_needed(store, task: str, method: str, seeds: int) -> bool:
+    """True unless every needed seed-child run is FINISHED (a deterministic
+    finished seed 0 also counts as complete, like the reference driver's
+    early stop)."""
+    for s in range(seeds):
+        run_name = f"{task}-{method}-{s}"
+        found = store.find_run(task, run_name)
+        if not found or found[1] != "FINISHED":
+            return True
+        rows = store.query(
+            "SELECT value FROM params WHERE run_uuid=? AND key='stochastic'",
+            (found[0],),
+        )
+        if rows and rows[0][0] == "False":
+            return False  # deterministic: remaining seeds identical
+    return False
+
+
+def build_cmd(args, task: str, method: str) -> list[str]:
+    cmd = list(args.launcher.split()) if args.launcher else []
+    cmd += [
+        sys.executable, os.path.join(REPO, "main.py"),
+        "--task", task,
+        "--method", method,
+        "--data-dir", args.pred_dir,
+        "--seeds", str(args.seeds),
+        "--iters", str(args.iters),
+        "--tracking-db", args.db,
+    ]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    if args.mesh:
+        cmd += ["--mesh", args.mesh]
+    cmd += decode_method_hparams(method)
+    return cmd
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pred-dir", default="data")
+    p.add_argument("--methods",
+                   default="iid,activetesting,vma,model_picker,uncertainty,coda")
+    p.add_argument("--tasks", default="all")
+    p.add_argument("--seeds", type=int, default=5)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--db", default="coda.sqlite")
+    p.add_argument("--max-concurrent", type=int, default=4,
+                   help="concurrent task-method processes on this host")
+    p.add_argument("--polling-interval", type=float, default=2.0)
+    p.add_argument("--launcher", default=None,
+                   help="optional launcher prefix, e.g. 'srun -p part --mem=64GB'")
+    p.add_argument("--platform", default=None, help="forwarded to main.py")
+    p.add_argument("--mesh", default=None, help="forwarded to main.py")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the job list and exit")
+    args = p.parse_args(argv)
+
+    from coda_tpu.tracking import TrackingStore
+
+    store = TrackingStore(args.db)
+    tasks = (list_tasks(args.pred_dir) if args.tasks == "all"
+             else args.tasks.split(","))
+    methods = [m.strip() for m in args.methods.split(",") if m.strip()]
+
+    queue: list[tuple[str, str, list[str]]] = []
+    for task in tasks:
+        for method in methods:
+            if not run_needed(store, task, method, args.seeds):
+                print(f"Skipping {task}/{method}; all seeds finished")
+                continue
+            queue.append((task, method, build_cmd(args, task, method)))
+
+    if not queue:
+        print("No jobs to run!")
+        return 0
+    print(f"{len(queue)} jobs, max {args.max_concurrent} concurrent")
+    if args.dry_run:
+        for task, method, cmd in queue:
+            print(f"  {task}/{method}: {' '.join(cmd)}")
+        return 0
+
+    running: dict[int, tuple[str, str, subprocess.Popen]] = {}
+    idx = n_failed = 0
+    while idx < len(queue) or running:
+        while idx < len(queue) and len(running) < args.max_concurrent:
+            task, method, cmd = queue[idx]
+            proc = subprocess.Popen(cmd)
+            running[proc.pid] = (task, method, proc)
+            print(f"Launched {task}/{method} (pid {proc.pid})")
+            idx += 1
+        time.sleep(args.polling_interval)
+        for pid in [pid for pid, (_, _, pr) in running.items()
+                    if pr.poll() is not None]:
+            task, method, proc = running.pop(pid)
+            status = "done" if proc.returncode == 0 else f"FAILED ({proc.returncode})"
+            n_failed += proc.returncode != 0
+            print(f"Job {task}/{method}: {status}")
+        done = idx - len(running)
+        print(f"Progress: {done}/{len(queue)} completed, "
+              f"{len(running)} running, {len(queue) - idx} pending")
+    print("All jobs completed!" + (f" ({n_failed} failed)" if n_failed else ""))
+    return 1 if n_failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,74 @@
+"""Run the epsilon grid search for every task missing from the results JSON.
+
+Capability parity with reference
+``scripts/modelselector/launch_missing_modelselector.py``: scans the data
+directory, skips tasks already present in ``best_epsilons.json``, and runs
+the grid search for the rest — as local subprocesses by default (the TPU
+sweep needs no cluster scheduler for this; seeds/realisations are already
+vmapped inside one process), or under any launcher prefix via ``--launcher``.
+
+Usage:
+    python scripts/launch_missing_modelselector.py --pred-dir data
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+DATA_EXTS = (".npy", ".npz", ".pt")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pred-dir", default="data")
+    p.add_argument("--results", default="best_epsilons.json")
+    p.add_argument("--launcher", default=None,
+                   help="optional launcher prefix, e.g. 'srun -p part'")
+    p.add_argument("--max-concurrent", type=int, default=1)
+    p.add_argument("--gridsearch-args", default="",
+                   help="extra args forwarded to the grid search script")
+    args = p.parse_args(argv)
+
+    existing = set()
+    if os.path.exists(args.results):
+        with open(args.results) as f:
+            for k in json.load(f):
+                existing.add(os.path.splitext(k)[0] if k.endswith(DATA_EXTS)
+                             else k)
+
+    tasks = sorted({
+        os.path.splitext(f)[0] for f in os.listdir(args.pred_dir)
+        if os.path.splitext(f)[1] in DATA_EXTS
+        and not os.path.splitext(f)[0].endswith("_labels")
+    })
+    todo = [t for t in tasks if t not in existing]
+    if not todo:
+        print("Nothing missing.")
+        return
+
+    import time
+
+    procs: list[subprocess.Popen] = []
+    for task in todo:
+        cmd = (list(args.launcher.split()) if args.launcher else []) + [
+            sys.executable,
+            os.path.join(SCRIPTS, "modelselector_eps_gridsearch.py"),
+            "--task", task,
+            "--pred-dir", args.pred_dir,
+            "--results", args.results,
+        ] + args.gridsearch_args.split()
+        while sum(p_.poll() is None for p_ in procs) >= args.max_concurrent:
+            time.sleep(1.0)
+        print("Launching:", " ".join(cmd))
+        procs.append(subprocess.Popen(cmd))
+    for pr in procs:
+        pr.wait()
+
+
+if __name__ == "__main__":
+    main()

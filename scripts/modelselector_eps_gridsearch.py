@@ -1,0 +1,231 @@
+"""Unsupervised ModelPicker epsilon tuning via grid search.
+
+Capability parity with reference
+``scripts/modelselector/modelselector_eps_gridsearch_v2.py``: a
+majority-vote pseudo-oracle stands in for labels; for each candidate epsilon,
+ModelPicker runs on random realisations (random subsets of the pool) and is
+scored by how often its best-model guess lands in the truly-best set
+(``avg_success``) and how fast the success rate crosses a threshold
+(``fastest_t``, invalidated when the smoothed curve sits below threshold).
+Results accumulate in ``best_epsilons.json`` with skip-if-present resume.
+
+TPU-native execution: the reference runs eps x 1000 realisations x 1000
+budget steps as nested Python loops (hours per task). Here one realisation
+is a ``lax.scan`` over budget steps on the *hard* argmax predictions only
+(ModelPicker never reads the soft scores), realisations batch under ``vmap``
+(chunked with ``lax.map`` as a memory valve), and the epsilon grid is the
+only Python loop. The whole search is a handful of compiled launches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+DEFAULT_EPSILONS = ("0.35,0.36,0.37,0.38,0.39,0.40,0.41,0.42,0.43,0.44,"
+                    "0.45,0.46,0.47,0.48,0.49")
+
+
+def majority_vote_labels(hard_preds: np.ndarray, C: int) -> np.ndarray:
+    """(N, H) int -> (N,) majority class per point (smallest wins ties,
+    matching the reference's np.unique-based vote)."""
+    votes = np.apply_along_axis(
+        lambda r: np.bincount(r, minlength=C), 1, hard_preds)
+    return votes.argmax(axis=1).astype(np.int32)
+
+
+def _run_realisations(hard_preds_sub, oracle_sub, C, gamma, budget, key,
+                      real_chunk=64):
+    """Batched ModelPicker runs. hard_preds_sub: (R, P, H); oracle: (R, P).
+
+    Returns (success (R, T), acc (R, T)) — per step, whether the guess is in
+    the truly-best set and its true (pseudo-oracle) accuracy.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from coda_tpu.ops.masked import masked_argmin_tiebreak
+    from coda_tpu.selectors.modelpicker import expected_entropies
+
+    R, P, H = hard_preds_sub.shape
+
+    def one(args):
+        hp, orc, k = args  # (P, H), (P,), key
+        disagree = (hp != hp[:, :1]).any(axis=1)
+        correct = (hp == orc[:, None])                  # (P, H)
+        true_acc = correct.mean(axis=0)                 # (H,)
+        best_set = true_acc == true_acc.max()
+
+        def step(carry, k_step):
+            unlabeled, posterior, counts = carry
+            k_sel, k_best = jax.random.split(k_step)
+            ent = expected_entropies(hp, posterior, gamma, C, chunk=P)
+            cand = disagree & unlabeled
+            cand = jnp.where(cand.any(), cand, unlabeled)
+            idx, _ = masked_argmin_tiebreak(k_sel, ent, cand)
+            agree = (hp[idx] == orc[idx]).astype(jnp.float32)
+            posterior = posterior * jnp.power(gamma, agree)
+            posterior = posterior / posterior.sum()
+            counts = counts + agree.astype(jnp.int32)
+            guess, _ = masked_argmin_tiebreak(
+                k_best, -counts.astype(jnp.float32), jnp.ones((H,), bool))
+            return ((unlabeled.at[idx].set(False), posterior, counts),
+                    (best_set[guess], true_acc[guess]))
+
+        keys = jax.random.split(k, budget)
+        init = (jnp.ones((P,), bool), jnp.full((H,), 1.0 / H),
+                jnp.zeros((H,), jnp.int32))
+        _, (succ, acc) = lax.scan(step, init, keys)
+        return succ, acc
+
+    keys = jax.random.split(key, R)
+    return jax.jit(
+        lambda a: lax.map(one, a, batch_size=min(real_chunk, R))
+    )((hard_preds_sub, oracle_sub, keys))
+
+
+def smooth_data(x: np.ndarray, kernel_size: int = 5) -> np.ndarray:
+    kernel = np.ones(kernel_size) / kernel_size
+    pad = kernel_size // 2
+    xp = np.pad(x, (pad, pad), "constant", constant_values=(x[0], x[-1]))
+    return np.convolve(xp, kernel, "valid")
+
+
+def run_grid_search(preds, eps_list, iterations=1000, pool_size=1000,
+                    budget=1000, threshold=0.9, seed=0, real_chunk=64):
+    """preds: (H, N, C) array-like. Returns the reference's result dict."""
+    import jax
+    import jax.numpy as jnp
+
+    preds = np.asarray(preds)
+    H, N, C = preds.shape
+    hard = preds.argmax(-1).T.astype(np.int32)          # (N, H)
+    majority = majority_vote_labels(hard, C)             # (N,)
+
+    pool_size = min(pool_size, N)
+    budget = min(budget, pool_size)
+    rng = np.random.default_rng(seed)
+    real_idx = np.stack([rng.permutation(N)[:pool_size]
+                         for _ in range(iterations)])    # (R, P)
+    hard_sub = jnp.asarray(hard[real_idx])               # (R, P, H)
+    orc_sub = jnp.asarray(majority[real_idx])            # (R, P)
+
+    results = {}
+    for i, eps in enumerate(eps_list):
+        gamma = (1.0 - eps) / eps
+        succ, acc = _run_realisations(
+            hard_sub, orc_sub, C, gamma, budget,
+            jax.random.PRNGKey(seed * 1000 + i), real_chunk=real_chunk)
+        success_mean = np.asarray(succ, dtype=np.float64).mean(axis=0)
+        acc_mean = np.asarray(acc, dtype=np.float64).mean(axis=0)
+        smooth = smooth_data(success_mean, kernel_size=5)
+        avg_success = float(success_mean.mean())
+        t_fast = int(np.argmax(success_mean >= threshold))
+        if smooth[t_fast] <= threshold:
+            t_fast = float("inf")
+        results[eps] = {
+            "success_mean": success_mean.tolist(),
+            "acc_mean": acc_mean.tolist(),
+            "avg_success": avg_success,
+            "fastest_t": t_fast,
+        }
+        print(f"eps={eps:.3f} avg_success={avg_success:.3f} fastest_t={t_fast}")
+
+    best_avg = max(results.items(), key=lambda x: x[1]["avg_success"])[0]
+    best_fast = min(results.items(), key=lambda x: x[1]["fastest_t"])[0]
+    print("\nOptimal epsilon (avg_success):", best_avg)
+    print("Optimal epsilon (fastest):", best_fast)
+    return {"best_avg": best_avg, "best_fast": best_fast, "metrics": results}
+
+
+def load_results(path):
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {}
+
+
+def save_result(path, key, res):
+    """Reload-merge-write (the reference's concurrency workaround; kept, but
+    atomic via replace so concurrent writers can't truncate each other)."""
+    overall = load_results(path)
+    overall[key] = {"best_avg": res["best_avg"], "best_fast": res["best_fast"]}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(overall, f, indent=2)
+    os.replace(tmp, path)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preds", help="path to (H,N,C) tensor file")
+    p.add_argument("--pred-dir", default="data")
+    p.add_argument("--task", default=None)
+    p.add_argument("--epsilons", default=DEFAULT_EPSILONS)
+    p.add_argument("--iterations", type=int, default=1000)
+    p.add_argument("--pool-size", type=int, default=1000)
+    p.add_argument("--budget", type=int, default=1000)
+    p.add_argument("--threshold", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--real-chunk", type=int, default=64,
+                   help="realisations per compiled map step (memory valve)")
+    p.add_argument("--results", default="best_epsilons.json")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from coda_tpu.data import Dataset
+
+    eps_list = [float(e) for e in args.epsilons.split(",")]
+
+    def search_one(key, path):
+        overall = load_results(args.results)
+        if key in overall:
+            print(key, "already computed; skipping")
+            return
+        ds = Dataset.from_file(path)
+        res = run_grid_search(
+            ds.preds, eps_list, iterations=args.iterations,
+            pool_size=args.pool_size, budget=args.budget,
+            threshold=args.threshold, seed=args.seed,
+            real_chunk=args.real_chunk)
+        save_result(args.results, key, res)
+
+    if args.task or args.preds:
+        path = args.preds or None
+        if args.task and not path:
+            for ext in (".npy", ".npz", ".pt"):
+                cand = os.path.join(args.pred_dir, args.task + ext)
+                if os.path.exists(cand):
+                    path = cand
+                    break
+        if not path:
+            p.error(f"no prediction file for task {args.task}")
+        search_one(args.task or os.path.basename(path), path)
+    else:
+        files = sorted(
+            f for f in os.listdir(args.pred_dir)
+            if os.path.splitext(f)[1] in (".npy", ".npz", ".pt")
+            and not os.path.splitext(f)[0].endswith("_labels"))
+        if not files:
+            p.error("no prediction files found")
+        for fname in files:
+            # key by bare task name so --task and directory-mode runs share
+            # the same resume entries
+            search_one(os.path.splitext(fname)[0],
+                       os.path.join(args.pred_dir, fname))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Tests for the paper analysis suite (SQL load, table/figure generation)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+PAPER = os.path.join(os.path.dirname(__file__), "..", "paper")
+
+
+def _load(name):
+    sys.path.insert(0, PAPER)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(PAPER, name + ".py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    finally:
+        sys.path.remove(PAPER)
+
+
+@pytest.fixture()
+def bench_db(tmp_path):
+    """A DB with 2 tasks x 2 methods x 2 seeds of regret traces."""
+    from coda_tpu.tracking import TrackingStore
+
+    db = str(tmp_path / "db.sqlite")
+    store = TrackingStore(db)
+    curves = {
+        # coda converges (regret < 1% from step 2), iid doesn't
+        "coda-lr=0.01-mult=2.0-no-prefilter": [2.0, 0.5, 0.2, 0.0],
+        "iid": [5.0, 4.0, 3.0, 2.0],
+    }
+    for task in ("cifar10_5592", "pacs"):
+        for method, curve in curves.items():
+            with store.run(task, f"{task}-{method}") as parent:
+                for s in range(2):
+                    noise = 0.1 * s
+                    with store.run(task, f"{task}-{method}-{s}",
+                                   parent=parent) as r:
+                        r.log_metric_series(
+                            "regret", [(v + noise) / 100 for v in curve],
+                            start_step=1)
+                        r.log_metric_series(
+                            "cumulative regret",
+                            list(np.cumsum([(v + noise) / 100 for v in curve])),
+                            start_step=1)
+    store.close()
+    return db
+
+
+def test_load_metric_and_canonicalization(bench_db):
+    common = _load("common")
+    df = common.load_metric(bench_db, "regret")
+    assert set(df.method) == {"CODA (Ours)", "Random Sampling"}
+    assert set(df.task) == {"cifar10_5592", "pacs"}
+    # seed-mean x100: step 1 coda = mean(2.0, 2.1)
+    row = df[(df.task == "pacs") & (df.method == "CODA (Ours)")
+             & (df.step == 1)]
+    np.testing.assert_allclose(row["value"].iloc[0], 2.05, rtol=1e-6)
+
+
+def test_load_metric_at_step(bench_db):
+    common = _load("common")
+    df = common.load_metric(bench_db, "cumulative regret", step=4)
+    assert set(df.step) == {4}
+
+
+def test_tab1_latex(bench_db):
+    common = _load("common")
+    tab1 = _load("tab1")
+    df = common.load_metric(bench_db, "cumulative regret", step=4)
+    latex = tab1.build_table(df)
+    assert r"\begin{tabular}" in latex and r"\bottomrule" in latex
+    assert "cifar10-high" in latex and "pacs" in latex
+    # coda has the lower cumulative regret -> bold inside its gray cell
+    assert r"\cellcolor{gray!15}\textbf{" in latex
+
+
+def test_fig1_convergence_logic(bench_db):
+    common = _load("common")
+    fig1 = _load("fig1")
+    df = common.load_metric(bench_db, "regret")
+    methods = ["Random Sampling", "CODA (Ours)"]
+    tasks = ["cifar10_5592", "pacs"]
+    conv = fig1.convergence_steps(df, methods, tasks, threshold=1.0,
+                                  max_steps=4)
+    assert conv["CODA (Ours)"]["pacs"] == 2
+    assert conv["Random Sampling"]["pacs"] == fig1.NO_CONVERGENCE
+    prop = fig1.proportions(conv, methods, tasks, max_steps=4)
+    np.testing.assert_allclose(prop["CODA (Ours)"], [0, 1, 1, 1])
+    np.testing.assert_allclose(prop["Random Sampling"], [0, 0, 0, 0])
+
+
+@pytest.mark.parametrize("script,extra", [
+    ("tab1.py", ["--step", "4"]),
+    ("fig1.py", ["--max-steps", "4"]),
+    ("fig3.py", []),
+    ("fig5.py", []),
+])
+def test_paper_scripts_end_to_end(bench_db, tmp_path, script, extra):
+    out = str(tmp_path / ("out." + ("tex" if script == "tab1.py" else "pdf")))
+    r = subprocess.run(
+        [sys.executable, os.path.join(PAPER, script), "--db", bench_db,
+         "--out", out] + extra,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(out)
+
+
+def test_fig4_probe(tmp_path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from coda_tpu.data import make_synthetic_task
+
+    fig4 = _load("fig4")
+    task = make_synthetic_task(seed=0, H=3, N=40, C=3)
+    np.save(str(tmp_path / "t.npy"), np.asarray(task.preds))
+    np.save(str(tmp_path / "t_labels.npy"), np.asarray(task.labels))
+    fig, axes = plt.subplots(1, 2)
+    fig4.probe_task(str(tmp_path / "t.npy"), axes[0], axes[1], "t")
+    plt.close(fig)
+
+
+def test_load_metric_excludes_nan_and_accepts_bare_coda(tmp_path):
+    from coda_tpu.tracking import TrackingStore
+
+    common = _load("common")
+    db = str(tmp_path / "db2.sqlite")
+    store = TrackingStore(db)
+    with store.run("t1", "t1-coda") as parent:
+        with store.run("t1", "t1-coda-0", parent=parent) as r:
+            r.log_metric_series("regret", [0.5, float("nan"), 0.3],
+                                start_step=1)
+    store.close()
+    df = common.load_metric(db, "regret")
+    # bare "coda" is the canonical config
+    assert set(df.method) == {"CODA (Ours)"}
+    # the NaN step is excluded, not read as 0.0
+    assert sorted(df.step) == [1, 3]

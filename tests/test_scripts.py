@@ -1,0 +1,154 @@
+"""Tests for the periphery scripts (aggregation, janitor, sweep launcher,
+epsilon grid search, format conversion)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(SCRIPTS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def seeded_store(tmp_path):
+    from coda_tpu.tracking import TrackingStore
+
+    db = str(tmp_path / "db.sqlite")
+    store = TrackingStore(db)
+    with store.run("taskA", "taskA-coda") as parent:
+        for s, (r0, r1) in enumerate([(0.4, 0.2), (0.6, 0.0)]):
+            with store.run("taskA", f"taskA-coda-{s}", parent=parent,
+                           params={"seed": s, "stochastic": "True"}) as r:
+                r.log_metric_series("regret", [r0, r1], start_step=1)
+    return store, db
+
+
+def test_aggregate_results(seeded_store):
+    store, db = seeded_store
+    agg = _load("aggregate_results")
+    n = agg.aggregate_metrics(store, ["regret"], quiet=True)
+    assert n == 2
+    rows = store.query(
+        """SELECT m.step, m.value FROM metrics m
+           JOIN tags t ON t.run_uuid = m.run_uuid AND t.key='mlflow.runName'
+           WHERE t.value='taskA-coda' AND m.key='mean_regret' ORDER BY m.step"""
+    )
+    assert rows == [(1, 0.5), (2, 0.1)]
+
+
+def test_clear_db_selected_and_all(seeded_store, tmp_path):
+    store, db = seeded_store
+    store.close()
+    clear = _load("clear_db")
+    clear.delete_selected(db, tasks=["taskA"], methods=None, skip_confirm=True)
+    from coda_tpu.tracking import TrackingStore
+
+    store2 = TrackingStore(db)
+    assert store2.query("SELECT COUNT(*) FROM runs") == [(0,)]
+    assert store2.query("SELECT COUNT(*) FROM metrics") == [(0,)]
+    store2.close()
+    clear.delete_all(db, skip_confirm=True)
+    assert not os.path.exists(db)
+
+
+def test_convert_pt_roundtrip(tmp_path):
+    torch = pytest.importorskip("torch")
+    conv = _load("convert_pt")
+    p = np.random.default_rng(0).random((3, 8, 4)).astype(np.float32)
+    torch.save(torch.from_numpy(p.copy()), str(tmp_path / "t.pt"))
+    torch.save(torch.from_numpy(np.arange(8)), str(tmp_path / "t_labels.pt"))
+    out = conv.convert(str(tmp_path / "t.pt"))
+    out_l = conv.convert(str(tmp_path / "t_labels.pt"))
+    np.testing.assert_array_equal(np.load(out), p)
+    assert np.load(out_l).dtype == np.int32
+
+
+def test_launcher_hparam_decode():
+    launch = _load("launch_all_methods")
+    flags = launch.decode_method_hparams(
+        "coda-lr=0.01-mult=2.0-alpha=0.8-q=eig-no-prefilter-no-diag")
+    assert flags == ["--learning-rate", "0.01", "--alpha", "0.8",
+                     "--multiplier", "2.0", "--q", "eig",
+                     "--prefilter-n", "0", "--no-diag-prior"]
+    assert launch.decode_method_hparams("iid") == []
+    assert launch.decode_method_hparams("coda-prefilter=100") == [
+        "--prefilter-n", "100"]
+
+
+def test_launcher_run_needed(seeded_store):
+    store, db = seeded_store
+    launch = _load("launch_all_methods")
+    # both seeds finished & stochastic -> seeds 0..1 done, seed 2 missing
+    assert not launch.run_needed(store, "taskA", "coda", 2)
+    assert launch.run_needed(store, "taskA", "coda", 3)
+    assert launch.run_needed(store, "taskA", "iid", 1)
+    # deterministic finished seed 0 marks the whole run complete
+    with store.run("taskB", "taskB-coda") as parent:
+        with store.run("taskB", "taskB-coda-0", parent=parent,
+                       params={"seed": 0, "stochastic": "False"}):
+            pass
+    assert not launch.run_needed(store, "taskB", "coda", 5)
+
+
+def test_launcher_dry_run(tmp_path, capsys):
+    launch = _load("launch_all_methods")
+    np.save(str(tmp_path / "t1.npy"),
+            np.zeros((2, 4, 3), dtype=np.float32))
+    np.save(str(tmp_path / "t1_labels.npy"), np.zeros(4, dtype=np.int32))
+    rc = launch.main([
+        "--pred-dir", str(tmp_path), "--methods", "iid,coda-lr=0.5",
+        "--db", str(tmp_path / "db.sqlite"), "--dry-run",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "t1/iid" in out and "t1/coda-lr=0.5" in out
+    assert "--learning-rate 0.5" in out
+
+
+def test_majority_vote_matches_reference_semantics():
+    gs = _load("modelselector_eps_gridsearch")
+    hard = np.array([[0, 1, 1], [2, 2, 0], [1, 0, 2]], dtype=np.int32)
+    # ties broken toward the smallest class id (np.unique order)
+    maj = gs.majority_vote_labels(hard, C=3)
+    assert maj.tolist() == [1, 2, 0]
+    tie = np.array([[0, 1], [2, 1]], dtype=np.int32)
+    assert gs.majority_vote_labels(tie, C=3).tolist() == [0, 1]
+
+
+def test_gridsearch_end_to_end(tmp_path):
+    gs = _load("modelselector_eps_gridsearch")
+    from coda_tpu.data import make_synthetic_task
+
+    task = make_synthetic_task(seed=2, H=4, N=60, C=3,
+                               acc_lo=0.3, acc_hi=0.95)
+    res = gs.run_grid_search(
+        task.preds, eps_list=[0.4, 0.46], iterations=8, pool_size=30,
+        budget=12, seed=0, real_chunk=8)
+    assert set(res) == {"best_avg", "best_fast", "metrics"}
+    for eps, m in res["metrics"].items():
+        assert len(m["success_mean"]) == 12
+        assert 0.0 <= m["avg_success"] <= 1.0
+        assert all(0.0 <= a <= 1.0 for a in m["acc_mean"])
+    # with a clearly-best model the search should find it often by the end
+    best_eps = res["best_avg"]
+    tail = np.mean(res["metrics"][best_eps]["success_mean"][-4:])
+    assert tail > 0.4
+
+    # skip-if-present resume via the results file
+    path = str(tmp_path / "best_epsilons.json")
+    gs.save_result(path, "taskX", res)
+    saved = gs.load_results(path)
+    assert saved["taskX"]["best_avg"] == res["best_avg"]

@@ -1,0 +1,124 @@
+"""Sharded-execution parity: the same jitted program on 1 chip vs. an
+8-virtual-device mesh must produce the same experiment trace.
+
+SURVEY.md section 4(c): the TPU build's distributed story is sharding the
+``(H, N, C)`` tensor over a ``jax.sharding.Mesh`` (N over the ``data`` axis —
+the context-parallel analog — and H over ``model``), with XLA inserting the
+collectives. These tests pin that the sharded program computes the *same
+numbers* as the single-device one (the only semantics the reference's
+single-GPU implementation defines), on the CPU backend with 8 virtual
+devices (conftest sets ``xla_force_host_platform_device_count=8``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from coda_tpu.data import make_synthetic_task
+from coda_tpu.engine import run_experiment
+from coda_tpu.oracle import true_losses
+from coda_tpu.parallel import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    mesh_from_spec,
+    preds_sharding,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _sharded_task(task, mesh):
+    preds = jax.device_put(task.preds, preds_sharding(mesh))
+    labels = jax.device_put(task.labels, NamedSharding(mesh, P(DATA_AXIS)))
+    return type(task)(preds=preds, labels=labels, name=task.name)
+
+
+def _trace(selector_factory, task, iters=8, seed=0, **kw):
+    sel = selector_factory(task.preds, **kw)
+    res = run_experiment(sel, task, iters=iters, seed=seed)
+    return (
+        np.asarray(res.chosen_idx),
+        np.asarray(res.best_model),
+        np.asarray(res.regret),
+    )
+
+
+@pytest.mark.parametrize("mesh_spec", ["data=8", "data=4,model=2", "model=4"])
+@pytest.mark.parametrize("method", ["coda", "iid", "uncertainty",
+                                    "activetesting", "vma", "model_picker"])
+def test_sharded_trace_matches_single_device(method, mesh_spec):
+    from coda_tpu.selectors import SELECTOR_FACTORIES
+
+    # shapes divisible by every mesh axis size used above
+    task = make_synthetic_task(seed=7, H=8, N=64, C=4)
+    mesh = mesh_from_spec(mesh_spec)
+
+    idx1, best1, reg1 = _trace(SELECTOR_FACTORIES[method], task)
+    idx8, best8, reg8 = _trace(
+        SELECTOR_FACTORIES[method], _sharded_task(task, mesh)
+    )
+
+    np.testing.assert_array_equal(idx1, idx8)
+    np.testing.assert_array_equal(best1, best8)
+    np.testing.assert_allclose(reg1, reg8, rtol=0, atol=0)
+
+
+def test_sharded_pbest_matches(tiny_task):
+    """The P(best) kernel with H sharded over the model axis (exclusive
+    log-CDF product = psum of per-model log-CDFs) matches replicated."""
+    from coda_tpu.ops.pbest import compute_pbest
+
+    H = 8
+    a = jnp.abs(jax.random.normal(jax.random.PRNGKey(0), (5, H))) * 10 + 1
+    b = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (5, H))) * 10 + 1
+
+    mesh = make_mesh(model=8)
+    sh = NamedSharding(mesh, P(None, MODEL_AXIS))
+    out1 = jax.jit(compute_pbest)(a, b)
+    out8 = jax.jit(compute_pbest)(jax.device_put(a, sh), jax.device_put(b, sh))
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out8),
+                               rtol=0, atol=0)
+
+
+def test_sharded_eig_scores_match():
+    """EIG scoring with N sharded over the data axis matches replicated."""
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.coda import eig_scores
+
+    task = make_synthetic_task(seed=11, H=4, N=64, C=4)
+    mesh = make_mesh(data=8)
+
+    def scores_for(preds):
+        sel = make_coda(preds, CODAHyperparams(eig_chunk=64, num_points=64))
+        state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+        hard = jnp.argmax(preds, -1).T.astype(jnp.int32)
+        return np.asarray(
+            jax.jit(
+                lambda s: eig_scores(s.dirichlets, s.pi_hat, s.pi_hat_xi,
+                                     hard, num_points=64, chunk=64)
+            )(state)
+        )
+
+    s1 = scores_for(task.preds)
+    s8 = scores_for(jax.device_put(task.preds, preds_sharding(mesh)))
+    # the pi-hat einsum reduces over the sharded N axis; partial-sum order
+    # differs under psum, so raw floats carry ~1e-7 reduction noise — the
+    # selection argmax (the semantics that matter) must still agree
+    np.testing.assert_allclose(s1, s8, atol=1e-6)
+    assert int(s1.argmax()) == int(s8.argmax())
+
+
+def test_mesh_spec_parsing_and_errors():
+    m = mesh_from_spec("data=4,model=2")
+    assert m.shape == {DATA_AXIS: 4, MODEL_AXIS: 2}
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        mesh_from_spec("bogus=2")
+    with pytest.raises(ValueError, match="needs"):
+        make_mesh(data=64)

@@ -101,3 +101,31 @@ def test_nan_metric_stored_as_is_nan(tmp_path):
     rows = store.query(
         "SELECT value, is_nan FROM metrics ORDER BY step")
     assert rows == [(1.0, 0), (0.0, 1), (3.0, 0)]
+
+
+def test_relog_series_replaces_not_duplicates(tmp_path):
+    from coda_tpu.tracking import TrackingStore
+
+    store = TrackingStore(str(tmp_path / "db.sqlite"))
+    with store.run("exp", "run") as r:
+        r.log_metric_series("m", [1.0, 2.0])
+        uuid = r.run_uuid
+    # reuse the run (e.g. --force-rerun) and re-log
+    with store.run("exp", "run") as r2:
+        assert r2.run_uuid == uuid
+        r2.log_metric_series("m", [5.0, 6.0])
+    assert store.metric_series(uuid, "m") == [(1, 5.0), (2, 6.0)]
+
+
+def test_metric_series_reconstitutes_nan(tmp_path):
+    import math
+
+    from coda_tpu.tracking import TrackingStore
+
+    store = TrackingStore(str(tmp_path / "db.sqlite"))
+    with store.run("exp", "run") as r:
+        r.log_metric_series("m", [1.0, float("nan")])
+        uuid = r.run_uuid
+    series = store.metric_series(uuid, "m")
+    assert series[0] == (1, 1.0)
+    assert series[1][0] == 2 and math.isnan(series[1][1])
