@@ -59,6 +59,11 @@ def parse_args(argv=None):
                    help="Acquisition function {eig, iid, uncertainty} (ablation 2).")
 
     # TPU execution settings (no reference equivalent)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable intra-run checkpoint/resume under this dir "
+                        "(seeds run serially, resuming from the last chunk)")
+    p.add_argument("--checkpoint-every", type=int, default=25,
+                   help="rounds between checkpoints (with --checkpoint-dir)")
     p.add_argument("--eig-chunk", type=int, default=1024,
                    help="lax.map batch size for the EIG scoring pass.")
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
@@ -77,16 +82,18 @@ def load_dataset(args):
                                    name=args.task or f"synthetic_{H}x{N}x{C}")
     if args.task is None:
         raise SystemExit("--task or --synthetic is required")
-    for ext in (".npy", ".npz", ".pt"):
-        fp = os.path.join(args.data_dir, args.task + ext)
-        if os.path.exists(fp):
-            sharding = None
-            if args.mesh:
-                from coda_tpu.parallel import mesh_from_spec, preds_sharding
+    from coda_tpu.data import find_task_file
 
-                sharding = preds_sharding(mesh_from_spec(args.mesh))
-            return Dataset.from_file(fp, sharding=sharding, name=args.task)
-    raise SystemExit(f"No data file for task '{args.task}' under {args.data_dir}/")
+    fp = find_task_file(args.data_dir, args.task)
+    if fp is None:
+        raise SystemExit(
+            f"No data file for task '{args.task}' under {args.data_dir}/")
+    sharding = None
+    if args.mesh:
+        from coda_tpu.parallel import mesh_from_spec, preds_sharding
+
+        sharding = preds_sharding(mesh_from_spec(args.mesh))
+    return Dataset.from_file(fp, sharding=sharding, name=args.task)
 
 
 def build_selector(args, dataset):
@@ -154,8 +161,27 @@ def main(argv=None):
     selector = build_selector(args, dataset)
 
     t0 = time.perf_counter()
-    result = run_seeds(selector, dataset, iters=args.iters, seeds=args.seeds,
-                       loss_fn=loss_fn, model_losses=model_losses)
+    if args.checkpoint_dir:
+        # resumable path: seeds run serially, each checkpointing its chunked
+        # scan under <dir>/seed_<s> (new capability; the reference's resume
+        # granularity is the whole seed-run, main.py:155-157)
+        from coda_tpu.engine import make_resumable_runner
+
+        runner = make_resumable_runner(
+            selector, dataset.labels, model_losses, iters=args.iters,
+            every=args.checkpoint_every, dataset_id=dataset.name,
+        )
+        per_seed = [
+            runner(s, os.path.join(args.checkpoint_dir, f"seed_{s}"))
+            for s in range(args.seeds)
+        ]
+        import jax.numpy as jnp
+
+        result = jax.tree.map(lambda *xs: jnp.stack(xs), *per_seed)
+    else:
+        result = run_seeds(selector, dataset, iters=args.iters,
+                           seeds=args.seeds, loss_fn=loss_fn,
+                           model_losses=model_losses)
     result.regret.block_until_ready()
     wall = time.perf_counter() - t0
     steps = args.iters * args.seeds

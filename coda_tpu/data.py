@@ -29,6 +29,29 @@ import jax.numpy as jnp
 import numpy as np
 
 
+DATA_EXTS = (".npy", ".npz", ".pt")
+
+
+def find_task_file(data_dir: str, task: str) -> Optional[str]:
+    """Path of ``<data_dir>/<task>.{npy,npz,pt}``, or None."""
+    for ext in DATA_EXTS:
+        fp = os.path.join(data_dir, task + ext)
+        if os.path.exists(fp):
+            return fp
+    return None
+
+
+def list_tasks(data_dir: str) -> list[str]:
+    """Task names with a prediction tensor under ``data_dir`` (label files
+    excluded), sorted."""
+    tasks = set()
+    for f in os.listdir(data_dir):
+        base, ext = os.path.splitext(f)
+        if ext in DATA_EXTS and not base.endswith("_labels"):
+            tasks.add(base)
+    return sorted(tasks)
+
+
 def _load_array(filepath: str) -> np.ndarray:
     """Load a dense array from .npy/.npz/.pt into host memory (numpy)."""
     if filepath.endswith(".npy"):

@@ -1,3 +1,31 @@
-from coda_tpu.engine.loop import ExperimentResult, run_experiment, run_seeds
+from coda_tpu.engine.loop import (
+    ExperimentResult,
+    make_step_fn,
+    run_experiment,
+    run_seeds,
+)
 
-__all__ = ["ExperimentResult", "run_experiment", "run_seeds"]
+_CHECKPOINT_EXPORTS = (
+    "ExperimentCheckpointer",
+    "latest_step",
+    "make_resumable_runner",
+    "run_experiment_resumable",
+)
+
+__all__ = [
+    "ExperimentResult",
+    "make_step_fn",
+    "run_experiment",
+    "run_seeds",
+    *_CHECKPOINT_EXPORTS,
+]
+
+
+def __getattr__(name):
+    # checkpoint.py pulls in orbax; keep it lazy so the core experiment path
+    # works on installs without orbax-checkpoint
+    if name in _CHECKPOINT_EXPORTS:
+        from coda_tpu.engine import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(name)

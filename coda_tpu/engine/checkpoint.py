@@ -1,0 +1,257 @@
+"""Intra-run checkpoint / resume for labeling experiments (orbax-backed).
+
+The reference has **no** intra-run checkpointing: selector state (Dirichlet
+posteriors, labeled set) lives only in process memory, and resume granularity
+is the whole seed-run via MLflow run status (reference ``main.py:155-157``;
+see SURVEY.md §5 "Checkpoint / resume"). Here the selector state is already a
+fixed-shape pytree, so a checkpoint is just that pytree plus the position in
+the per-round RNG key table and the partial metric traces — tiny next to the
+``(H, N, C)`` prediction tensor, which is *not* checkpointed (it is
+deterministic input data, reloaded from the dataset file).
+
+Execution model: the ``iters``-round experiment runs as a sequence of jitted
+``lax.scan`` chunks of ``every`` rounds. After each chunk the carry (state,
+cumulative regret) and the filled trace prefix are saved under
+``<dir>/step_<r>``. On restart, the newest usable checkpoint is restored and
+the scan continues from round ``r`` — replaying nothing, and producing
+bitwise-identical traces to an uninterrupted run because the per-round keys
+come from the same ``jax.random.split`` table (prefix-stable, so a resume
+with a *smaller* ``iters`` restores an earlier checkpoint and is still
+exact). A fingerprint of the selector configuration is saved alongside and
+validated on resume, so checkpoints from a different method/hyperparams/
+dataset shape fail loudly instead of blending two configs into one trace.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import zlib
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import orbax.checkpoint as ocp
+
+from coda_tpu.engine.loop import ExperimentResult, make_step_fn
+from coda_tpu.selectors.protocol import Selector
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_FINGERPRINT = "fingerprint.json"
+
+
+def _saved_steps(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(
+        int(m.group(1))
+        for m in map(_STEP_RE.match, os.listdir(ckpt_dir))
+        if m
+    )
+
+
+def latest_step(ckpt_dir: str, at_most: Optional[int] = None) -> Optional[int]:
+    """The largest checkpointed round (optionally ≤ ``at_most``), or None."""
+    steps = _saved_steps(ckpt_dir)
+    if at_most is not None:
+        steps = [s for s in steps if s <= at_most]
+    return max(steps) if steps else None
+
+
+class ExperimentCheckpointer:
+    """Saves/restores the experiment pytree at round boundaries.
+
+    Crash-safety comes from orbax's atomic tmp-dir-then-rename save; a
+    partial save never appears under the final ``step_<r>`` name.
+    """
+
+    def __init__(self, ckpt_dir: str, keep: int = 2):
+        self.ckpt_dir = os.path.abspath(ckpt_dir)
+        self.keep = keep
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def save(self, round_: int, tree) -> None:
+        path = os.path.join(self.ckpt_dir, f"step_{round_}")
+        if os.path.exists(path):  # stale complete save from an older run
+            shutil.rmtree(path)
+        self._ckptr.save(path, tree)
+        self._gc()
+
+    def restore(self, round_: int):
+        return self._ckptr.restore(
+            os.path.join(self.ckpt_dir, f"step_{round_}")
+        )
+
+    def _gc(self) -> None:
+        steps = _saved_steps(self.ckpt_dir)
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(os.path.join(self.ckpt_dir, f"step_{s}"),
+                          ignore_errors=True)
+
+
+def _fingerprint(selector: Selector, labels, seed: int,
+                 dataset_id: Optional[str] = None) -> dict:
+    # labels CRC distinguishes same-shape tasks (e.g. the two cifar10_* tasks
+    # have identical (H, N, C)); dataset_id catches renamed runs of the same
+    # labels with different prediction tensors
+    return {
+        "selector": selector.name,
+        "hyperparams": {k: repr(v)
+                        for k, v in sorted(selector.hyperparams.items())},
+        "n_points": int(labels.shape[0]),
+        "labels_crc32": int(zlib.crc32(
+            np.ascontiguousarray(np.asarray(labels)).tobytes())),
+        "dataset": dataset_id,
+        "seed": int(seed),
+    }
+
+
+def _check_fingerprint(ckpt_dir: str, fp: dict) -> None:
+    path = os.path.join(ckpt_dir, _FINGERPRINT)
+    if os.path.exists(path):
+        with open(path) as f:
+            saved = json.load(f)
+        if saved != fp:
+            raise ValueError(
+                f"checkpoint dir {ckpt_dir!r} was written by a different "
+                f"configuration:\n  saved:   {saved}\n  current: {fp}\n"
+                "Use a fresh --checkpoint-dir (or delete this one)."
+            )
+    else:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(fp, f, indent=2)
+
+
+_TRACE_NAMES = ("chosen_idx", "true_class", "best_model", "regret",
+                "cumulative_regret", "select_prob")
+_TRACE_DTYPES = (np.int32, np.int32, np.int32, np.float32, np.float32,
+                 np.float32)
+
+
+def make_resumable_runner(
+    selector: Selector,
+    labels: jnp.ndarray,
+    model_losses: jnp.ndarray,
+    iters: int,
+    every: int = 25,
+    dataset_id: Optional[str] = None,
+) -> Callable[[int, str], ExperimentResult]:
+    """Build ``run(seed, ckpt_dir) -> ExperimentResult`` with shared jits.
+
+    The chunk scan and init are compiled once and reused across all seeds
+    (keys are jit *arguments*, not closure constants); only the ragged final
+    chunk adds a second chunk compilation.
+    """
+    N = labels.shape[0]
+    if iters > N:
+        raise ValueError(
+            f"iters={iters} exceeds the {N} labelable points; the unlabeled "
+            "set would be exhausted mid-run"
+        )
+    budget = selector.hyperparams.get("budget")
+    if budget is not None and iters > budget:
+        raise ValueError(
+            f"selector '{selector.name}' has a fixed label buffer of "
+            f"{budget} but iters={iters}; rebuild it with budget >= iters"
+        )
+    best_loss = model_losses.min()
+    step = make_step_fn(selector, labels, model_losses)
+
+    @jax.jit
+    def init_fn(k_init, k_prior):
+        state0 = selector.init(k_init)
+        best0, stoch0 = selector.best(state0, k_prior)
+        return state0, model_losses[best0] - best_loss, stoch0
+
+    @jax.jit
+    def chunk_fn(state, cum, keys):
+        (state, cum), outs = lax.scan(step, (state, cum), keys)
+        return state, cum, outs
+
+    # orbax restores pytrees as plain dicts; flatten the selector state for
+    # saving and unflatten against the init treedef on restore so custom
+    # containers (NamedTuples, dataclasses) survive the round-trip
+    state_treedef = jax.tree.structure(
+        jax.eval_shape(selector.init, jax.random.PRNGKey(0))
+    )
+
+    def run(seed: int, ckpt_dir: str) -> ExperimentResult:
+        key = jax.random.PRNGKey(seed)
+        k_init, k_prior, k_scan = jax.random.split(key, 3)
+        round_keys = jax.random.split(k_scan, iters)
+
+        _check_fingerprint(
+            ckpt_dir, _fingerprint(selector, labels, seed, dataset_id))
+        ckptr = ExperimentCheckpointer(ckpt_dir)
+        traces = {n: np.zeros(iters, d)
+                  for n, d in zip(_TRACE_NAMES, _TRACE_DTYPES)}
+
+        start = latest_step(ckpt_dir, at_most=iters)
+        if start is not None and start > 0:
+            restored = ckptr.restore(start)
+            leaves = [jnp.asarray(restored["state"][f"{i:04d}"])
+                      for i in range(len(restored["state"]))]
+            state = jax.tree.unflatten(state_treedef, leaves)
+            cum = jnp.asarray(restored["cum"])
+            regret0 = np.float32(restored["regret0"])
+            stoch = bool(restored["stochastic"])
+            for n in _TRACE_NAMES:
+                traces[n][:start] = restored["traces"][n][:start]
+        else:
+            start = 0
+            state, regret0, stoch0 = init_fn(k_init, k_prior)
+            cum = jnp.asarray(0.0, jnp.float32)
+            regret0 = np.float32(regret0)
+            stoch = bool(stoch0)
+
+        for lo in range(start, iters, every):
+            hi = min(lo + every, iters)
+            state, cum, outs = chunk_fn(state, cum, round_keys[lo:hi])
+            idxs, tcs, bests, regrets, cums, probs, stoch_c = outs
+            for n, arr in zip(_TRACE_NAMES,
+                              (idxs, tcs, bests, regrets, cums, probs)):
+                traces[n][lo:hi] = np.asarray(arr)
+            stoch = stoch or bool(np.asarray(stoch_c).any())
+            if hi < iters:  # final result needs no checkpoint
+                ckptr.save(hi, {
+                    "state": {f"{i:04d}": leaf for i, leaf
+                              in enumerate(jax.tree.leaves(state))},
+                    "cum": cum,
+                    "regret0": np.asarray(regret0, np.float32),
+                    "stochastic": np.asarray(stoch),
+                    "traces": traces,
+                })
+
+        return ExperimentResult(
+            chosen_idx=jnp.asarray(traces["chosen_idx"]),
+            true_class=jnp.asarray(traces["true_class"]),
+            best_model=jnp.asarray(traces["best_model"]),
+            regret=jnp.asarray(traces["regret"]),
+            cumulative_regret=jnp.asarray(traces["cumulative_regret"]),
+            select_prob=jnp.asarray(traces["select_prob"]),
+            regret_at_0=jnp.asarray(regret0),
+            stochastic=jnp.asarray(stoch or selector.always_stochastic),
+        )
+
+    return run
+
+
+def run_experiment_resumable(
+    selector: Selector,
+    labels: jnp.ndarray,
+    model_losses: jnp.ndarray,
+    iters: int,
+    seed: int,
+    ckpt_dir: str,
+    every: int = 25,
+    dataset_id: Optional[str] = None,
+) -> ExperimentResult:
+    """One-shot convenience wrapper around :func:`make_resumable_runner`."""
+    return make_resumable_runner(selector, labels, model_losses, iters,
+                                 every, dataset_id)(seed, ckpt_dir)

@@ -38,6 +38,35 @@ class ExperimentResult(NamedTuple):
     stochastic: jnp.ndarray    # scalar bool — did RNG affect the run?
 
 
+def make_step_fn(
+    selector: Selector,
+    labels: jnp.ndarray,
+    model_losses: jnp.ndarray,
+):
+    """One labeling round as a pure scan step.
+
+    ``carry = (selector state, cumulative regret)``; per-round outputs are
+    ``(idx, true_class, best, regret, cum, prob, stochastic)``. Shared by the
+    single-shot scan (`build_experiment_fn`) and the chunked resumable runner
+    (`coda_tpu.engine.checkpoint`), so both execute the identical program.
+    """
+    best_loss = model_losses.min()
+
+    def step(carry, k):
+        state, cum = carry
+        k_sel, k_best = jax.random.split(k)
+        res = selector.select(state, k_sel)
+        tc = labels[res.idx]
+        state = selector.update(state, res.idx, tc, res.prob)
+        best, b_stoch = selector.best(state, k_best)
+        regret = model_losses[best] - best_loss
+        cum = cum + regret
+        return (state, cum), (res.idx, tc, best, regret, cum, res.prob,
+                              res.stochastic | b_stoch)
+
+    return step
+
+
 def build_experiment_fn(
     selector: Selector,
     labels: jnp.ndarray,
@@ -59,23 +88,13 @@ def build_experiment_fn(
             f"{budget} but iters={iters}; rebuild it with budget >= iters"
         )
 
+    step = make_step_fn(selector, labels, model_losses)
+
     def experiment(key: jax.Array) -> ExperimentResult:
         k_init, k_prior, k_scan = jax.random.split(key, 3)
         state0 = selector.init(k_init)
         best0, stoch0 = selector.best(state0, k_prior)
         regret0 = model_losses[best0] - best_loss
-
-        def step(carry, k):
-            state, cum = carry
-            k_sel, k_best = jax.random.split(k)
-            res = selector.select(state, k_sel)
-            tc = labels[res.idx]
-            state = selector.update(state, res.idx, tc, res.prob)
-            best, b_stoch = selector.best(state, k_best)
-            regret = model_losses[best] - best_loss
-            cum = cum + regret
-            return (state, cum), (res.idx, tc, best, regret, cum, res.prob,
-                                  res.stochastic | b_stoch)
 
         keys = jax.random.split(k_scan, iters)
         (_, _), (idxs, tcs, bests, regrets, cums, probs, stoch) = lax.scan(

@@ -12,11 +12,8 @@ from __future__ import annotations
 
 import os
 import sqlite3
-import sys
 
 import pandas as pd
-
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 # canonical CODA config used in every reference figure (paper/tab1.py:60)
 CODA_NAME = "coda-lr=0.01-mult=2.0-no-prefilter"

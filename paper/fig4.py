@@ -65,14 +65,6 @@ def probe_task(path: str, ax_cm, ax_marginal, title: str):
     ax_marginal.legend(fontsize=8)
 
 
-def _find(data_dir: str, task: str):
-    for ext in (".npy", ".npz", ".pt"):
-        fp = os.path.join(data_dir, task + ext)
-        if os.path.exists(fp):
-            return fp
-    return None
-
-
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--tasks", default="civilcomments,glue_cola")
@@ -89,7 +81,9 @@ def main(argv=None):
     tasks = args.tasks.split(",")
     paths = []
     for t in tasks:
-        fp = _find(args.data_dir, t)
+        from coda_tpu.data import find_task_file
+
+        fp = find_task_file(args.data_dir, t)
         if fp is None:
             print(f"skipping {t}: no data file in {args.data_dir}")
             continue

@@ -61,7 +61,9 @@ def build_table(df, methods=GLOBAL_METHODS, groups=None) -> str:
             for i, m in enumerate(methods):
                 v = vals[i, j]
                 s = "--" if np.isnan(v) else f"{v:.1f}"
-                if best[j] == i:
+                if np.isnan(v):
+                    pass  # never highlight a missing cell
+                elif best[j] == i:
                     s = rf"\textbf{{{s}}}"
                 elif second[j] == i:
                     s = rf"\underline{{{s}}}"

@@ -20,6 +20,7 @@ import sys
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from coda_tpu.tracking import TrackingStore  # noqa: E402
+from coda_tpu.tracking.store import Run  # noqa: E402
 
 DEFAULT_METRICS = ["regret", "cumulative regret"]
 
@@ -39,7 +40,6 @@ def aggregate_metrics(store: TrackingStore, metric_keys=None, quiet=False):
         if not children:
             continue
         placeholders = ",".join("?" * len(children))
-        parent_run = store._conn  # direct batch write below
         for metric in metric_keys:
             rows = store.query(
                 f"""SELECT step, AVG(value) FROM metrics
@@ -49,8 +49,6 @@ def aggregate_metrics(store: TrackingStore, metric_keys=None, quiet=False):
             )
             if not rows:
                 continue
-            from coda_tpu.tracking.store import Run
-
             r = Run(store, parent_uuid)
             # write each mean at its actual step (the GROUP BY rows may have
             # gaps where every child logged NaN)
@@ -60,7 +58,7 @@ def aggregate_metrics(store: TrackingStore, metric_keys=None, quiet=False):
                 for step, v in rows:
                     print(f"[Exp {exp_name}] parent {parent_uuid[:8]} | "
                           f"step {step} mean_{metric} = {v:.6f}")
-        parent_run.commit()
+        store._conn.commit()
     return n_written
 
 

@@ -52,7 +52,10 @@ def delete_selected(db_path: str, tasks, methods, skip_confirm=False):
         method_clause = " OR ".join(
             ["t.value LIKE ?"] * len(methods))
         clauses.append(f"({method_clause})")
-        params += [f"%-{m}" for m in methods]
+        # substring match, like the reference janitor's `method in run_name`
+        # (reference scripts/clear_db.py:68) — canonical CODA runs carry
+        # hyperparam suffixes, e.g. `<task>-coda-lr=0.01-mult=2.0-no-prefilter`
+        params += [f"%-{m}%" for m in methods]
     where = " AND ".join(clauses) if clauses else "1=1"
 
     parents = store.query(

@@ -33,7 +33,7 @@ import time
 REPO = __file__.rsplit("/", 2)[0]
 sys.path.insert(0, REPO)
 
-DATA_EXTS = (".npy", ".npz", ".pt")
+from coda_tpu.data import list_tasks  # noqa: E402
 
 
 def decode_method_hparams(method: str) -> list[str]:
@@ -54,15 +54,6 @@ def decode_method_hparams(method: str) -> list[str]:
     if "-no-diag" in method:
         flags += ["--no-diag-prior"]
     return flags
-
-
-def list_tasks(pred_dir: str) -> list[str]:
-    tasks = set()
-    for f in os.listdir(pred_dir):
-        base, ext = os.path.splitext(f)
-        if ext in DATA_EXTS and not base.endswith("_labels"):
-            tasks.add(base)
-    return sorted(tasks)
 
 
 def run_needed(store, task: str, method: str, seeds: int) -> bool:

@@ -20,7 +20,9 @@ import subprocess
 import sys
 
 SCRIPTS = os.path.dirname(os.path.abspath(__file__))
-DATA_EXTS = (".npy", ".npz", ".pt")
+sys.path.insert(0, os.path.dirname(SCRIPTS))
+
+from coda_tpu.data import DATA_EXTS, list_tasks  # noqa: E402
 
 
 def main(argv=None):
@@ -41,11 +43,7 @@ def main(argv=None):
                 existing.add(os.path.splitext(k)[0] if k.endswith(DATA_EXTS)
                              else k)
 
-    tasks = sorted({
-        os.path.splitext(f)[0] for f in os.listdir(args.pred_dir)
-        if os.path.splitext(f)[1] in DATA_EXTS
-        and not os.path.splitext(f)[0].endswith("_labels")
-    })
+    tasks = list_tasks(args.pred_dir)
     todo = [t for t in tasks if t not in existing]
     if not todo:
         print("Nothing missing.")

@@ -35,8 +35,9 @@ DEFAULT_EPSILONS = ("0.35,0.36,0.37,0.38,0.39,0.40,0.41,0.42,0.43,0.44,"
 def majority_vote_labels(hard_preds: np.ndarray, C: int) -> np.ndarray:
     """(N, H) int -> (N,) majority class per point (smallest wins ties,
     matching the reference's np.unique-based vote)."""
-    votes = np.apply_along_axis(
-        lambda r: np.bincount(r, minlength=C), 1, hard_preds)
+    N = hard_preds.shape[0]
+    votes = np.zeros((N, C), np.int32)
+    np.add.at(votes, (np.arange(N)[:, None], hard_preds), 1)
     return votes.argmax(axis=1).astype(np.int32)
 
 
@@ -205,26 +206,22 @@ def main(argv=None):
     if args.task or args.preds:
         path = args.preds or None
         if args.task and not path:
-            for ext in (".npy", ".npz", ".pt"):
-                cand = os.path.join(args.pred_dir, args.task + ext)
-                if os.path.exists(cand):
-                    path = cand
-                    break
+            from coda_tpu.data import find_task_file
+
+            path = find_task_file(args.pred_dir, args.task)
         if not path:
             p.error(f"no prediction file for task {args.task}")
         search_one(args.task or os.path.basename(path), path)
     else:
-        files = sorted(
-            f for f in os.listdir(args.pred_dir)
-            if os.path.splitext(f)[1] in (".npy", ".npz", ".pt")
-            and not os.path.splitext(f)[0].endswith("_labels"))
-        if not files:
+        from coda_tpu.data import find_task_file, list_tasks
+
+        tasks = list_tasks(args.pred_dir)
+        if not tasks:
             p.error("no prediction files found")
-        for fname in files:
+        for t in tasks:
             # key by bare task name so --task and directory-mode runs share
             # the same resume entries
-            search_one(os.path.splitext(fname)[0],
-                       os.path.join(args.pred_dir, fname))
+            search_one(t, find_task_file(args.pred_dir, t))
 
 
 if __name__ == "__main__":
