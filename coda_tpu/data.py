@@ -58,7 +58,7 @@ def _load_array(filepath: str) -> np.ndarray:
         return np.load(filepath)
     if filepath.endswith(".npz"):
         with np.load(filepath) as z:
-            return z[z.files[0]]
+            return z["preds"] if "preds" in z.files else z[z.files[0]]
     if filepath.endswith(".pt"):
         try:
             import torch
@@ -116,9 +116,16 @@ class Dataset:
             preds = jnp.asarray(preds_np)
 
         labels = None
-        lp = _labels_path(filepath)
-        if os.path.exists(lp):
-            labels = jnp.asarray(_load_array(lp).astype(np.int32))
+        if filepath.endswith(".npz"):
+            # single-file native format: preds + labels in one npz (what the
+            # pool builder writes)
+            with np.load(filepath) as z:
+                if "labels" in z.files:
+                    labels = jnp.asarray(z["labels"].astype(np.int32))
+        if labels is None:
+            lp = _labels_path(filepath)
+            if os.path.exists(lp):
+                labels = jnp.asarray(_load_array(lp).astype(np.int32))
         task = name or os.path.splitext(os.path.basename(filepath))[0]
         return cls(preds=preds, labels=labels, name=task)
 

@@ -116,7 +116,14 @@ def _check_fingerprint(ckpt_dir: str, fp: dict) -> None:
     if os.path.exists(path):
         with open(path) as f:
             saved = json.load(f)
-        if saved != fp:
+        # Hyperparams added after a checkpoint was written (new fields with
+        # defaults, e.g. eig_mode) must not invalidate it: compare only the
+        # keys the saved fingerprint knows about. Everything else is strict.
+        saved_hp = saved.get("hyperparams", {})
+        cur = dict(fp, hyperparams={k: v
+                                    for k, v in fp["hyperparams"].items()
+                                    if k in saved_hp})
+        if saved != cur:
             raise ValueError(
                 f"checkpoint dir {ckpt_dir!r} was written by a different "
                 f"configuration:\n  saved:   {saved}\n  current: {fp}\n"

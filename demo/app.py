@@ -1,0 +1,317 @@
+"""Human-in-the-loop CODA demo: the user is the oracle.
+
+Capability parity with the reference Gradio app (reference ``demo/app.py``):
+pick the next most-informative item (``get_next_coda_image``,
+``demo/app.py:137-172``), let a human label it with one of the class buttons
+or skip with "I don't know" — which removes the point from the pool without
+updating beliefs (``demo/app.py:186-189``) — and show live charts of CODA's
+P(best) per model next to the models' true accuracies
+(``demo/app.py:212-301``). Deliberately wrong answers are allowed and, as in
+the reference, "may mislead the model selection process" (``demo/app.py:195``).
+
+Re-architected for this framework:
+
+  * no Gradio (not in the image): a dependency-free ``http.server`` JSON API
+    plus one self-contained HTML page with inline SVG charts;
+  * selector state is the pure-functional CODA state behind an
+    ``InteractiveSelector`` (the one consumer that genuinely needs a
+    host-driven incremental ``step()`` — SURVEY.md §7.6), jit-compiled once
+    at session start, so each click is a few compiled device calls;
+  * sessions are isolated objects keyed by a token — the reference keeps one
+    process-global session (``demo/app.py:86-92``).
+
+Run:  python demo/app.py [--task TASK --data-dir data] [--port 7860]
+Without a task file it falls back to a seeded synthetic pool so the demo
+always works offline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+# ----------------------------------------------------------------------------
+# session: one human-in-the-loop experiment
+# ----------------------------------------------------------------------------
+
+class DemoSession:
+    """One interactive CODA run over a (H, N, C) prediction pool."""
+
+    def __init__(self, preds, labels, class_names=None, model_names=None,
+                 seed: int = 0):
+        import jax.numpy as jnp
+
+        from coda_tpu.oracle import true_losses
+        from coda_tpu.selectors import CODAHyperparams, make_coda
+        from coda_tpu.selectors.protocol import InteractiveSelector
+
+        self.preds = np.asarray(preds, np.float32)
+        self.labels = None if labels is None else np.asarray(labels)
+        H, N, C = self.preds.shape
+        self.class_names = list(class_names or [f"class {c}" for c in range(C)])
+        self.model_names = list(model_names or [f"model {h}" for h in range(H)])
+        # demo hyperparams follow the reference's Args stub (demo/app.py:70-81)
+        self.selector = InteractiveSelector(
+            make_coda(jnp.asarray(self.preds), CODAHyperparams()), seed=seed
+        )
+        self.true_accs = (
+            None
+            if self.labels is None
+            else (1.0 - np.asarray(
+                true_losses(jnp.asarray(self.preds), jnp.asarray(self.labels))
+            )).tolist()
+        )
+        self.step = 0
+        self.skipped: list[int] = []
+        self.current_idx: int | None = None
+        self.current_prob = 0.0
+        self.lock = threading.Lock()
+
+    # -- the reference's get_next_coda_image (demo/app.py:137-172) -----------
+    def next_item(self) -> dict:
+        idx, prob = self.selector.get_next_item_to_label()
+        self.current_idx, self.current_prob = idx, prob
+        return self.state()
+
+    # -- the reference's check_answer (demo/app.py:174-210) ------------------
+    def answer(self, label) -> dict:
+        with self.lock:
+            idx = self.current_idx
+            if idx is None:
+                return self.state()
+            if label == "skip":
+                # "I don't know": drop the point, no belief update
+                # (reference demo/app.py:186-189)
+                self.selector.state = self.selector.state._replace(
+                    unlabeled=self.selector.state.unlabeled.at[idx].set(False)
+                )
+                self.skipped.append(idx)
+            else:
+                label = int(label)  # ValueError/TypeError -> HTTP 400
+                if not 0 <= label < len(self.class_names):
+                    raise ValueError(f"label {label} out of range")
+                self.selector.add_label(idx, label, self.current_prob)
+            self.step += 1
+            self.current_idx = None
+            return self.next_item()
+
+    def state(self) -> dict:
+        import jax
+
+        pbest = np.asarray(
+            jax.jit(self.selector.selector.extras["get_pbest"])(
+                self.selector.state
+            )
+        )
+        idx = self.current_idx
+        item_preds = (
+            None if idx is None else self.preds[:, idx, :].tolist()
+        )
+        true_label = (
+            None
+            if (self.labels is None or idx is None)
+            else int(self.labels[idx])
+        )
+        return {
+            "step": self.step,
+            "idx": idx,
+            "item_preds": item_preds,
+            "true_label": true_label,
+            "class_names": self.class_names,
+            "model_names": self.model_names,
+            "pbest": pbest.tolist(),
+            "true_accs": self.true_accs,
+            "best_model": int(np.argmax(pbest)),
+            "n_labeled": len(self.selector.labeled_idxs),
+            "n_skipped": len(self.skipped),
+        }
+
+
+# ----------------------------------------------------------------------------
+# HTTP server
+# ----------------------------------------------------------------------------
+
+# Bounded session table: each session holds a full host + device copy of the
+# prediction pool, so unbounded growth (one /api/start per page load) would
+# OOM on large pools. Oldest sessions are evicted FIFO past the cap — still
+# an upgrade over the reference's single process-global session
+# (reference demo/app.py:86-92).
+MAX_SESSIONS = 8
+_SESSIONS: dict[str, DemoSession] = {}  # insertion-ordered
+_FACTORY = None  # () -> DemoSession
+
+
+PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>CODA demo</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;max-width:70rem}
+ button{margin:.2rem;padding:.5rem 1rem;font-size:1rem;cursor:pointer}
+ .cols{display:flex;gap:2rem;flex-wrap:wrap}
+ .card{border:1px solid #ccc;border-radius:8px;padding:1rem;flex:1;min-width:20rem}
+ .bar{fill:#4a7dbd}.bar.best{fill:#d97706}.truebar{fill:#999}
+ td,th{padding:.15rem .5rem;text-align:right;font-variant-numeric:tabular-nums}
+ #status{color:#666}
+</style></head><body>
+<h2>CODA: consensus-driven active model selection — you are the oracle</h2>
+<p id="status">starting…</p>
+<div class="cols">
+ <div class="card"><h3>Label this item</h3>
+  <p>Item <span id="idx">—</span>. Which class is it?
+     (the true class is hidden; answer honestly — or don't, and watch CODA cope)</p>
+  <div id="buttons"></div>
+  <h4>Per-model predictions for this item</h4>
+  <div id="preds"></div></div>
+ <div class="card"><h3>CODA's belief: P(model is best)</h3>
+  <svg id="pbest" width="420" height="240"></svg>
+  <h3>True accuracy (hidden from CODA)</h3>
+  <svg id="accs" width="420" height="240"></svg></div>
+</div>
+<script>
+let token=null;
+async function api(path,body){
+ const r=await fetch(path,{method:body?"POST":"GET",
+   headers:{"Content-Type":"application/json"},
+   body:body?JSON.stringify(body):undefined});
+ return r.json();}
+function bars(svgId,vals,names,best){
+ const svg=document.getElementById(svgId);const W=420,H=240,m=4;
+ const bw=(H-20)/vals.length; const mx=Math.max(...vals,1e-9);
+ svg.innerHTML=vals.map((v,i)=>{
+  const w=(W-150)*v/mx;
+  return `<rect class="bar${i===best?' best':''}" x="130" y="${10+i*bw}" width="${w}" height="${bw-m}"></rect>`+
+   `<text x="125" y="${10+i*bw+bw/2}" text-anchor="end" font-size="11">${names[i]}</text>`+
+   `<text x="${135+w}" y="${10+i*bw+bw/2}" font-size="11">${v.toFixed(3)}</text>`;
+ }).join("");}
+function render(s){
+ document.getElementById("status").textContent=
+  `step ${s.step} — ${s.n_labeled} labeled, ${s.n_skipped} skipped — `+
+  `CODA's current pick: ${s.model_names[s.best_model]}`;
+ document.getElementById("idx").textContent=s.idx;
+ const bt=document.getElementById("buttons");
+ bt.innerHTML=s.class_names.map((c,i)=>
+   `<button onclick="answer(${i})">${c}</button>`).join("")+
+   `<button onclick="answer('skip')" style="background:#eee">I don't know</button>`;
+ if(s.item_preds){
+  const rows=s.model_names.map((m,h)=>`<tr><th>${m}</th>`+
+    s.item_preds[h].map(p=>`<td>${p.toFixed(2)}</td>`).join("")+`</tr>`);
+  document.getElementById("preds").innerHTML=
+   `<table><tr><th></th>${s.class_names.map(c=>`<th>${c}</th>`).join("")}</tr>`+
+   rows.join("")+`</table>`;}
+ bars("pbest",s.pbest,s.model_names,s.best_model);
+ if(s.true_accs) bars("accs",s.true_accs,s.model_names,
+   s.true_accs.indexOf(Math.max(...s.true_accs)));}
+async function answer(l){render(await api("/api/answer",{token,label:l}));}
+(async()=>{const s=await api("/api/start",{});token=s.token;render(s.state);})();
+</script></body></html>
+"""
+
+
+class Handler(BaseHTTPRequestHandler):
+    def _json(self, obj, code: int = 200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_GET(self):
+        if self.path in ("/", "/index.html"):
+            body = PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        try:
+            self._do_post()
+        except (ValueError, TypeError, KeyError) as e:
+            # malformed JSON / non-integer label / missing field -> 400,
+            # never a dropped connection
+            self._json({"error": f"bad request: {e}"}, 400)
+
+    def _do_post(self):
+        n = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(n) or b"{}")
+        if self.path == "/api/start":
+            sess = _FACTORY()
+            token = secrets.token_hex(8)
+            _SESSIONS[token] = sess
+            while len(_SESSIONS) > MAX_SESSIONS:
+                _SESSIONS.pop(next(iter(_SESSIONS)))
+            self._json({"token": token, "state": sess.next_item()})
+        elif self.path == "/api/answer":
+            sess = _SESSIONS.get(req.get("token", ""))
+            if sess is None:
+                self._json({"error": "unknown session"}, 400)
+            else:
+                self._json(sess.answer(req.get("label")))
+        elif self.path == "/api/state":
+            sess = _SESSIONS.get(req.get("token", ""))
+            if sess is None:
+                self._json({"error": "unknown session"}, 400)
+            else:
+                self._json(sess.state())
+        else:
+            self._json({"error": "not found"}, 404)
+
+
+def make_server(factory, port: int = 0) -> ThreadingHTTPServer:
+    """Build the HTTP server; ``port=0`` picks a free port (for tests)."""
+    global _FACTORY
+    _FACTORY = factory
+    return ThreadingHTTPServer(("127.0.0.1", port), Handler)
+
+
+def default_factory(args):
+    def factory() -> DemoSession:
+        from coda_tpu.cli import load_dataset
+
+        if args.task:
+            ds = load_dataset(args)
+            return DemoSession(ds.preds, ds.labels)
+        # offline fallback: small seeded pool, 3 models x 5 classes like the
+        # reference's iWildCam subset (demo/app.py README)
+        from coda_tpu.data import make_synthetic_task
+
+        task = make_synthetic_task(seed=0, H=3, N=200, C=5)
+        return DemoSession(
+            task.preds, task.labels,
+            class_names=[f"species {c}" for c in range(5)],
+            model_names=["clip-vit-l", "siglip2", "bioclip"],
+        )
+
+    return factory
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--task", default=None)
+    p.add_argument("--data-dir", default="data")
+    p.add_argument("--synthetic", default=None)
+    p.add_argument("--port", type=int, default=7860)
+    args = p.parse_args(argv)
+
+    srv = make_server(default_factory(args), args.port)
+    print(f"CODA demo on http://127.0.0.1:{srv.server_address[1]}/")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,219 @@
+"""Build a candidate model pool: zero-shot predictions -> (H, N, C) tensor.
+
+Capability parity with the reference pool builder (reference
+``demo/hf_zeroshot.py``): run several zero-shot image classifiers over an
+image folder, write one JSON of per-image class scores per model with
+skip-if-exists resume (``demo/hf_zeroshot.py:244-246``), degrade to a uniform
+distribution when a model fails on an image (``:108-110,162``), then assemble
+all model outputs into the dense prediction tensor the selectors consume.
+
+TPU-first differences from the reference:
+
+  * model backends are a small registry of callables instead of three
+    hard-coded branches (CLIP via the generic transformers pipeline
+    ``:170-219``, SigLIP via manual processor+softmax ``:118-168``, BioCLIP
+    via pybioclip ``:71-116``); backends whose libraries are missing are
+    *gated*, not errors, so the builder runs in this image (transformers is
+    present; pybioclip/open_clip are not);
+  * the assembled pool is saved as ``<task>.npz`` (preds + labels), the
+    native format of ``coda_tpu.data.Dataset`` — host-side IO stays NumPy,
+    device work stays in the selectors;
+  * ``build_pool`` accepts injected scorer callables, so tests exercise the
+    full resume/fallback/assembly logic offline with fake models.
+
+CLI:  python demo/hf_zeroshot.py --images-dir D --classes a b c --out task
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Callable, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# model registry: name -> factory returning score_image(path, classes) -> list
+# ---------------------------------------------------------------------------
+
+# the reference's candidate pool (demo/hf_zeroshot.py:46-50)
+DEFAULT_MODELS = [
+    "openai/clip-vit-large-patch14",
+    "google/siglip2-base-patch16-224",
+    "imageomics/bioclip",
+]
+
+
+def _hf_pipeline_scorer(model_name: str) -> Callable:
+    """Generic transformers zero-shot pipeline (reference ``:170-219``).
+
+    Handles both CLIP-style and SigLIP-style checkpoints; transformers picks
+    the right processor. Raises ImportError when transformers is missing.
+    """
+    from transformers import pipeline
+
+    pipe = pipeline("zero-shot-image-classification", model=model_name)
+
+    def score(image_path: str, classes: Sequence[str]) -> list[float]:
+        out = pipe(image_path, candidate_labels=list(classes))
+        by_label = {o["label"]: float(o["score"]) for o in out}
+        scores = np.array([by_label.get(c, 0.0) for c in classes], np.float64)
+        total = scores.sum()
+        return (scores / total if total > 0 else
+                np.full(len(classes), 1.0 / len(classes))).tolist()
+
+    return score
+
+
+def _bioclip_scorer(model_name: str) -> Callable:
+    """BioCLIP via pybioclip (reference ``:71-116``); gated on the import."""
+    from bioclip import CustomLabelsClassifier  # not in this image: gated
+
+    clf_cache: dict[tuple, object] = {}
+
+    def score(image_path: str, classes: Sequence[str]) -> list[float]:
+        # build the classifier once per class list, not once per image
+        key = tuple(classes)
+        if key not in clf_cache:
+            clf_cache[key] = CustomLabelsClassifier(list(classes))
+        out = clf_cache[key].predict(image_path)
+        by_label = {o["classification"]: float(o["score"]) for o in out}
+        return [by_label.get(c, 0.0) for c in classes]
+
+    return score
+
+
+def make_scorer(model_name: str) -> Callable:
+    if "bioclip" in model_name.lower():
+        return _bioclip_scorer(model_name)
+    return _hf_pipeline_scorer(model_name)
+
+
+# ---------------------------------------------------------------------------
+# pool building
+# ---------------------------------------------------------------------------
+
+IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".webp", ".bmp")
+
+
+def list_images(images_dir: str) -> list[str]:
+    return sorted(
+        os.path.join(images_dir, f)
+        for f in os.listdir(images_dir)
+        if f.lower().endswith(IMAGE_EXTS)
+    )
+
+
+def run_model(
+    model_name: str,
+    images: Sequence[str],
+    classes: Sequence[str],
+    out_dir: str,
+    scorer: Callable | None = None,
+) -> str:
+    """Score every image with one model -> ``<out_dir>/<model>.json``.
+
+    Resumes by skipping models whose output file already exists (reference
+    ``demo/hf_zeroshot.py:244-246``); falls back to a uniform distribution
+    for images the model fails on (``:108-110,162``).
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, model_name.replace("/", "__") + ".json")
+    if os.path.exists(out_path):
+        return out_path
+
+    if scorer is None:
+        scorer = make_scorer(model_name)
+    uniform = [1.0 / len(classes)] * len(classes)
+    results = {}
+    for img in images:
+        try:
+            results[os.path.basename(img)] = scorer(img, classes)
+        except Exception as e:  # per-image failure -> uniform (reference)
+            print(f"[pool] {model_name} failed on {img}: {e}")
+            results[os.path.basename(img)] = uniform
+
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"model": model_name, "classes": list(classes),
+                   "scores": results}, f)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def assemble_pool(
+    json_paths: Sequence[str],
+    images: Sequence[str],
+    classes: Sequence[str],
+    out_path: str,
+    labels: Sequence[int] | None = None,
+) -> np.ndarray:
+    """Stack per-model JSONs into the dense fp32 ``(H, N, C)`` tensor and
+    save it (plus optional labels) as ``.npz`` for ``Dataset.from_file``."""
+    H, N, C = len(json_paths), len(images), len(classes)
+    preds = np.full((H, N, C), 1.0 / C, np.float32)
+    names = [os.path.basename(p) for p in images]
+    for h, jp in enumerate(json_paths):
+        with open(jp) as f:
+            data = json.load(f)
+        assert data["classes"] == list(classes), (
+            f"{jp}: class list mismatch vs pool"
+        )
+        for n, name in enumerate(names):
+            if name in data["scores"]:
+                preds[h, n] = np.asarray(data["scores"][name], np.float32)
+    out = {"preds": preds}
+    if labels is not None:
+        out["labels"] = np.asarray(labels, np.int64)
+    np.savez(out_path, **out)
+    return preds
+
+
+def build_pool(
+    images_dir: str,
+    classes: Sequence[str],
+    out: str,
+    models: Sequence[str] = tuple(DEFAULT_MODELS),
+    scorers: dict[str, Callable] | None = None,
+    labels: Sequence[int] | None = None,
+    results_dir: str | None = None,
+) -> np.ndarray:
+    """End-to-end: score all models (resumable), assemble, save ``<out>.npz``.
+
+    Models whose backend libraries are unavailable are skipped with a notice
+    rather than failing the build — the pool is whatever subset ran.
+    """
+    images = list_images(images_dir)
+    if not images:
+        raise ValueError(f"no images found under {images_dir}")
+    results_dir = results_dir or (out + "_results")
+    json_paths = []
+    for m in models:
+        try:
+            scorer = (scorers or {}).get(m)
+            json_paths.append(run_model(m, images, classes, results_dir,
+                                        scorer=scorer))
+        except ImportError as e:
+            print(f"[pool] skipping {m}: backend unavailable ({e})")
+    if not json_paths:
+        raise RuntimeError("no model backend available; nothing scored")
+    return assemble_pool(json_paths, images, classes, out + ".npz",
+                         labels=labels)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--images-dir", required=True)
+    p.add_argument("--classes", nargs="+", required=True)
+    p.add_argument("--out", required=True,
+                   help="output task path (writes <out>.npz)")
+    p.add_argument("--models", nargs="+", default=DEFAULT_MODELS)
+    args = p.parse_args(argv)
+    preds = build_pool(args.images_dir, args.classes, args.out,
+                       models=args.models)
+    print(f"pool shape {preds.shape} -> {args.out}.npz")
+
+
+if __name__ == "__main__":
+    main()

@@ -175,6 +175,42 @@ def test_coda_lockstep_trace_parity(task, ref_ds):
                                    rtol=1e-4, atol=1e-6)
 
 
+def test_coda_factored_eig_lockstep_parity(task, ref_ds):
+    """The MXU-factored EIG kernel (the production path at scale) must match
+    the reference's EIG vectors in lockstep, same as the direct kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.coda import eig_scores_factored
+
+    labels_np = np.asarray(task.labels)
+    ref = _fresh_ref_coda(ref_ds)
+    sel = _ours_coda(task)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    hard_preds = jnp.argmax(task.preds, -1).T.astype(jnp.int32)
+
+    eig_jit = jax.jit(
+        lambda s: eig_scores_factored(
+            s.dirichlets, s.pi_hat, s.pi_hat_xi, hard_preds, chunk=16
+        )
+    )
+    update_jit = jax.jit(sel.update)
+
+    for rnd in range(4):
+        ref_q, ref_cand = ref.eig_batched()
+        ref_q = ref_q.numpy()
+        ours_q = np.asarray(eig_jit(state))[np.asarray(ref_cand)]
+        np.testing.assert_allclose(ours_q, ref_q, rtol=5e-4, atol=1e-5,
+                                   err_msg=f"factored EIG mismatch @ {rnd}")
+        assert int(np.argmax(ours_q)) == int(np.argmax(ref_q)), rnd
+
+        idx = int(ref_cand[int(np.argmax(ref_q))])
+        tc = int(labels_np[idx])
+        ref.add_label(idx, tc, float(ref_q.max()))
+        state = update_jit(state, jnp.asarray(idx), jnp.asarray(tc),
+                           jnp.asarray(0.0))
+
+
 def test_coda_independent_trace_parity(task, ref_ds):
     """Full independent runs must produce the same selection + best-model
     sequence (both greedy; the task has no EIG ties)."""
