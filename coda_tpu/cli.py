@@ -70,12 +70,29 @@ def parse_args(argv=None):
                    help="shard the (H,N,C) tensor, e.g. 'data=4' or 'data=4,model=2'")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (cpu/tpu), e.g. for local runs")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler device trace of the compiled "
+                        "run into this directory (TensorBoard/Perfetto)")
+    p.add_argument("--debug-viz", action="store_true",
+                   help="log P(best) / regret-curve charts as artifacts to "
+                        "the tracking store (reference _DEBUG_VIZ analog)")
     return p.parse_args(argv)
 
 
 def load_dataset(args):
+    """Load the dataset an argparse namespace points at.
+
+    Only ``task`` / ``data_dir`` / ``synthetic`` / ``mesh`` are read, with
+    getattr defaults so partial namespaces (e.g. the demo's parser) work.
+    """
     from coda_tpu.data import Dataset, make_synthetic_task
 
+    args = argparse.Namespace(
+        task=getattr(args, "task", None),
+        data_dir=getattr(args, "data_dir", "data"),
+        synthetic=getattr(args, "synthetic", None),
+        mesh=getattr(args, "mesh", None),
+    )
     if args.synthetic:
         H, N, C = (int(x) for x in args.synthetic.split(","))
         return make_synthetic_task(seed=0, H=H, N=N, C=C,
@@ -133,6 +150,43 @@ def build_selector(args, dataset):
     raise SystemExit(f"{method} is not a supported method.")
 
 
+def _log_debug_viz(run, selector, result, seed: int, iters: int) -> None:
+    """Log end-of-run charts as artifacts (reference ``_DEBUG_VIZ`` analog,
+    ``coda/coda.py:299-303,337-341`` — which logs per-step bar charts; here
+    the per-step traces come out of the scan and the final P(best) is
+    recovered by replaying the recorded label sequence through the pure
+    ``update`` function, so nothing slows the compiled hot loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.utils.viz import plot_bar, plot_series
+
+    regret = np.asarray(result.regret)[seed]
+    cum = np.asarray(result.cumulative_regret)[seed]
+    run.log_figure(
+        "regret_curve",
+        plot_series([regret, cum], title=f"seed {seed}",
+                    ylabel="regret", labels=["regret", "cumulative"]),
+    )
+    get_pbest = selector.extras.get("get_pbest")
+    if get_pbest is None:
+        return
+    idxs = np.asarray(result.chosen_idx)[seed]
+    tcs = np.asarray(result.true_class)[seed]
+    state = jax.jit(selector.init)(jax.random.PRNGKey(seed))
+    update = jax.jit(selector.update)
+    for i in range(iters):
+        state = update(state, jnp.asarray(int(idxs[i])),
+                       jnp.asarray(int(tcs[i])), jnp.asarray(0.0))
+    pbest = np.asarray(jax.jit(get_pbest)(state))
+    run.log_figure(
+        "pbest",
+        plot_bar(pbest, title=f"P(best) after {iters} labels (seed {seed})",
+                 highlight=int(pbest.argmax()), xlabel="model",
+                 ylabel="P(best)"),
+    )
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.platform:
@@ -142,7 +196,6 @@ def main(argv=None):
 
     import jax
 
-    from coda_tpu.engine import run_seeds
     from coda_tpu.losses import LOSS_FNS
     from coda_tpu.oracle import true_losses
 
@@ -160,29 +213,15 @@ def main(argv=None):
 
     selector = build_selector(args, dataset)
 
+    from coda_tpu.utils.profiling import trace as profiler_trace
+
     t0 = time.perf_counter()
-    if args.checkpoint_dir:
-        # resumable path: seeds run serially, each checkpointing its chunked
-        # scan under <dir>/seed_<s> (new capability; the reference's resume
-        # granularity is the whole seed-run, main.py:155-157)
-        from coda_tpu.engine import make_resumable_runner
-
-        runner = make_resumable_runner(
-            selector, dataset.labels, model_losses, iters=args.iters,
-            every=args.checkpoint_every, dataset_id=dataset.name,
-        )
-        per_seed = [
-            runner(s, os.path.join(args.checkpoint_dir, f"seed_{s}"))
-            for s in range(args.seeds)
-        ]
-        import jax.numpy as jnp
-
-        result = jax.tree.map(lambda *xs: jnp.stack(xs), *per_seed)
-    else:
-        result = run_seeds(selector, dataset, iters=args.iters,
-                           seeds=args.seeds, loss_fn=loss_fn,
-                           model_losses=model_losses)
-    result.regret.block_until_ready()
+    with profiler_trace(args.profile_dir):
+        result = _run_all_seeds(args, selector, dataset, model_losses,
+                                loss_fn)
+        result.regret.block_until_ready()
+    if args.profile_dir:
+        print(f"Profiler trace written to {args.profile_dir}")
     wall = time.perf_counter() - t0
     steps = args.iters * args.seeds
     print(f"{steps} selection steps in {wall:.2f}s "
@@ -211,12 +250,43 @@ def main(argv=None):
                                params={"seed": s, "stochastic": bool(stoch[s])}) as r:
                     r.log_metric_series("regret", regrets[s], start_step=1)
                     r.log_metric_series("cumulative regret", cums[s], start_step=1)
+                    if args.debug_viz:
+                        _log_debug_viz(r, selector, result, s, args.iters)
                 if not stoch[s]:
                     print("Method is not stochastic for this task. "
                           "Remaining seeds are identical.")
                     break
         print(f"Logged to {args.tracking_db}")
 
+    return result
+
+
+def _run_all_seeds(args, selector, dataset, model_losses, loss_fn):
+    import jax
+
+    from coda_tpu.engine import run_seeds
+
+    if args.checkpoint_dir:
+        # resumable path: seeds run serially, each checkpointing its chunked
+        # scan under <dir>/seed_<s> (new capability; the reference's resume
+        # granularity is the whole seed-run, main.py:155-157)
+        from coda_tpu.engine import make_resumable_runner
+
+        runner = make_resumable_runner(
+            selector, dataset.labels, model_losses, iters=args.iters,
+            every=args.checkpoint_every, dataset_id=dataset.name,
+        )
+        per_seed = [
+            runner(s, os.path.join(args.checkpoint_dir, f"seed_{s}"))
+            for s in range(args.seeds)
+        ]
+        import jax.numpy as jnp
+
+        result = jax.tree.map(lambda *xs: jnp.stack(xs), *per_seed)
+    else:
+        result = run_seeds(selector, dataset, iters=args.iters,
+                           seeds=args.seeds, loss_fn=loss_fn,
+                           model_losses=model_losses)
     return result
 
 

@@ -103,6 +103,9 @@ def _fingerprint(selector: Selector, labels, seed: int,
         "selector": selector.name,
         "hyperparams": {k: repr(v)
                         for k, v in sorted(selector.hyperparams.items())},
+        "_hyperparam_defaults": {
+            k: repr(v) for k, v in sorted(selector.hyperparam_defaults.items())
+        },
         "n_points": int(labels.shape[0]),
         "labels_crc32": int(zlib.crc32(
             np.ascontiguousarray(np.asarray(labels)).tobytes())),
@@ -117,13 +120,21 @@ def _check_fingerprint(ckpt_dir: str, fp: dict) -> None:
         with open(path) as f:
             saved = json.load(f)
         # Hyperparams added after a checkpoint was written (new fields with
-        # defaults, e.g. eig_mode) must not invalidate it: compare only the
-        # keys the saved fingerprint knows about. Everything else is strict.
+        # defaults, e.g. eig_mode) must not invalidate it — but ONLY while
+        # the new field sits at its construction default; an explicit
+        # override of a field the checkpoint predates is a real mismatch.
         saved_hp = saved.get("hyperparams", {})
-        cur = dict(fp, hyperparams={k: v
-                                    for k, v in fp["hyperparams"].items()
-                                    if k in saved_hp})
-        if saved != cur:
+        defaults = fp.get("_hyperparam_defaults", {})
+        cur_hp = {
+            k: v for k, v in fp["hyperparams"].items()
+            if k in saved_hp or v != defaults.get(k, object())
+        }
+        cur = dict(fp, hyperparams=cur_hp)
+        saved_cmp = {k: v for k, v in saved.items()
+                     if k != "_hyperparam_defaults"}
+        cur_cmp = {k: v for k, v in cur.items()
+                   if k != "_hyperparam_defaults"}
+        if saved_cmp != cur_cmp:
             raise ValueError(
                 f"checkpoint dir {ckpt_dir!r} was written by a different "
                 f"configuration:\n  saved:   {saved}\n  current: {fp}\n"

@@ -6,6 +6,7 @@ from coda_tpu.parallel.mesh import (
     preds_sharding,
     replicated,
 )
+from coda_tpu.parallel.distributed import initialize, is_primary
 
 __all__ = [
     "MODEL_AXIS",
@@ -14,4 +15,6 @@ __all__ = [
     "mesh_from_spec",
     "preds_sharding",
     "replicated",
+    "initialize",
+    "is_primary",
 ]

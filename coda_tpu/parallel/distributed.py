@@ -1,0 +1,62 @@
+"""Multi-host runtime: the distributed communication backend.
+
+The reference's only distribution mechanism is SLURM job fan-out with no
+inter-process communication (reference ``scripts/launch_all_methods.py:135-153``
+— srun is pure job placement; there is no NCCL/MPI anywhere in its tree).
+The TPU-native backend is ``jax.distributed`` + SPMD over a global mesh:
+
+  * every host calls :func:`initialize` (coordinator address + process id,
+    from flags or the TPU pod environment), after which ``jax.devices()``
+    spans the whole pod slice;
+  * the same jitted selector program then runs on a mesh over all global
+    devices — XLA inserts the collectives (psum/all-gather for the pi-hat
+    sums and P(best) normalization, a global argmax for selection), routed
+    over ICI within a slice and DCN across slices;
+  * there is deliberately NO hand-written send/recv layer: collective choice
+    and scheduling belong to the compiler (SURVEY.md §5 "distributed
+    communication backend").
+
+Single-process runs (tests, one chip, CPU) skip initialization entirely.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host runtime; returns True if distributed mode is on.
+
+    Arguments default to the standard environment (``JAX_COORDINATOR``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``; on TPU pods jax can infer all
+    three from the metadata server, so bare ``initialize()`` works there).
+    A single-process configuration is a no-op returning False.
+    """
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+
+    import jax
+
+    if num_processes <= 1 and coordinator_address is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def is_primary() -> bool:
+    """True on the process that should own logging / checkpoint writes."""
+    import jax
+
+    return jax.process_index() == 0

@@ -403,5 +403,6 @@ def make_coda(
         best=best,
         always_stochastic=False,
         hyperparams=dict(hp._asdict()),
+        hyperparam_defaults=dict(CODAHyperparams()._asdict()),
         extras={"get_pbest": get_pbest, "eig_scores": eig_scores},
     )

@@ -55,6 +55,10 @@ class Selector:
     # reference's `stochastic` early-stop (reference main.py:128-130).
     always_stochastic: bool = False
     hyperparams: dict = field(default_factory=dict)
+    # construction defaults of the hyperparams (e.g. Hyperparams()._asdict());
+    # lets checkpoints written before a hyperparam existed keep resuming —
+    # but only when the new field is at its default value
+    hyperparam_defaults: dict = field(default_factory=dict)
     # extra method-specific pure functions (e.g. CODA's get_pbest) for demos
     # and diagnostics; not part of the scan loop
     extras: dict = field(default_factory=dict)

@@ -143,6 +143,35 @@ class Run:
             rows,
         )
 
+    def log_artifact_bytes(self, name: str, data: bytes) -> str:
+        """Write ``data`` under this run's artifact dir; returns the path.
+
+        The artifact dir is ``<db>_artifacts/<run_uuid>/`` and is recorded in
+        the run's ``artifact_uri`` column (the MLflow convention the
+        reference's consumers expect to exist, reference ``main.py:101-103``
+        under ``_DEBUG_VIZ``).
+        """
+        import os
+
+        d = os.path.join(self.store.artifact_root, self.run_uuid)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, name)
+        with open(path, "wb") as f:
+            f.write(data)
+        self.store._conn.execute(
+            "UPDATE runs SET artifact_uri=? WHERE run_uuid=?",
+            (d, self.run_uuid),
+        )
+        return path
+
+    def log_figure(self, name: str, fig) -> str:
+        """Rasterize a matplotlib figure and log it as a PNG artifact."""
+        from coda_tpu.utils.viz import fig_to_png
+
+        if not name.endswith(".png"):
+            name += ".png"
+        return self.log_artifact_bytes(name, fig_to_png(fig))
+
     def finish(self, status: str = "FINISHED") -> None:
         self.store._conn.execute(
             "UPDATE runs SET status=?, end_time=? WHERE run_uuid=?",
@@ -162,6 +191,7 @@ class TrackingStore:
 
     def __init__(self, db_path: str = "coda.sqlite"):
         self.db_path = db_path
+        self.artifact_root = db_path + "_artifacts"
         parent = os.path.dirname(os.path.abspath(db_path))
         os.makedirs(parent, exist_ok=True)
         self._conn = sqlite3.connect(db_path, timeout=60.0)

@@ -1,0 +1,62 @@
+"""Tracing / profiling utilities.
+
+The reference has no profiling at all — only tqdm bars (SURVEY.md §5). Here
+profiling is first-class and nearly free:
+
+  * :func:`trace` wraps ``jax.profiler.trace`` so any compiled region can be
+    captured to a TensorBoard/Perfetto trace directory with one flag
+    (``main.py --profile-dir``);
+  * :class:`StepTimer` records host-side wall-clock per labeled region and
+    reports steps/sec — the per-step metrics the tracking store logs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Capture a device trace of the enclosed block into ``log_dir``.
+
+    No-op when ``log_dir`` is falsy, so call sites don't branch. View with
+    TensorBoard's profile plugin or Perfetto.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir, create_perfetto_trace=True):
+        yield
+
+
+class StepTimer:
+    """Accumulates named wall-clock spans; reports totals and rates."""
+
+    def __init__(self):
+        self.spans: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str, steps: int = 1):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.spans[name] = self.spans.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + steps
+
+    def rate(self, name: str) -> float:
+        """Steps/sec for a span (0.0 when never entered)."""
+        dt = self.spans.get(name, 0.0)
+        return self.counts.get(name, 0) / dt if dt > 0 else 0.0
+
+    def summary(self) -> dict[str, dict]:
+        return {
+            k: {"seconds": self.spans[k], "steps": self.counts[k],
+                "steps_per_sec": self.rate(k)}
+            for k in self.spans
+        }

@@ -1,0 +1,71 @@
+"""Debug visualization: bar charts of P(best) / acquisition scores.
+
+Capability parity with the reference's debug renderer (reference
+``coda/util.py:42-66`` ``plot_bar`` and the ``_DEBUG_VIZ`` hooks at
+``coda/coda.py:299-303,337-341`` that log EIG / P(best) bar charts per step).
+Host-side only — figures are rendered after compiled runs finish, never
+inside jit. Matplotlib uses the Agg backend so this works headless.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+
+def plot_bar(values, title: str = "", highlight: int | None = None,
+             xlabel: str = "", ylabel: str = ""):
+    """Bar chart of a 1-D score vector -> matplotlib Figure.
+
+    ``highlight`` draws one bar (e.g. the argmax / chosen model) in a
+    distinct color, like the reference's chosen-bar styling.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    values = np.asarray(values)
+    colors = ["tab:blue"] * len(values)
+    if highlight is not None:
+        colors[int(highlight)] = "tab:orange"
+    fig, ax = plt.subplots(figsize=(max(4, len(values) * 0.35), 3))
+    ax.bar(np.arange(len(values)), values, color=colors)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    fig.tight_layout()
+    return fig
+
+
+def plot_series(series, title: str = "", xlabel: str = "step",
+                ylabel: str = "", labels=None):
+    """Line plot of one or more per-step traces (e.g. regret curves)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    arr = np.atleast_2d(np.asarray(series))
+    fig, ax = plt.subplots(figsize=(5, 3))
+    for i, row in enumerate(arr):
+        ax.plot(np.arange(1, len(row) + 1), row,
+                label=None if labels is None else labels[i])
+    if labels is not None:
+        ax.legend(fontsize=8)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    fig.tight_layout()
+    return fig
+
+
+def fig_to_png(fig) -> bytes:
+    """Rasterize a figure to PNG bytes (for artifact logging)."""
+    buf = io.BytesIO()
+    fig.savefig(buf, format="png", dpi=120)
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+    return buf.getvalue()

@@ -72,6 +72,10 @@ class DemoSession:
         self.current_idx: int | None = None
         self.current_prob = 0.0
         self.lock = threading.Lock()
+        # compile once at session start; clicks reuse the executable
+        import jax
+
+        self._get_pbest = jax.jit(self.selector.selector.extras["get_pbest"])
 
     # -- the reference's get_next_coda_image (demo/app.py:137-172) -----------
     def next_item(self) -> dict:
@@ -102,13 +106,7 @@ class DemoSession:
             return self.next_item()
 
     def state(self) -> dict:
-        import jax
-
-        pbest = np.asarray(
-            jax.jit(self.selector.selector.extras["get_pbest"])(
-                self.selector.state
-            )
-        )
+        pbest = np.asarray(self._get_pbest(self.selector.state))
         idx = self.current_idx
         item_preds = (
             None if idx is None else self.preds[:, idx, :].tolist()
@@ -280,7 +278,7 @@ def default_factory(args):
     def factory() -> DemoSession:
         from coda_tpu.cli import load_dataset
 
-        if args.task:
+        if args.task or args.synthetic:
             ds = load_dataset(args)
             return DemoSession(ds.preds, ds.labels)
         # offline fallback: small seeded pool, 3 models x 5 classes like the

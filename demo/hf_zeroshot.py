@@ -118,8 +118,17 @@ def run_model(
     ``demo/hf_zeroshot.py:244-246``); falls back to a uniform distribution
     for images the model fails on (``:108-110,162``).
     """
+    import hashlib
+
     os.makedirs(out_dir, exist_ok=True)
-    out_path = os.path.join(out_dir, model_name.replace("/", "__") + ".json")
+    # resume key includes the class list: rerunning with different classes
+    # must re-score, not silently reuse stale per-model JSON
+    class_tag = hashlib.sha1(
+        "\x00".join(classes).encode()
+    ).hexdigest()[:8]
+    out_path = os.path.join(
+        out_dir, f"{model_name.replace('/', '__')}_{class_tag}.json"
+    )
     if os.path.exists(out_path):
         return out_path
 
@@ -157,9 +166,8 @@ def assemble_pool(
     for h, jp in enumerate(json_paths):
         with open(jp) as f:
             data = json.load(f)
-        assert data["classes"] == list(classes), (
-            f"{jp}: class list mismatch vs pool"
-        )
+        if data["classes"] != list(classes):
+            raise ValueError(f"{jp}: class list mismatch vs pool")
         for n, name in enumerate(names):
             if name in data["scores"]:
                 preds[h, n] = np.asarray(data["scores"][name], np.float32)

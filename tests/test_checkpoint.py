@@ -107,6 +107,37 @@ def test_fingerprint_mismatch_raises(setup, tmp_path):
                                  ckpt_dir=ckpt, every=3)
 
 
+def test_fingerprint_tolerates_new_default_field(setup, tmp_path):
+    """A checkpoint written before a hyperparam existed keeps resuming while
+    the new field is at its default — but an explicit override is rejected."""
+    import json
+
+    task, losses = setup
+    sel = make_coda(task.preds, CODAHyperparams(eig_chunk=16))
+    ckpt = str(tmp_path / "ck")
+    run_experiment_resumable(sel, task.labels, losses, iters=6, seed=0,
+                             ckpt_dir=ckpt, every=3)
+
+    # simulate a checkpoint from before eig_mode existed
+    fp_path = os.path.join(ckpt, "fingerprint.json")
+    with open(fp_path) as f:
+        saved = json.load(f)
+    del saved["hyperparams"]["eig_mode"]
+    with open(fp_path, "w") as f:
+        json.dump(saved, f)
+
+    # default value of the new field: resume is fine
+    run_experiment_resumable(sel, task.labels, losses, iters=6, seed=0,
+                             ckpt_dir=ckpt, every=3)
+
+    # explicit non-default override of the new field: real mismatch
+    sel_direct = make_coda(task.preds,
+                           CODAHyperparams(eig_chunk=16, eig_mode="direct"))
+    with pytest.raises(ValueError, match="different configuration"):
+        run_experiment_resumable(sel_direct, task.labels, losses, iters=6,
+                                 seed=0, ckpt_dir=ckpt, every=3)
+
+
 def test_budget_guard(setup, tmp_path):
     from coda_tpu.selectors import make_activetesting
 
