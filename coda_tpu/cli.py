@@ -113,7 +113,14 @@ def load_dataset(args):
     return Dataset.from_file(fp, sharding=sharding, name=args.task)
 
 
-def build_selector(args, dataset):
+def build_selector_factory(args, task_name: str):
+    """``preds -> Selector`` for the configured method.
+
+    Returned as a factory (not a built selector) so callers can construct the
+    selector *inside* a jitted function, keeping the prediction tensor a
+    traced argument instead of a captured constant
+    (see ``run_seeds_compiled``).
+    """
     from coda_tpu.selectors import (
         CODAHyperparams,
         SELECTOR_FACTORIES,
@@ -135,19 +142,23 @@ def build_selector(args, dataset):
             q=args.q,
             eig_chunk=args.eig_chunk,
         )
-        return make_coda(dataset.preds, hp, name=method)
+        return lambda preds: make_coda(preds, hp, name=method)
     if method == "model_picker":
-        eps = TASK_EPS.get(dataset.name)
+        eps = TASK_EPS.get(task_name)
         if eps is None:
-            print(f"{dataset.name} not in TASK_EPS; using default")
-            return make_modelpicker(dataset.preds)
-        return make_modelpicker(dataset.preds, epsilon=eps)
+            print(f"{task_name} not in TASK_EPS; using default")
+            return lambda preds: make_modelpicker(preds)
+        return lambda preds: make_modelpicker(preds, epsilon=eps)
     if method in ("activetesting", "vma"):
-        return SELECTOR_FACTORIES[method](dataset.preds, loss_fn=loss_fn,
-                                          budget=args.iters)
+        return lambda preds: SELECTOR_FACTORIES[method](
+            preds, loss_fn=loss_fn, budget=args.iters)
     if method in SELECTOR_FACTORIES:
-        return SELECTOR_FACTORIES[method](dataset.preds, loss_fn=loss_fn)
+        return lambda preds: SELECTOR_FACTORIES[method](preds, loss_fn=loss_fn)
     raise SystemExit(f"{method} is not a supported method.")
+
+
+def build_selector(args, dataset):
+    return build_selector_factory(args, dataset.name)(dataset.preds)
 
 
 def _log_debug_viz(run, selector, result, seed: int, iters: int) -> None:
@@ -211,14 +222,15 @@ def main(argv=None):
     best_loss = float(np.asarray(model_losses).min())
     print("Best possible loss is", best_loss)
 
-    selector = build_selector(args, dataset)
+    factory = build_selector_factory(args, dataset.name)
+    selector = factory(dataset.preds)
 
     from coda_tpu.utils.profiling import trace as profiler_trace
 
     t0 = time.perf_counter()
     with profiler_trace(args.profile_dir):
-        result = _run_all_seeds(args, selector, dataset, model_losses,
-                                loss_fn)
+        result = _run_all_seeds(args, factory, selector, dataset,
+                                model_losses, loss_fn)
         result.regret.block_until_ready()
     if args.profile_dir:
         print(f"Profiler trace written to {args.profile_dir}")
@@ -261,10 +273,10 @@ def main(argv=None):
     return result
 
 
-def _run_all_seeds(args, selector, dataset, model_losses, loss_fn):
+def _run_all_seeds(args, factory, selector, dataset, model_losses, loss_fn):
     import jax
 
-    from coda_tpu.engine import run_seeds
+    from coda_tpu.engine import run_seeds_compiled
 
     if args.checkpoint_dir:
         # resumable path: seeds run serially, each checkpointing its chunked
@@ -284,9 +296,9 @@ def _run_all_seeds(args, selector, dataset, model_losses, loss_fn):
 
         result = jax.tree.map(lambda *xs: jnp.stack(xs), *per_seed)
     else:
-        result = run_seeds(selector, dataset, iters=args.iters,
-                           seeds=args.seeds, loss_fn=loss_fn,
-                           model_losses=model_losses)
+        result = run_seeds_compiled(factory, dataset.preds, dataset.labels,
+                                    iters=args.iters, seeds=args.seeds,
+                                    loss_fn=loss_fn)
     return result
 
 

@@ -3,6 +3,7 @@ from coda_tpu.engine.loop import (
     make_step_fn,
     run_experiment,
     run_seeds,
+    run_seeds_compiled,
 )
 
 _CHECKPOINT_EXPORTS = (
@@ -17,6 +18,7 @@ __all__ = [
     "make_step_fn",
     "run_experiment",
     "run_seeds",
+    "run_seeds_compiled",
     *_CHECKPOINT_EXPORTS,
 ]
 

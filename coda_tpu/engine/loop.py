@@ -130,6 +130,48 @@ def run_experiment(
     return jax.jit(fn)(jax.random.PRNGKey(seed))
 
 
+def run_seeds_compiled(
+    selector_factory: Callable[[jnp.ndarray], Selector],
+    preds: jnp.ndarray,
+    labels: jnp.ndarray,
+    iters: int = 100,
+    seeds: int = 5,
+    loss_fn: Callable = accuracy_loss,
+) -> ExperimentResult:
+    """All seeds, with the prediction tensor as a *traced jit argument*.
+
+    ``run_seeds`` takes an already-built selector, whose closures hold the
+    concrete ``(H, N, C)`` array — jit then bakes it into the executable as a
+    captured constant, which at DomainNet scale (10 GB fp32,
+    reference ``paper/fig3.py:129-193``) doubles HBM and stalls lowering.
+    Here the selector is constructed inside the traced function from the
+    ``preds`` argument, so the tensor stays a runtime parameter. This is the
+    production entry point for the CLI and bench.
+    """
+    fn = make_batched_experiment_fn(selector_factory, iters, loss_fn)
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in range(seeds)])
+    return jax.jit(fn)(preds, labels, keys)
+
+
+def make_batched_experiment_fn(
+    selector_factory: Callable[[jnp.ndarray], Selector],
+    iters: int,
+    loss_fn: Callable = accuracy_loss,
+):
+    """``(preds, labels, keys) -> ExperimentResult`` (seed axis leading).
+
+    Pure and preds-as-argument, so one ``jax.jit`` wrapper of the returned
+    function serves *every same-shape task* from the compile cache — the
+    basis of the in-process suite runner.
+    """
+    def fn(preds, labels, keys):
+        sel = selector_factory(preds)
+        losses = compute_true_losses(preds, labels, loss_fn)
+        return jax.vmap(build_experiment_fn(sel, labels, losses, iters))(keys)
+
+    return fn
+
+
 def run_seeds(
     selector: Selector,
     dataset,

@@ -1,0 +1,92 @@
+"""North-star benchmark: a 26-task reference-shaped sweep in one process.
+
+Generates 26 synthetic tasks shaped like the reference benchmark's families
+(reference ``paper/tab1.py:82-90``: 12 DomainNet126 + 4 WILDS + 3 MSV +
+7 GLUE; per-family sizes scaled to stream through one chip's HBM), then runs
+every method x 5 seeds x 100 iters through the in-process suite runner and
+prints ONE JSON line with the total wall-clock.
+
+BASELINE.md's target: the full sweep under 60 s on a v5e-8. Compiles are
+cached persistently (--compile-cache), so steady-state reruns measure pure
+execution.
+
+    python scripts/bench_suite.py [--small] [--methods iid,coda]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+# (family, count, H, N, C) — shapes follow the reference families, N scaled
+FAMILIES = [
+    ("domainnet", 12, 30, 20000, 126),
+    ("wilds", 4, 20, 20000, 62),
+    ("msv", 3, 80, 10000, 10),
+    ("glue", 7, 30, 5000, 3),
+]
+SMALL_FAMILIES = [
+    ("domainnet", 3, 8, 2000, 26),
+    ("glue", 3, 8, 1000, 3),
+]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--small", action="store_true")
+    p.add_argument("--methods",
+                   default="iid,uncertainty,coda,activetesting,vma,model_picker")
+    p.add_argument("--seeds", type=int, default=5)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--eig-chunk", type=int, default=2048)
+    p.add_argument("--compile-cache", default=".jax_cache")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.compile_cache:
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine.suite import SuiteRunner
+
+    fams = SMALL_FAMILIES if args.small else FAMILIES
+    loaders = []
+    for fam, count, H, N, C in fams:
+        for i in range(count):
+            loaders.append(
+                lambda fam=fam, i=i, H=H, N=N, C=C: make_synthetic_task(
+                    seed=hash((fam, i)) % (2**31), H=H, N=N, C=C,
+                    name=f"{fam}_{i}",
+                )
+            )
+
+    methods = args.methods.split(",")
+    runner = SuiteRunner(iters=args.iters, seeds=args.seeds)
+    t0 = time.perf_counter()
+    results = runner.run(loaders, methods,
+                         method_args={"eig_chunk": args.eig_chunk})
+    wall = time.perf_counter() - t0
+    n_pairs = len(results)
+    stats = getattr(runner, "last_stats", {})
+    print(json.dumps({
+        "metric": f"suite-26task-wall ({n_pairs} task-method pairs, "
+                  f"{args.seeds} seeds, {args.iters} iters)",
+        "value": round(stats.get("compute_s", wall), 2),
+        "unit": "seconds (compute; total incl. synthetic datagen in "
+                "total_wall)",
+        "total_wall": round(wall, 2),
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""In-process benchmark sweep: every task x method x seed in ONE process.
+
+TPU-native counterpart of the reference's SLURM fan-out
+(reference ``scripts/launch_all_methods.py``): instead of one cluster job
+per task-method pair, the whole sweep runs in-process — seeds vmapped,
+compiled programs shared across same-shape tasks, results in the same
+MLflow-schema DB with DB-checked resume. Use ``launch_all_methods.py`` only
+when tasks must spread across hosts.
+
+    python scripts/run_suite.py --pred-dir data --db coda.sqlite \
+        --methods iid,uncertainty,coda --seeds 5 --iters 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")  # repo-root invocation
+
+
+DEFAULT_METHODS = "iid,uncertainty,coda,activetesting,vma,model_picker"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pred-dir", default="data")
+    p.add_argument("--db", default="coda.sqlite")
+    p.add_argument("--methods", default=DEFAULT_METHODS)
+    p.add_argument("--tasks", default=None,
+                   help="comma-separated subset (default: all in --pred-dir)")
+    p.add_argument("--seeds", type=int, default=5)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--loss", default="acc")
+    p.add_argument("--force-rerun", action="store_true")
+    p.add_argument("--no-db", action="store_true")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from coda_tpu.data import Dataset, find_task_file, list_tasks
+    from coda_tpu.engine.suite import SuiteRunner
+    from coda_tpu.tracking import TrackingStore
+
+    tasks = (args.tasks.split(",") if args.tasks
+             else list_tasks(args.pred_dir))
+    if not tasks:
+        raise SystemExit(f"no tasks under {args.pred_dir}")
+    # lazy loaders ordered by file size (shape proxy): tasks stream through
+    # HBM one at a time, same-size tasks run consecutively for compile reuse
+    import os
+
+    paths = []
+    for t in tasks:
+        fp = find_task_file(args.pred_dir, t)
+        if fp is None:
+            raise SystemExit(f"no data file for task {t!r}")
+        paths.append((os.path.getsize(fp), fp, t))
+    datasets = [
+        (lambda fp=fp, t=t: Dataset.from_file(fp, name=t))
+        for _, fp, t in sorted(paths)
+    ]
+
+    store = None if args.no_db else TrackingStore(args.db)
+    runner = SuiteRunner(iters=args.iters, seeds=args.seeds, loss=args.loss)
+    t0 = time.perf_counter()
+    results = runner.run(datasets, args.methods.split(","), store=store,
+                         force_rerun=args.force_rerun)
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "suite-wall-clock",
+        "tasks": len(datasets),
+        "methods": len(args.methods.split(",")),
+        "seeds": args.seeds,
+        "iters": args.iters,
+        "pairs_run": len(results),
+        "value": round(wall, 2),
+        "unit": "seconds",
+    }))
+
+
+if __name__ == "__main__":
+    main()

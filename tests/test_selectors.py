@@ -104,6 +104,27 @@ def test_run_seeds_batches(task):
     assert len(seqs) > 1
 
 
+def test_run_seeds_compiled_matches_run_seeds(task):
+    """The preds-as-argument compile path must equal the closure path
+    bit-for-bit (same program, different constant handling)."""
+    from coda_tpu.engine import run_seeds_compiled
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    hp = CODAHyperparams(eig_chunk=16)
+    want = run_seeds(make_coda(task.preds, hp), task, iters=5, seeds=2)
+    got = run_seeds_compiled(lambda p: make_coda(p, hp), task.preds,
+                             task.labels, iters=5, seeds=2)
+    for name in want._fields:
+        a, b = np.asarray(getattr(want, name)), np.asarray(getattr(got, name))
+        if a.dtype.kind == "f":
+            # constant-folding vs runtime parameters reorders a few fused
+            # ops; traces must agree, float scores only to epsilon
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6,
+                                       err_msg=name)
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=name)
+
+
 def test_uncertainty_picks_highest_entropy(task, results):
     _, res = results["uncertainty"]
     from coda_tpu.selectors.uncertainty import uncertainty_scores
