@@ -58,6 +58,19 @@ def parse_args(argv=None):
     p.add_argument("--q", default="eig",
                    help="Acquisition function {eig, iid, uncertainty} (ablation 2).")
 
+    # ModelPicker settings
+    def _epsilon(v):
+        f = float(v)
+        if not 0.0 < f < 1.0:
+            raise argparse.ArgumentTypeError(
+                f"epsilon must be in (0, 1), got {f}")
+        return f
+
+    p.add_argument("--epsilon", type=_epsilon, default=None,
+                   help="ModelPicker epsilon in (0, 1); default: the "
+                        "per-task tuned TASK_EPS table "
+                        "(reference modelpicker.py:5-35)")
+
     # TPU execution settings (no reference equivalent)
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable intra-run checkpoint/resume under this dir "
@@ -144,7 +157,9 @@ def build_selector_factory(args, task_name: str):
         )
         return lambda preds: make_coda(preds, hp, name=method)
     if method == "model_picker":
-        eps = TASK_EPS.get(task_name)
+        eps = getattr(args, "epsilon", None)
+        if eps is None:
+            eps = TASK_EPS.get(task_name)
         if eps is None:
             print(f"{task_name} not in TASK_EPS; using default")
             return lambda preds: make_modelpicker(preds)
@@ -264,10 +279,13 @@ def main(argv=None):
                     r.log_metric_series("cumulative regret", cums[s], start_step=1)
                     if args.debug_viz:
                         _log_debug_viz(r, selector, result, s, args.iters)
-                if not stoch[s]:
-                    print("Method is not stochastic for this task. "
-                          "Remaining seeds are identical.")
-                    break
+            # every seed child is logged: the reference stops after the first
+            # non-stochastic seed (main.py:166-168) because there the flag
+            # gates *compute*; here all seeds were already computed batched,
+            # and a uniform DB layout keeps resume checks and the analysis
+            # SQL (mean over children) free of special cases
+            if not stoch.any():
+                print("Method is not stochastic for this task.")
         print(f"Logged to {args.tracking_db}")
 
     return result

@@ -50,9 +50,6 @@ class SuiteRunner:
         # remaining seeds. Cuts 5x compute for CODA/uncertainty on tie-free
         # tasks at the cost of one extra (1-seed) compile per method.
         self.dedup_seeds = dedup_seeds
-        # (method, shape) pairs observed stochastic: skip the 1-seed probe
-        # next time (it would just waste a run)
-        self._seen_stochastic: set = set()
         self._jitted: dict = {}
         self._keys = jax.numpy.stack(
             [jax.random.PRNGKey(s) for s in range(seeds)]
@@ -60,17 +57,25 @@ class SuiteRunner:
         self._jax = jax
 
     def _fn_for(self, method: str, method_args: Optional[dict], task_name: str):
-        import argparse
-
         from coda_tpu.cli import build_selector_factory, parse_args
 
-        key = (method, tuple(sorted((method_args or {}).items())))
+        # Task-dependent hyperparams must be resolved BEFORE the cache key is
+        # formed: ``build_selector_factory`` bakes them into the jitted
+        # closure, so two tasks with different tuned values must not share an
+        # executable (but tasks resolving to the same value still do).
+        resolved = dict(method_args or {})
+        if method == "model_picker" and "epsilon" not in resolved:
+            from coda_tpu.selectors import TASK_EPS
+            from coda_tpu.selectors.modelpicker import DEFAULT_EPS
+
+            resolved["epsilon"] = TASK_EPS.get(task_name, DEFAULT_EPS)
+        key = (method, tuple(sorted(resolved.items())))
         if key not in self._jitted:
             args = parse_args([])
             args.method = method
             args.loss = [k for k, v in LOSS_FNS.items() if v is self.loss_fn][0]
             args.iters = self.iters
-            for k, v in (method_args or {}).items():
+            for k, v in resolved.items():
                 setattr(args, k, v)
             factory = build_selector_factory(args, task_name)
             self._jitted[key] = self._jax.jit(
@@ -81,16 +86,23 @@ class SuiteRunner:
     def run_one(self, method: str, dataset, method_args: Optional[dict] = None):
         """One task-method pair, all seeds batched. Returns ExperimentResult."""
         fn = self._fn_for(method, method_args, dataset.name)
-        probe_key = (method, tuple(dataset.shape))
-        if (self.dedup_seeds and self.seeds > 1
-                and probe_key not in self._seen_stochastic):
+        if self.dedup_seeds and self.seeds > 1:
+            # seed 0 runs alone; deterministic -> broadcast, stochastic ->
+            # run only the REMAINING seeds and concatenate (the probe result
+            # is kept, never recomputed). Total device work is exactly
+            # ``seeds`` experiments either way; two batch sizes (1, seeds-1)
+            # get compiled per method instead of one.
             r0 = fn(dataset.preds, dataset.labels, self._keys[:1])
             if not bool(np.asarray(r0.stochastic)[0]):
                 # deterministic run: every seed is identical — broadcast
                 return type(r0)(*[
                     np.repeat(np.asarray(x), self.seeds, axis=0) for x in r0
                 ])
-            self._seen_stochastic.add(probe_key)
+            rest = fn(dataset.preds, dataset.labels, self._keys[1:])
+            return type(r0)(*[
+                np.concatenate([np.asarray(a), np.asarray(b)], axis=0)
+                for a, b in zip(r0, rest)
+            ])
         return fn(dataset.preds, dataset.labels, self._keys)
 
     def run(
@@ -167,6 +179,13 @@ def _finished(store, task: str, method: str, seeds: int) -> bool:
 
 
 def _log(store, task: str, method: str, res, seeds: int, iters: int) -> None:
+    """Log every seed child, always. Seed dedup is a *compute* optimization
+    (``run_one`` broadcasts the seed-0 result); logging the broadcast copies
+    keeps the DB layout identical for deterministic and stochastic pairs, so
+    ``_finished``'s all-children resume check and the reference analysis SQL
+    (mean over child runs) need no special cases. The per-seed ``stochastic``
+    flag is trajectory-dependent for tie-break methods, so it must not gate
+    which seeds get logged."""
     regrets = np.asarray(res.regret)
     cums = np.asarray(res.cumulative_regret)
     stoch = np.asarray(res.stochastic)
@@ -179,5 +198,3 @@ def _log(store, task: str, method: str, res, seeds: int, iters: int) -> None:
                 r.log_metric_series("regret", regrets[s], start_step=1)
                 r.log_metric_series("cumulative regret", cums[s],
                                     start_step=1)
-            if not stoch[s]:
-                break

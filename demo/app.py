@@ -71,7 +71,8 @@ class DemoSession:
         self.skipped: list[int] = []
         self.current_idx: int | None = None
         self.current_prob = 0.0
-        self.lock = threading.Lock()
+        # reentrant: answer() holds it across its next_item()/state() calls
+        self.lock = threading.RLock()
         # compile once at session start; clicks reuse the executable
         import jax
 
@@ -79,9 +80,10 @@ class DemoSession:
 
     # -- the reference's get_next_coda_image (demo/app.py:137-172) -----------
     def next_item(self) -> dict:
-        idx, prob = self.selector.get_next_item_to_label()
-        self.current_idx, self.current_prob = idx, prob
-        return self.state()
+        with self.lock:
+            idx, prob = self.selector.get_next_item_to_label()
+            self.current_idx, self.current_prob = idx, prob
+            return self.state()
 
     # -- the reference's check_answer (demo/app.py:174-210) ------------------
     def answer(self, label) -> dict:
@@ -106,29 +108,30 @@ class DemoSession:
             return self.next_item()
 
     def state(self) -> dict:
-        pbest = np.asarray(self._get_pbest(self.selector.state))
-        idx = self.current_idx
-        item_preds = (
-            None if idx is None else self.preds[:, idx, :].tolist()
-        )
-        true_label = (
-            None
-            if (self.labels is None or idx is None)
-            else int(self.labels[idx])
-        )
-        return {
-            "step": self.step,
-            "idx": idx,
-            "item_preds": item_preds,
-            "true_label": true_label,
-            "class_names": self.class_names,
-            "model_names": self.model_names,
-            "pbest": pbest.tolist(),
-            "true_accs": self.true_accs,
-            "best_model": int(np.argmax(pbest)),
-            "n_labeled": len(self.selector.labeled_idxs),
-            "n_skipped": len(self.skipped),
-        }
+        with self.lock:
+            pbest = np.asarray(self._get_pbest(self.selector.state))
+            idx = self.current_idx
+            item_preds = (
+                None if idx is None else self.preds[:, idx, :].tolist()
+            )
+            true_label = (
+                None
+                if (self.labels is None or idx is None)
+                else int(self.labels[idx])
+            )
+            return {
+                "step": self.step,
+                "idx": idx,
+                "item_preds": item_preds,
+                "true_label": true_label,
+                "class_names": self.class_names,
+                "model_names": self.model_names,
+                "pbest": pbest.tolist(),
+                "true_accs": self.true_accs,
+                "best_model": int(np.argmax(pbest)),
+                "n_labeled": len(self.selector.labeled_idxs),
+                "n_skipped": len(self.skipped),
+            }
 
 
 # ----------------------------------------------------------------------------
@@ -142,6 +145,7 @@ class DemoSession:
 # (reference demo/app.py:86-92).
 MAX_SESSIONS = 8
 _SESSIONS: dict[str, DemoSession] = {}  # insertion-ordered
+_SESSIONS_LOCK = threading.Lock()  # guards insert/evict/lookup
 _FACTORY = None  # () -> DemoSession
 
 
@@ -247,18 +251,21 @@ class Handler(BaseHTTPRequestHandler):
         if self.path == "/api/start":
             sess = _FACTORY()
             token = secrets.token_hex(8)
-            _SESSIONS[token] = sess
-            while len(_SESSIONS) > MAX_SESSIONS:
-                _SESSIONS.pop(next(iter(_SESSIONS)))
+            with _SESSIONS_LOCK:
+                _SESSIONS[token] = sess
+                while len(_SESSIONS) > MAX_SESSIONS:
+                    _SESSIONS.pop(next(iter(_SESSIONS)))
             self._json({"token": token, "state": sess.next_item()})
         elif self.path == "/api/answer":
-            sess = _SESSIONS.get(req.get("token", ""))
+            with _SESSIONS_LOCK:
+                sess = _SESSIONS.get(req.get("token", ""))
             if sess is None:
                 self._json({"error": "unknown session"}, 400)
             else:
                 self._json(sess.answer(req.get("label")))
         elif self.path == "/api/state":
-            sess = _SESSIONS.get(req.get("token", ""))
+            with _SESSIONS_LOCK:
+                sess = _SESSIONS.get(req.get("token", ""))
             if sess is None:
                 self._json({"error": "unknown session"}, 400)
             else:

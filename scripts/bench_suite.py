@@ -19,6 +19,7 @@ import argparse
 import json
 import sys
 import time
+import zlib
 
 sys.path.insert(0, ".")
 
@@ -63,9 +64,10 @@ def main(argv=None):
     for fam, count, H, N, C in fams:
         for i in range(count):
             loaders.append(
+                # stable across processes (hash() is PYTHONHASHSEED-salted)
                 lambda fam=fam, i=i, H=H, N=N, C=C: make_synthetic_task(
-                    seed=hash((fam, i)) % (2**31), H=H, N=N, C=C,
-                    name=f"{fam}_{i}",
+                    seed=zlib.crc32(f"{fam}_{i}".encode()) % (2**31),
+                    H=H, N=N, C=C, name=f"{fam}_{i}",
                 )
             )
 

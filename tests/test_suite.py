@@ -59,6 +59,42 @@ def test_suite_seed_dedup(three_tasks):
     assert len(seqs) > 1
 
 
+def test_suite_modelpicker_per_task_epsilon():
+    """Task-dependent TASK_EPS must not leak across the compile cache:
+    same-shape tasks with different tuned epsilons get different
+    executables, keyed by the resolved epsilon; tasks resolving to the
+    same epsilon still share one."""
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine.suite import SuiteRunner
+
+    mk = lambda name: make_synthetic_task(seed=1, H=4, N=40, C=3, name=name)
+    runner = SuiteRunner(iters=4, seeds=2)
+    runner.run_one("model_picker", mk("real_painting"))  # eps 0.35
+    runner.run_one("model_picker", mk("iwildcam"))       # eps 0.49
+    runner.run_one("model_picker", mk("cifar10_4070"))   # eps 0.47
+    runner.run_one("model_picker", mk("glue/qqp"))       # eps 0.47 (shared)
+    eps = sorted(dict(k[1])["epsilon"] for k in runner._jitted)
+    assert eps == [0.35, 0.47, 0.49]
+
+
+def test_suite_resume_skips_deterministic(three_tasks, tmp_path):
+    """Deterministic pairs broadcast the seed-0 result but still log every
+    seed child, so the all-children resume check skips them on rerun."""
+    from coda_tpu.engine.suite import SuiteRunner
+    from coda_tpu.tracking import TrackingStore
+
+    store = TrackingStore(str(tmp_path / "s.sqlite"))
+    runner = SuiteRunner(iters=3, seeds=3)
+    runner.run(three_tasks[:1], ["uncertainty"], store=store,
+               progress=lambda s: None)
+    msgs: list[str] = []
+    out = runner.run(three_tasks[:1], ["uncertainty"], store=store,
+                     progress=msgs.append)
+    assert out == {}
+    assert any("skip" in m for m in msgs)
+    store.close()
+
+
 def test_suite_logs_and_resumes(three_tasks, tmp_path):
     from coda_tpu.engine.suite import SuiteRunner
     from coda_tpu.tracking import TrackingStore
