@@ -44,13 +44,23 @@ def surrogate_expected_losses(preds: jnp.ndarray) -> jnp.ndarray:
     return 1.0 - y_star
 
 
-def lure_risks(
+def lure_risks_and_vars(
     losses: jnp.ndarray,   # (H, T)
     qs: jnp.ndarray,       # (T,)
     M: jnp.ndarray,        # scalar int
     N: int,
-) -> jnp.ndarray:
-    """LURE risk estimates (H,); masked over the first M buffer slots."""
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """LURE risk estimates and estimator variances, both (H,).
+
+    Masked over the first M buffer slots. Matches the reference's
+    ``get_lure_risks_and_vars`` (reference
+    ``coda/baselines/activetesting.py:69-90``): estimate = mean of the
+    v-weighted losses, variance = unbiased sample variance of the weighted
+    losses divided by M. Divergence: at M <= 1 the reference's unbiased
+    variance is NaN (0/0); we return 0 there (masked reductions can't emit
+    the reference's accidental NaN, and callers only consume the variance
+    once labels exist).
+    """
     T = qs.shape[0]
     m_idx = jnp.arange(1, T + 1, dtype=jnp.float32)     # 1-indexed m
     Mf = M.astype(jnp.float32)
@@ -60,7 +70,21 @@ def lure_risks(
     )
     v = jnp.where(valid, v, 0.0)
     weighted = v[None, :] * losses                      # (H, T)
-    return weighted.sum(axis=1) / jnp.clip(Mf, 1.0, None)
+    mean = weighted.sum(axis=1) / jnp.clip(Mf, 1.0, None)
+    sq_dev = jnp.where(valid[None, :],
+                       (weighted - mean[:, None]) ** 2, 0.0)
+    sample_var = sq_dev.sum(axis=1) / jnp.clip(Mf - 1.0, 1.0, None)
+    return mean, sample_var / jnp.clip(Mf, 1.0, None)
+
+
+def lure_risks(
+    losses: jnp.ndarray,   # (H, T)
+    qs: jnp.ndarray,       # (T,)
+    M: jnp.ndarray,        # scalar int
+    N: int,
+) -> jnp.ndarray:
+    """LURE risk estimates (H,); masked over the first M buffer slots."""
+    return lure_risks_and_vars(losses, qs, M, N)[0]
 
 
 def make_activetesting(
@@ -116,5 +140,9 @@ def make_activetesting(
         name=name, init=init, select=select, update=update, best=best,
         always_stochastic=True,
         hyperparams={"budget": budget},
-        extras={"lure_risks": lambda s: lure_risks(s.losses, s.qs, s.n_labeled, N)},
+        extras={
+            "lure_risks": lambda s: lure_risks(s.losses, s.qs, s.n_labeled, N),
+            "lure_risks_and_vars": lambda s: lure_risks_and_vars(
+                s.losses, s.qs, s.n_labeled, N),
+        },
     )

@@ -238,6 +238,194 @@ def test_coda_independent_trace_parity(task, ref_ds):
     assert np.asarray(res.best_model).tolist() == ref_bests
 
 
+def _lockstep_coda_trace(task, ref_ds, rounds: int, **kw):
+    """Drive both implementations with the reference's label choices and
+    compare Dirichlets / pi-hat / P(best) every round (shared by the C=3
+    and binary-C tasks)."""
+    import jax
+    import jax.numpy as jnp
+
+    labels_np = np.asarray(task.labels)
+    ref = _fresh_ref_coda(ref_ds, **kw)
+    sel = _ours_coda(task, **kw)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    update_jit = jax.jit(sel.update)
+    select_jit = jax.jit(sel.select)
+
+    for rnd in range(rounds):
+        ref_idx, ref_prob = ref.get_next_item_to_label()
+        res = select_jit(state, jax.random.PRNGKey(rnd))
+        assert not bool(res.stochastic), f"unexpected tie at round {rnd}"
+        assert not ref.stochastic
+        assert int(res.idx) == int(ref_idx), f"selection differs at {rnd}"
+        np.testing.assert_allclose(float(res.prob), float(ref_prob),
+                                   rtol=5e-4, atol=1e-5)
+
+        tc = int(labels_np[int(ref_idx)])
+        ref.add_label(int(ref_idx), tc, float(ref_prob))
+        state = update_jit(state, jnp.asarray(int(ref_idx)), jnp.asarray(tc),
+                           jnp.asarray(0.0))
+        np.testing.assert_allclose(
+            np.asarray(state.dirichlets), ref.dirichlets.numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(state.pi_hat), ref.pi_hat.numpy(), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(sel.extras["get_pbest"](state)),
+            ref.get_pbest().numpy().squeeze(), rtol=1e-4, atol=1e-6,
+        )
+
+
+@pytest.fixture(scope="module")
+def task_binary():
+    from coda_tpu.data import make_synthetic_task
+
+    # C=2: the diag prior's off-diagonal 1/(C-1) hits 1.0 and every Beta is
+    # the whole Dirichlet row (the civilcomments/GLUE-shaped case)
+    return make_synthetic_task(seed=5, H=4, N=30, C=2)
+
+
+def test_coda_binary_task_lockstep_parity(task_binary):
+    _lockstep_coda_trace(task_binary, RefDS(task_binary), rounds=5)
+
+
+def test_coda_q_iid_ablation_parity(task, ref_ds):
+    """Ablation q=iid (reference coda/coda.py:289-291): uniform scores over
+    the prefiltered pool — always tied, so both sides flag stochastic; the
+    uniform probability must agree, and belief updates stay in lockstep."""
+    import jax
+    import jax.numpy as jnp
+
+    labels_np = np.asarray(task.labels)
+    ref = _fresh_ref_coda(ref_ds, q="iid")
+    sel = _ours_coda(task, q="iid")
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    select_jit = jax.jit(sel.select)
+    update_jit = jax.jit(sel.update)
+
+    for rnd in range(4):
+        cand = ref._prefilter(ref.unlabeled_idxs) or ref.unlabeled_idxs
+        ref_idx, ref_prob = ref.get_next_item_to_label()
+        res = select_jit(state, jax.random.PRNGKey(rnd))
+        assert ref.stochastic and bool(res.stochastic)
+        np.testing.assert_allclose(float(res.prob), 1.0 / len(cand),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(ref_prob), 1.0 / len(cand),
+                                   rtol=1e-6)
+        assert int(res.idx) in cand
+
+        tc = int(labels_np[int(ref_idx)])
+        ref.add_label(int(ref_idx), tc, float(ref_prob))
+        state = update_jit(state, jnp.asarray(int(ref_idx)), jnp.asarray(tc),
+                           jnp.asarray(0.0))
+        np.testing.assert_allclose(
+            np.asarray(state.dirichlets), ref.dirichlets.numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_coda_q_uncertainty_ablation_parity(task, ref_ds):
+    """Ablation q=uncertainty (reference coda/coda.py:292-295): committee
+    disagreement scores over the prefiltered pool; tie-free on this task, so
+    selections match exactly in lockstep."""
+    import jax
+    import jax.numpy as jnp
+
+    labels_np = np.asarray(task.labels)
+    ref = _fresh_ref_coda(ref_ds, q="uncertainty")
+    sel = _ours_coda(task, q="uncertainty")
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    select_jit = jax.jit(sel.select)
+    update_jit = jax.jit(sel.update)
+
+    for rnd in range(4):
+        ref_idx, ref_prob = ref.get_next_item_to_label()
+        res = select_jit(state, jax.random.PRNGKey(rnd))
+        assert not ref.stochastic and not bool(res.stochastic)
+        assert int(res.idx) == int(ref_idx), rnd
+        np.testing.assert_allclose(float(res.prob), float(ref_prob),
+                                   rtol=1e-5, atol=1e-7)
+
+        tc = int(labels_np[int(ref_idx)])
+        ref.add_label(int(ref_idx), tc, float(ref_prob))
+        state = update_jit(state, jnp.asarray(int(ref_idx)), jnp.asarray(tc),
+                           jnp.asarray(0.0))
+        np.testing.assert_allclose(
+            np.asarray(state.dirichlets), ref.dirichlets.numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def _disagreement_pool(ref_ds) -> list[int]:
+    maj, _ = torch.mode(ref_ds.preds.argmax(-1), dim=0)
+    mask = (ref_ds.preds.argmax(-1) != maj).sum(0) > 0
+    return [i for i in range(ref_ds.preds.shape[1]) if mask[i]]
+
+
+def test_coda_prefilter_noop_lockstep_parity(task, ref_ds):
+    """prefilter_n >= |disagreement pool|: neither side subsamples
+    (reference coda/coda.py:220-224 requires len(idxs) > prefilter_n), so the
+    full greedy EIG trace must match and stay deterministic."""
+    pool = _disagreement_pool(ref_ds)
+    assert 0 < len(pool) < task.preds.shape[1]
+    _lockstep_coda_trace(task, ref_ds, rounds=4, prefilter_n=len(pool))
+
+
+def test_coda_prefilter_subsample_stochastic_both_sides(task, ref_ds):
+    """prefilter_n < |disagreement pool|: both sides randomly subsample the
+    EIG pool, flag the run stochastic, and pick from the disagreement set."""
+    import jax
+
+    pool = _disagreement_pool(ref_ds)
+    k = len(pool) - 2
+    assert k >= 1
+    ref = _fresh_ref_coda(ref_ds, prefilter_n=k)
+    ref_idx, _ = ref.get_next_item_to_label()
+    assert ref.stochastic
+    assert int(ref_idx) in pool
+
+    sel = _ours_coda(task, prefilter_n=k)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    res = jax.jit(sel.select)(state, jax.random.PRNGKey(0))
+    assert bool(res.stochastic)
+    assert int(res.idx) in pool
+
+
+def test_coda_eig_tie_marks_stochastic_both_sides():
+    """Exact EIG ties (duplicated points) must set the stochastic flag on
+    both implementations (reference coda/coda.py:306-311 isclose tie-break)."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.data import make_synthetic_task
+
+    base = make_synthetic_task(seed=7, H=3, N=2, C=3)
+    preds = np.repeat(np.asarray(base.preds), 4, axis=1)      # (H, 8, C)
+    labels = np.repeat(np.asarray(base.labels), 4)
+
+    class DS:
+        pass
+
+    ds = DS()
+    ds.preds = torch.from_numpy(preds).float()
+    ds.labels = torch.from_numpy(labels).long()
+    ds.device = ds.preds.device
+    random.seed(0)
+    torch.manual_seed(0)
+    ref = RefCODA(ds)
+    ref.get_next_item_to_label()
+    assert ref.stochastic
+
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    sel = make_coda(jnp.asarray(preds), CODAHyperparams())
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    res = jax.jit(sel.select)(state, jax.random.PRNGKey(0))
+    assert bool(res.stochastic)
+
+
 # ------------------------------------------------------------- baselines
 
 
@@ -313,10 +501,18 @@ def test_activetesting_lockstep_parity(task, ref_ds):
         state = update_jit(state, jnp.asarray(idx), jnp.asarray(tc),
                            jnp.asarray(ours_prob, jnp.float32))
 
-        ours_risk = np.asarray(sel.extras["lure_risks"](state))
-        theirs_risk = ref.get_risk_estimates().numpy()
+        ours_risk, ours_var = (
+            np.asarray(x) for x in sel.extras["lure_risks_and_vars"](state)
+        )
+        theirs_risk, theirs_var = (
+            x.numpy() for x in ref.get_lure_risks_and_vars()
+        )
         np.testing.assert_allclose(ours_risk, theirs_risk, rtol=1e-4,
                                    atol=1e-6, err_msg=f"LURE step {step}")
+        if step > 0:  # reference variance is NaN (0/0 unbiased var) at M=1
+            np.testing.assert_allclose(ours_var, theirs_var, rtol=1e-4,
+                                       atol=1e-6,
+                                       err_msg=f"LURE var step {step}")
 
 
 def test_vma_scores_parity(task, ref_ds):
