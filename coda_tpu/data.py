@@ -85,11 +85,16 @@ class Dataset:
       preds: ``(H, N, C)`` float32 post-softmax scores.
       labels: optional ``(N,)`` int32 ground-truth classes.
       name: task name (used as the tracking experiment name).
+      filenames: optional ``(N,)`` source-image filenames (written by the
+        pool builder; lets the demo serve the item being labeled).
+      class_names: optional ``(C,)`` human-readable class names.
     """
 
     preds: jax.Array
     labels: Optional[jax.Array] = None
     name: str = "task"
+    filenames: Optional[list] = None
+    class_names: Optional[list] = None
 
     @property
     def shape(self) -> tuple[int, int, int]:
@@ -116,18 +121,25 @@ class Dataset:
             preds = jnp.asarray(preds_np)
 
         labels = None
+        filenames = class_names = None
         if filepath.endswith(".npz"):
-            # single-file native format: preds + labels in one npz (what the
-            # pool builder writes)
+            # single-file native format: preds + labels (+ optional item
+            # filenames and class names) in one npz, as the pool builder
+            # writes it
             with np.load(filepath) as z:
                 if "labels" in z.files:
                     labels = jnp.asarray(z["labels"].astype(np.int32))
+                if "filenames" in z.files:
+                    filenames = [str(s) for s in z["filenames"]]
+                if "classes" in z.files:
+                    class_names = [str(s) for s in z["classes"]]
         if labels is None:
             lp = _labels_path(filepath)
             if os.path.exists(lp):
                 labels = jnp.asarray(_load_array(lp).astype(np.int32))
         task = name or os.path.splitext(os.path.basename(filepath))[0]
-        return cls(preds=preds, labels=labels, name=task)
+        return cls(preds=preds, labels=labels, name=task,
+                   filenames=filenames, class_names=class_names)
 
 
 def make_synthetic_task(

@@ -29,11 +29,19 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import secrets
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
+
+# direct script execution (`python demo/app.py`) puts demo/ on sys.path, not
+# the repo root — make `coda_tpu` / `demo.*` importable either way
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 
 # ----------------------------------------------------------------------------
@@ -44,7 +52,7 @@ class DemoSession:
     """One interactive CODA run over a (H, N, C) prediction pool."""
 
     def __init__(self, preds, labels, class_names=None, model_names=None,
-                 seed: int = 0):
+                 seed: int = 0, image_paths=None):
         import jax.numpy as jnp
 
         from coda_tpu.oracle import true_losses
@@ -54,6 +62,12 @@ class DemoSession:
         self.preds = np.asarray(preds, np.float32)
         self.labels = None if labels is None else np.asarray(labels)
         H, N, C = self.preds.shape
+        # one path per item (index order = npz order); None for tensor-only
+        # tasks, which fall back to the prediction table
+        if image_paths is not None and len(image_paths) != N:
+            raise ValueError(
+                f"got {len(image_paths)} image paths for {N} items")
+        self.image_paths = None if image_paths is None else list(image_paths)
         self.class_names = list(class_names or [f"class {c}" for c in range(C)])
         self.model_names = list(model_names or [f"model {h}" for h in range(H)])
         # demo hyperparams follow the reference's Args stub (demo/app.py:70-81)
@@ -122,6 +136,7 @@ class DemoSession:
             return {
                 "step": self.step,
                 "idx": idx,
+                "has_images": self.image_paths is not None,
                 "item_preds": item_preds,
                 "true_label": true_label,
                 "class_names": self.class_names,
@@ -166,6 +181,9 @@ PAGE = """<!doctype html>
  <div class="card"><h3>Label this item</h3>
   <p>Item <span id="idx">—</span>. Which class is it?
      (the true class is hidden; answer honestly — or don't, and watch CODA cope)</p>
+  <img id="itemimg" alt="item being labeled"
+       style="display:none;max-width:100%;max-height:320px;border-radius:6px;
+              border:1px solid #ccc;margin-bottom:.5rem">
   <div id="buttons"></div>
   <h4>Per-model predictions for this item</h4>
   <div id="preds"></div></div>
@@ -195,6 +213,10 @@ function render(s){
   `step ${s.step} — ${s.n_labeled} labeled, ${s.n_skipped} skipped — `+
   `CODA's current pick: ${s.model_names[s.best_model]}`;
  document.getElementById("idx").textContent=s.idx;
+ const img=document.getElementById("itemimg");
+ if(s.has_images&&s.idx!==null){
+  img.src=`/api/image?token=${token}&idx=${s.idx}`;img.style.display="block";
+ }else{img.style.display="none";}
  const bt=document.getElementById("buttons");
  bt.innerHTML=s.class_names.map((c,i)=>
    `<button onclick="answer(${i})">${c}</button>`).join("")+
@@ -234,8 +256,43 @@ class Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.startswith("/api/image"):
+            self._serve_image()
         else:
             self._json({"error": "not found"}, 404)
+
+    def _serve_image(self):
+        """GET /api/image?token=T&idx=I -> the item's image bytes.
+
+        Only paths from the session's own ``image_paths`` list are ever
+        opened (idx is range-checked), so no request-controlled path
+        touches the filesystem."""
+        import mimetypes
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        with _SESSIONS_LOCK:
+            sess = _SESSIONS.get((q.get("token") or [""])[0])
+        if sess is None or sess.image_paths is None:
+            return self._json({"error": "no images for this session"}, 404)
+        try:
+            idx = int((q.get("idx") or [""])[0])
+        except ValueError:
+            return self._json({"error": "bad idx"}, 400)
+        if not 0 <= idx < len(sess.image_paths):
+            return self._json({"error": "idx out of range"}, 400)
+        path = sess.image_paths[idx]
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return self._json({"error": "image unavailable"}, 404)
+        ctype = mimetypes.guess_type(path)[0] or "application/octet-stream"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def do_POST(self):
         try:
@@ -281,13 +338,41 @@ def make_server(factory, port: int = 0) -> ThreadingHTTPServer:
     return ThreadingHTTPServer(("127.0.0.1", port), Handler)
 
 
+def resolve_image_paths(ds, images_dir):
+    """Per-item image paths for a loaded dataset, or None (table fallback).
+
+    Preferred source: the ``filenames`` the pool builder records in the npz
+    (index order is authoritative), joined onto ``--images-dir``. Without
+    recorded filenames, the sorted directory listing is used — the same
+    ordering contract ``hf_zeroshot.list_images`` built the tensor with.
+    """
+    if images_dir is None:
+        return None
+    N = ds.preds.shape[1]
+    if ds.filenames is not None:
+        return [os.path.join(images_dir, f) for f in ds.filenames]
+    from demo.hf_zeroshot import list_images
+
+    paths = list_images(images_dir)
+    if len(paths) != N:
+        raise SystemExit(
+            f"--images-dir has {len(paths)} images but the task has {N} "
+            "items; rebuild the pool or pass the matching directory")
+    return paths
+
+
 def default_factory(args):
     def factory() -> DemoSession:
         from coda_tpu.cli import load_dataset
 
         if args.task or args.synthetic:
             ds = load_dataset(args)
-            return DemoSession(ds.preds, ds.labels)
+            return DemoSession(
+                ds.preds, ds.labels,
+                class_names=ds.class_names,
+                image_paths=resolve_image_paths(
+                    ds, getattr(args, "images_dir", None)),
+            )
         # offline fallback: small seeded pool, 3 models x 5 classes like the
         # reference's iWildCam subset (demo/app.py README)
         from coda_tpu.data import make_synthetic_task
@@ -307,6 +392,10 @@ def main(argv=None):
     p.add_argument("--task", default=None)
     p.add_argument("--data-dir", default="data")
     p.add_argument("--synthetic", default=None)
+    p.add_argument("--images-dir", default=None,
+                   help="directory with the task's source images; the page "
+                        "then shows the item being labeled (reference "
+                        "demo/app.py:137-172)")
     p.add_argument("--port", type=int, default=7860)
     args = p.parse_args(argv)
 

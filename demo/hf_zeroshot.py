@@ -159,7 +159,13 @@ def assemble_pool(
     labels: Sequence[int] | None = None,
 ) -> np.ndarray:
     """Stack per-model JSONs into the dense fp32 ``(H, N, C)`` tensor and
-    save it (plus optional labels) as ``.npz`` for ``Dataset.from_file``."""
+    save it (plus optional labels) as ``.npz`` for ``Dataset.from_file``.
+
+    The item filenames and class names are recorded alongside the tensor so
+    downstream consumers (the human-in-the-loop demo) can show the actual
+    image being labeled (the reference's demo loop, reference
+    ``demo/app.py:137-172``) — index order in the npz IS the filename order.
+    """
     H, N, C = len(json_paths), len(images), len(classes)
     preds = np.full((H, N, C), 1.0 / C, np.float32)
     names = [os.path.basename(p) for p in images]
@@ -171,7 +177,9 @@ def assemble_pool(
         for n, name in enumerate(names):
             if name in data["scores"]:
                 preds[h, n] = np.asarray(data["scores"][name], np.float32)
-    out = {"preds": preds}
+    out = {"preds": preds,
+           "filenames": np.asarray(names),
+           "classes": np.asarray(list(classes))}
     if labels is not None:
         out["labels"] = np.asarray(labels, np.int64)
     np.savez(out_path, **out)

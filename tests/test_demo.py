@@ -84,6 +84,107 @@ def test_demo_unknown_session(demo_server):
     assert status == 400
 
 
+def test_demo_no_images_fallback(demo_server):
+    """Tensor-only sessions report has_images=False and 404 the image
+    route (the prediction-table fallback)."""
+    status, body = _req(demo_server, "POST", "/api/start", {})
+    out = json.loads(body)
+    assert out["state"]["has_images"] is False
+    status, _ = _req(demo_server, "GET",
+                     f"/api/image?token={out['token']}&idx=0")
+    assert status == 404
+
+
+# ---------------------------------------------------------------------------
+# image-backed demo: the page shows the item being labeled
+# ---------------------------------------------------------------------------
+
+# 1x1 red PNG (valid image bytes for the content-type contract)
+_PNG = bytes.fromhex(
+    "89504e470d0a1a0a0000000d49484452000000010000000108020000009077"
+    "53de0000000c4944415408d763f8cfc000000301010018dd8db00000000049"
+    "454e44ae426082"
+)
+
+
+@pytest.fixture(scope="module")
+def image_demo_server(tmp_path_factory):
+    from coda_tpu.data import make_synthetic_task
+    from demo.app import DemoSession, make_server
+
+    d = tmp_path_factory.mktemp("demo_imgs")
+    N = 20
+    paths = []
+    for i in range(N):
+        p = d / f"img_{i:02d}.png"
+        p.write_bytes(_PNG)
+        paths.append(str(p))
+    task = make_synthetic_task(seed=1, H=3, N=N, C=4)
+
+    def factory():
+        return DemoSession(task.preds, task.labels, seed=0,
+                           image_paths=paths)
+
+    srv = make_server(factory, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_demo_serves_item_image(image_demo_server):
+    """The reference demo loop end to end: the session proposes an item,
+    the image route returns its actual bytes, a label advances the loop
+    (reference demo/app.py:137-210)."""
+    status, body = _req(image_demo_server, "POST", "/api/start", {})
+    assert status == 200
+    out = json.loads(body)
+    token, state = out["token"], out["state"]
+    assert state["has_images"] is True
+    assert state["idx"] is not None
+
+    conn = http.client.HTTPConnection("127.0.0.1", image_demo_server,
+                                      timeout=30)
+    conn.request("GET", f"/api/image?token={token}&idx={state['idx']}")
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "image/png"
+    assert data == _PNG
+
+    # label it; the next item's image is also servable
+    status, body = _req(image_demo_server, "POST", "/api/answer",
+                        {"token": token, "label": state["true_label"]})
+    state = json.loads(body)
+    assert state["n_labeled"] == 1
+    status, _ = _req(image_demo_server, "GET",
+                     f"/api/image?token={token}&idx={state['idx']}")
+    assert status == 200
+
+
+def test_demo_image_route_validates(image_demo_server):
+    status, body = _req(image_demo_server, "POST", "/api/start", {})
+    token = json.loads(body)["token"]
+    status, _ = _req(image_demo_server, "GET",
+                     f"/api/image?token={token}&idx=9999")
+    assert status == 400
+    status, _ = _req(image_demo_server, "GET",
+                     f"/api/image?token={token}&idx=abc")
+    assert status == 400
+    status, _ = _req(image_demo_server, "GET", "/api/image?token=nope&idx=0")
+    assert status == 404
+
+
+def test_demo_session_rejects_mismatched_paths():
+    from coda_tpu.data import make_synthetic_task
+    from demo.app import DemoSession
+
+    task = make_synthetic_task(seed=2, H=3, N=10, C=3)
+    with pytest.raises(ValueError, match="image paths"):
+        DemoSession(task.preds, task.labels, image_paths=["only_one.png"])
+
+
 # ---------------------------------------------------------------------------
 # pool builder
 # ---------------------------------------------------------------------------
@@ -127,12 +228,24 @@ def test_build_pool_offline(image_dir, tmp_path):
     # the failed image degraded to uniform (reference fallback semantics)
     np.testing.assert_allclose(preds[1, 3], 1.0 / 3, atol=1e-6)
 
-    # the saved npz round-trips through the framework Dataset
+    # the saved npz round-trips through the framework Dataset, including
+    # the recorded item filenames + class names (what the demo's image
+    # serving keys on)
     from coda_tpu.data import Dataset
 
     ds = Dataset.from_file(out + ".npz")
     assert ds.preds.shape == (2, 6, 3)
     assert ds.labels is not None
+    assert ds.filenames == [f"img_{i:02d}.png" for i in range(6)]
+    assert ds.class_names == classes
+
+    # filenames + --images-dir resolve to the actual on-disk paths
+    from demo.app import resolve_image_paths
+
+    paths = resolve_image_paths(ds, image_dir)
+    assert len(paths) == 6
+    assert all(os.path.exists(p) for p in paths)
+    assert resolve_image_paths(ds, None) is None
 
 
 def test_build_pool_resume_skips_existing(image_dir, tmp_path):
