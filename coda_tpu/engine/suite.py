@@ -136,6 +136,8 @@ class SuiteRunner:
         t_start = time.perf_counter()
         t_load = 0.0
         t_compute = 0.0
+        pairs: list = []  # per task-method timing records (for BENCH_SUITE)
+        seen_shapes: set = set()
         for ds_or_loader in datasets:
             lazy = callable(ds_or_loader)
             t0 = time.perf_counter()
@@ -147,13 +149,20 @@ class SuiteRunner:
                 ):
                     progress(f"skip {ds.name}/{method} (finished)")
                     continue
+                shape_key = (method, tuple(ds.shape))
+                cold = shape_key not in seen_shapes  # first run pays compile
+                seen_shapes.add(shape_key)
                 t0 = time.perf_counter()
                 res = self.run_one(method, ds, method_args)
                 res = _to_host(res)  # sync + free device result buffers
                 dt = time.perf_counter() - t0
                 t_compute += dt
+                pairs.append({"task": ds.name, "method": method,
+                              "shape": list(ds.shape), "seconds": dt,
+                              "cold": cold})
                 progress(f"{ds.name}/{method}: {self.seeds} seeds x "
-                         f"{self.iters} iters in {dt:.2f}s")
+                         f"{self.iters} iters in {dt:.2f}s"
+                         f"{' (incl. compile)' if cold else ''}")
                 results[(ds.name, method)] = res
                 if store is not None:
                     _log(store, ds.name, method, res, self.seeds, self.iters)
@@ -161,7 +170,7 @@ class SuiteRunner:
                 del ds  # drop the device tensor before the next task loads
         total = time.perf_counter() - t_start
         self.last_stats = {"total_s": total, "load_s": t_load,
-                           "compute_s": t_compute}
+                           "compute_s": t_compute, "pairs": pairs}
         progress(f"suite: {len(results)} task-method pairs in {total:.2f}s "
                  f"(compute {t_compute:.2f}s, data load {t_load:.2f}s)")
         return results

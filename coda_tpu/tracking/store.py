@@ -17,6 +17,15 @@ Concurrency: sqlite in WAL mode with a busy timeout — multiple benchmark
 processes (the sweep engine's analog of the reference's SLURM fan-out) can
 log to one DB, which is exactly the concurrency control the reference
 delegates to MLflow.
+
+MLflow-client compatibility: this store implements the tables the analysis
+SQL joins on (experiments/runs/metrics/params/tags) plus ``latest_metrics``,
+but NOT MLflow's alembic version bookkeeping — so pointing ``mlflow ui``
+directly at this file will trigger its schema-version check. The supported
+path to the real UI is ``scripts/export_mlflow.py``, which replays the store
+through the genuine MLflow client API into a fresh MLflow-owned DB
+(round-trip covered by ``tests/test_mlflow_compat.py``, skipped where mlflow
+isn't installed — it is not in TPU images).
 """
 
 from __future__ import annotations
@@ -71,6 +80,15 @@ CREATE TABLE IF NOT EXISTS tags (
     key      TEXT NOT NULL,
     value    TEXT,
     run_uuid TEXT NOT NULL,
+    PRIMARY KEY (key, run_uuid)
+);
+CREATE TABLE IF NOT EXISTS latest_metrics (
+    key       TEXT NOT NULL,
+    value     REAL NOT NULL,
+    timestamp INTEGER,
+    step      INTEGER NOT NULL,
+    is_nan    INTEGER NOT NULL,
+    run_uuid  TEXT NOT NULL,
     PRIMARY KEY (key, run_uuid)
 );
 CREATE INDEX IF NOT EXISTS idx_metrics_run ON metrics(run_uuid);
@@ -133,6 +151,8 @@ class Run:
             is_nan = v != v
             rows.append((key, 0.0 if is_nan else v, ts + i, self.run_uuid,
                          int(step), int(is_nan)))
+        if not rows:
+            return
         self.store._conn.executemany(
             "DELETE FROM metrics WHERE run_uuid=? AND key=? AND step=?",
             [(self.run_uuid, key, r[4]) for r in rows],
@@ -141,6 +161,18 @@ class Run:
             "INSERT INTO metrics (key, value, timestamp, run_uuid,"
             " step, is_nan) VALUES (?,?,?,?,?,?)",
             rows,
+        )
+        # maintain MLflow's latest_metrics (max-step row per key; what the
+        # MLflow UI's run table reads)
+        last = max(rows, key=lambda r: r[4])
+        self.store._conn.execute(
+            "INSERT INTO latest_metrics (key, value, timestamp, step,"
+            " is_nan, run_uuid) VALUES (?,?,?,?,?,?)"
+            " ON CONFLICT(key, run_uuid) DO UPDATE SET"
+            " value=excluded.value, timestamp=excluded.timestamp,"
+            " step=excluded.step, is_nan=excluded.is_nan"
+            " WHERE excluded.step >= latest_metrics.step",
+            (key, last[1], last[2], last[4], last[5], self.run_uuid),
         )
 
     def log_artifact_bytes(self, name: str, data: bytes) -> str:

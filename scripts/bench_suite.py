@@ -46,6 +46,9 @@ def main(argv=None):
     p.add_argument("--eig-chunk", type=int, default=2048)
     p.add_argument("--compile-cache", default=".jax_cache")
     p.add_argument("--platform", default=None)
+    p.add_argument("--out", default=None, metavar="BENCH_SUITE.json",
+                   help="also write the full per-method/per-pair breakdown "
+                        "to this JSON file")
     args = p.parse_args(argv)
 
     import jax
@@ -79,15 +82,49 @@ def main(argv=None):
     wall = time.perf_counter() - t0
     n_pairs = len(results)
     stats = getattr(runner, "last_stats", {})
-    print(json.dumps({
+
+    # per-method totals + the compile/execute split: the first run of each
+    # (method, shape) includes its jit compile, later same-shape tasks are
+    # pure execution — "warm" extrapolates a steady-state rerun
+    per_method: dict = {}
+    warm_s = 0.0
+    for p_ in stats.get("pairs", []):
+        m = per_method.setdefault(
+            p_["method"], {"seconds": 0.0, "pairs": 0, "cold_pairs": 0})
+        m["seconds"] += p_["seconds"]
+        m["pairs"] += 1
+        if p_["cold"]:
+            m["cold_pairs"] += 1
+        else:
+            warm_s += p_["seconds"]
+    for m in per_method.values():
+        m["seconds"] = round(m["seconds"], 3)
+
+    line = {
         "metric": f"suite-26task-wall ({n_pairs} task-method pairs, "
                   f"{args.seeds} seeds, {args.iters} iters)",
         "value": round(stats.get("compute_s", wall), 2),
         "unit": "seconds (compute; total incl. synthetic datagen in "
                 "total_wall)",
         "total_wall": round(wall, 2),
+        "load_s": round(stats.get("load_s", 0.0), 2),
+        "warm_pairs_s": round(warm_s, 2),
+        "per_method_s": {k: v["seconds"] for k, v in per_method.items()},
         "vs_baseline": 0.0,
-    }))
+    }
+    print(json.dumps(line))
+    if args.out:
+        import platform as _pl
+
+        import jax as _jax
+
+        detail = dict(line)
+        detail["devices"] = [str(d) for d in _jax.devices()]
+        detail["hostname"] = _pl.node()
+        detail["per_method"] = per_method
+        detail["pairs"] = stats.get("pairs", [])
+        with open(args.out, "w") as f:
+            json.dump(detail, f, indent=2)
 
 
 if __name__ == "__main__":

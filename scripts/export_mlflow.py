@@ -1,0 +1,120 @@
+"""Export the native tracking store into a real MLflow tracking backend.
+
+The native store (``coda_tpu/tracking/store.py``) implements the schema
+subset the reference's analysis SQL needs, but not MLflow's alembic
+version bookkeeping — ``mlflow ui`` refuses unversioned DBs. This script
+replays every experiment/run/param/tag/metric through the *genuine* MLflow
+client API into a fresh MLflow-owned backend, so the resulting store is
+exactly what ``mlflow ui`` expects (the reference workflow, reference
+``README.md:45``), with the experiment -> parent-run -> seed-child layout
+preserved via the same ``mlflow.parentRunId`` / ``mlflow.runName`` tags.
+
+    python scripts/export_mlflow.py --db coda.sqlite \
+        --dest sqlite:///mlflow.sqlite
+    mlflow ui --backend-store-uri sqlite:///mlflow.sqlite
+
+Requires mlflow (not in TPU images — run wherever the UI runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+# tags that MlflowClient.create_run manages itself or that we set explicitly
+_CONTROLLED_TAGS = {"mlflow.runName", "mlflow.parentRunId"}
+
+
+def export(db_path: str, dest_uri: str, progress=print) -> dict:
+    """Replay ``db_path`` into the MLflow backend at ``dest_uri``.
+
+    Returns {experiments, runs, metrics} counts. Parent runs are created
+    before their children so ``mlflow.parentRunId`` tags resolve.
+    """
+    from mlflow.entities import Metric, Param, RunTag
+    from mlflow.tracking import MlflowClient
+
+    from coda_tpu.tracking import TrackingStore
+
+    store = TrackingStore(db_path)
+    client = MlflowClient(tracking_uri=dest_uri)
+    counts = {"experiments": 0, "runs": 0, "metrics": 0}
+
+    experiments = store.query(
+        "SELECT experiment_id, name FROM experiments"
+        " WHERE lifecycle_stage='active' ORDER BY experiment_id")
+    for exp_id, exp_name in experiments:
+        existing = client.get_experiment_by_name(exp_name)
+        dest_exp = (existing.experiment_id if existing
+                    else client.create_experiment(exp_name))
+        counts["experiments"] += 1
+
+        runs = store.query(
+            """SELECT r.run_uuid, r.status, r.start_time, r.end_time
+               FROM runs r WHERE r.experiment_id=?
+               AND r.lifecycle_stage='active' ORDER BY r.start_time""",
+            (exp_id,))
+        # parents (no mlflow.parentRunId tag) first, then children
+        id_map: dict[str, str] = {}
+        annotated = []
+        for run_uuid, status, t0, t1 in runs:
+            tags = dict(store.query(
+                "SELECT key, value FROM tags WHERE run_uuid=?", (run_uuid,)))
+            annotated.append((run_uuid, status, t0, t1, tags))
+        annotated.sort(key=lambda r: "mlflow.parentRunId" in r[4])
+
+        for run_uuid, status, t0, t1, tags in annotated:
+            run_name = tags.get("mlflow.runName", run_uuid)
+            dest_tags = {"mlflow.runName": run_name}
+            parent = tags.get("mlflow.parentRunId")
+            if parent is not None:
+                if parent not in id_map:
+                    progress(f"[export] {run_name}: parent {parent} missing;"
+                             " exporting as top-level")
+                else:
+                    dest_tags["mlflow.parentRunId"] = id_map[parent]
+            for k, v in tags.items():
+                if k not in _CONTROLLED_TAGS:
+                    dest_tags[k] = v
+            run = client.create_run(dest_exp, start_time=t0 or 0,
+                                    tags=dest_tags, run_name=run_name)
+            id_map[run_uuid] = run.info.run_id
+            counts["runs"] += 1
+
+            params = store.query(
+                "SELECT key, value FROM params WHERE run_uuid=?", (run_uuid,))
+            metrics = store.query(
+                "SELECT key, value, timestamp, step, is_nan FROM metrics"
+                " WHERE run_uuid=? ORDER BY step", (run_uuid,))
+            client.log_batch(
+                run.info.run_id,
+                metrics=[Metric(k, float("nan") if n else v, ts, step)
+                         for k, v, ts, step, n in metrics],
+                params=[Param(k, str(v)[:500]) for k, v in params],
+                tags=[RunTag("exported_from", db_path)],
+            )
+            counts["metrics"] += len(metrics)
+            client.set_terminated(run.info.run_id,
+                                  status=status or "FINISHED",
+                                  end_time=t1)
+    store.close()
+    progress(f"[export] {counts['experiments']} experiments, "
+             f"{counts['runs']} runs, {counts['metrics']} metric points "
+             f"-> {dest_uri}")
+    return counts
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--db", default="coda.sqlite",
+                   help="native tracking store to export")
+    p.add_argument("--dest", default="sqlite:///mlflow.sqlite",
+                   help="MLflow tracking URI to export into")
+    args = p.parse_args(argv)
+    export(args.db, args.dest)
+
+
+if __name__ == "__main__":
+    main()

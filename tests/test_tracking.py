@@ -129,3 +129,29 @@ def test_metric_series_reconstitutes_nan(tmp_path):
     series = store.metric_series(uuid, "m")
     assert series[0] == (1, 1.0)
     assert series[1][0] == 2 and math.isnan(series[1][1])
+
+
+def test_latest_metrics_maintained(tmp_path):
+    """latest_metrics (the MLflow UI's run-table source) holds the max-step
+    row per key and follows re-logs."""
+    from coda_tpu.tracking import TrackingStore
+
+    store = TrackingStore(str(tmp_path / "db.sqlite"))
+    with store.run("exp", "run") as r:
+        r.log_metric_series("regret", [0.5, 0.3, 0.1], start_step=1)
+        r.log_metric("final", 7.0, step=0)
+        uuid = r.run_uuid
+    rows = dict(
+        (k, (v, s)) for k, v, s in store.query(
+            "SELECT key, value, step FROM latest_metrics WHERE run_uuid=?",
+            (uuid,))
+    )
+    assert rows["regret"] == (0.1, 3)
+    assert rows["final"] == (7.0, 0)
+    # re-log replaces
+    with store.run("exp", "run") as r2:
+        r2.log_metric_series("regret", [0.4, 0.2, 0.05], start_step=1)
+    (v, s), = store.query(
+        "SELECT value, step FROM latest_metrics WHERE run_uuid=? AND"
+        " key='regret'", (uuid,))
+    assert (v, s) == (0.05, 3)
