@@ -1,0 +1,84 @@
+"""Multi-process distributed backend smoke test.
+
+Launches two real OS processes that join one ``jax.distributed`` runtime
+through ``coda_tpu.parallel.distributed.initialize`` (CPU backend, one
+virtual device each) and run a cross-process psum — catching coordinator
+env-var/API drift that the in-process no-op path can't
+(``parallel/distributed.py:28-55``; SURVEY.md §5 distributed comm backend).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")  # site hook may register axon
+sys.path.insert(0, os.environ["CODA_REPO"])
+from coda_tpu.parallel.distributed import initialize, is_primary
+
+pid = int(sys.argv[1])
+ok = initialize(coordinator_address=os.environ["COORD"],
+                num_processes=2, process_id=pid)
+assert ok, "initialize returned False in a 2-process config"
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 2, jax.device_count()
+assert jax.local_device_count() == 1
+assert is_primary() == (pid == 0)
+
+import jax.numpy as jnp
+
+# one local device per process; pmap's axis spans all GLOBAL devices, so the
+# psum crosses the process boundary through the distributed runtime
+out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+    jnp.asarray([float(pid + 1)])
+)
+assert float(out[0]) == 3.0, float(out[0])
+print(f"worker {pid} psum ok", flush=True)
+"""
+
+
+def test_two_process_psum(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env["COORD"] = f"127.0.0.1:{port}"
+    env["CODA_REPO"] = os.path.join(os.path.dirname(__file__), "..")
+    env.pop("JAX_COORDINATOR", None)
+    procs = [
+        subprocess.Popen([sys.executable, str(worker), str(pid)], env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"worker {pid} psum ok" in out
+
+
+def test_single_process_is_noop(monkeypatch):
+    from coda_tpu.parallel.distributed import initialize
+
+    monkeypatch.delenv("JAX_COORDINATOR", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert initialize() is False
