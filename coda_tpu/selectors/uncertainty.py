@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from coda_tpu.losses import accuracy_loss
 from coda_tpu.ops.masked import masked_argmax_tiebreak
-from coda_tpu.selectors.iid import RiskState, make_risk_readout
+from coda_tpu.selectors.iid import make_risk_readout
 from coda_tpu.selectors.protocol import Selector, SelectResult
 
 
@@ -32,15 +32,11 @@ def make_uncertainty(
 ) -> Selector:
     H, N, C = preds.shape
     scores = uncertainty_scores(preds)  # static: non-adaptive acquisition
-    risk, best = make_risk_readout(preds, loss_fn)
+    init_state, risk, best, update = make_risk_readout(preds, loss_fn)
 
     def init(key):
         del key
-        return RiskState(
-            unlabeled=jnp.ones((N,), dtype=bool),
-            labels_acq=jnp.zeros((N,), dtype=jnp.int32),
-            n_labeled=jnp.asarray(0, jnp.int32),
-        )
+        return init_state()
 
     def select(state, key) -> SelectResult:
         idx, n_ties = masked_argmax_tiebreak(key, scores, state.unlabeled)
@@ -48,14 +44,6 @@ def make_uncertainty(
             idx=idx.astype(jnp.int32),
             prob=scores[idx],
             stochastic=n_ties > 1,
-        )
-
-    def update(state, idx, true_class, prob):
-        del prob
-        return RiskState(
-            unlabeled=state.unlabeled.at[idx].set(False),
-            labels_acq=state.labels_acq.at[idx].set(true_class),
-            n_labeled=state.n_labeled + 1,
         )
 
     return Selector(
