@@ -79,6 +79,12 @@ def parse_args(argv=None):
                    help="rounds between checkpoints (with --checkpoint-dir)")
     p.add_argument("--eig-chunk", type=int, default=1024,
                    help="lax.map batch size for the EIG scoring pass.")
+    p.add_argument("--eig-mode", default="auto",
+                   choices=["auto", "incremental", "factored", "rowscan",
+                            "direct"],
+                   help="EIG kernel: auto picks incremental (cached "
+                        "per-class P(best), C-fold fewer FLOPs/round) when "
+                        "its cache fits, else factored, else rowscan")
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
                    help="shard the (H,N,C) tensor, e.g. 'data=4' or 'data=4,model=2'")
     p.add_argument("--platform", default=None,
@@ -154,6 +160,7 @@ def build_selector_factory(args, task_name: str):
             disable_diag_prior=args.no_diag_prior,
             q=args.q,
             eig_chunk=args.eig_chunk,
+            eig_mode=getattr(args, "eig_mode", "auto"),
         )
         return lambda preds: make_coda(preds, hp, name=method)
     if method == "model_picker":

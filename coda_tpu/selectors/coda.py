@@ -13,7 +13,14 @@ and its kernel functions at ``:14-168``), re-architected for XLA:
     cumulative trapezoid (see ``coda_tpu/ops/pbest.py``);
   * the consensus prefilter (drop points where every model agrees,
     ``coda/coda.py:215-224``) becomes a static boolean mask; the optional
-    ``prefilter_n`` random subsample becomes a top-k over masked uniforms.
+    ``prefilter_n`` random subsample becomes a top-k over masked uniforms;
+  * the default EIG is INCREMENTAL: a labeling round touches only Dirichlet
+    row ``true_class``, so the (N, C, H) hypothetical-P(best) tensor is
+    carried in the scan state and only the updated class row is recomputed
+    per round — a C-fold FLOP cut over re-deriving everything, with scoring
+    reduced to elementwise mixture entropies over the cache. ``eig_mode``
+    tiers: incremental (cache fits) -> factored (tables fit) -> rowscan
+    (very large C·H pools, O(H·G) temps), all computing the same integral.
 
 Numeric choreography (grid endpoints, eps floors, +-80 clamps, fp32
 everywhere, HIGHEST-precision einsums) follows the reference so the EIG
@@ -54,8 +61,50 @@ class CODAHyperparams(NamedTuple):
     q: str = "eig"                # acquisition: eig | iid | uncertainty (ablation 2)
     eig_chunk: int = 256          # memory valve for the EIG map
     num_points: int = 256         # P(best) integration grid
-    eig_mode: str = "factored"    # factored (MXU, default) | direct (reference
-    #                               numeric choreography, kept for cross-checks)
+    eig_mode: str = "auto"        # auto | incremental (cached per-class P(best),
+    #                               C-fold fewer FLOPs/round) | factored (MXU,
+    #                               stateless) | direct (reference numeric
+    #                               choreography, kept for cross-checks)
+
+
+# "auto" picks the incremental EIG only while its (N, C, H) fp32 cache fits
+# comfortably on one chip; past this it falls back to the stateless factored
+# kernel (the cache is exactly as large as the prediction tensor itself, so
+# at the 100 GB ImageNet scale it must be sharded deliberately, not by default)
+_INCR_CACHE_MAX_BYTES = 4 << 30
+# past this the factored kernel's four (C, H, num_points) fp32 Beta tables
+# don't fit either and "auto" scans class rows instead. For calibration: the
+# ImageNet-scale config (C=1000, H=500, G=256) needs 4 x 512 MB of tables —
+# within this budget, so "auto" stays factored there; rowscan engages for
+# pools ~4x beyond it (e.g. the C=1000 x H=2000+ HF zero-shot pool).
+_TABLES_MAX_BYTES = 2 << 30
+
+
+def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
+    """The concrete EIG kernel tier for this config (shared with bench.py so
+    reported FLOPs always describe the kernel that actually ran).
+
+    auto -> incremental while (a) the acquisition is full-pool EIG — the
+    prefilter path re-scores a different random subset each round, while the
+    cache's row refresh is O(N) regardless — and (b) the (N, C, H) cache
+    fits; else factored while its (C, H, G) tables fit; else rowscan.
+    """
+    full_pool_eig = (hp.q == "eig"
+                     and not (hp.prefilter_n and hp.prefilter_n < N))
+    if hp.eig_mode != "auto":
+        if hp.eig_mode == "incremental" and not full_pool_eig:
+            raise ValueError(
+                "eig_mode='incremental' requires the full-pool EIG "
+                "acquisition (q='eig' without an active prefilter); the "
+                f"requested config (q={hp.q!r}, prefilter_n={hp.prefilter_n}) "
+                "would maintain a large P(best) cache that is never read"
+            )
+        return hp.eig_mode
+    if full_pool_eig and 4 * N * C * H <= _INCR_CACHE_MAX_BYTES:
+        return "incremental"
+    if 16 * C * H * hp.num_points <= _TABLES_MAX_BYTES:
+        return "factored"
+    return "rowscan"
 
 
 class CODAState(NamedTuple):
@@ -63,6 +112,13 @@ class CODAState(NamedTuple):
     pi_hat_xi: jnp.ndarray     # (N, C) per-item class posterior
     pi_hat: jnp.ndarray        # (C,) marginal class estimate
     unlabeled: jnp.ndarray     # (N,) bool
+    # incremental-EIG cache (None unless eig_mode resolves to "incremental"):
+    # P(best | class row c) under the current posterior, and under the
+    # hypothetical +1 label of item n as class c. Only Dirichlet row
+    # ``true_class`` changes per labeling round (see ``update``), so all other
+    # rows of both tensors carry over unchanged between rounds.
+    pbest_rows: Optional[jnp.ndarray] = None   # (C, H)
+    pbest_hyp: Optional[jnp.ndarray] = None    # (N, C, H)
 
 
 def update_pi_hat(
@@ -124,6 +180,251 @@ def eig_scores(
     return lax.map(item_eig, (hard_preds, pi_hat_xi), batch_size=chunk)
 
 
+def _trapz_weights(num_points: int, dx, dtype) -> jnp.ndarray:
+    """Uniform-grid trapezoid weights. Any constant scale cancels in the
+    per-(n, c) normalization over models, but keep the exact rule anyway."""
+    w = jnp.full((num_points,), dx, dtype).at[0].set(0.5 * dx)
+    return w.at[-1].set(0.5 * dx)
+
+
+def _bump_tables(a, b, x, dx, update_weight):
+    """Per-model Beta grid tables for the two hypothetical-label variants.
+
+    ``a``, ``b``: ``(..., H)`` diagonal-Beta parameters (leading axes are
+    class rows when called on the full posterior, absent when called on the
+    single updated row). The +1-count hypothetical update gives every model's
+    Beta one of only TWO settings — "bumped" ``(a+w, b)`` when the model
+    predicted the hypothesized class, else "unbumped" ``(a, b+w)`` — so the
+    expensive transcendentals are O(|a| * G), independent of N.
+
+    Returns ``(S0, dlogcdf, F_u, dF)`` with the grid axis last, where
+    ``S0 = Σ_H logcdf_unbumped`` and the ``d*`` tables are bumped - unbumped.
+    """
+    def tab(aa, bb):
+        logpdf = beta_log_pdf(x, aa[..., None], bb[..., None])   # (..., H, G)
+        pdf = jnp.exp(logpdf)
+        cdf = cumtrapz_uniform(pdf, dx, axis=-1)
+        logcdf = jnp.log(jnp.clip(cdf, _EPS, None))
+        # exp(logpdf - logcdf) <= pdf_max * 1/eps-floor; cap the exponent so
+        # fp32 never overflows (binds only where the integrand is ~0 anyway)
+        F = jnp.exp(jnp.clip(logpdf - logcdf, None, 85.0))
+        return logcdf, F
+
+    logcdf_u, F_u = tab(a, b + update_weight)        # model predicted != c
+    logcdf_b, F_b = tab(a + update_weight, b)        # model predicted c
+    return logcdf_u.sum(axis=-2), logcdf_b - logcdf_u, F_u, F_b - F_u
+
+
+def _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz):
+    """Hypothetical P(best) for a block of items: ``eq`` (B, C, H) -> (B, C, H).
+
+    Three dense einsums over the model/grid axes — fp32 matmuls on the MXU
+    instead of per-item lgamma/cumsum. The max-shift of S per (n, c) replaces
+    the reference's ±80 clamp (both only affect integrand tails ~1e-35 below
+    the peak; normalization over models cancels the shift exactly).
+    """
+    # S[n,c,g] = Σ_h logcdf of whichever variant model h takes at (n,c)
+    S = S0[None] + jnp.einsum("bch,chg->bcg", eq, dlogcdf,
+                              precision=_PRECISION)
+    S = S - S.max(axis=-1, keepdims=True)            # underflow guard
+    wE = w_trapz * jnp.exp(S)                        # (B, C, G)
+    t_base = jnp.einsum("bcg,chg->bch", wE, F_u, precision=_PRECISION)
+    t_diff = jnp.einsum("bcg,chg->bch", wE, dF, precision=_PRECISION)
+    unnorm = t_base + eq * t_diff                    # (B, C, H)
+    return unnorm / jnp.clip(unnorm.sum(-1, keepdims=True), _EPS, None)
+
+
+def build_eig_cache(
+    dirichlets: jnp.ndarray,   # (H, C, C)
+    hard_preds: jnp.ndarray,   # (N, H) int32
+    update_weight: float = 1.0,
+    num_points: int = 256,
+    chunk: int = 256,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Full (pbest_rows, pbest_hyp) cache for the incremental EIG.
+
+    One factored pass over all N items and C class rows — the same math as
+    :func:`eig_scores_factored`'s table+einsum stage, run once at selector
+    init (and never again: ``update_eig_cache`` refreshes single rows).
+    """
+    H, C, _ = dirichlets.shape
+    N = hard_preds.shape[0]
+    a_cc, b_cc = dirichlet_to_beta(dirichlets)
+    aT, bT = a_cc.T, b_cc.T                          # (C, H)
+    pbest_rows = compute_pbest(aT, bT, num_points=num_points)
+    x = pbest_grid(num_points)
+    dx = x[1] - x[0]
+    w_trapz = _trapz_weights(num_points, dx, x.dtype)
+    S0, dlogcdf, F_u, dF = _bump_tables(aT, bT, x, dx, update_weight)
+    class_range = jnp.arange(C, dtype=jnp.int32)
+
+    def blk(pred_b):                                 # (B, H) -> (B, C, H)
+        eq = (pred_b[:, None, :] == class_range[None, :, None]).astype(x.dtype)
+        return _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz)
+
+    B = min(chunk, N)
+    if B >= N:
+        return pbest_rows, blk(hard_preds)
+    # explicit (chunk, ·) blocks, padded remainder — same scheme as the
+    # factored kernel's memory valve
+    pad = (-N) % B
+    hp_pad = jnp.pad(hard_preds, ((0, pad), (0, 0)))
+    out = lax.map(blk, hp_pad.reshape((N + pad) // B, B, -1))
+    return pbest_rows, out.reshape(N + pad, C, -1)[:N]
+
+
+def update_eig_cache(
+    dirichlets: jnp.ndarray,   # (H, C, C) — ALREADY holding the new label
+    true_class: jnp.ndarray,   # scalar int
+    hard_preds: jnp.ndarray,   # (N, H) int32
+    pbest_rows: jnp.ndarray,   # (C, H)
+    pbest_hyp: jnp.ndarray,    # (N, C, H)
+    update_weight: float = 1.0,
+    num_points: int = 256,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Refresh class row ``true_class`` of the incremental-EIG cache.
+
+    A labeling round touches only Dirichlet row ``true_class`` (reference
+    semantics ``coda/coda.py:313-316``: ``dirichlets[:, y, :] += lr * onehot``),
+    and both cache tensors factor per class row — the hypothetical P(best)
+    normalization is per (item, row) over models — so every other row is
+    bitwise carried over. Cost: O(N·H·G) einsums for one row instead of the
+    full kernel's O(N·C·H·G), the C-fold saving that makes the EIG
+    incremental.
+    """
+    a_cc, b_cc = dirichlet_to_beta(dirichlets)       # (H, C)
+    a_t = jnp.take(a_cc, true_class, axis=1)         # (H,)
+    b_t = jnp.take(b_cc, true_class, axis=1)
+    eq_t = (hard_preds == true_class)                # (N, H) bool
+    hyp_t = _pbest_hyp_row(a_t, b_t, eq_t, update_weight, num_points)
+    row_t = compute_pbest(a_t, b_t, num_points=num_points)       # (H,)
+    return (
+        pbest_rows.at[true_class].set(row_t),
+        pbest_hyp.at[:, true_class, :].set(hyp_t),
+    )
+
+
+def _pbest_hyp_row(a_t, b_t, eq_t, update_weight: float, num_points: int):
+    """Hypothetical P(best) for ONE class row over a batch of items.
+
+    ``a_t``, ``b_t``: ``(H,)`` diagonal-Beta parameters of the row;
+    ``eq_t``: ``(B, H)`` bool — did model h predict this class at item b.
+    Returns ``(B, H)``. Temp footprint is O(H·G + B·G + B·H) — independent
+    of C, so the incremental row refresh costs 1/C of the full factored
+    pass, and the row-scanned EIG stays viable past the point where the
+    (C, H, G) tables blow the ``_TABLES_MAX_BYTES`` budget.
+    """
+    x = pbest_grid(num_points)
+    dx = x[1] - x[0]
+    w_trapz = _trapz_weights(num_points, dx, x.dtype)
+    S0_t, dlogcdf_t, F_u_t, dF_t = _bump_tables(a_t, b_t, x, dx, update_weight)
+    eq = eq_t.astype(x.dtype)
+    S = S0_t[None] + jnp.einsum("nh,hg->ng", eq, dlogcdf_t,
+                                precision=_PRECISION)
+    S = S - S.max(axis=-1, keepdims=True)
+    wE = w_trapz * jnp.exp(S)                                    # (B, G)
+    t_base = jnp.einsum("ng,hg->nh", wE, F_u_t, precision=_PRECISION)
+    t_diff = jnp.einsum("ng,hg->nh", wE, dF_t, precision=_PRECISION)
+    unnorm = t_base + eq * t_diff                                # (B, H)
+    return unnorm / jnp.clip(unnorm.sum(-1, keepdims=True), _EPS, None)
+
+
+def compute_pbest_rows(aT, bT, num_points: int = 256,
+                       row_chunk: int = 1) -> jnp.ndarray:
+    """:func:`~coda_tpu.ops.pbest.compute_pbest` row by row: ``(C, H)`` from
+    ``(C, H)`` Beta parameters with O(row_chunk·H·G) temps instead of the
+    one-shot kernel's (C, H, G)."""
+    return lax.map(
+        lambda ab: compute_pbest(ab[0], ab[1], num_points=num_points),
+        (aT, bT), batch_size=min(row_chunk, aT.shape[0]),
+    )
+
+
+def eig_scores_rowscan(
+    dirichlets: jnp.ndarray,   # (H, C, C)
+    pi_hat: jnp.ndarray,       # (C,)
+    pi_hat_xi: jnp.ndarray,    # (N, C)
+    hard_preds: jnp.ndarray,   # (N, H) int32 argmax predictions
+    update_weight: float = 1.0,
+    num_points: int = 256,
+    chunk: int = 256,
+) -> jnp.ndarray:
+    """EIG of labeling each point, scanned over class rows. Returns (N,).
+
+    Same integral as :func:`eig_scores_factored`, restructured for large
+    C·H: the factored kernel materializes four (C, H, G) Beta tables — 512 MB
+    each at the ImageNet-scale config (C=1000, H=500, G=256), which still
+    fits, but growing linearly with the model pool (the C=1000 x H=2000+ HF
+    zero-shot pool puts them past ``_TABLES_MAX_BYTES``). Here a ``lax.scan``
+    visits one class row at a time — O(H·G) tables, O(chunk·G) integrand —
+    and accumulates each row's expected-entropy contribution
+    ``pi_hat_xi[:, c] * H(mixture | label c)`` into a running (N,) sum.
+    FLOPs are identical to the factored kernel; only temp memory changes.
+    """
+    H, C, _ = dirichlets.shape
+    N = hard_preds.shape[0]
+    a_cc, b_cc = dirichlet_to_beta(dirichlets)
+    aT, bT = a_cc.T, b_cc.T                          # (C, H)
+    pbest_before = compute_pbest_rows(aT, bT, num_points=num_points)
+    mixture0 = (pi_hat[:, None] * pbest_before).sum(0)           # (H,)
+    h_before = entropy2(mixture0)
+
+    class_range = jnp.arange(C, dtype=jnp.int32)
+    # pad the (cheap, int32) item axis once so every class row sees the same
+    # static (n_blocks, B, H) blocking
+    B = min(chunk, N)
+    pad = (-N) % B
+    hp_blocks = jnp.pad(hard_preds, ((0, pad), (0, 0))).reshape(
+        (N + pad) // B, B, -1
+    )
+
+    def class_row(acc, xs):
+        c_idx, a_t, b_t, before_t, pi_c = xs
+
+        def blk(pred_b):                             # (B, H) -> (B,)
+            hyp = _pbest_hyp_row(a_t, b_t, pred_b == c_idx,
+                                 update_weight, num_points)
+            mix = mixture0[None] + pi_c * (hyp - before_t[None])
+            return entropy2(mix, axis=-1)
+
+        h_after_c = lax.map(blk, hp_blocks).reshape(-1)[:N]
+        return acc + pi_hat_xi[:, c_idx] * h_after_c, None
+
+    acc, _ = lax.scan(
+        class_row, jnp.zeros((N,), mixture0.dtype),
+        (class_range, aT, bT, pbest_before, pi_hat),
+    )
+    return h_before - acc
+
+
+def eig_scores_from_cache(
+    pbest_rows: jnp.ndarray,   # (C, H)
+    pbest_hyp: jnp.ndarray,    # (N, C, H)
+    pi_hat: jnp.ndarray,       # (C,)
+    pi_hat_xi: jnp.ndarray,    # (N, C)
+    chunk: int = 256,
+) -> jnp.ndarray:
+    """EIG of labeling each point from the incremental cache. Returns (N,).
+
+    With the hypothetical P(best) tensors cached, scoring a round is pure
+    elementwise work + reductions — O(N·C·H) with no transcendental tables
+    and no matmuls — evaluated in blocks so the (B, C, H) mixture temp stays
+    a fraction of the cache itself. Matches :func:`eig_scores_factored`'s
+    tail exactly (same mixture-delta and entropy expressions).
+    """
+    mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
+    h_before = entropy2(mixture0)
+
+    def item(args):
+        hyp_n, pi_xi_n = args                        # (C, H), (C,)
+        mix_new = mixture0[None] + pi_hat[:, None] * (hyp_n - pbest_rows)
+        h_after = entropy2(mix_new, axis=-1)         # (C,)
+        return h_before - (pi_xi_n * h_after).sum()
+
+    N = pbest_hyp.shape[0]
+    return lax.map(item, (pbest_hyp, pi_hat_xi), batch_size=min(chunk, N))
+
+
 def eig_scores_factored(
     dirichlets: jnp.ndarray,   # (H, C, C)
     pi_hat: jnp.ndarray,       # (C,)
@@ -162,41 +463,15 @@ def eig_scores_factored(
 
     x = pbest_grid(num_points)                       # (G,)
     dx = x[1] - x[0]
-    # uniform-grid trapezoid weights; any constant scale cancels in the
-    # per-(n,c) normalization over models, but keep the exact rule anyway
-    w_trapz = jnp.full((num_points,), dx, x.dtype).at[0].set(0.5 * dx)
-    w_trapz = w_trapz.at[-1].set(0.5 * dx)
-
-    def tables(a, b):
-        logpdf = beta_log_pdf(x, a[..., None], b[..., None])     # (C, H, G)
-        pdf = jnp.exp(logpdf)
-        cdf = cumtrapz_uniform(pdf, dx, axis=-1)
-        logcdf = jnp.log(jnp.clip(cdf, _EPS, None))
-        # exp(logpdf - logcdf) <= pdf_max * 1/eps-floor; cap the exponent so
-        # fp32 never overflows (binds only where the integrand is ~0 anyway)
-        F = jnp.exp(jnp.clip(logpdf - logcdf, None, 85.0))
-        return logcdf, F
-
-    logcdf_u, F_u = tables(aT, bT + update_weight)   # model predicted != c
-    logcdf_b, F_b = tables(aT + update_weight, bT)   # model predicted c
-    S0 = logcdf_u.sum(axis=1)                        # (C, G)
-    dlogcdf = logcdf_b - logcdf_u                    # (C, H, G)
-    dF = F_b - F_u                                   # (C, H, G)
+    w_trapz = _trapz_weights(num_points, dx, x.dtype)
+    S0, dlogcdf, F_u, dF = _bump_tables(aT, bT, x, dx, update_weight)
 
     class_range = jnp.arange(C, dtype=jnp.int32)
 
     def chunk_eig(args):
         pred_b, pi_xi_b = args                       # (B, H) int32, (B, C)
         eq = (pred_b[:, None, :] == class_range[None, :, None]).astype(x.dtype)
-        # S[n,c,g] = Σ_h logcdf of whichever variant model h takes at (n,c)
-        S = S0[None] + jnp.einsum("bch,chg->bcg", eq, dlogcdf,
-                                  precision=_PRECISION)
-        S = S - S.max(axis=-1, keepdims=True)        # underflow guard
-        wE = w_trapz * jnp.exp(S)                    # (B, C, G)
-        t_base = jnp.einsum("bcg,chg->bch", wE, F_u, precision=_PRECISION)
-        t_diff = jnp.einsum("bcg,chg->bch", wE, dF, precision=_PRECISION)
-        unnorm = t_base + eq * t_diff                # (B, C, H)
-        pbest_hyp = unnorm / jnp.clip(unnorm.sum(-1, keepdims=True), _EPS, None)
+        pbest_hyp = _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz)
         # only row c changed; propagate the delta through the class mixture
         mix_new = mixture0[None, None] + pi_hat[None, :, None] * (
             pbest_hyp - pbest_before[None]
@@ -227,10 +502,17 @@ def _disagreement_mask(hard_preds: jnp.ndarray, C: int) -> jnp.ndarray:
 
     The reference uses ``torch.mode`` over models (``coda/coda.py:215-219``);
     here the majority is the argmax of one-hot vote counts (identical choice:
-    both pick the smallest modal class).
+    both pick the smallest modal class). Blocked over items so the (B, H, C)
+    one-hot temp stays ~64 MB even at ImageNet scale (H=500, C=1000).
     """
-    votes = jax.nn.one_hot(hard_preds, C, dtype=jnp.int32).sum(axis=1)  # (N, C)
-    maj = jnp.argmax(votes, axis=-1)                                    # (N,)
+    N, H = hard_preds.shape
+
+    def item_majority(pred_n):                       # (H,) -> scalar
+        votes = jax.nn.one_hot(pred_n, C, dtype=jnp.int32).sum(axis=0)
+        return jnp.argmax(votes)
+
+    B = max(1, min(N, (64 << 20) // max(1, 4 * H * C)))
+    maj = lax.map(item_majority, hard_preds, batch_size=B)              # (N,)
     return (hard_preds != maj[:, None]).any(axis=-1)
 
 
@@ -257,14 +539,25 @@ def make_coda(
         from coda_tpu.selectors.uncertainty import uncertainty_scores
         unc_scores = uncertainty_scores(preds)            # (N,)
 
+    use_prefilter = hp.q == "eig" and hp.prefilter_n and hp.prefilter_n < N
+    eig_mode = resolve_eig_mode(hp, H, N, C)
+    incremental = eig_mode == "incremental"
+
     def init(key):
         del key  # CODA's initialization is deterministic
         pi_xi, pi = update_pi_hat(dirichlets0, preds)
+        rows, hyp = (
+            build_eig_cache(dirichlets0, hard_preds,
+                            num_points=hp.num_points, chunk=hp.eig_chunk)
+            if incremental else (None, None)
+        )
         return CODAState(
             dirichlets=dirichlets0,
             pi_hat_xi=pi_xi,
             pi_hat=pi,
             unlabeled=jnp.ones((N,), dtype=bool),
+            pbest_rows=rows,
+            pbest_hyp=hyp,
         )
 
     def _candidates(state: CODAState) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -280,19 +573,27 @@ def make_coda(
         cand = jnp.where(empty, state.unlabeled, cand0)
         return cand, ~empty
 
-    if hp.eig_mode == "factored":
+    if eig_mode in ("factored", "incremental"):
         eig_fn = eig_scores_factored
-    elif hp.eig_mode == "direct":
+    elif eig_mode == "rowscan":
+        eig_fn = eig_scores_rowscan
+    elif eig_mode == "direct":
         eig_fn = eig_scores
     else:
-        raise ValueError(f"unknown eig_mode {hp.eig_mode!r}")
+        raise ValueError(f"unknown eig_mode {eig_mode!r}")
 
     def _eig_select_full(state: CODAState, cand, k_tie) -> SelectResult:
         """Score every point, mask to the candidate set at argmax time."""
-        scores = eig_fn(
-            state.dirichlets, state.pi_hat, state.pi_hat_xi, hard_preds,
-            num_points=hp.num_points, chunk=hp.eig_chunk,
-        )
+        if incremental:
+            scores = eig_scores_from_cache(
+                state.pbest_rows, state.pbest_hyp, state.pi_hat,
+                state.pi_hat_xi, chunk=hp.eig_chunk,
+            )
+        else:
+            scores = eig_fn(
+                state.dirichlets, state.pi_hat, state.pi_hat_xi, hard_preds,
+                num_points=hp.num_points, chunk=hp.eig_chunk,
+            )
         idx, n_ties = masked_argmax_tiebreak(k_tie, scores, cand,
                                              rtol=_TIE_RTOL, atol=_TIE_ATOL)
         return SelectResult(
@@ -330,7 +631,6 @@ def make_coda(
     def select(state: CODAState, key) -> SelectResult:
         k_sub, k_tie = jax.random.split(key)
         cand, may_subsample = _candidates(state)
-        use_prefilter = hp.q == "eig" and hp.prefilter_n and hp.prefilter_n < N
 
         if hp.q == "eig" and not use_prefilter:
             return _eig_select_full(state, cand, k_tie)
@@ -380,14 +680,31 @@ def make_coda(
             update_strength * onehot
         )
         pi_xi, pi = update_pi_hat(dirichlets, preds)
+        rows, hyp = (
+            update_eig_cache(dirichlets, true_class, hard_preds,
+                             state.pbest_rows, state.pbest_hyp,
+                             num_points=hp.num_points)
+            if incremental else (None, None)
+        )
         return CODAState(
             dirichlets=dirichlets,
             pi_hat_xi=pi_xi,
             pi_hat=pi,
             unlabeled=state.unlabeled.at[idx].set(False),
+            pbest_rows=rows,
+            pbest_hyp=hyp,
         )
 
     def get_pbest(state: CODAState) -> jnp.ndarray:
+        if incremental:
+            # the cached per-row P(best) is exactly compute_pbest of the
+            # current posterior; only the pi-hat mixture is recomputed
+            return (state.pi_hat[:, None] * state.pbest_rows).sum(0)
+        if eig_mode == "rowscan":  # large C: avoid the (C, H, G) temp
+            a_cc, b_cc = dirichlet_to_beta(state.dirichlets)
+            rows = compute_pbest_rows(a_cc.T, b_cc.T,
+                                      num_points=hp.num_points)
+            return (state.pi_hat[:, None] * rows).sum(0)
         return pbest_row_mixture(state.dirichlets, state.pi_hat,
                                  num_points=hp.num_points)  # (H,)
 

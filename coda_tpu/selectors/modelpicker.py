@@ -96,6 +96,23 @@ def make_modelpicker(
     # points where any model disagrees with model 0 (reference :46-48)
     disagree = (hard_preds != hard_preds[:, :1]).any(axis=1)
 
+    # Where the prediction tensor is concrete (CLI / bench path), the
+    # disagreement set is static — score ONLY those points each round. This
+    # is exact, not an approximation: at a full-agreement point every
+    # hypothetical class shifts all model logits by the same constant, and
+    # softmax is shift-invariant, so its expected entropy is the posterior's
+    # own entropy — one scalar, identical for every such point (and bitwise
+    # equal to what the full kernel computes for them). Under a tracer
+    # (selector built inside jit) the set isn't static; keep full scoring.
+    import numpy as np
+
+    static_cand = None
+    if not isinstance(preds, jax.core.Tracer):
+        idxs = np.flatnonzero(np.asarray(disagree))
+        if 0 < idxs.size < N:
+            static_cand = jnp.asarray(idxs, jnp.int32)
+            hard_sub = hard_preds[static_cand]         # (K, H)
+
     def init(key):
         del key
         return ModelPickerState(
@@ -106,7 +123,12 @@ def make_modelpicker(
         )
 
     def select(state, key) -> SelectResult:
-        ent = expected_entropies(hard_preds, state.posterior, gamma, C)
+        if static_cand is not None:
+            ent_sub = expected_entropies(hard_sub, state.posterior, gamma, C)
+            h_agree = entropy2(state.posterior)
+            ent = jnp.full((N,), h_agree).at[static_cand].set(ent_sub)
+        else:
+            ent = expected_entropies(hard_preds, state.posterior, gamma, C)
         # restrict to disagreement points when any remain unlabeled
         # (reference sets agreement entropies to +inf only if mask.any())
         cand = disagree & state.unlabeled

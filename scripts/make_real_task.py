@@ -1,0 +1,115 @@
+"""Build a REAL (non-synthetic) model-selection task: the NIST digits data.
+
+The reference validates on 26 real prediction tensors downloaded from its
+release artifacts (reference ``README.md:53``); none are fetchable in this
+offline environment, so this script reconstructs the same *kind* of artifact
+from first principles: a pool of genuinely different models — varied
+families, capacities, and regularization, some strong and some deliberately
+weak — trained on a real dataset (sklearn's bundled NIST digits, 1797
+8x8 images, 10 classes), scored on a held-out evaluation split. The output
+is a native ``<task>.npz`` (post-softmax ``(H, N, C)`` preds + labels +
+class names) consumed by ``main.py`` exactly like any reference task tensor.
+
+Usage: python scripts/make_real_task.py [--out data/digits.npz] [--test-frac 0.5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+
+def model_pool(seed: int = 0):
+    """A diverse pool: (name, estimator) pairs, all with predict_proba."""
+    from sklearn.ensemble import (
+        GradientBoostingClassifier,
+        RandomForestClassifier,
+    )
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.naive_bayes import GaussianNB
+    from sklearn.neighbors import KNeighborsClassifier
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.svm import SVC
+    from sklearn.tree import DecisionTreeClassifier
+
+    return [
+        ("logreg_c0.01", LogisticRegression(C=0.01, max_iter=2000)),
+        ("logreg_c1", LogisticRegression(C=1.0, max_iter=2000)),
+        ("logreg_c100", LogisticRegression(C=100.0, max_iter=2000)),
+        ("mlp_16", MLPClassifier((16,), max_iter=600, random_state=seed)),
+        ("mlp_64", MLPClassifier((64,), max_iter=600, random_state=seed + 1)),
+        ("mlp_64x32", MLPClassifier((64, 32), max_iter=600,
+                                    random_state=seed + 2)),
+        ("rf_depth3", RandomForestClassifier(
+            n_estimators=50, max_depth=3, random_state=seed)),
+        ("rf_depth10", RandomForestClassifier(
+            n_estimators=100, max_depth=10, random_state=seed + 1)),
+        ("gboost", GradientBoostingClassifier(
+            n_estimators=60, max_depth=2, random_state=seed)),
+        ("knn_3", KNeighborsClassifier(3)),
+        ("knn_25", KNeighborsClassifier(25)),
+        ("tree_depth4", DecisionTreeClassifier(
+            max_depth=4, random_state=seed)),
+        ("gauss_nb", GaussianNB()),
+        ("svc_rbf", SVC(probability=True, random_state=seed)),
+    ]
+
+
+def build(out: str, test_frac: float = 0.5, seed: int = 0) -> dict:
+    from sklearn.datasets import load_digits
+    from sklearn.model_selection import train_test_split
+
+    digits = load_digits()
+    x_tr, x_ev, y_tr, y_ev = train_test_split(
+        digits.data.astype(np.float32) / 16.0,
+        digits.target.astype(np.int32),
+        test_size=test_frac, random_state=seed, stratify=digits.target,
+    )
+
+    pool = model_pool(seed)
+    C = len(digits.target_names)
+    preds = np.zeros((len(pool), len(y_ev), C), dtype=np.float32)
+    accs = {}
+    for h, (name, est) in enumerate(pool):
+        est.fit(x_tr, y_tr)
+        p = est.predict_proba(x_ev).astype(np.float32)
+        # some estimators can drop classes absent from their training view;
+        # guard the invariant the framework assumes
+        assert p.shape == (len(y_ev), C), (name, p.shape)
+        preds[h] = p / np.clip(p.sum(-1, keepdims=True), 1e-12, None)
+        accs[name] = float((p.argmax(-1) == y_ev).mean())
+
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    np.savez_compressed(
+        out,
+        preds=preds,
+        labels=y_ev.astype(np.int32),
+        classes=np.asarray([str(c) for c in digits.target_names]),
+        models=np.asarray([n for n, _ in pool]),
+    )
+    return accs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(REPO, "data", "digits.npz"))
+    ap.add_argument("--test-frac", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    accs = build(args.out, args.test_frac, args.seed)
+    print(f"wrote {args.out}")
+    for name, acc in sorted(accs.items(), key=lambda kv: -kv[1]):
+        print(f"  {name:14s} acc={acc:.4f}")
+    best = max(accs.values())
+    spread = best - min(accs.values())
+    print(f"pool: {len(accs)} models, best acc {best:.4f}, spread {spread:.4f}")
+
+
+if __name__ == "__main__":
+    main()
