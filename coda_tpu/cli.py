@@ -85,6 +85,10 @@ def parse_args(argv=None):
                    help="EIG kernel: auto picks incremental (cached "
                         "per-class P(best), C-fold fewer FLOPs/round) when "
                         "its cache fits, else factored, else rowscan")
+    p.add_argument("--eig-backend", default="jnp",
+                   choices=["jnp", "pallas"],
+                   help="incremental-EIG scoring backend: pallas = fused "
+                        "single-HBM-pass TPU kernel (interpreted off-TPU)")
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
                    help="shard the (H,N,C) tensor, e.g. 'data=4' or 'data=4,model=2'")
     p.add_argument("--platform", default=None,
@@ -161,6 +165,7 @@ def build_selector_factory(args, task_name: str):
             q=args.q,
             eig_chunk=args.eig_chunk,
             eig_mode=getattr(args, "eig_mode", "auto"),
+            eig_backend=getattr(args, "eig_backend", "jnp"),
         )
         return lambda preds: make_coda(preds, hp, name=method)
     if method == "model_picker":

@@ -1,0 +1,104 @@
+"""Pallas TPU kernel for the incremental-EIG scoring pass.
+
+The incremental CODA selector scores a round by streaming the cached
+``(N, C, H)`` hypothetical-P(best) tensor once and reducing it to ``(N,)``
+expected-entropy drops (see ``coda_tpu.selectors.coda.eig_scores_from_cache``
+— identical math). At the headline config the cache is 2 GB, so the pass is
+HBM-bandwidth-bound; this kernel tiles N into VMEM-resident blocks and fuses
+the whole chain — mixture delta, clamp, log2 entropy, class mixture — into
+one read of each cache element, with no intermediate (B, C, H) tensors ever
+returning to HBM.
+
+The jnp reference path remains the default everywhere; the kernel is opt-in
+via ``CODAHyperparams(eig_backend="pallas")`` / ``--eig-backend pallas``. On
+non-TPU backends it runs in interpreter mode (tests exercise it on CPU).
+Single-device only: ``pallas_call`` is an opaque custom call that GSPMD
+cannot partition, so ``make_coda`` rejects the combination of this backend
+with a multi-device-sharded prediction tensor.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_ENTROPY_FLOOR = 1e-12  # reference clamp, see ops/masked.py entropy2
+
+
+def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
+                        hyp_ref, pi_xi_ref, out_ref):
+    """One N-tile: (B, C, H) cache block -> (B,) scores.
+
+    Refs: mixture0 (1, H); h_before (1, 1); pi_hat (1, C); rows (C, H);
+    hyp (B, C, H); pi_xi (B, C); out (B,).
+    """
+    mixture0 = mixture0_ref[0, :]                    # (H,)
+    pi_hat = pi_hat_ref[0, :]                        # (C,)
+    hyp = hyp_ref[:]                                 # (B, C, H)
+    delta = hyp - rows_ref[:][None]                  # (B, C, H)
+    mix = mixture0[None, None, :] + pi_hat[None, :, None] * delta
+    p = jnp.maximum(mix, _ENTROPY_FLOOR)
+    h_after = -(p * (jnp.log(p) * 1.4426950408889634)).sum(axis=-1)  # (B, C)
+    out_ref[:] = h_before_ref[0, 0] - (pi_xi_ref[:] * h_after).sum(axis=-1)
+
+
+_VMEM_TILE_BYTES = 4 << 20  # target VMEM footprint of one (B, C, H) tile
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def eig_scores_cache_pallas(
+    pbest_rows: jnp.ndarray,   # (C, H)
+    pbest_hyp: jnp.ndarray,    # (N, C, H)
+    pi_hat: jnp.ndarray,       # (C,)
+    pi_hat_xi: jnp.ndarray,    # (N, C)
+    block: int = 0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(N,) EIG scores from the incremental cache, fused in one HBM pass.
+
+    Matches ``eig_scores_from_cache`` numerics: same mixture-delta, the same
+    1e-12 entropy floor, log2 via ln·log2(e) (the same lowering XLA emits
+    for ``jnp.log2``). ``block`` is a CAP on the N-tile; the actual tile is
+    bounded so one (B, C, H) fp32 block stays within ~4 MB of VMEM
+    (block=0 means "derive from VMEM alone").
+    """
+    N, C, H = pbest_hyp.shape
+    vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, 4 * C * H))
+    block = min(block, vmem_cap) if block else vmem_cap
+    mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
+    pc = jnp.clip(mixture0, _ENTROPY_FLOOR, None)
+    h_before = -(pc * jnp.log2(pc)).sum()
+
+    B = min(block, N)
+    pad = (-N) % B
+    hyp_p = jnp.pad(pbest_hyp, ((0, pad), (0, 0), (0, 0)))
+    # padded rows score garbage into padded out slots; sliced off below
+    pi_xi_p = jnp.pad(pi_hat_xi, ((0, pad), (0, 0)))
+    n_blocks = (N + pad) // B
+
+    out = pl.pallas_call(
+        _score_block_kernel,
+        out_shape=jax.ShapeDtypeStruct((N + pad,), pbest_hyp.dtype),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((1, H), lambda i: (0, 0)),          # mixture0
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # h_before
+            pl.BlockSpec((1, C), lambda i: (0, 0)),          # pi_hat
+            pl.BlockSpec((C, H), lambda i: (0, 0)),          # rows
+            pl.BlockSpec((B, C, H), lambda i: (i, 0, 0)),    # hyp tile
+            pl.BlockSpec((B, C), lambda i: (i, 0)),          # pi_xi tile
+        ],
+        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        interpret=interpret,
+    )(
+        mixture0[None, :],
+        h_before[None, None],
+        pi_hat[None, :],
+        pbest_rows,
+        hyp_p,
+        pi_xi_p,
+    )
+    return out[:N]

@@ -65,6 +65,8 @@ class CODAHyperparams(NamedTuple):
     #                               C-fold fewer FLOPs/round) | factored (MXU,
     #                               stateless) | direct (reference numeric
     #                               choreography, kept for cross-checks)
+    eig_backend: str = "jnp"      # jnp | pallas (fused single-HBM-pass TPU
+    #                               kernel for the incremental scoring)
 
 
 # "auto" picks the incremental EIG only while its (N, C, H) fp32 cache fits
@@ -542,6 +544,26 @@ def make_coda(
     use_prefilter = hp.q == "eig" and hp.prefilter_n and hp.prefilter_n < N
     eig_mode = resolve_eig_mode(hp, H, N, C)
     incremental = eig_mode == "incremental"
+    if hp.eig_backend not in ("jnp", "pallas"):
+        raise ValueError(f"unknown eig_backend {hp.eig_backend!r} "
+                         "(use 'jnp' or 'pallas')")
+    if hp.eig_backend == "pallas":
+        if not incremental:
+            raise ValueError(
+                "eig_backend='pallas' accelerates the incremental scoring "
+                f"pass, but this config resolved to eig_mode={eig_mode!r} — "
+                "it would silently never run; use the jnp backend here"
+            )
+        sharding = getattr(preds, "sharding", None)
+        if sharding is not None and getattr(
+                sharding, "num_devices", 1) > 1 and not getattr(
+                sharding, "is_fully_replicated", False):
+            raise ValueError(
+                "eig_backend='pallas' is single-device: pallas_call is an "
+                "opaque custom call GSPMD cannot partition, so a sharded "
+                "(H, N, C) tensor would be all-gathered per device; use the "
+                "jnp backend for sharded runs"
+            )
 
     def init(key):
         del key  # CODA's initialization is deterministic
@@ -585,10 +607,19 @@ def make_coda(
     def _eig_select_full(state: CODAState, cand, k_tie) -> SelectResult:
         """Score every point, mask to the candidate set at argmax time."""
         if incremental:
-            scores = eig_scores_from_cache(
-                state.pbest_rows, state.pbest_hyp, state.pi_hat,
-                state.pi_hat_xi, chunk=hp.eig_chunk,
-            )
+            if hp.eig_backend == "pallas":
+                from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
+
+                scores = eig_scores_cache_pallas(
+                    state.pbest_rows, state.pbest_hyp, state.pi_hat,
+                    state.pi_hat_xi, block=hp.eig_chunk,
+                    interpret=jax.default_backend() != "tpu",
+                )
+            else:
+                scores = eig_scores_from_cache(
+                    state.pbest_rows, state.pbest_hyp, state.pi_hat,
+                    state.pi_hat_xi, chunk=hp.eig_chunk,
+                )
         else:
             scores = eig_fn(
                 state.dirichlets, state.pi_hat, state.pi_hat_xi, hard_preds,
