@@ -156,6 +156,16 @@ def build_selector_factory(args, task_name: str):
     loss_fn = LOSS_FNS[args.loss]
     method = args.method
     if method.startswith("coda"):
+        if (getattr(args, "eig_backend", "jnp") == "pallas"
+                and getattr(args, "mesh", None)):
+            # preds is a traced jit argument on the mesh path, so make_coda's
+            # concrete-array sharding guard cannot fire there — reject the
+            # combination before the tensor is ever placed
+            raise SystemExit(
+                "--eig-backend pallas is single-device (GSPMD cannot "
+                "partition a pallas_call); drop --mesh or use the jnp "
+                "backend for sharded runs"
+            )
         hp = CODAHyperparams(
             prefilter_n=args.prefilter_n,
             alpha=args.alpha,

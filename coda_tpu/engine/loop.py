@@ -123,7 +123,14 @@ def run_experiment(
     loss_fn: Callable = accuracy_loss,
     model_losses: Optional[jnp.ndarray] = None,
 ) -> ExperimentResult:
-    """Run one seed of the labeling experiment, fully jit-compiled."""
+    """Run one seed of the labeling experiment, fully jit-compiled.
+
+    NOTE: the selector's closure-captured prediction tensor is baked into
+    the executable as a constant — and a jit-captured SHARDED array is
+    silently committed to one device. For sharded/mesh execution use
+    :func:`run_seeds_compiled` / :func:`make_batched_experiment_fn`, which
+    take ``preds`` as a traced argument and keep GSPMD sharding live.
+    """
     if model_losses is None:
         model_losses = compute_true_losses(dataset.preds, dataset.labels, loss_fn)
     fn = build_experiment_fn(selector, dataset.labels, model_losses, iters)

@@ -67,7 +67,14 @@ def eig_scores_cache_pallas(
     """
     N, C, H = pbest_hyp.shape
     vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, 4 * C * H))
-    block = min(block, vmem_cap) if block else vmem_cap
+    cap = min(block, vmem_cap) if block else vmem_cap
+    # prefer the largest tile <= cap that DIVIDES N: a ragged grid needs
+    # jnp.pad of the whole (N, C, H) cache, i.e. a full HBM copy per round
+    # on a pass whose point is a single HBM read. Fall back to padding only
+    # when N has no usable divisor (e.g. prime N) — correct, just slower.
+    block = next((b for b in range(min(cap, N), 0, -1) if N % b == 0), 1)
+    if block < max(8, cap // 4):
+        block = min(cap, N)
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
     pc = jnp.clip(mixture0, _ENTROPY_FLOOR, None)
     h_before = -(pc * jnp.log2(pc)).sum()

@@ -554,6 +554,12 @@ def make_coda(
                 f"pass, but this config resolved to eig_mode={eig_mode!r} — "
                 "it would silently never run; use the jnp backend here"
             )
+        # NOTE: this guard only sees a CONCRETE array's sharding. Under the
+        # preds-as-argument jit pattern preds is a tracer here and the
+        # sharding is unknowable at trace time — the CLI therefore rejects
+        # --eig-backend pallas together with --mesh (cli.py), and library
+        # users combining a sharded traced tensor with the pallas backend
+        # must shard_map it themselves.
         sharding = getattr(preds, "sharding", None)
         if sharding is not None and getattr(
                 sharding, "num_devices", 1) > 1 and not getattr(

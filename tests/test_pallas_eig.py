@@ -84,3 +84,14 @@ def test_pallas_backend_config_guards():
         sharded = jax.device_put(t.preds, preds_sharding(make_mesh(data=8)))
         with pytest.raises(ValueError, match="single-device"):
             make_coda(sharded, CODAHyperparams(eig_backend="pallas"))
+
+
+def test_cli_rejects_pallas_with_mesh(tmp_path):
+    import pytest
+
+    from coda_tpu.cli import build_selector_factory, parse_args
+
+    args = parse_args(["--synthetic", "4,32,4", "--method", "coda",
+                       "--eig-backend", "pallas", "--mesh", "data=2"])
+    with pytest.raises(SystemExit, match="single-device"):
+        build_selector_factory(args, "synthetic")

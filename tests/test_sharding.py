@@ -41,18 +41,29 @@ def _sharded_task(task, mesh):
 
 
 def _trace(selector_factory, task, iters=8, seed=0, **kw):
-    sel = selector_factory(task.preds, **kw)
-    res = run_experiment(sel, task, iters=iters, seed=seed)
+    """Run via the preds-as-ARGUMENT path (run_seeds_compiled's pattern).
+
+    A jit-CAPTURED sharded array is silently committed to one device (XLA
+    constant-folds the closure), so closure-style runs would de-shard and
+    make these parity tests vacuous; passing the tensor as a traced argument
+    keeps GSPMD sharding live through the whole experiment.
+    """
+    from coda_tpu.engine.loop import make_batched_experiment_fn
+
+    fn = make_batched_experiment_fn(lambda p: selector_factory(p, **kw),
+                                    iters=iters)
+    keys = jnp.stack([jax.random.PRNGKey(seed)])
+    res = jax.jit(fn)(task.preds, task.labels, keys)
     return (
-        np.asarray(res.chosen_idx),
-        np.asarray(res.best_model),
-        np.asarray(res.regret),
+        np.asarray(res.chosen_idx)[0],
+        np.asarray(res.best_model)[0],
+        np.asarray(res.regret)[0],
     )
 
 
 @pytest.mark.parametrize("mesh_spec", ["data=8", "data=4,model=2", "model=4"])
 @pytest.mark.parametrize("method", ["coda", "iid", "uncertainty",
-                                    "activetesting", "vma", "model_picker"])
+                                    "activetesting", "vma"])
 def test_sharded_trace_matches_single_device(method, mesh_spec):
     from coda_tpu.selectors import SELECTOR_FACTORIES
 
@@ -67,7 +78,37 @@ def test_sharded_trace_matches_single_device(method, mesh_spec):
 
     np.testing.assert_array_equal(idx1, idx8)
     np.testing.assert_array_equal(best1, best8)
-    np.testing.assert_allclose(reg1, reg8, rtol=0, atol=0)
+    np.testing.assert_allclose(reg1, reg8, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("mesh_spec", ["data=8", "data=4,model=2", "model=4"])
+def test_sharded_modelpicker_scores_match(mesh_spec):
+    """ModelPicker's parity claim under sharding is at the SCORE level: with
+    a uniform initial posterior many points tie at the exact minimum entropy,
+    and psum partial-sum ordering over a sharded H axis legitimately perturbs
+    which entries are bitwise equal — the tied pick is stochastic by the
+    method's own semantics (always_stochastic). So assert the expected
+    entropies match within reduction noise and the achieved minimum is the
+    same; the trace-equality claim is covered by the deterministic methods
+    above."""
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.modelpicker import expected_entropies
+
+    task = make_synthetic_task(seed=7, H=8, N=64, C=4)
+    mesh = mesh_from_spec(mesh_spec)
+    post = jnp.full((8,), 1.0 / 8, jnp.float32)
+
+    def ent_of(preds):
+        hard = jnp.argmax(preds, -1).T.astype(jnp.int32)
+        return np.asarray(jax.jit(
+            lambda h: expected_entropies(h, post, (1 - 0.46) / 0.46, 4)
+        )(hard))
+
+    e1 = ent_of(task.preds)
+    e8 = ent_of(jax.device_put(task.preds, preds_sharding(mesh)))
+    np.testing.assert_allclose(e1, e8, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(e1.min(), e8.min(), rtol=1e-6)
 
 
 def test_sharded_pbest_matches(tiny_task):
@@ -169,3 +210,31 @@ def test_imagenet_scale_aot_memory_analysis():
         f"temps {ma.temp_size_in_bytes / 2**30:.2f} GiB/device vs shard "
         f"{shard / 2**30:.2f} GiB — temps should be O(shard)"
     )
+
+
+def test_incremental_cache_shards_over_data_axis():
+    """The incremental-EIG state cache (N, C, H) must inherit the data-axis
+    sharding of the prediction tensor — replicating it would double every
+    device's footprint at headline scale (the cache is as large as preds)."""
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    task = make_synthetic_task(seed=9, H=8, N=64, C=4)
+    mesh = mesh_from_spec("data=4,model=2")
+    preds = jax.device_put(task.preds, preds_sharding(mesh))
+
+    # production pattern: preds is a traced jit ARGUMENT (run_seeds_compiled),
+    # so GSPMD propagates its sharding into the state pytree
+    @jax.jit
+    def init_of(p, key):
+        return make_coda(p, CODAHyperparams(eig_mode="incremental",
+                                            eig_chunk=64)).init(key)
+
+    state = init_of(preds, jax.random.PRNGKey(0))
+    assert state.pbest_hyp is not None
+    spec = state.pbest_hyp.sharding.spec
+    # leading (N) axis split over the data mesh axis; no dimension may be
+    # sharded in a way that replicates N per device
+    assert spec[0] == DATA_AXIS or spec[0] == (DATA_AXIS,), spec
+    n_shard_bytes = state.pbest_hyp.addressable_shards[0].data.nbytes
+    total = 4 * 64 * 4 * 8
+    assert n_shard_bytes <= total // 4, (n_shard_bytes, total)
