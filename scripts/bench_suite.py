@@ -46,6 +46,10 @@ def main(argv=None):
     p.add_argument("--eig-chunk", type=int, default=2048)
     p.add_argument("--compile-cache", default=".jax_cache")
     p.add_argument("--platform", default=None)
+    p.add_argument("--warm-rerun", action="store_true",
+                   help="run the sweep a second time off the hot compile "
+                        "cache and report the steady-state wall-clock "
+                        "(BASELINE.md's <60 s v5e-8 target is steady-state)")
     p.add_argument("--out", default=None, metavar="BENCH_SUITE.json",
                    help="also write the full per-method/per-pair breakdown "
                         "to this JSON file")
@@ -112,6 +116,19 @@ def main(argv=None):
         "per_method_s": {k: v["seconds"] for k, v in per_method.items()},
         "vs_baseline": 0.0,
     }
+
+    if args.warm_rerun:
+        # second pass off the hot in-process jit cache: pairs are pure
+        # execution, but the lazy loaders REGENERATE each synthetic tensor,
+        # so the wall includes datagen. steady_state_compute_s excludes it
+        # and is the number comparable to the cold "value" (also compute-
+        # only) and to BASELINE.md's <60 s steady-state target.
+        t0 = time.perf_counter()
+        runner.run(loaders, methods, method_args={"eig_chunk": args.eig_chunk})
+        line["steady_state_compute_s"] = round(
+            runner.last_stats.get("compute_s", 0.0), 2)
+        line["steady_state_wall_incl_datagen"] = round(
+            time.perf_counter() - t0, 2)
     print(json.dumps(line))
     if args.out:
         import platform as _pl
