@@ -237,10 +237,9 @@ def _log_debug_viz(run, selector, result, seed: int, iters: int) -> None:
 
 def main(argv=None):
     args = parse_args(argv)
-    if args.platform:
-        import jax
+    from coda_tpu.utils.platform import pin_platform
 
-        jax.config.update("jax_platforms", args.platform)
+    pin_platform(args.platform)
 
     import jax
 

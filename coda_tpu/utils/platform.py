@@ -1,0 +1,21 @@
+"""One shared site-hook workaround for every CLI entry point.
+
+This environment's site hook force-registers an accelerator platform and
+overrides ``JAX_PLATFORMS``; when that device tunnel is wedged, any jax
+array op hangs the process. Pinning must happen in-process *before any
+backend initializes* — which is why every entry point defers its jax
+imports and calls :func:`pin_platform` first.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def pin_platform(platform: Optional[str]) -> None:
+    """Force a jax platform (e.g. ``"cpu"``/``"tpu"``); no-op when None."""
+    if not platform:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platform)

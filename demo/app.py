@@ -397,7 +397,15 @@ def main(argv=None):
                         "then shows the item being labeled (reference "
                         "demo/app.py:137-172)")
     p.add_argument("--port", type=int, default=7860)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (cpu/tpu) — same as main.py; "
+                        "env JAX_PLATFORMS alone is overridden by site "
+                        "hooks that force-register an accelerator")
     args = p.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
 
     srv = make_server(default_factory(args), args.port)
     print(f"CODA demo on http://127.0.0.1:{srv.server_address[1]}/")

@@ -55,10 +55,11 @@ def main(argv=None):
                         "to this JSON file")
     args = p.parse_args(argv)
 
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
     if args.compile_cache:
         jax.config.update("jax_compilation_cache_dir", args.compile_cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

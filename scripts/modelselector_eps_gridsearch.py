@@ -181,10 +181,9 @@ def main(argv=None):
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
 
-    if args.platform:
-        import jax
+    from coda_tpu.utils.platform import pin_platform
 
-        jax.config.update("jax_platforms", args.platform)
+    pin_platform(args.platform)
 
     from coda_tpu.data import Dataset
 
