@@ -46,9 +46,17 @@ print(f"worker {pid} psum ok", flush=True)
 """
 
 
-def test_two_process_psum(tmp_path):
+def _run_two_workers(worker_src: str, tmp_path, timeout: float = 300.0):
+    """Launch two coordinator-joined worker processes and return their
+    outputs, asserting both exited 0 and printed their marker line.
+
+    The coordinator port is picked by bind-then-close — inherently TOCTOU
+    (jax.distributed must bind the port itself), so a rare collision on a
+    busy host surfaces as the communicate timeout; centralizing here keeps
+    any future hardening in one place.
+    """
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+    worker.write_text(worker_src)
     with socket.socket() as s:  # free port for the coordinator
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -66,13 +74,19 @@ def test_two_process_psum(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=150)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         for p in procs:
             p.kill()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+    return outs
+
+
+def test_two_process_psum(tmp_path):
+    outs = _run_two_workers(_WORKER, tmp_path, timeout=150)
+    for pid, out in enumerate(outs):
         assert f"worker {pid} psum ok" in out
 
 
@@ -82,3 +96,65 @@ def test_single_process_is_noop(monkeypatch):
     monkeypatch.delenv("JAX_COORDINATOR", raising=False)
     monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
     assert initialize() is False
+
+
+_EXPERIMENT_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # site hook may register axon
+sys.path.insert(0, os.environ["CODA_REPO"])
+from coda_tpu.parallel.distributed import initialize
+
+pid = int(sys.argv[1])
+assert initialize(coordinator_address=os.environ["COORD"],
+                  num_processes=2, process_id=pid)
+assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from coda_tpu.data import make_synthetic_task
+from coda_tpu.engine.loop import make_batched_experiment_fn
+from coda_tpu.parallel import DATA_AXIS, make_mesh
+from coda_tpu.selectors import CODAHyperparams, make_coda
+
+task = make_synthetic_task(seed=0, H=4, N=32, C=3)  # same tensor on both procs
+preds_np, labels_np = np.asarray(task.preds), np.asarray(task.labels)
+mesh = make_mesh(data=4)  # spans BOTH processes' devices
+psh = NamedSharding(mesh, P(None, DATA_AXIS, None))
+preds = jax.make_array_from_callback(preds_np.shape, psh,
+                                     lambda idx: preds_np[idx])
+labels = jax.make_array_from_callback(
+    labels_np.shape, NamedSharding(mesh, P(DATA_AXIS)),
+    lambda idx: labels_np[idx])
+
+iters = 6
+hp = CODAHyperparams(eig_chunk=32, num_points=64)
+fn = make_batched_experiment_fn(lambda p: make_coda(p, hp), iters=iters)
+keys = jnp.stack([jax.random.PRNGKey(0)])
+res = jax.jit(fn)(preds, labels, keys)
+# per-round traces are replicated scalars -> readable on every process
+assert res.chosen_idx.is_fully_replicated
+got_idx = np.asarray(res.chosen_idx)[0]
+got_best = np.asarray(res.best_model)[0]
+
+# in-process single-device reference of the same program
+ref = jax.jit(fn)(jnp.asarray(preds_np), jnp.asarray(labels_np), keys)
+np.testing.assert_array_equal(got_idx, np.asarray(ref.chosen_idx)[0])
+np.testing.assert_array_equal(got_best, np.asarray(ref.best_model)[0])
+print(f"worker {pid} experiment trace parity ok: idx={got_idx.tolist()}",
+      flush=True)
+"""
+
+
+def test_two_process_sharded_experiment_trace_parity(tmp_path):
+    """The FULL CODA experiment (scan + vmapped seeds + incremental cache)
+    running SPMD across two OS processes — (H, N, C) sharded over a global
+    4-device data mesh — must reproduce the single-process trace. This is
+    the multi-host analog of dryrun_multichip, through the real
+    jax.distributed runtime."""
+    outs = _run_two_workers(_EXPERIMENT_WORKER, tmp_path)
+    for pid, out in enumerate(outs):
+        assert f"worker {pid} experiment trace parity ok" in out
