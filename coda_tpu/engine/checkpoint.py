@@ -13,13 +13,16 @@ Execution model: the ``iters``-round experiment runs as a sequence of jitted
 ``lax.scan`` chunks of ``every`` rounds. After each chunk the carry (state,
 cumulative regret) and the filled trace prefix are saved under
 ``<dir>/step_<r>``. On restart, the newest usable checkpoint is restored and
-the scan continues from round ``r`` — replaying nothing, and producing
-bitwise-identical traces to an uninterrupted run because the per-round keys
-come from the same ``jax.random.split`` table (prefix-stable, so a resume
-with a *smaller* ``iters`` restores an earlier checkpoint and is still
-exact). A fingerprint of the selector configuration is saved alongside and
-validated on resume, so checkpoints from a different method/hyperparams/
-dataset shape fail loudly instead of blending two configs into one trace.
+the scan continues from round ``r`` — replaying nothing. Selection traces
+(indices, best-model) are identical to an uninterrupted run because the
+per-round keys come from the same ``jax.random.split`` table (prefix-stable,
+so a resume with a *smaller* ``iters`` restores an earlier checkpoint and is
+still exact); float metrics agree to ~1 ulp — the chunked program and a
+single monolithic scan are separately compiled, and XLA may schedule
+reductions differently per scan length. A fingerprint of the selector
+configuration is saved alongside and validated on resume, so checkpoints
+from a different method/hyperparams/dataset shape fail loudly instead of
+blending two configs into one trace.
 """
 
 from __future__ import annotations
@@ -213,6 +216,18 @@ def make_resumable_runner(
         start = latest_step(ckpt_dir, at_most=iters)
         if start is not None and start > 0:
             restored = ckptr.restore(start)
+            if len(restored["state"]) != state_treedef.num_leaves:
+                # a fingerprint from before a state field existed can match
+                # while the pytree structure does not (e.g. the incremental
+                # cache gained a leaf) — fail with an actionable message, not
+                # a raw unflatten error
+                raise ValueError(
+                    f"checkpoint at {ckpt_dir!r} step {start} has "
+                    f"{len(restored['state'])} state leaves but this "
+                    f"selector build expects {state_treedef.num_leaves} — "
+                    "it predates a selector-state layout change. Use a "
+                    "fresh --checkpoint-dir (or delete this one)."
+                )
             leaves = [jnp.asarray(restored["state"][f"{i:04d}"])
                       for i in range(len(restored["state"]))]
             state = jax.tree.unflatten(state_treedef, leaves)

@@ -121,6 +121,11 @@ class CODAState(NamedTuple):
     # rows of both tensors carry over unchanged between rounds.
     pbest_rows: Optional[jnp.ndarray] = None   # (C, H)
     pbest_hyp: Optional[jnp.ndarray] = None    # (N, C, H)
+    # unnormalized pi_hat_xi, same factorization: column c of
+    # ``Σ_{h,s} dirichlets[h,c,s]·preds[h,n,s]`` depends only on Dirichlet
+    # row c, so the update refreshes one column at O(N·H·C) instead of the
+    # full O(N·H·C²) einsum — the dominant per-round cost at large C
+    pi_xi_unnorm: Optional[jnp.ndarray] = None  # (N, C)
 
 
 def update_pi_hat(
@@ -132,13 +137,44 @@ def update_pi_hat(
     models (reference ``coda/coda.py:226-233``) — a batched matmul that maps
     straight onto the MXU.
     """
+    pi_xi, pi = _normalize_pi(pi_unnorm(dirichlets, preds))
+    return pi_xi, pi
+
+
+def pi_unnorm(dirichlets: jnp.ndarray, preds: jnp.ndarray) -> jnp.ndarray:
+    """Unnormalized (N, C) class scores — the ONE pi-hat contraction kernel
+    (shared by the full recompute and the incremental column cache so the
+    two paths can never desync numerically)."""
     # contract models inside the einsum: the (H, N, C) adjusted tensor (2 GB
     # at M=1k, N=50k) never materializes — one MXU pass straight to (N, C)
-    pi_xi = jnp.einsum("hcs,hns->nc", dirichlets, preds, precision=_PRECISION)
-    pi_xi = pi_xi / jnp.clip(pi_xi.sum(axis=-1, keepdims=True), 1e-12, None)
+    return jnp.einsum("hcs,hns->nc", dirichlets, preds, precision=_PRECISION)
+
+
+def _normalize_pi(unnorm: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(pi_hat_xi, pi_hat) from the unnormalized (N, C) class scores."""
+    pi_xi = unnorm / jnp.clip(unnorm.sum(axis=-1, keepdims=True), 1e-12, None)
     pi = pi_xi.sum(axis=0)
-    pi = pi / pi.sum()
-    return pi_xi, pi
+    return pi_xi, pi / pi.sum()
+
+
+def update_pi_hat_column(
+    dirichlets: jnp.ndarray,   # (H, C, C) — ALREADY holding the new label
+    true_class: jnp.ndarray,   # scalar int
+    preds: jnp.ndarray,        # (H, N, C)
+    pi_xi_unnorm: jnp.ndarray,  # (N, C) unnormalized cache
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Refresh only column ``true_class`` of the pi-hat factorization.
+
+    ``unnorm[n,c]`` contracts Dirichlet row c with the predictions, so a
+    labeling round (which touches only row ``true_class``) invalidates one
+    column: one O(N·H·C) einsum instead of the full O(N·H·C²) pass.
+    Returns ``(pi_hat_xi, pi_hat, new_unnorm)``.
+    """
+    d_t = jnp.take(dirichlets, true_class, axis=1)     # (H, C)
+    col = jnp.einsum("hs,hns->n", d_t, preds, precision=_PRECISION)  # (N,)
+    unnorm = pi_xi_unnorm.at[:, true_class].set(col)
+    pi_xi, pi = _normalize_pi(unnorm)
+    return pi_xi, pi, unnorm
 
 
 def eig_scores(
@@ -573,7 +609,8 @@ def make_coda(
 
     def init(key):
         del key  # CODA's initialization is deterministic
-        pi_xi, pi = update_pi_hat(dirichlets0, preds)
+        unnorm = pi_unnorm(dirichlets0, preds)
+        pi_xi, pi = _normalize_pi(unnorm)
         rows, hyp = (
             build_eig_cache(dirichlets0, hard_preds,
                             num_points=hp.num_points, chunk=hp.eig_chunk)
@@ -586,6 +623,7 @@ def make_coda(
             unlabeled=jnp.ones((N,), dtype=bool),
             pbest_rows=rows,
             pbest_hyp=hyp,
+            pi_xi_unnorm=unnorm if incremental else None,
         )
 
     def _candidates(state: CODAState) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -716,13 +754,16 @@ def make_coda(
         dirichlets = state.dirichlets.at[:, true_class, :].add(
             update_strength * onehot
         )
-        pi_xi, pi = update_pi_hat(dirichlets, preds)
-        rows, hyp = (
-            update_eig_cache(dirichlets, true_class, hard_preds,
-                             state.pbest_rows, state.pbest_hyp,
-                             num_points=hp.num_points)
-            if incremental else (None, None)
-        )
+        if incremental:
+            pi_xi, pi, unnorm = update_pi_hat_column(
+                dirichlets, true_class, preds, state.pi_xi_unnorm
+            )
+            rows, hyp = update_eig_cache(dirichlets, true_class, hard_preds,
+                                         state.pbest_rows, state.pbest_hyp,
+                                         num_points=hp.num_points)
+        else:
+            pi_xi, pi = update_pi_hat(dirichlets, preds)
+            unnorm = rows = hyp = None
         return CODAState(
             dirichlets=dirichlets,
             pi_hat_xi=pi_xi,
@@ -730,6 +771,7 @@ def make_coda(
             unlabeled=state.unlabeled.at[idx].set(False),
             pbest_rows=rows,
             pbest_hyp=hyp,
+            pi_xi_unnorm=unnorm,
         )
 
     def get_pbest(state: CODAState) -> jnp.ndarray:

@@ -32,11 +32,20 @@ def setup(tiny_task):
 
 
 def _assert_results_equal(a, b):
+    """Selection decisions (indices, best-model, flags) must be EXACT; float
+    metrics may differ by ~1 ulp because the chunked runner and the single
+    scan are separately compiled programs — XLA may schedule a reduction
+    (e.g. the incremental pi-hat column einsum) differently per scan length,
+    which is not a resume error."""
+    exact = ("chosen_idx", "true_class", "best_model", "stochastic")
     for name in a._fields:
-        np.testing.assert_array_equal(
-            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
-            err_msg=name,
-        )
+        x = np.asarray(getattr(a, name))
+        y = np.asarray(getattr(b, name))
+        if name in exact:
+            np.testing.assert_array_equal(x, y, err_msg=name)
+        else:
+            np.testing.assert_allclose(x, y, rtol=3e-5, atol=1e-7,
+                                       err_msg=name)
 
 
 def test_resumable_matches_single_scan(setup, tmp_path):
@@ -146,3 +155,28 @@ def test_budget_guard(setup, tmp_path):
     with pytest.raises(ValueError, match="fixed label buffer"):
         run_experiment_resumable(sel, task.labels, losses, iters=10, seed=0,
                                  ckpt_dir=str(tmp_path / "ck"), every=5)
+
+
+def test_stale_state_layout_fails_loudly(setup, tmp_path):
+    """A checkpoint whose state pytree predates a selector-state layout
+    change (fewer leaves) must fail with the actionable message, not a raw
+    tree-unflatten error."""
+    import shutil
+
+    task, losses = setup
+    sel = make_coda(task.preds, CODAHyperparams(eig_chunk=16))
+    ckpt = str(tmp_path / "ck")
+    run_experiment_resumable(sel, task.labels, losses, iters=9, seed=0,
+                             ckpt_dir=ckpt, every=3)
+    # simulate an old layout: drop one saved state leaf from the newest step
+    step = latest_step(ckpt)
+    ckptr = ExperimentCheckpointer(ckpt)
+    tree = ckptr.restore(step)
+    n = len(tree["state"])
+    tree["state"] = {f"{i:04d}": tree["state"][f"{i:04d}"]
+                     for i in range(n - 1)}
+    shutil.rmtree(os.path.join(ckpt, f"step_{step}"))
+    ckptr.save(step, tree)
+    with pytest.raises(ValueError, match="layout change"):
+        run_experiment_resumable(sel, task.labels, losses, iters=12, seed=0,
+                                 ckpt_dir=ckpt, every=3)

@@ -485,3 +485,31 @@ def test_coda_rowscan_matches_factored(task):
                                   np.asarray(res_r.chosen_idx))
     np.testing.assert_array_equal(np.asarray(res_f.best_model),
                                   np.asarray(res_r.best_model))
+
+
+def test_coda_incremental_pi_hat_column_exact(task):
+    """The single-column pi-hat refresh must equal the full einsum: columns
+    c != true_class are carried bitwise, the refreshed column and the
+    normalized posteriors match the full recompute."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.coda import update_pi_hat
+
+    sel = make_coda(task.preds, CODAHyperparams(eig_mode="incremental",
+                                                eig_chunk=1000))
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    labels = np.asarray(task.labels)
+    update = jax.jit(sel.update)
+    full_j = jax.jit(lambda d: update_pi_hat(d, task.preds))
+
+    for idx in (4, 19, 2, 31):
+        state = update(state, jnp.asarray(idx),
+                       jnp.asarray(int(labels[idx])), jnp.asarray(0.0))
+        pi_xi_full, pi_full = full_j(state.dirichlets)
+        np.testing.assert_allclose(np.asarray(state.pi_hat_xi),
+                                   np.asarray(pi_xi_full),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(state.pi_hat),
+                                   np.asarray(pi_full), rtol=1e-6, atol=1e-7)
