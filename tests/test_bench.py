@@ -1,0 +1,76 @@
+"""Unit tests for bench.py's measurement protocol helpers.
+
+The benchmark's credibility rests on these pieces (VERDICT r2: the committed
+numbers were measurement artifacts), so they get direct tests: robust noise
+estimation, the analytic FLOP model staying in lockstep with the kernel
+resolver, and the cached multi-size reference-baseline bookkeeping.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench
+
+
+def test_mad_robust_to_single_outlier():
+    # one tunnel hiccup (observed: a rep taking 6x the median) must not
+    # inflate the noise floor the linearity guard compares against
+    walls = [1.81, 1.82, 1.815, 1.87, 11.4]
+    assert bench._mad(walls) < 0.06
+    assert np.std(walls) > 3.0  # the non-robust estimate the guard replaced
+
+
+def test_analytic_flops_follows_resolver():
+    from coda_tpu.selectors import CODAHyperparams
+    from coda_tpu.selectors.coda import resolve_eig_mode
+
+    # headline config resolves incremental; flops must be the row-refresh
+    # model, ~C-fold below the factored count
+    f_inc, m_inc = bench._analytic_step_flops(1000, 50_000, 10)
+    assert m_inc == resolve_eig_mode(CODAHyperparams(), 1000, 50_000, 10)
+    assert m_inc == "incremental"
+    f_fac, m_fac = bench._analytic_step_flops(1000, 50_000, 10,
+                                              mode="factored")
+    assert m_fac == "factored"
+    assert f_fac / f_inc > 5  # C=10 cuts the dominant einsums ~10x
+
+    # past the cache budget auto must fall back -> factored FLOPs
+    f_big, m_big = bench._analytic_step_flops(1000, 200_000, 10)
+    assert m_big == "factored"
+    assert f_big > f_fac
+
+
+def test_reference_baseline_cache_roundtrip(tmp_path, monkeypatch):
+    # pre-seed the cache with all three sizes: no measurement should run
+    cache = {"sizes": {}}
+    for h, n in bench.REF_SIZES:
+        cache["sizes"][f"h{h}_n{n}_c10"] = {
+            "steps_per_sec": 1000.0 / (h * n), "steps": 5,
+            "H": h, "N": n, "C": 10,
+        }
+    path = tmp_path / "bench_baseline.json"
+    path.write_text(json.dumps(cache))
+    monkeypatch.setattr(bench, "BASELINE_CACHE", str(path))
+
+    def boom(*a, **k):  # measurement must not be invoked on a warm cache
+        raise AssertionError("measure_reference_at called despite cache")
+
+    monkeypatch.setattr(bench, "measure_reference_at", boom)
+    base = bench.reference_baseline(10, skip=False)
+    # k = sps * H * N was seeded constant => perfect linearity
+    assert base["linearity_dev"] == pytest.approx(0.0, abs=1e-12)
+    assert base["k_mean"] == pytest.approx(1000.0)
+    assert len(base["sizes"]) == 3
+
+
+def test_reference_baseline_skip_without_cache(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "BASELINE_CACHE", str(tmp_path / "nope.json"))
+    assert bench.reference_baseline(10, skip=True) == {}
