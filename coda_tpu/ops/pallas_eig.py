@@ -30,10 +30,11 @@ _ENTROPY_FLOOR = 1e-12  # reference clamp, see ops/masked.py entropy2
 
 def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
                         hyp_ref, pi_xi_ref, out_ref):
-    """One N-tile: (B, C, H) cache block -> (B,) scores.
+    """One N-tile: (B, C, H) cache block -> (B, 1) scores.
 
     Refs: mixture0 (1, H); h_before (1, 1); pi_hat (1, C); rows (C, H);
-    hyp (B, C, H); pi_xi (B, C); out (B,).
+    hyp (B, C, H); pi_xi (B, C); out (B, 1) — 2-D so the N-tile only needs
+    sublane (x8) alignment, not the x128 lane alignment a 1-D out would.
     """
     mixture0 = mixture0_ref[0, :]                    # (H,)
     pi_hat = pi_hat_ref[0, :]                        # (C,)
@@ -42,10 +43,24 @@ def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
     mix = mixture0[None, None, :] + pi_hat[None, :, None] * delta
     p = jnp.maximum(mix, _ENTROPY_FLOOR)
     h_after = -(p * (jnp.log(p) * 1.4426950408889634)).sum(axis=-1)  # (B, C)
-    out_ref[:] = h_before_ref[0, 0] - (pi_xi_ref[:] * h_after).sum(axis=-1)
+    scores = h_before_ref[0, 0] - (pi_xi_ref[:] * h_after).sum(axis=-1)
+    out_ref[:] = scores[:, None]
 
 
-_VMEM_TILE_BYTES = 4 << 20  # target VMEM footprint of one (B, C, H) tile
+_VMEM_TILE_BYTES = 8 << 20  # target VMEM footprint of one (B, C, H) tile
+
+
+def choose_block(N: int, C: int, H: int, block: int = 0) -> int:
+    """The N-tile size: sublane-aligned (x8) under the VMEM budget, or all
+    of N when it fits — the two shapes Mosaic accepts for the (B, C) /
+    (B, 1) blocks without host-padding the cache. The x8 hardware minimum
+    wins over a smaller caller ``block`` cap (a cap below 8 cannot lower
+    the tile's VMEM footprint further)."""
+    vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, 4 * C * H))
+    cap = min(block, vmem_cap) if block else vmem_cap
+    if N <= max(cap, 8):
+        return N
+    return max(8, (cap // 8) * 8)
 
 
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
@@ -62,33 +77,28 @@ def eig_scores_cache_pallas(
     Matches ``eig_scores_from_cache`` numerics: same mixture-delta, the same
     1e-12 entropy floor, log2 via ln·log2(e) (the same lowering XLA emits
     for ``jnp.log2``). ``block`` is a CAP on the N-tile; the actual tile is
-    bounded so one (B, C, H) fp32 block stays within ~4 MB of VMEM
+    bounded so one (B, C, H) fp32 block stays within ~8 MB of VMEM
     (block=0 means "derive from VMEM alone").
+
+    Blocking obeys the TPU tiling rules (a block dim must be a multiple of
+    its hardware tile or span the whole array dim): the (C, H) minor dims
+    always span the array, the N-tile is sublane-aligned (x8) — legal for
+    the (B, C) pi_xi block and the (B, 1) out block — and a ragged final
+    block is left to pallas' edge masking rather than host-padding the
+    cache (a jnp.pad here would copy the whole 2 GB tensor every round, on
+    a pass whose point is a single HBM read).
     """
     N, C, H = pbest_hyp.shape
-    vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, 4 * C * H))
-    cap = min(block, vmem_cap) if block else vmem_cap
-    # prefer the largest tile <= cap that DIVIDES N: a ragged grid needs
-    # jnp.pad of the whole (N, C, H) cache, i.e. a full HBM copy per round
-    # on a pass whose point is a single HBM read. Fall back to padding only
-    # when N has no usable divisor (e.g. prime N) — correct, just slower.
-    block = next((b for b in range(min(cap, N), 0, -1) if N % b == 0), 1)
-    if block < max(8, cap // 4):
-        block = min(cap, N)
+    B = choose_block(N, C, H, block)
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
     pc = jnp.clip(mixture0, _ENTROPY_FLOOR, None)
     h_before = -(pc * jnp.log2(pc)).sum()
 
-    B = min(block, N)
-    pad = (-N) % B
-    hyp_p = jnp.pad(pbest_hyp, ((0, pad), (0, 0), (0, 0)))
-    # padded rows score garbage into padded out slots; sliced off below
-    pi_xi_p = jnp.pad(pi_hat_xi, ((0, pad), (0, 0)))
-    n_blocks = (N + pad) // B
+    n_blocks = -(-N // B)
 
     out = pl.pallas_call(
         _score_block_kernel,
-        out_shape=jax.ShapeDtypeStruct((N + pad,), pbest_hyp.dtype),
+        out_shape=jax.ShapeDtypeStruct((N, 1), pbest_hyp.dtype),
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((1, H), lambda i: (0, 0)),          # mixture0
@@ -98,14 +108,14 @@ def eig_scores_cache_pallas(
             pl.BlockSpec((B, C, H), lambda i: (i, 0, 0)),    # hyp tile
             pl.BlockSpec((B, C), lambda i: (i, 0)),          # pi_xi tile
         ],
-        out_specs=pl.BlockSpec((B,), lambda i: (i,)),
+        out_specs=pl.BlockSpec((B, 1), lambda i: (i, 0)),
         interpret=interpret,
     )(
         mixture0[None, :],
         h_before[None, None],
         pi_hat[None, :],
         pbest_rows,
-        hyp_p,
-        pi_xi_p,
+        pbest_hyp,
+        pi_hat_xi,
     )
-    return out[:N]
+    return out[:, 0]

@@ -47,6 +47,41 @@ def test_pallas_ragged_block_padding():
     np.testing.assert_allclose(ref, pal, rtol=1e-4, atol=1e-6)
 
 
+def test_choose_block_obeys_tpu_tiling():
+    """Mosaic accepts an N-tile only when it is x8-aligned or spans all of
+    N (observed lowering failure on a real v5e: block (100, 10) on a
+    (50000, 10) operand). The chooser must never emit anything else."""
+    from coda_tpu.ops.pallas_eig import _VMEM_TILE_BYTES, choose_block
+
+    for N, C, H, blk in [
+        (50_000, 10, 1000, 2048),   # headline: vmem-capped, must align
+        (50_000, 10, 1000, 0),
+        (77, 4, 9, 32),             # ragged small task
+        (300, 5, 12, 64),
+        (64, 4, 6, 0),              # fits in one block
+        (100, 1000, 500, 0),        # huge C*H: cap < 8 rows, N > cap
+        (5, 3, 4, 0),               # N < 8
+    ]:
+        B = choose_block(N, C, H, blk)
+        assert 1 <= B <= N
+        assert B == N or B % 8 == 0, (N, C, H, blk, B)
+        if B < N:  # the tile must respect the VMEM budget it claims
+            assert 4 * B * C * H <= 2 * _VMEM_TILE_BYTES
+
+
+def test_pallas_large_ch_small_tile():
+    """C*H big enough that the VMEM budget allows <8 rows: the x8 minimum
+    still applies and the result still matches the jnp path."""
+    from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    rows, hyp, pi, pi_xi = _random_cache(jax.random.PRNGKey(2), 13, 40, 700)
+    ref = np.asarray(eig_scores_from_cache(rows, hyp, pi, pi_xi, chunk=8))
+    pal = np.asarray(eig_scores_cache_pallas(rows, hyp, pi, pi_xi,
+                                             interpret=True))
+    np.testing.assert_allclose(ref, pal, rtol=1e-4, atol=1e-6)
+
+
 def test_pallas_backend_selector_trace_matches():
     """A full experiment with eig_backend='pallas' reproduces the jnp trace."""
     from coda_tpu.data import make_synthetic_task
