@@ -76,9 +76,12 @@ def eig_scores_cache_pallas(
 
     Matches ``eig_scores_from_cache`` numerics: same mixture-delta, the same
     1e-12 entropy floor, log2 via ln·log2(e) (the same lowering XLA emits
-    for ``jnp.log2``). ``block`` is a CAP on the N-tile; the actual tile is
-    bounded so one (B, C, H) fp32 block stays within ~8 MB of VMEM
-    (block=0 means "derive from VMEM alone").
+    for ``jnp.log2``). ``block`` is a CAP on the N-tile; the actual tile
+    targets ~8 MB of VMEM per (B, C, H) fp32 block (block=0 means "derive
+    from VMEM alone"). The x8 sublane minimum floors the tile at 8 rows,
+    so a huge-C*H cache (C*H > ~256k elements) can exceed the target up to
+    2x — that regime is exercised only in interpret-mode tests, not on
+    hardware (the jnp path is the safe choice there).
 
     Blocking obeys the TPU tiling rules (a block dim must be a multiple of
     its hardware tile or span the whole array dim): the (C, H) minor dims

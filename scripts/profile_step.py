@@ -1,0 +1,195 @@
+"""Per-component marginal-cost profile of one CODA labeling round.
+
+Times each stage of the incremental-EIG step (cache scoring — jnp and
+pallas backends —, cache row refresh, pi-hat column refresh, masked
+argmax) plus the full scan step, using the loop-in-jit discipline that
+survives this environment's device tunnel: every stage runs ``n`` times
+inside one ``lax.fori_loop`` with a data dependence threaded through a
+scalar carry, the program's single scalar output is materialized on the
+host (forcing the whole chain to execute), and the reported cost is the
+marginal (hi - lo) / (n_hi - n_lo) — fixed dispatch/transfer overhead
+cancels. A bare ``block_until_ready`` is NOT trusted: through the
+experimental axon tunnel it demonstrably returns before the device queue
+drains (see BENCH notes in VERDICT round 2).
+
+    python scripts/profile_step.py                  # headline M=1k,N=50k
+    python scripts/profile_step.py --shape 32,2000,10 --platform cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def marginal_ms(body, carry0, n_hi: int, n_lo: int, reps: int) -> dict:
+    """Median marginal per-iteration cost of ``body`` in milliseconds.
+
+    ``body(carry, i) -> carry`` must thread a data dependence through the
+    carry (multiply-by-tiny, add — anything XLA cannot fold away).
+    """
+    import jax
+    import numpy as np
+    from jax import lax
+
+    def run(n: int) -> float:
+        @jax.jit
+        def f(c0):
+            return lax.fori_loop(0, n, lambda i, c: body(c, i), c0)
+
+        out = f(carry0)
+        jax.tree.map(np.asarray, out)  # warm-up, forced to completion
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.tree.map(np.asarray, f(carry0))
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    hi, lo = run(n_hi), run(n_lo)
+    return {
+        "ms_per_iter": (hi - lo) / (n_hi - n_lo) * 1e3,
+        "wall_hi_s": round(hi, 4),
+        "wall_lo_s": round(lo, 4),
+        "n_hi": n_hi,
+        "n_lo": n_lo,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--shape", default="1000,50000,10",
+                    help="H,N,C of the synthetic task")
+    ap.add_argument("--eig-chunk", type=int, default=2048)
+    ap.add_argument("--num-points", type=int, default=256)
+    ap.add_argument("--n-hi", type=int, default=10)
+    ap.add_argument("--n-lo", type=int, default=2)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--skip", default="",
+                    help="comma list of stages to skip (e.g. pallas)")
+    args = ap.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.ops.confusion import (
+        create_confusion_matrices,
+        ensemble_preds,
+        initialize_dirichlets,
+    )
+    from coda_tpu.ops.masked import masked_argmax_tiebreak
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.coda import (
+        _normalize_pi,
+        build_eig_cache,
+        eig_scores_from_cache,
+        pi_unnorm,
+        update_eig_cache,
+        update_pi_hat_column,
+    )
+
+    H, N, C = (int(x) for x in args.shape.split(","))
+    G, CH = args.num_points, args.eig_chunk
+    skip = set(filter(None, args.skip.split(",")))
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    task = make_synthetic_task(seed=0, H=H, N=N, C=C)
+    preds = jax.device_put(task.preds)
+    hard = preds.argmax(-1).T.astype(jnp.int32)
+    ens = ensemble_preds(preds).argmax(-1)
+    soft = create_confusion_matrices(ens, preds, mode="soft")
+    dir0 = 2.0 * initialize_dirichlets(soft, 0.1, False)
+    unnorm = pi_unnorm(dir0, preds)
+    pi_xi, pi = _normalize_pi(unnorm)
+    rows, hyp = jax.jit(
+        lambda d, h: build_eig_cache(d, h, num_points=G, chunk=CH)
+    )(dir0, hard)
+    np.asarray(rows)
+
+    eps = jnp.float32(1e-20)  # runtime value: XLA cannot fold the dependence
+    results = {}
+
+    def stage(name, body, carry0):
+        if name.split(":")[0] in skip:
+            return
+        r = marginal_ms(body, carry0, args.n_hi, args.n_lo, args.reps)
+        results[name] = round(r["ms_per_iter"], 3)
+        print(f"{name:34s} {r['ms_per_iter']:9.3f} ms/iter  "
+              f"(hi={r['wall_hi_s']}s lo={r['wall_lo_s']}s)", file=sys.stderr)
+
+    def body_score(c, i):
+        s = eig_scores_from_cache(rows, hyp, pi + c * eps, pi_xi, chunk=CH)
+        return c + s[0] * eps
+
+    stage(f"score:jnp chunk={CH}", body_score, jnp.float32(0))
+
+    def body_pallas(c, i):
+        from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
+
+        s = eig_scores_cache_pallas(
+            rows, hyp, pi + c * eps, pi_xi, block=CH,
+            interpret=jax.default_backend() != "tpu",
+        )
+        return c + s[0] * eps
+
+    stage("pallas:score", body_pallas, jnp.float32(0))
+
+    def body_upd(carry, i):
+        r, h = carry
+        return update_eig_cache(dir0, i % C, hard, r, h, num_points=G)
+
+    stage("update:eig-cache row refresh", body_upd, (rows, hyp))
+
+    def body_pi(u, i):
+        _, _, u2 = update_pi_hat_column(dir0, i % C, preds, u)
+        return u2
+
+    stage("update:pi-hat column", body_pi, unnorm)
+
+    scores0 = jax.jit(
+        lambda: eig_scores_from_cache(rows, hyp, pi, pi_xi, chunk=CH)
+    )()
+    cand = jnp.ones((N,), bool)
+
+    def body_am(c, i):
+        idx, _ = masked_argmax_tiebreak(
+            jax.random.PRNGKey(0), scores0 + c * eps, cand,
+            rtol=1e-8, atol=1e-8,
+        )
+        return c + idx.astype(jnp.float32) * eps
+
+    stage("select:masked argmax", body_am, jnp.float32(0))
+
+    # the full scan step, for the unexplained-residual check: the sum of
+    # the stages above should account for most of this
+    sel = make_coda(preds, CODAHyperparams(eig_chunk=CH, num_points=G))
+    labels = task.labels
+    state0 = sel.init(jax.random.PRNGKey(0))
+
+    def body_full(carry, i):
+        state, c = carry
+        res = sel.select(state, jax.random.fold_in(jax.random.PRNGKey(1), i))
+        state = sel.update(state, res.idx, labels[res.idx], res.prob)
+        best, _ = sel.best(state, jax.random.PRNGKey(2))
+        return state, c + best.astype(jnp.float32) * eps
+
+    stage("full:select+update+best step", body_full, (state0, jnp.float32(0)))
+
+    print(json.dumps({"shape": [H, N, C], "eig_chunk": CH, "num_points": G,
+                      "backend": jax.default_backend(),
+                      "ms_per_iter": results}))
+
+
+if __name__ == "__main__":
+    main()
