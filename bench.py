@@ -59,7 +59,7 @@ REF_STEPS = 5
 
 
 def _build_fn(H: int, N: int, C: int, iters: int, eig_chunk: int,
-              eig_mode: str = "auto"):
+              eig_mode: str = "auto", eig_backend: str = "jnp"):
     """(jitted experiment fn, (preds, labels)) for one config."""
     import jax
 
@@ -69,7 +69,8 @@ def _build_fn(H: int, N: int, C: int, iters: int, eig_chunk: int,
     from coda_tpu.selectors import CODAHyperparams, make_coda
 
     task = make_synthetic_task(seed=0, H=H, N=N, C=C)
-    hp = CODAHyperparams(eig_chunk=eig_chunk, eig_mode=eig_mode)
+    hp = CODAHyperparams(eig_chunk=eig_chunk, eig_mode=eig_mode,
+                         eig_backend=eig_backend)
 
     # Build the selector INSIDE the jitted function so the (H, N, C) tensor
     # is a traced argument, not a baked-in constant (2 GB of captured
@@ -155,7 +156,8 @@ def _mad(xs: list[float]) -> float:
 
 
 def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
-               reps: int = 5, eig_mode: str = "auto") -> dict:
+               reps: int = 5, eig_mode: str = "auto",
+               eig_backend: str = "jnp") -> dict:
     """Trustworthy steps/sec: two scan lengths, marginal cost, FLOPs, MFU.
 
     The same experiment is compiled at ``iters`` and ``iters // 2`` scan
@@ -171,10 +173,11 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     import jax
 
     half_iters = max(1, iters // 2)
-    fn, data = _build_fn(H, N, C, iters, eig_chunk, eig_mode)
+    fn, data = _build_fn(H, N, C, iters, eig_chunk, eig_mode, eig_backend)
     compiled = _compile(fn, data)
     walls = _timed_reps(compiled, data, reps)
-    fn_half, data_half = _build_fn(H, N, C, half_iters, eig_chunk, eig_mode)
+    fn_half, data_half = _build_fn(H, N, C, half_iters, eig_chunk, eig_mode,
+                                   eig_backend)
     compiled_half = _compile(fn_half, data_half)
     walls_half = _timed_reps(compiled_half, data_half, reps)
 
@@ -212,6 +215,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
             "ok": linear_ok,
         },
         "eig_mode": mode,
+        "eig_backend": eig_backend,
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
         "achieved_flops_per_sec": achieved,
@@ -313,6 +317,9 @@ def main():
     ap.add_argument("--eig-mode", default="auto",
                     help="force a CODA EIG kernel tier (for comparisons); "
                          "auto = incremental when its cache fits")
+    ap.add_argument("--eig-backend", default="jnp",
+                    help="incremental-EIG scoring backend: jnp | pallas "
+                         "(fused single-HBM-pass TPU kernel)")
     ap.add_argument("--skip-reference", action="store_true")
     args = ap.parse_args()
 
@@ -322,7 +329,8 @@ def main():
         H, N, C, iters, chunk = 1000, 50_000, 10, 50, 2048
 
     ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
-                      reps=args.reps, eig_mode=args.eig_mode)
+                      reps=args.reps, eig_mode=args.eig_mode,
+                      eig_backend=args.eig_backend)
 
     base = reference_baseline(C, skip=args.skip_reference)
     out = {
@@ -338,7 +346,7 @@ def main():
         "devices": {k: ours[k] for k in
                     ("device_kind", "n_devices", "platform")},
         "compute": {k: ours[k] for k in
-                    ("eig_mode", "flops_per_step_analytic",
+                    ("eig_mode", "eig_backend", "flops_per_step_analytic",
                      "flops_xla_scan_body_once", "achieved_flops_per_sec",
                      "peak_flops_per_sec", "mfu")},
     }
@@ -351,7 +359,8 @@ def main():
         ref_matched = base["sizes"][f"h{hm}_n{nm}_c{C}"]["steps_per_sec"]
         ours_matched = bench_ours(hm, nm, C, iters=MATCHED_ITERS,
                                   eig_chunk=chunk, reps=args.reps,
-                                  eig_mode=args.eig_mode)
+                                  eig_mode=args.eig_mode,
+                                  eig_backend=args.eig_backend)
         out["vs_baseline"] = round(
             ours_matched["steps_per_sec"] / ref_matched, 4)
         out["vs_baseline_measured_at"] = (

@@ -78,10 +78,11 @@ def eig_scores_cache_pallas(
     1e-12 entropy floor, log2 via ln·log2(e) (the same lowering XLA emits
     for ``jnp.log2``). ``block`` is a CAP on the N-tile; the actual tile
     targets ~8 MB of VMEM per (B, C, H) fp32 block (block=0 means "derive
-    from VMEM alone"). The x8 sublane minimum floors the tile at 8 rows,
-    so a huge-C*H cache (C*H > ~256k elements) can exceed the target up to
-    2x — that regime is exercised only in interpret-mode tests, not on
-    hardware (the jnp path is the safe choice there).
+    from VMEM alone"). The x8 sublane minimum floors the tile at 8 rows =
+    32*C*H bytes, which exceeds the target once C*H > ~256k elements and
+    keeps growing linearly with C*H — that regime is exercised only in
+    interpret-mode tests, not on hardware (the jnp path is the safe choice
+    there).
 
     Blocking obeys the TPU tiling rules (a block dim must be a multiple of
     its hardware tile or span the whole array dim): the (C, H) minor dims
