@@ -47,9 +47,13 @@ def main(argv=None):
     p.add_argument("--compile-cache", default=".jax_cache")
     p.add_argument("--platform", default=None)
     p.add_argument("--warm-rerun", action="store_true",
-                   help="run the sweep a second time off the hot compile "
-                        "cache and report the steady-state wall-clock "
-                        "(BASELINE.md's <60 s v5e-8 target is steady-state)")
+                   help="run the sweep again off the hot compile cache and "
+                        "report the steady-state wall-clock (BASELINE.md's "
+                        "<60 s v5e-8 target is steady-state)")
+    p.add_argument("--warm-reps", type=int, default=None,
+                   help="number of warm reruns; the steady-state number is "
+                        "their MEDIAN (median-of-k discipline for numbers "
+                        "captured through a flaky device tunnel)")
     p.add_argument("--out", default=None, metavar="BENCH_SUITE.json",
                    help="also write the full per-method/per-pair breakdown "
                         "to this JSON file")
@@ -118,18 +122,26 @@ def main(argv=None):
         "vs_baseline": 0.0,
     }
 
-    if args.warm_rerun:
-        # second pass off the hot in-process jit cache: pairs are pure
+    if args.warm_rerun or args.warm_reps is not None:
+        # warm passes off the hot in-process jit cache: pairs are pure
         # execution, but the lazy loaders REGENERATE each synthetic tensor,
         # so the wall includes datagen. steady_state_compute_s excludes it
         # and is the number comparable to the cold "value" (also compute-
         # only) and to BASELINE.md's <60 s steady-state target.
-        t0 = time.perf_counter()
-        runner.run(loaders, methods, method_args={"eig_chunk": args.eig_chunk})
-        line["steady_state_compute_s"] = round(
-            runner.last_stats.get("compute_s", 0.0), 2)
-        line["steady_state_wall_incl_datagen"] = round(
-            time.perf_counter() - t0, 2)
+        import statistics
+
+        computes, walls = [], []
+        for _ in range(max(1, args.warm_reps or 1)):
+            t0 = time.perf_counter()
+            runner.run(loaders, methods,
+                       method_args={"eig_chunk": args.eig_chunk})
+            walls.append(round(time.perf_counter() - t0, 2))
+            computes.append(round(runner.last_stats.get("compute_s", 0.0), 2))
+        line["steady_state_compute_s"] = statistics.median(computes)
+        line["steady_state_wall_incl_datagen"] = statistics.median(walls)
+        line["steady_state_reps"] = len(computes)
+        line["steady_state_compute_s_all"] = computes
+        line["steady_state_wall_all"] = walls
     print(json.dumps(line))
     if args.out:
         import platform as _pl
