@@ -58,6 +58,8 @@ def main(argv=None):
                    help="also write the full per-method/per-pair breakdown "
                         "to this JSON file")
     args = p.parse_args(argv)
+    if args.warm_reps is not None and args.warm_reps < 1:
+        p.error("--warm-reps must be >= 1")
 
     from coda_tpu.utils.platform import pin_platform
 
