@@ -76,9 +76,25 @@ def _build_fn(H: int, N: int, C: int, iters: int, eig_chunk: int,
     # is a traced argument, not a baked-in constant (2 GB of captured
     # constants at M=1k, N=50k would bloat lowering and HBM).
     def run(preds, labels, key):
-        sel = make_coda(preds, hp)
-        losses = true_losses(preds, labels)
-        return build_experiment_fn(sel, labels, losses, iters=iters)(key)
+        import jax.numpy as jnp
+
+        res = build_experiment_fn(
+            make_coda(preds, hp), labels, true_losses(preds, labels),
+            iters=iters,
+        )(key)
+        # pack the full result tree into ONE device buffer: every host
+        # materialization pays a fixed per-buffer latency (~65 ms through
+        # the axon tunnel), so 8 leaves cost ~0.5 s of pure transfer
+        # latency per invocation. All int traces (idx < N, classes < C,
+        # model ids < H) are exact in f32. The pack is part of the timed
+        # program; nothing of the experiment itself changes.
+        traces = jnp.stack([x.astype(jnp.float32) for x in
+                            (res.chosen_idx, res.true_class, res.best_model,
+                             res.regret, res.cumulative_regret,
+                             res.select_prob)])
+        scalars = jnp.stack([res.regret_at_0.astype(jnp.float32),
+                             res.stochastic.astype(jnp.float32)])
+        return jnp.concatenate([traces.ravel(), scalars])
 
     return jax.jit(run), (task.preds, task.labels)
 
