@@ -116,7 +116,11 @@ class Dataset:
         if preds_np.ndim != 3:
             raise ValueError(f"preds must be (H, N, C); got {preds_np.shape}")
         if sharding is not None:
-            preds = jax.device_put(jnp.asarray(preds_np), sharding)
+            # device_put straight from the host array: going through
+            # jnp.asarray first would commit the FULL tensor to one chip's
+            # HBM before resharding — an OOM for exactly the over-HBM
+            # tensors sharding exists to serve
+            preds = jax.device_put(preds_np, sharding)
         else:
             preds = jnp.asarray(preds_np)
 
@@ -142,6 +146,24 @@ class Dataset:
                    filenames=filenames, class_names=class_names)
 
 
+def load_with_sharding_fallback(build, sharding, name, warn=print):
+    """``build(sharding) -> Dataset``, degrading to unsharded placement when
+    the task shape doesn't divide the mesh (a ``NamedSharding`` needs even
+    shards; a heterogeneous sweep shouldn't abort on one awkward N). The
+    check matches both jax wordings ("divisible by" from pjit aval checks,
+    "evenly divide" from ``Sharding.shard_shape``)."""
+    if sharding is None:
+        return build(None)
+    try:
+        return build(sharding)
+    except ValueError as e:
+        if not any(w in str(e) for w in ("divisible", "divide")):
+            raise
+        warn(f"[data] {name}: shape not divisible by the mesh; "
+             "loading unsharded")
+        return build(None)
+
+
 def make_synthetic_task(
     seed: int,
     H: int = 8,
@@ -151,6 +173,7 @@ def make_synthetic_task(
     acc_hi: float = 0.9,
     sharpness: float = 4.0,
     name: Optional[str] = None,
+    sharding: Optional[jax.sharding.Sharding] = None,
 ) -> Dataset:
     """Seeded synthetic model-selection task.
 
@@ -179,8 +202,10 @@ def make_synthetic_task(
     p = np.exp(logits)
     p /= p.sum(-1, keepdims=True)
 
+    p = p.astype(np.float32)
     return Dataset(
-        preds=jnp.asarray(p.astype(np.float32)),
+        preds=(jax.device_put(p, sharding) if sharding is not None
+               else jnp.asarray(p)),
         labels=jnp.asarray(labels),
         name=name or f"synthetic_h{H}_n{N}_c{C}_s{seed}",
     )

@@ -46,6 +46,9 @@ def main(argv=None):
     p.add_argument("--eig-chunk", type=int, default=2048)
     p.add_argument("--compile-cache", default=".jax_cache")
     p.add_argument("--platform", default=None)
+    p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
+                   help="shard each task over a device mesh, e.g. data=8 "
+                        "(N over the data axis) — the v5e-8 target config")
     p.add_argument("--warm-rerun", action="store_true",
                    help="run the sweep again off the hot compile cache and "
                         "report the steady-state wall-clock (BASELINE.md's "
@@ -70,8 +73,14 @@ def main(argv=None):
         jax.config.update("jax_compilation_cache_dir", args.compile_cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.data import load_with_sharding_fallback, make_synthetic_task
     from coda_tpu.engine.suite import SuiteRunner
+
+    sharding = None
+    if args.mesh:
+        from coda_tpu.parallel import mesh_from_spec, preds_sharding
+
+        sharding = preds_sharding(mesh_from_spec(args.mesh))
 
     fams = SMALL_FAMILIES if args.small else FAMILIES
     loaders = []
@@ -79,9 +88,12 @@ def main(argv=None):
         for i in range(count):
             loaders.append(
                 # stable across processes (hash() is PYTHONHASHSEED-salted)
-                lambda fam=fam, i=i, H=H, N=N, C=C: make_synthetic_task(
-                    seed=zlib.crc32(f"{fam}_{i}".encode()) % (2**31),
-                    H=H, N=N, C=C, name=f"{fam}_{i}",
+                lambda fam=fam, i=i, H=H, N=N, C=C: load_with_sharding_fallback(
+                    lambda s, fam=fam, i=i, H=H, N=N, C=C: make_synthetic_task(
+                        seed=zlib.crc32(f"{fam}_{i}".encode()) % (2**31),
+                        H=H, N=N, C=C, name=f"{fam}_{i}", sharding=s,
+                    ),
+                    sharding, f"{fam}_{i}",
                 )
             )
 

@@ -37,6 +37,9 @@ def main(argv=None):
     p.add_argument("--force-rerun", action="store_true")
     p.add_argument("--no-db", action="store_true")
     p.add_argument("--platform", default=None)
+    p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
+                   help="shard each task tensor over a device mesh, "
+                        "e.g. data=8 or data=4,model=2")
     args = p.parse_args(argv)
 
     from coda_tpu.utils.platform import pin_platform
@@ -61,8 +64,18 @@ def main(argv=None):
         if fp is None:
             raise SystemExit(f"no data file for task {t!r}")
         paths.append((os.path.getsize(fp), fp, t))
+    sharding = None
+    if args.mesh:
+        from coda_tpu.parallel import mesh_from_spec, preds_sharding
+
+        sharding = preds_sharding(mesh_from_spec(args.mesh))
+
+    from coda_tpu.data import load_with_sharding_fallback
+
     datasets = [
-        (lambda fp=fp, t=t: Dataset.from_file(fp, name=t))
+        (lambda fp=fp, t=t: load_with_sharding_fallback(
+            lambda s, fp=fp, t=t: Dataset.from_file(fp, name=t, sharding=s),
+            sharding, t))
         for _, fp, t in sorted(paths)
     ]
 

@@ -1,5 +1,6 @@
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from coda_tpu.data import Dataset, make_synthetic_task
 from coda_tpu.losses import LOSS_FNS, accuracy_loss, cross_entropy_loss
@@ -94,3 +95,34 @@ def test_oracle_requires_labels(tiny_task):
     ds = Dataset(preds=tiny_task.preds, labels=None)
     with pytest.raises(ValueError):
         Oracle(ds)
+
+
+def test_load_with_sharding_fallback_wordings():
+    """Both jax uneven-shard error wordings must trigger the unsharded
+    retry ("divisible by" from pjit aval checks, "evenly divide" from
+    Sharding.shard_shape); anything else must propagate."""
+    from coda_tpu.data import load_with_sharding_fallback
+
+    warns = []
+    for msg in ("size of its dimension 1 should be divisible by 4",
+                "tiling factors should evenly divide the shape"):
+        calls = []
+
+        def build(s, msg=msg):
+            calls.append(s)
+            if s is not None:
+                raise ValueError(msg)
+            return "dataset"
+
+        out = load_with_sharding_fallback(build, "mesh", "t",
+                                          warn=warns.append)
+        assert out == "dataset" and calls == ["mesh", None]
+    assert len(warns) == 2
+
+    with pytest.raises(ValueError, match="unrelated"):
+        load_with_sharding_fallback(
+            lambda s: (_ for _ in ()).throw(ValueError("unrelated")),
+            "mesh", "t", warn=lambda m: None)
+
+    # no sharding: build once, unsharded
+    assert load_with_sharding_fallback(lambda s: s is None, None, "t")

@@ -144,3 +144,33 @@ def test_run_suite_cli(three_tasks, tmp_path):
     (n,) = store.query("SELECT COUNT(*) FROM experiments")[0]
     assert n == 3
     store.close()
+
+
+def test_suite_sharded_task_matches_unsharded():
+    """A task sharded over a (data x model) mesh must produce the same
+    traces through the suite runner as its unsharded copy (same jitted
+    program; GSPMD inserts the collectives)."""
+    import jax
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine.suite import SuiteRunner
+    from coda_tpu.parallel import make_mesh, preds_sharding
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    sharding = preds_sharding(make_mesh(data=4, model=2))
+    plain = make_synthetic_task(seed=9, H=4, N=40, C=3, name="shardtask")
+    sharded = make_synthetic_task(seed=9, H=4, N=40, C=3, name="shardtask",
+                                  sharding=sharding)
+    assert sharded.preds.sharding.num_devices == 8
+
+    runner = SuiteRunner(iters=5, seeds=2)
+    r_plain = runner.run([plain], ["iid", "coda"], progress=lambda s: None)
+    r_shard = runner.run([sharded], ["iid", "coda"], progress=lambda s: None)
+    for key in r_plain:
+        np.testing.assert_array_equal(
+            np.asarray(r_plain[key].chosen_idx),
+            np.asarray(r_shard[key].chosen_idx))
+        np.testing.assert_array_equal(
+            np.asarray(r_plain[key].best_model),
+            np.asarray(r_shard[key].best_model))
