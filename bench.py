@@ -344,9 +344,21 @@ def main():
     else:
         H, N, C, iters, chunk = 1000, 50_000, 10, 50, 2048
 
-    ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
-                      reps=args.reps, eig_mode=args.eig_mode,
-                      eig_backend=args.eig_backend)
+    # one retry if the linearity guard trips: a single tunnel hiccup can
+    # blow the noise floor of one rep set, and re-measuring is cheaper and
+    # more honest than discarding the whole round. A SECOND failure means
+    # the protocol genuinely can't resolve the per-step cost — report
+    # invalid as before.
+    for attempt in range(2):
+        ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
+                          reps=args.reps, eig_mode=args.eig_mode,
+                          eig_backend=args.eig_backend)
+        if ours["linearity"]["ok"] or args.small:
+            break
+        print("[bench] linearity guard tripped on attempt "
+              f"{attempt + 1}; " + ("re-measuring" if attempt == 0 else
+                                    "giving up — reporting invalid"),
+              file=sys.stderr)
 
     base = reference_baseline(C, skip=args.skip_reference)
     out = {

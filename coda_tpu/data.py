@@ -106,23 +106,19 @@ class Dataset:
         filepath: str,
         sharding: Optional[jax.sharding.Sharding] = None,
         name: Optional[str] = None,
+        unsharded_fallback: bool = False,
     ) -> "Dataset":
         """Load ``<task>.{npy,npz,pt}`` (+ optional ``<task>_labels.*``).
 
         If ``sharding`` is given the prediction tensor is placed with it
-        (sharded across the mesh) instead of committed to the default device.
+        (sharded across the mesh) instead of committed to the default
+        device; see :func:`_place_preds` for ``unsharded_fallback``.
         """
         preds_np = _load_array(filepath).astype(np.float32)  # fp32 mandatory
         if preds_np.ndim != 3:
             raise ValueError(f"preds must be (H, N, C); got {preds_np.shape}")
-        if sharding is not None:
-            # device_put straight from the host array: going through
-            # jnp.asarray first would commit the FULL tensor to one chip's
-            # HBM before resharding — an OOM for exactly the over-HBM
-            # tensors sharding exists to serve
-            preds = jax.device_put(preds_np, sharding)
-        else:
-            preds = jnp.asarray(preds_np)
+        task = name or os.path.splitext(os.path.basename(filepath))[0]
+        preds = _place_preds(preds_np, sharding, unsharded_fallback, task)
 
         labels = None
         filenames = class_names = None
@@ -141,27 +137,34 @@ class Dataset:
             lp = _labels_path(filepath)
             if os.path.exists(lp):
                 labels = jnp.asarray(_load_array(lp).astype(np.int32))
-        task = name or os.path.splitext(os.path.basename(filepath))[0]
         return cls(preds=preds, labels=labels, name=task,
                    filenames=filenames, class_names=class_names)
 
 
-def load_with_sharding_fallback(build, sharding, name, warn=print):
-    """``build(sharding) -> Dataset``, degrading to unsharded placement when
-    the task shape doesn't divide the mesh (a ``NamedSharding`` needs even
-    shards; a heterogeneous sweep shouldn't abort on one awkward N). The
-    check matches both jax wordings ("divisible by" from pjit aval checks,
-    "evenly divide" from ``Sharding.shard_shape``)."""
+def _place_preds(preds_np, sharding, unsharded_fallback, name, warn=print):
+    """Device placement of a host ``(H, N, C)`` array.
+
+    With a ``sharding``, ``device_put`` goes straight from host memory into
+    the shards (staging through ``jnp.asarray`` first would commit the FULL
+    tensor to one chip's HBM — an OOM for exactly the over-HBM tensors
+    sharding exists to serve). A ``NamedSharding`` needs even shards; with
+    ``unsharded_fallback`` a shape that doesn't divide the mesh degrades to
+    unsharded placement with a warning (so a heterogeneous sweep doesn't
+    abort on one awkward N) instead of raising.
+    """
     if sharding is None:
-        return build(None)
+        return jnp.asarray(preds_np)
     try:
-        return build(sharding)
+        return jax.device_put(preds_np, sharding)
     except ValueError as e:
-        if not any(w in str(e) for w in ("divisible", "divide")):
+        # a ValueError from device_put of a host array IS a placement
+        # failure (uneven shards, mesh/shape mismatch) — no error-string
+        # matching needed
+        if not unsharded_fallback:
             raise
-        warn(f"[data] {name}: shape not divisible by the mesh; "
+        warn(f"[data] {name}: sharded placement failed ({e}); "
              "loading unsharded")
-        return build(None)
+        return jnp.asarray(preds_np)
 
 
 def make_synthetic_task(
@@ -174,6 +177,7 @@ def make_synthetic_task(
     sharpness: float = 4.0,
     name: Optional[str] = None,
     sharding: Optional[jax.sharding.Sharding] = None,
+    unsharded_fallback: bool = False,
 ) -> Dataset:
     """Seeded synthetic model-selection task.
 
@@ -203,9 +207,9 @@ def make_synthetic_task(
     p /= p.sum(-1, keepdims=True)
 
     p = p.astype(np.float32)
+    task = name or f"synthetic_h{H}_n{N}_c{C}_s{seed}"
     return Dataset(
-        preds=(jax.device_put(p, sharding) if sharding is not None
-               else jnp.asarray(p)),
+        preds=_place_preds(p, sharding, unsharded_fallback, task),
         labels=jnp.asarray(labels),
-        name=name or f"synthetic_h{H}_n{N}_c{C}_s{seed}",
+        name=task,
     )

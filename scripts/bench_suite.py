@@ -73,7 +73,7 @@ def main(argv=None):
         jax.config.update("jax_compilation_cache_dir", args.compile_cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    from coda_tpu.data import load_with_sharding_fallback, make_synthetic_task
+    from coda_tpu.data import make_synthetic_task
     from coda_tpu.engine.suite import SuiteRunner
 
     sharding = None
@@ -88,12 +88,10 @@ def main(argv=None):
         for i in range(count):
             loaders.append(
                 # stable across processes (hash() is PYTHONHASHSEED-salted)
-                lambda fam=fam, i=i, H=H, N=N, C=C: load_with_sharding_fallback(
-                    lambda s, fam=fam, i=i, H=H, N=N, C=C: make_synthetic_task(
-                        seed=zlib.crc32(f"{fam}_{i}".encode()) % (2**31),
-                        H=H, N=N, C=C, name=f"{fam}_{i}", sharding=s,
-                    ),
-                    sharding, f"{fam}_{i}",
+                lambda fam=fam, i=i, H=H, N=N, C=C: make_synthetic_task(
+                    seed=zlib.crc32(f"{fam}_{i}".encode()) % (2**31),
+                    H=H, N=N, C=C, name=f"{fam}_{i}", sharding=sharding,
+                    unsharded_fallback=True,
                 )
             )
 

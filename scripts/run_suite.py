@@ -70,12 +70,9 @@ def main(argv=None):
 
         sharding = preds_sharding(mesh_from_spec(args.mesh))
 
-    from coda_tpu.data import load_with_sharding_fallback
-
     datasets = [
-        (lambda fp=fp, t=t: load_with_sharding_fallback(
-            lambda s, fp=fp, t=t: Dataset.from_file(fp, name=t, sharding=s),
-            sharding, t))
+        (lambda fp=fp, t=t: Dataset.from_file(
+            fp, name=t, sharding=sharding, unsharded_fallback=True))
         for _, fp, t in sorted(paths)
     ]
 
