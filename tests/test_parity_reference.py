@@ -520,19 +520,7 @@ def test_activetesting_lockstep_parity(task, ref_ds):
 def test_vma_scores_parity(task, ref_ds):
     from coda_tpu.selectors.vma import vma_scores
 
-    H, N, C = task.preds.shape
-    random.seed(0)
-    ref = RefVMA(ref_ds, REF_LOSS_FNS["acc"])
-
-    # reproduce the reference's acquisition internals on the full set
-    pi_y = ref.surrogate.get_preds()
-    pred_classes = ref_ds.preds.argmax(dim=2)
-    cols = torch.arange(N).unsqueeze(0).expand(H, N)
-    losses_all = 1.0 - pi_y[cols, pred_classes]
-    diff = (losses_all.unsqueeze(0) - losses_all.unsqueeze(1)).abs()
-    mask = torch.triu(torch.ones(H, H, dtype=torch.bool), diagonal=1)
-    theirs = diff[mask].sum(0).numpy()
-
+    theirs = _ref_vma_acquisition(ref_ds)
     ours = np.asarray(vma_scores(task.preds))
     np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-6)
 
@@ -584,13 +572,12 @@ def sel_gamma(eps: float) -> float:
 # ------------------------------------------------------- real-data parity
 
 
-def test_coda_real_digits_independent_trace_parity():
-    """Independent CODA runs on REAL data (the committed digits tensor:
-    14 sklearn classifiers x NIST digit scans, see REAL_TASK.md) must agree
-    with the reference trace — synthetic toys can't catch distribution-
-    dependent divergence (peaked/flat posteriors, near-tie EIG structure).
-    N is subset for the reference's per-round Python-loop speed; the slice
-    keeps the real per-model error structure intact."""
+@pytest.fixture(scope="module")
+def digits_task():
+    """Real-data slice (the committed digits tensor: 14 sklearn classifiers
+    x NIST digit scans, see REAL_TASK.md). N is subset for the reference's
+    per-round Python-loop speed; the slice keeps the real per-model error
+    structure intact."""
     import os
 
     from coda_tpu.data import Dataset
@@ -598,8 +585,81 @@ def test_coda_real_digits_independent_trace_parity():
     path = os.path.join(os.path.dirname(__file__), "..", "data", "digits.npz")
     if not os.path.exists(path):
         pytest.skip("digits.npz not committed")
-
     full = Dataset.from_file(path)
-    task = Dataset(preds=full.preds[:, :220, :], labels=full.labels[:220],
+    return Dataset(preds=full.preds[:, :220, :], labels=full.labels[:220],
                    name="digits_sub")
-    _independent_trace_parity(task, RefDS(task), iters=8)
+
+
+def _ref_vma_acquisition(ref_ds):
+    """The reference VMA acquisition reconstructed on the full point set
+    (shared by the synthetic and real-data parity tests)."""
+    H, N, _ = ref_ds.preds.shape
+    random.seed(0)
+    ref = RefVMA(ref_ds, REF_LOSS_FNS["acc"])
+    pi_y = ref.surrogate.get_preds()
+    pred_classes = ref_ds.preds.argmax(dim=2)
+    cols = torch.arange(N).unsqueeze(0).expand(H, N)
+    losses_all = 1.0 - pi_y[cols, pred_classes]
+    diff = (losses_all.unsqueeze(0) - losses_all.unsqueeze(1)).abs()
+    mask = torch.triu(torch.ones(H, H, dtype=torch.bool), diagonal=1)
+    return diff[mask].sum(0).numpy()
+
+
+def test_coda_real_digits_independent_trace_parity(digits_task):
+    """Independent CODA runs on REAL data must agree with the reference
+    trace — synthetic toys can't catch distribution-dependent divergence
+    (peaked/flat posteriors, near-tie EIG structure)."""
+    _independent_trace_parity(digits_task, RefDS(digits_task), iters=8)
+
+
+def test_uncertainty_real_digits_scores_parity(digits_task):
+    from coda_tpu.selectors.uncertainty import uncertainty_scores
+
+    ref_ds = RefDS(digits_task)
+    N = digits_task.preds.shape[1]
+    theirs = ref_uncertainty_scores(ref_ds.preds, list(range(N))).numpy()
+    ours = np.asarray(uncertainty_scores(digits_task.preds))
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-7)
+
+
+def test_vma_real_digits_scores_parity(digits_task):
+    from coda_tpu.selectors.vma import vma_scores
+
+    theirs = _ref_vma_acquisition(RefDS(digits_task))
+    np.testing.assert_allclose(np.asarray(vma_scores(digits_task.preds)),
+                               theirs, rtol=1e-4, atol=1e-6)
+
+
+def test_modelpicker_real_digits_lockstep_parity(digits_task):
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.modelpicker import (
+        expected_entropies,
+        make_modelpicker,
+    )
+
+    ref_ds = RefDS(digits_task)
+    H, N, C = digits_task.preds.shape
+    eps = 0.46
+    mp_ref = RefMP(ref_ds, epsilon=eps)
+    sel = make_modelpicker(digits_task.preds, epsilon=eps)
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    update_jit = jax.jit(sel.update)
+    hard_preds = jnp.argmax(digits_task.preds, -1).T.astype(jnp.int32)
+    labels_np = np.asarray(digits_task.labels)
+    for idx in [3, 57, 120]:
+        pred_u = ref_ds.preds.argmax(dim=2).transpose(0, 1)[mp_ref.d_u_idxs]
+        theirs_ent = mp_ref.compute_entropies(
+            pred_u, mp_ref.posterior, H, C, mp_ref.gamma).numpy()
+        ours_ent = np.asarray(
+            expected_entropies(hard_preds, state.posterior, sel_gamma(eps), C)
+        )[np.asarray(mp_ref.d_u_idxs)]
+        np.testing.assert_allclose(ours_ent, theirs_ent, rtol=1e-5, atol=1e-6)
+        tc = int(labels_np[idx])
+        mp_ref.add_label(idx, tc)
+        state = update_jit(state, jnp.asarray(idx), jnp.asarray(tc),
+                           jnp.asarray(0.0))
+        np.testing.assert_allclose(np.asarray(state.posterior),
+                                   mp_ref.posterior.numpy(),
+                                   rtol=1e-5, atol=1e-7)
