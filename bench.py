@@ -59,7 +59,8 @@ REF_STEPS = 5
 
 
 def _build_fn(H: int, N: int, C: int, iters: int, eig_chunk: int,
-              eig_mode: str = "auto", eig_backend: str = "jnp"):
+              eig_mode: str = "auto", eig_backend: str = "jnp",
+              eig_precision: str = "highest"):
     """(jitted experiment fn, (preds, labels)) for one config."""
     import jax
 
@@ -70,7 +71,8 @@ def _build_fn(H: int, N: int, C: int, iters: int, eig_chunk: int,
 
     task = make_synthetic_task(seed=0, H=H, N=N, C=C)
     hp = CODAHyperparams(eig_chunk=eig_chunk, eig_mode=eig_mode,
-                         eig_backend=eig_backend)
+                         eig_backend=eig_backend,
+                         eig_precision=eig_precision)
 
     # Build the selector INSIDE the jitted function so the (H, N, C) tensor
     # is a traced argument, not a baked-in constant (2 GB of captured
@@ -173,7 +175,8 @@ def _mad(xs: list[float]) -> float:
 
 def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
                reps: int = 5, eig_mode: str = "auto",
-               eig_backend: str = "jnp") -> dict:
+               eig_backend: str = "jnp",
+               eig_precision: str = "highest") -> dict:
     """Trustworthy steps/sec: two scan lengths, marginal cost, FLOPs, MFU.
 
     The same experiment is compiled at ``iters`` and ``iters // 2`` scan
@@ -189,11 +192,12 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     import jax
 
     half_iters = max(1, iters // 2)
-    fn, data = _build_fn(H, N, C, iters, eig_chunk, eig_mode, eig_backend)
+    fn, data = _build_fn(H, N, C, iters, eig_chunk, eig_mode, eig_backend,
+                         eig_precision)
     compiled = _compile(fn, data)
     walls = _timed_reps(compiled, data, reps)
     fn_half, data_half = _build_fn(H, N, C, half_iters, eig_chunk, eig_mode,
-                                   eig_backend)
+                                   eig_backend, eig_precision)
     compiled_half = _compile(fn_half, data_half)
     walls_half = _timed_reps(compiled_half, data_half, reps)
 
@@ -232,6 +236,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         },
         "eig_mode": mode,
         "eig_backend": eig_backend,
+        "eig_precision": eig_precision,
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
         "achieved_flops_per_sec": achieved,
@@ -336,6 +341,11 @@ def main():
     ap.add_argument("--eig-backend", default="jnp",
                     help="incremental-EIG scoring backend: jnp | pallas "
                          "(fused single-HBM-pass TPU kernel)")
+    ap.add_argument("--eig-precision", default="highest",
+                    choices=["highest", "high", "default"],
+                    help="EIG table-einsum matmul precision: highest "
+                         "(reference numerics) | high | default — below "
+                         "highest is an opt-in speed/parity tradeoff")
     ap.add_argument("--skip-reference", action="store_true")
     args = ap.parse_args()
 
@@ -352,7 +362,8 @@ def main():
     for attempt in range(2):
         ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
                           reps=args.reps, eig_mode=args.eig_mode,
-                          eig_backend=args.eig_backend)
+                          eig_backend=args.eig_backend,
+                          eig_precision=args.eig_precision)
         if ours["linearity"]["ok"] or args.small:
             break
         print("[bench] linearity guard tripped on attempt "
@@ -374,7 +385,8 @@ def main():
         "devices": {k: ours[k] for k in
                     ("device_kind", "n_devices", "platform")},
         "compute": {k: ours[k] for k in
-                    ("eig_mode", "eig_backend", "flops_per_step_analytic",
+                    ("eig_mode", "eig_backend", "eig_precision",
+                     "flops_per_step_analytic",
                      "flops_xla_scan_body_once", "achieved_flops_per_sec",
                      "peak_flops_per_sec", "mfu")},
     }
@@ -388,7 +400,8 @@ def main():
         ours_matched = bench_ours(hm, nm, C, iters=MATCHED_ITERS,
                                   eig_chunk=chunk, reps=args.reps,
                                   eig_mode=args.eig_mode,
-                                  eig_backend=args.eig_backend)
+                                  eig_backend=args.eig_backend,
+                                  eig_precision=args.eig_precision)
         out["vs_baseline"] = round(
             ours_matched["steps_per_sec"] / ref_matched, 4)
         out["vs_baseline_measured_at"] = (

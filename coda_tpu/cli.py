@@ -89,6 +89,11 @@ def parse_args(argv=None):
                    choices=["jnp", "pallas"],
                    help="incremental-EIG scoring backend: pallas = fused "
                         "single-HBM-pass TPU kernel (interpreted off-TPU)")
+    p.add_argument("--eig-precision", default="highest",
+                   choices=["highest", "high", "default"],
+                   help="matmul precision of the EIG table einsums: highest "
+                        "= reference numerics (parity-tested default); "
+                        "lower tiers trade trace parity for MXU throughput")
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
                    help="shard the (H,N,C) tensor, e.g. 'data=4' or 'data=4,model=2'")
     p.add_argument("--platform", default=None,
@@ -176,6 +181,7 @@ def build_selector_factory(args, task_name: str):
             eig_chunk=args.eig_chunk,
             eig_mode=getattr(args, "eig_mode", "auto"),
             eig_backend=getattr(args, "eig_backend", "jnp"),
+            eig_precision=getattr(args, "eig_precision", "highest"),
         )
         return lambda preds: make_coda(preds, hp, name=method)
     if method == "model_picker":

@@ -67,6 +67,15 @@ class CODAHyperparams(NamedTuple):
     #                               choreography, kept for cross-checks)
     eig_backend: str = "jnp"      # jnp | pallas (fused single-HBM-pass TPU
     #                               kernel for the incremental scoring)
+    eig_precision: str = "highest"  # highest | high | default — matmul
+    #                               precision of the EIG table einsums ONLY
+    #                               (S and t passes, 6*N*H*G FLOPs). highest
+    #                               = 6-pass fp32 (reference numerics, the
+    #                               parity-tested default); high = 3-pass
+    #                               (~2x MXU throughput on TPU); default =
+    #                               1-pass bf16. Anything below highest can
+    #                               reorder near-tie EIG argmaxes on TPU —
+    #                               opt-in speed, not reference semantics.
 
 
 # "auto" picks the incremental EIG only while its (N, C, H) fp32 cache fits
@@ -218,6 +227,18 @@ def eig_scores(
     return lax.map(item_eig, (hard_preds, pi_hat_xi), batch_size=chunk)
 
 
+def resolve_precision(name: str) -> lax.Precision:
+    """CODAHyperparams.eig_precision -> lax.Precision (fails loudly)."""
+    try:
+        return {"highest": lax.Precision.HIGHEST,
+                "high": lax.Precision.HIGH,
+                "default": lax.Precision.DEFAULT}[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown eig_precision {name!r} (use highest/high/default)"
+        ) from None
+
+
 def _trapz_weights(num_points: int, dx, dtype) -> jnp.ndarray:
     """Uniform-grid trapezoid weights. Any constant scale cancels in the
     per-(n, c) normalization over models, but keep the exact rule anyway."""
@@ -253,7 +274,8 @@ def _bump_tables(a, b, x, dx, update_weight):
     return logcdf_u.sum(axis=-2), logcdf_b - logcdf_u, F_u, F_b - F_u
 
 
-def _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz):
+def _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz,
+                     precision=_PRECISION):
     """Hypothetical P(best) for a block of items: ``eq`` (B, C, H) -> (B, C, H).
 
     Three dense einsums over the model/grid axes — fp32 matmuls on the MXU
@@ -263,11 +285,11 @@ def _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz):
     """
     # S[n,c,g] = Σ_h logcdf of whichever variant model h takes at (n,c)
     S = S0[None] + jnp.einsum("bch,chg->bcg", eq, dlogcdf,
-                              precision=_PRECISION)
+                              precision=precision)
     S = S - S.max(axis=-1, keepdims=True)            # underflow guard
     wE = w_trapz * jnp.exp(S)                        # (B, C, G)
-    t_base = jnp.einsum("bcg,chg->bch", wE, F_u, precision=_PRECISION)
-    t_diff = jnp.einsum("bcg,chg->bch", wE, dF, precision=_PRECISION)
+    t_base = jnp.einsum("bcg,chg->bch", wE, F_u, precision=precision)
+    t_diff = jnp.einsum("bcg,chg->bch", wE, dF, precision=precision)
     unnorm = t_base + eq * t_diff                    # (B, C, H)
     return unnorm / jnp.clip(unnorm.sum(-1, keepdims=True), _EPS, None)
 
@@ -278,6 +300,7 @@ def build_eig_cache(
     update_weight: float = 1.0,
     num_points: int = 256,
     chunk: int = 256,
+    precision=_PRECISION,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Full (pbest_rows, pbest_hyp) cache for the incremental EIG.
 
@@ -298,7 +321,7 @@ def build_eig_cache(
 
     def blk(pred_b):                                 # (B, H) -> (B, C, H)
         eq = (pred_b[:, None, :] == class_range[None, :, None]).astype(x.dtype)
-        return _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz)
+        return _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz, precision)
 
     B = min(chunk, N)
     if B >= N:
@@ -319,6 +342,7 @@ def update_eig_cache(
     pbest_hyp: jnp.ndarray,    # (N, C, H)
     update_weight: float = 1.0,
     num_points: int = 256,
+    precision=_PRECISION,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Refresh class row ``true_class`` of the incremental-EIG cache.
 
@@ -334,7 +358,8 @@ def update_eig_cache(
     a_t = jnp.take(a_cc, true_class, axis=1)         # (H,)
     b_t = jnp.take(b_cc, true_class, axis=1)
     eq_t = (hard_preds == true_class)                # (N, H) bool
-    hyp_t = _pbest_hyp_row(a_t, b_t, eq_t, update_weight, num_points)
+    hyp_t = _pbest_hyp_row(a_t, b_t, eq_t, update_weight, num_points,
+                           precision)
     row_t = compute_pbest(a_t, b_t, num_points=num_points)       # (H,)
     return (
         pbest_rows.at[true_class].set(row_t),
@@ -342,7 +367,8 @@ def update_eig_cache(
     )
 
 
-def _pbest_hyp_row(a_t, b_t, eq_t, update_weight: float, num_points: int):
+def _pbest_hyp_row(a_t, b_t, eq_t, update_weight: float, num_points: int,
+                   precision=_PRECISION):
     """Hypothetical P(best) for ONE class row over a batch of items.
 
     ``a_t``, ``b_t``: ``(H,)`` diagonal-Beta parameters of the row;
@@ -358,11 +384,11 @@ def _pbest_hyp_row(a_t, b_t, eq_t, update_weight: float, num_points: int):
     S0_t, dlogcdf_t, F_u_t, dF_t = _bump_tables(a_t, b_t, x, dx, update_weight)
     eq = eq_t.astype(x.dtype)
     S = S0_t[None] + jnp.einsum("nh,hg->ng", eq, dlogcdf_t,
-                                precision=_PRECISION)
+                                precision=precision)
     S = S - S.max(axis=-1, keepdims=True)
     wE = w_trapz * jnp.exp(S)                                    # (B, G)
-    t_base = jnp.einsum("ng,hg->nh", wE, F_u_t, precision=_PRECISION)
-    t_diff = jnp.einsum("ng,hg->nh", wE, dF_t, precision=_PRECISION)
+    t_base = jnp.einsum("ng,hg->nh", wE, F_u_t, precision=precision)
+    t_diff = jnp.einsum("ng,hg->nh", wE, dF_t, precision=precision)
     unnorm = t_base + eq * t_diff                                # (B, H)
     return unnorm / jnp.clip(unnorm.sum(-1, keepdims=True), _EPS, None)
 
@@ -386,6 +412,7 @@ def eig_scores_rowscan(
     update_weight: float = 1.0,
     num_points: int = 256,
     chunk: int = 256,
+    precision=_PRECISION,
 ) -> jnp.ndarray:
     """EIG of labeling each point, scanned over class rows. Returns (N,).
 
@@ -421,7 +448,7 @@ def eig_scores_rowscan(
 
         def blk(pred_b):                             # (B, H) -> (B,)
             hyp = _pbest_hyp_row(a_t, b_t, pred_b == c_idx,
-                                 update_weight, num_points)
+                                 update_weight, num_points, precision)
             mix = mixture0[None] + pi_c * (hyp - before_t[None])
             return entropy2(mix, axis=-1)
 
@@ -471,6 +498,7 @@ def eig_scores_factored(
     update_weight: float = 1.0,
     num_points: int = 256,
     chunk: int = 256,
+    precision=_PRECISION,
 ) -> jnp.ndarray:
     """EIG of labeling each point, factored for the MXU. Returns (N,).
 
@@ -509,7 +537,8 @@ def eig_scores_factored(
     def chunk_eig(args):
         pred_b, pi_xi_b = args                       # (B, H) int32, (B, C)
         eq = (pred_b[:, None, :] == class_range[None, :, None]).astype(x.dtype)
-        pbest_hyp = _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz)
+        pbest_hyp = _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz,
+                                     precision)
         # only row c changed; propagate the delta through the class mixture
         mix_new = mixture0[None, None] + pi_hat[None, :, None] * (
             pbest_hyp - pbest_before[None]
@@ -579,6 +608,15 @@ def make_coda(
 
     use_prefilter = hp.q == "eig" and hp.prefilter_n and hp.prefilter_n < N
     eig_mode = resolve_eig_mode(hp, H, N, C)
+    eig_precision = resolve_precision(hp.eig_precision)
+    if eig_mode == "direct" and hp.eig_precision != "highest":
+        raise ValueError(
+            "eig_mode='direct' is the reference-choreography cross-check "
+            "kernel and always runs at HIGHEST precision; "
+            f"eig_precision={hp.eig_precision!r} would silently not apply"
+        )
+    # the direct kernel takes no precision parameter (see guard above)
+    eig_kwargs = {} if eig_mode == "direct" else {"precision": eig_precision}
     incremental = eig_mode == "incremental"
     if hp.eig_backend not in ("jnp", "pallas"):
         raise ValueError(f"unknown eig_backend {hp.eig_backend!r} "
@@ -613,7 +651,8 @@ def make_coda(
         pi_xi, pi = _normalize_pi(unnorm)
         rows, hyp = (
             build_eig_cache(dirichlets0, hard_preds,
-                            num_points=hp.num_points, chunk=hp.eig_chunk)
+                            num_points=hp.num_points, chunk=hp.eig_chunk,
+                            precision=eig_precision)
             if incremental else (None, None)
         )
         return CODAState(
@@ -667,7 +706,7 @@ def make_coda(
         else:
             scores = eig_fn(
                 state.dirichlets, state.pi_hat, state.pi_hat_xi, hard_preds,
-                num_points=hp.num_points, chunk=hp.eig_chunk,
+                num_points=hp.num_points, chunk=hp.eig_chunk, **eig_kwargs,
             )
         idx, n_ties = masked_argmax_tiebreak(k_tie, scores, cand,
                                              rtol=_TIE_RTOL, atol=_TIE_ATOL)
@@ -691,7 +730,7 @@ def make_coda(
             state.dirichlets, state.pi_hat, state.pi_hat_xi[cand_idx],
             hard_preds[cand_idx],
             num_points=hp.num_points,
-            chunk=min(hp.eig_chunk, hp.prefilter_n),
+            chunk=min(hp.eig_chunk, hp.prefilter_n), **eig_kwargs,
         )
         local, n_ties = masked_argmax_tiebreak(
             k_tie, scores_sub, valid, rtol=_TIE_RTOL, atol=_TIE_ATOL
@@ -760,7 +799,8 @@ def make_coda(
             )
             rows, hyp = update_eig_cache(dirichlets, true_class, hard_preds,
                                          state.pbest_rows, state.pbest_hyp,
-                                         num_points=hp.num_points)
+                                         num_points=hp.num_points,
+                                         precision=eig_precision)
         else:
             pi_xi, pi = update_pi_hat(dirichlets, preds)
             unnorm = rows = hyp = None

@@ -513,3 +513,45 @@ def test_coda_incremental_pi_hat_column_exact(task):
                                    rtol=1e-6, atol=1e-7)
         np.testing.assert_allclose(np.asarray(state.pi_hat),
                                    np.asarray(pi_full), rtol=1e-6, atol=1e-7)
+
+
+def test_eig_precision_plumbing():
+    """All precision tiers must run (CPU ignores matmul precision, so
+    traces are identical here — the knob's numeric effect is TPU-only and
+    documented as an opt-in parity tradeoff); unknown names fail loudly."""
+    import pytest
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine import run_experiment
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    task = make_synthetic_task(seed=11, H=5, N=48, C=4)
+    traces = []
+    for prec in ("highest", "high", "default"):
+        res = run_experiment(
+            make_coda(task.preds, CODAHyperparams(eig_precision=prec)),
+            task, iters=5, seed=0)
+        traces.append(np.asarray(res.chosen_idx).tolist())
+    assert traces[0] == traces[1] == traces[2]  # CPU: bitwise identical
+
+    for mode in ("factored", "rowscan"):
+        res = run_experiment(
+            make_coda(task.preds, CODAHyperparams(eig_precision="high",
+                                                  eig_mode=mode)),
+            task, iters=3, seed=0)
+        assert np.isfinite(np.asarray(res.regret)).all()
+
+    with pytest.raises(ValueError, match="eig_precision"):
+        make_coda(task.preds, CODAHyperparams(eig_precision="bf16"))
+
+
+def test_eig_precision_direct_mode_rejected():
+    import pytest
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    task = make_synthetic_task(seed=11, H=4, N=24, C=3)
+    with pytest.raises(ValueError, match="direct"):
+        make_coda(task.preds, CODAHyperparams(eig_mode="direct",
+                                              eig_precision="high"))
