@@ -1,16 +1,20 @@
-"""Build a REAL (non-synthetic) model-selection task: the NIST digits data.
+"""Build a REAL (non-synthetic) model-selection task from bundled data.
 
 The reference validates on 26 real prediction tensors downloaded from its
 release artifacts (reference ``README.md:53``); none are fetchable in this
 offline environment, so this script reconstructs the same *kind* of artifact
 from first principles: a pool of genuinely different models — varied
 families, capacities, and regularization, some strong and some deliberately
-weak — trained on a real dataset (sklearn's bundled NIST digits, 1797
-8x8 images, 10 classes), scored on a held-out evaluation split. The output
-is a native ``<task>.npz`` (post-softmax ``(H, N, C)`` preds + labels +
-class names) consumed by ``main.py`` exactly like any reference task tensor.
+weak — trained on a real dataset bundled with sklearn, scored on a held-out
+evaluation split. The output is a native ``<task>.npz`` (post-softmax
+``(H, N, C)`` preds + labels + class names) consumed by ``main.py`` exactly
+like any reference task tensor.
 
-Usage: python scripts/make_real_task.py [--out data/digits.npz] [--test-frac 0.5]
+Datasets: ``digits`` (1797 8x8 scans, C=10), ``breast_cancer`` (569 points,
+C=2 — the binary case that exercises the Beta/diag-prior edge on real
+data), ``wine`` (178 points, C=3).
+
+Usage: python scripts/make_real_task.py [--dataset digits] [--out data/digits.npz]
 """
 
 from __future__ import annotations
@@ -61,19 +65,34 @@ def model_pool(seed: int = 0):
     ]
 
 
-def build(out: str, test_frac: float = 0.5, seed: int = 0) -> dict:
-    from sklearn.datasets import load_digits
+DATASETS = {
+    "digits": ("load_digits", 16.0),
+    "breast_cancer": ("load_breast_cancer", None),  # None -> standardize
+    "wine": ("load_wine", None),
+}
+
+
+def build(out: str, test_frac: float = 0.5, seed: int = 0,
+          dataset: str = "digits") -> dict:
+    import sklearn.datasets
     from sklearn.model_selection import train_test_split
 
-    digits = load_digits()
+    loader, scale = DATASETS[dataset]
+    data = getattr(sklearn.datasets, loader)()
+    x = data.data.astype(np.float32)
     x_tr, x_ev, y_tr, y_ev = train_test_split(
-        digits.data.astype(np.float32) / 16.0,
-        digits.target.astype(np.int32),
-        test_size=test_frac, random_state=seed, stratify=digits.target,
+        x, data.target.astype(np.int32),
+        test_size=test_frac, random_state=seed, stratify=data.target,
     )
+    if scale:  # digits pixels are 0..16 (fixed scale)
+        x_tr, x_ev = x_tr / scale, x_ev / scale
+    else:  # tabular sets standardize with TRAIN statistics only (no
+        #    eval-set leakage into the preprocessing models train on)
+        mu, sd = x_tr.mean(0), np.clip(x_tr.std(0), 1e-6, None)
+        x_tr, x_ev = (x_tr - mu) / sd, (x_ev - mu) / sd
 
     pool = model_pool(seed)
-    C = len(digits.target_names)
+    C = len(data.target_names)
     preds = np.zeros((len(pool), len(y_ev), C), dtype=np.float32)
     accs = {}
     for h, (name, est) in enumerate(pool):
@@ -90,7 +109,7 @@ def build(out: str, test_frac: float = 0.5, seed: int = 0) -> dict:
         out,
         preds=preds,
         labels=y_ev.astype(np.int32),
-        classes=np.asarray([str(c) for c in digits.target_names]),
+        classes=np.asarray([str(c) for c in data.target_names]),
         models=np.asarray([n for n, _ in pool]),
     )
     return accs
@@ -98,12 +117,15 @@ def build(out: str, test_frac: float = 0.5, seed: int = 0) -> dict:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--out", default=os.path.join(REPO, "data", "digits.npz"))
+    ap.add_argument("--dataset", default="digits", choices=sorted(DATASETS))
+    ap.add_argument("--out", default=None,
+                    help="output path (default data/<dataset>.npz)")
     ap.add_argument("--test-frac", type=float, default=0.5)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
-    accs = build(args.out, args.test_frac, args.seed)
-    print(f"wrote {args.out}")
+    out = args.out or os.path.join(REPO, "data", f"{args.dataset}.npz")
+    accs = build(out, args.test_frac, args.seed, args.dataset)
+    print(f"wrote {out}")
     for name, acc in sorted(accs.items(), key=lambda kv: -kv[1]):
         print(f"  {name:14s} acc={acc:.4f}")
     best = max(accs.values())

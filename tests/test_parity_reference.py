@@ -612,6 +612,21 @@ def test_coda_real_digits_independent_trace_parity(digits_task):
     _independent_trace_parity(digits_task, RefDS(digits_task), iters=8)
 
 
+def test_coda_real_binary_independent_trace_parity():
+    """The C=2 edge (off-diag prior hits 1.0, every Beta is the whole
+    Dirichlet row) on REAL data: the committed breast_cancer task."""
+    import os
+
+    from coda_tpu.data import Dataset
+
+    path = os.path.join(os.path.dirname(__file__), "..", "data",
+                        "breast_cancer.npz")
+    if not os.path.exists(path):
+        pytest.skip("breast_cancer.npz not committed")
+    task = Dataset.from_file(path)
+    _independent_trace_parity(task, RefDS(task), iters=8)
+
+
 def test_uncertainty_real_digits_scores_parity(digits_task):
     from coda_tpu.selectors.uncertainty import uncertainty_scores
 
