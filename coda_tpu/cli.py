@@ -182,6 +182,15 @@ def build_selector_factory(args, task_name: str):
             eig_mode=getattr(args, "eig_mode", "auto"),
             eig_backend=getattr(args, "eig_backend", "jnp"),
             eig_precision=getattr(args, "eig_precision", "highest"),
+            # vmapped seeds each carry their own incremental cache; the
+            # auto eig_mode budget must see the whole batch. Runners with a
+            # different execution width (the suite's dedup batches, future
+            # serial runners) set args.n_parallel explicitly; the default
+            # infers it from the CLI's all-seeds vmap (the serial
+            # checkpoint path runs one seed at a time).
+            n_parallel=(getattr(args, "n_parallel", None)
+                        or (1 if getattr(args, "checkpoint_dir", None)
+                            else max(1, getattr(args, "seeds", 1)))),
         )
         return lambda preds: make_coda(preds, hp, name=method)
     if method == "model_picker":

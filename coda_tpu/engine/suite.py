@@ -56,25 +56,32 @@ class SuiteRunner:
         )
         self._jax = jax
 
-    def _fn_for(self, method: str, method_args: Optional[dict], task_name: str):
+    def _fn_for(self, method: str, method_args: Optional[dict],
+                task_name: str, width: int = 1):
         from coda_tpu.cli import build_selector_factory, parse_args
 
         # Task-dependent hyperparams must be resolved BEFORE the cache key is
         # formed: ``build_selector_factory`` bakes them into the jitted
         # closure, so two tasks with different tuned values must not share an
         # executable (but tasks resolving to the same value still do).
+        # ``width`` = how many seed replicas this executable batches (the
+        # dedup path runs batches of 1 and seeds-1): it keys the cache and
+        # feeds the auto eig_mode memory budget, so the 1-seed probe is
+        # never forced off the incremental kernel by replicas that don't
+        # share its program.
         resolved = dict(method_args or {})
         if method == "model_picker" and "epsilon" not in resolved:
             from coda_tpu.selectors import TASK_EPS
             from coda_tpu.selectors.modelpicker import DEFAULT_EPS
 
             resolved["epsilon"] = TASK_EPS.get(task_name, DEFAULT_EPS)
-        key = (method, tuple(sorted(resolved.items())))
+        key = (method, tuple(sorted(resolved.items())), width)
         if key not in self._jitted:
             args = parse_args([])
             args.method = method
             args.loss = [k for k, v in LOSS_FNS.items() if v is self.loss_fn][0]
             args.iters = self.iters
+            args.n_parallel = max(1, width)
             for k, v in resolved.items():
                 setattr(args, k, v)
             factory = build_selector_factory(args, task_name)
@@ -85,8 +92,8 @@ class SuiteRunner:
 
     def run_one(self, method: str, dataset, method_args: Optional[dict] = None):
         """One task-method pair, all seeds batched. Returns ExperimentResult."""
-        fn = self._fn_for(method, method_args, dataset.name)
         if self.dedup_seeds and self.seeds > 1:
+            fn = self._fn_for(method, method_args, dataset.name, width=1)
             # seed 0 runs alone; deterministic -> broadcast, stochastic ->
             # run only the REMAINING seeds and concatenate (the probe result
             # is kept, never recomputed). Total device work is exactly
@@ -98,11 +105,14 @@ class SuiteRunner:
                 return type(r0)(*[
                     np.repeat(np.asarray(x), self.seeds, axis=0) for x in r0
                 ])
-            rest = fn(dataset.preds, dataset.labels, self._keys[1:])
+            rest_fn = self._fn_for(method, method_args, dataset.name,
+                                   width=self.seeds - 1)
+            rest = rest_fn(dataset.preds, dataset.labels, self._keys[1:])
             return type(r0)(*[
                 np.concatenate([np.asarray(a), np.asarray(b)], axis=0)
                 for a, b in zip(r0, rest)
             ])
+        fn = self._fn_for(method, method_args, dataset.name, width=self.seeds)
         return fn(dataset.preds, dataset.labels, self._keys)
 
     def run(

@@ -67,6 +67,13 @@ class CODAHyperparams(NamedTuple):
     #                               choreography, kept for cross-checks)
     eig_backend: str = "jnp"      # jnp | pallas (fused single-HBM-pass TPU
     #                               kernel for the incremental scoring)
+    n_parallel: int = 1           # replicas of this experiment sharing the
+    #                               chip (e.g. vmapped seeds): multiplies the
+    #                               per-replica cache/table footprints in the
+    #                               "auto" eig_mode budget — 5 vmapped seeds
+    #                               at M=1k/N=50k carry 5 x 2 GB caches, so
+    #                               auto must fall back to factored where a
+    #                               single run would stay incremental
     eig_precision: str = "highest"  # highest | high | default — matmul
     #                               precision of the EIG table einsums ONLY
     #                               (S and t passes, 6*N*H*G FLOPs). highest
@@ -111,9 +118,10 @@ def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
                 "would maintain a large P(best) cache that is never read"
             )
         return hp.eig_mode
-    if full_pool_eig and 4 * N * C * H <= _INCR_CACHE_MAX_BYTES:
+    par = max(1, hp.n_parallel)
+    if full_pool_eig and par * 4 * N * C * H <= _INCR_CACHE_MAX_BYTES:
         return "incremental"
-    if 16 * C * H * hp.num_points <= _TABLES_MAX_BYTES:
+    if par * 16 * C * H * hp.num_points <= _TABLES_MAX_BYTES:
         return "factored"
     return "rowscan"
 

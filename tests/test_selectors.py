@@ -555,3 +555,25 @@ def test_eig_precision_direct_mode_rejected():
     with pytest.raises(ValueError, match="direct"):
         make_coda(task.preds, CODAHyperparams(eig_mode="direct",
                                               eig_precision="high"))
+
+
+def test_auto_eig_mode_accounts_for_vmapped_replicas():
+    """The 'auto' tier budget is per-chip, not per-replica: a shape whose
+    single cache fits must fall back to the stateless factored kernel when
+    vmapped seeds would carry several caches at once."""
+    from coda_tpu.selectors import CODAHyperparams
+    from coda_tpu.selectors.coda import (
+        _INCR_CACHE_MAX_BYTES,
+        resolve_eig_mode,
+    )
+
+    H, C = 1000, 10
+    # one cache just under the budget
+    N = _INCR_CACHE_MAX_BYTES // (4 * C * H) - 1
+    assert resolve_eig_mode(CODAHyperparams(), H, N, C) == "incremental"
+    assert resolve_eig_mode(
+        CODAHyperparams(n_parallel=5), H, N, C) == "factored"
+    # explicit mode is never overridden by the budget
+    assert resolve_eig_mode(
+        CODAHyperparams(n_parallel=5, eig_mode="incremental"), H, N, C
+    ) == "incremental"
