@@ -59,9 +59,13 @@ REF_STEPS = 5
 
 
 def _build_fn(H: int, N: int, C: int, iters: int, eig_chunk: int,
-              eig_mode: str = "auto", eig_backend: str = "jnp",
-              eig_precision: str = "highest"):
-    """(jitted experiment fn, (preds, labels)) for one config."""
+              eig_opts: dict | None = None):
+    """(jitted experiment fn, (preds, labels)) for one config.
+
+    ``eig_opts``: CODAHyperparams overrides (eig_mode / eig_backend /
+    eig_precision) carried as one dict so a new knob doesn't have to thread
+    through every bench signature.
+    """
     import jax
 
     from coda_tpu.data import make_synthetic_task
@@ -70,9 +74,7 @@ def _build_fn(H: int, N: int, C: int, iters: int, eig_chunk: int,
     from coda_tpu.selectors import CODAHyperparams, make_coda
 
     task = make_synthetic_task(seed=0, H=H, N=N, C=C)
-    hp = CODAHyperparams(eig_chunk=eig_chunk, eig_mode=eig_mode,
-                         eig_backend=eig_backend,
-                         eig_precision=eig_precision)
+    hp = CODAHyperparams(eig_chunk=eig_chunk, **(eig_opts or {}))
 
     # Build the selector INSIDE the jitted function so the (H, N, C) tensor
     # is a traced argument, not a baked-in constant (2 GB of captured
@@ -174,9 +176,7 @@ def _mad(xs: list[float]) -> float:
 
 
 def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
-               reps: int = 5, eig_mode: str = "auto",
-               eig_backend: str = "jnp",
-               eig_precision: str = "highest") -> dict:
+               reps: int = 5, eig_opts: dict | None = None) -> dict:
     """Trustworthy steps/sec: two scan lengths, marginal cost, FLOPs, MFU.
 
     The same experiment is compiled at ``iters`` and ``iters // 2`` scan
@@ -191,13 +191,19 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     """
     import jax
 
+    from coda_tpu.selectors import CODAHyperparams
+
+    # normalize against the hyperparam defaults ONCE so the reported
+    # metadata can never drift from what the selector actually ran with
+    defaults = CODAHyperparams()._asdict()
+    eig_opts = {**{k: defaults[k] for k in
+                   ("eig_mode", "eig_backend", "eig_precision")},
+                **(eig_opts or {})}
     half_iters = max(1, iters // 2)
-    fn, data = _build_fn(H, N, C, iters, eig_chunk, eig_mode, eig_backend,
-                         eig_precision)
+    fn, data = _build_fn(H, N, C, iters, eig_chunk, eig_opts)
     compiled = _compile(fn, data)
     walls = _timed_reps(compiled, data, reps)
-    fn_half, data_half = _build_fn(H, N, C, half_iters, eig_chunk, eig_mode,
-                                   eig_backend, eig_precision)
+    fn_half, data_half = _build_fn(H, N, C, half_iters, eig_chunk, eig_opts)
     compiled_half = _compile(fn_half, data_half)
     walls_half = _timed_reps(compiled_half, data_half, reps)
 
@@ -211,7 +217,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     marginal_step_s = dw / d_iters if d_iters else float("nan")
     overhead_s = wall - iters * marginal_step_s
 
-    flops_per_step, mode = _analytic_step_flops(H, N, C, mode=eig_mode)
+    flops_per_step, mode = _analytic_step_flops(
+        H, N, C, mode=eig_opts["eig_mode"])
 
     dev = jax.devices()[0]
     peak = _PEAK_FLOPS.get(dev.device_kind)
@@ -235,8 +242,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
             "ok": linear_ok,
         },
         "eig_mode": mode,
-        "eig_backend": eig_backend,
-        "eig_precision": eig_precision,
+        "eig_backend": eig_opts["eig_backend"],
+        "eig_precision": eig_opts["eig_precision"],
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
         "achieved_flops_per_sec": achieved,
@@ -359,11 +366,11 @@ def main():
     # more honest than discarding the whole round. A SECOND failure means
     # the protocol genuinely can't resolve the per-step cost — report
     # invalid as before.
+    eig_opts = {"eig_mode": args.eig_mode, "eig_backend": args.eig_backend,
+                "eig_precision": args.eig_precision}
     for attempt in range(2):
         ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
-                          reps=args.reps, eig_mode=args.eig_mode,
-                          eig_backend=args.eig_backend,
-                          eig_precision=args.eig_precision)
+                          reps=args.reps, eig_opts=eig_opts)
         if ours["linearity"]["ok"] or args.small:
             break
         print("[bench] linearity guard tripped on attempt "
@@ -399,9 +406,7 @@ def main():
         ref_matched = base["sizes"][f"h{hm}_n{nm}_c{C}"]["steps_per_sec"]
         ours_matched = bench_ours(hm, nm, C, iters=MATCHED_ITERS,
                                   eig_chunk=chunk, reps=args.reps,
-                                  eig_mode=args.eig_mode,
-                                  eig_backend=args.eig_backend,
-                                  eig_precision=args.eig_precision)
+                                  eig_opts=eig_opts)
         out["vs_baseline"] = round(
             ours_matched["steps_per_sec"] / ref_matched, 4)
         out["vs_baseline_measured_at"] = (

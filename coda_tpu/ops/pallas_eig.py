@@ -70,7 +70,7 @@ def eig_scores_cache_pallas(
     pi_hat: jnp.ndarray,       # (C,)
     pi_hat_xi: jnp.ndarray,    # (N, C)
     block: int = 0,
-    interpret: bool = False,
+    interpret: bool | None = None,
 ) -> jnp.ndarray:
     """(N,) EIG scores from the incremental cache, fused in one HBM pass.
 
@@ -92,6 +92,8 @@ def eig_scores_cache_pallas(
     cache (a jnp.pad here would copy the whole 2 GB tensor every round, on
     a pass whose point is a single HBM read).
     """
+    if interpret is None:  # Mosaic compiles only on real TPUs
+        interpret = jax.default_backend() != "tpu"
     N, C, H = pbest_hyp.shape
     B = choose_block(N, C, H, block)
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)

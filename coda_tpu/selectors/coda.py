@@ -704,7 +704,6 @@ def make_coda(
                 scores = eig_scores_cache_pallas(
                     state.pbest_rows, state.pbest_hyp, state.pi_hat,
                     state.pi_hat_xi, block=hp.eig_chunk,
-                    interpret=jax.default_backend() != "tpu",
                 )
             else:
                 scores = eig_scores_from_cache(

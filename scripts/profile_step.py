@@ -142,10 +142,7 @@ def main(argv=None):
     def body_pallas(c, i):
         from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
 
-        s = eig_scores_cache_pallas(
-            rows, hyp, pi + c * eps, pi_xi, block=CH,
-            interpret=jax.default_backend() != "tpu",
-        )
+        s = eig_scores_cache_pallas(rows, hyp, pi + c * eps, pi_xi, block=CH)
         return c + s[0] * eps
 
     stage("pallas:score", body_pallas, jnp.float32(0))
