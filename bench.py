@@ -10,10 +10,11 @@ Timing protocol (designed so the number survives independent re-timing):
     before the compute queue drains;
   * the reported value is the median of ``--reps`` repetitions;
   * a linearity guard re-runs the same config compiled at half the scan
-    length and requires wall-clock to scale with the work (ratio in
-    [1.3, 3.5] for 2x the steps). If the timed region does not scale with
-    the computation the measurement is *invalid* and the bench exits
-    non-zero rather than print a fabricated number;
+    length and requires the wall-clock GROWTH between the two lengths to
+    clear 4x the repetition noise floor (median absolute deviation). If
+    the timed region does not scale with the computation the measurement
+    is *invalid*: the bench retries once (tunnel hiccup tolerance), then
+    exits non-zero rather than print a fabricated number;
   * per-step FLOPs come from XLA's own ``compiled.cost_analysis()``, and
     MFU is reported against the detected chip's published peak — a
     steps/sec claim that implies >100% MFU is impossible and the guard
