@@ -52,6 +52,21 @@ _PEAK_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+# published HBM bandwidth per chip (bytes/s). The incremental EIG is
+# bandwidth-bound: its per-round FLOP/byte ratio is ~21 at the headline
+# config (9.2e10 FLOPs / 4.4e9 bytes), far below the ~240 FLOP/byte
+# machine balance of a v5e — so MBU against this peak, not MFU against
+# the matmul peak, is the roofline that describes it.
+_PEAK_HBM_BPS = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
 # measured-at-size protocol constants: FIXED regardless of --small/--iters so
 # the same-named metric always means the same measurement
 MATCHED_ITERS = 100
@@ -169,6 +184,22 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
     return 6.0 * N * C * H * G + pi_hat, mode
 
 
+def _analytic_step_bytes(H: int, N: int, C: int) -> float:
+    """Analytic HBM traffic per round (bytes), for the bandwidth roofline.
+
+    Incremental EIG per round: the scoring pass streams the (N, C, H) fp32
+    cache once; the pi-hat column refresh streams the (H, N, C) preds once;
+    the cache row refresh reads the (N, H) int32 hard preds and writes the
+    (N, H) fp32 row. The factored/rowscan tiers stream the same-shaped
+    (N, C, H) hypothetical tensor as intermediates instead of reading a
+    cache, so the same expression is the right order for every tier.
+    """
+    cache_or_hyp = 4.0 * N * C * H
+    preds = 4.0 * H * N * C
+    row = 8.0 * N * H
+    return cache_or_hyp + preds + row
+
+
 def _mad(xs: list[float]) -> float:
     """Median absolute deviation — robust to a single tunnel-hiccup outlier
     (observed: one rep in ~10 takes 6x the median through the axon tunnel)."""
@@ -223,8 +254,12 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
 
     dev = jax.devices()[0]
     peak = _PEAK_FLOPS.get(dev.device_kind)
+    peak_bw = _PEAK_HBM_BPS.get(dev.device_kind)
+    bytes_per_step = _analytic_step_bytes(H, N, C)
     achieved = (flops_per_step / marginal_step_s
                 if linear_ok and marginal_step_s > 0 else 0.0)
+    achieved_bps = (bytes_per_step / marginal_step_s
+                    if linear_ok and marginal_step_s > 0 else 0.0)
     return {
         "steps_per_sec": iters / wall,
         "marginal_steps_per_sec": (1.0 / marginal_step_s
@@ -248,6 +283,11 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
         "achieved_flops_per_sec": achieved,
+        "bytes_per_step_analytic": bytes_per_step,
+        "achieved_bytes_per_sec": achieved_bps,
+        "peak_hbm_bytes_per_sec": peak_bw,
+        "mbu": (achieved_bps / peak_bw) if (peak_bw and achieved_bps)
+               else None,
         "device_kind": dev.device_kind,
         "n_devices": len(jax.devices()),
         "platform": dev.platform,
@@ -396,7 +436,9 @@ def main():
                     ("eig_mode", "eig_backend", "eig_precision",
                      "flops_per_step_analytic",
                      "flops_xla_scan_body_once", "achieved_flops_per_sec",
-                     "peak_flops_per_sec", "mfu")},
+                     "peak_flops_per_sec", "mfu",
+                     "bytes_per_step_analytic", "achieved_bytes_per_sec",
+                     "peak_hbm_bytes_per_sec", "mbu")},
     }
     if base:
         # PRIMARY ratio: both implementations measured at the same size, no

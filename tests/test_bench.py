@@ -74,3 +74,32 @@ def test_reference_baseline_cache_roundtrip(tmp_path, monkeypatch):
 def test_reference_baseline_skip_without_cache(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "BASELINE_CACHE", str(tmp_path / "nope.json"))
     assert bench.reference_baseline(10, skip=True) == {}
+
+
+def test_analytic_step_bytes_matches_documented_traffic():
+    """The bytes model feeds the reported MBU; pin it to the documented
+    per-round traffic (cache/hyp stream + preds stream + row write+read)."""
+    from bench import _analytic_step_bytes
+
+    H, N, C = 1000, 50_000, 10
+    expected = 4.0 * N * C * H + 4.0 * H * N * C + 8.0 * N * H
+    assert _analytic_step_bytes(H, N, C) == expected
+    # arithmetic intensity stays far below a v5e's ~240 FLOP/byte balance:
+    # the kernel is bandwidth-bound and MBU is the honest roofline
+    from bench import _analytic_step_flops
+
+    flops, mode = _analytic_step_flops(H, N, C)
+    assert mode == "incremental"
+    assert flops / _analytic_step_bytes(H, N, C) < 60
+
+
+def test_mbu_reported_against_known_chip():
+    """bench_ours wires bytes/s through to mbu only when the chip's peak
+    bandwidth is known and the linearity guard passed."""
+    from bench import _PEAK_HBM_BPS
+
+    # every chip with a FLOP peak also has a bandwidth peak (the two
+    # tables must stay in lockstep or mbu silently reports None)
+    from bench import _PEAK_FLOPS
+
+    assert set(_PEAK_HBM_BPS) == set(_PEAK_FLOPS)
