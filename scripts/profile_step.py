@@ -37,7 +37,7 @@ def marginal_ms(body, carry0, n_hi: int, n_lo: int, reps: int) -> dict:
     import numpy as np
     from jax import lax
 
-    def run(n: int) -> float:
+    def run(n: int) -> list:
         @jax.jit
         def f(c0):
             return lax.fori_loop(0, n, lambda i, c: body(c, i), c0)
@@ -49,15 +49,22 @@ def marginal_ms(body, carry0, n_hi: int, n_lo: int, reps: int) -> dict:
             t0 = time.perf_counter()
             jax.tree.map(np.asarray, f(carry0))
             ts.append(time.perf_counter() - t0)
-        return statistics.median(ts)
+        return ts
 
-    hi, lo = run(n_hi), run(n_lo)
+    from bench import _mad  # the unit-tested MAD helper (repo root on path)
+
+    ts_hi, ts_lo = run(n_hi), run(n_lo)
+    hi, lo = statistics.median(ts_hi), statistics.median(ts_lo)
+    noise = max(_mad(ts_hi), _mad(ts_lo))
     return {
         "ms_per_iter": (hi - lo) / (n_hi - n_lo) * 1e3,
         "wall_hi_s": round(hi, 4),
         "wall_lo_s": round(lo, 4),
         "n_hi": n_hi,
         "n_lo": n_lo,
+        # the marginal is real only when the growth clears the rep noise;
+        # a single rep has no noise estimate, so it can never resolve
+        "resolved": bool(reps >= 2 and hi - lo > 4.0 * noise),
     }
 
 
@@ -129,9 +136,12 @@ def main(argv=None):
         if name.split(":")[0] in skip:
             return
         r = marginal_ms(body, carry0, args.n_hi, args.n_lo, args.reps)
-        results[name] = round(r["ms_per_iter"], 3)
+        results[name] = {"ms_per_iter": round(r["ms_per_iter"], 3),
+                         "resolved": r["resolved"]}
+        flag = "" if r["resolved"] else "  [below noise floor]"
         print(f"{name:34s} {r['ms_per_iter']:9.3f} ms/iter  "
-              f"(hi={r['wall_hi_s']}s lo={r['wall_lo_s']}s)", file=sys.stderr)
+              f"(hi={r['wall_hi_s']}s lo={r['wall_lo_s']}s){flag}",
+              file=sys.stderr)
 
     def body_score(c, i):
         s = eig_scores_from_cache(rows, hyp, pi + c * eps, pi_xi, chunk=CH)
