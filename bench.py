@@ -375,6 +375,34 @@ def reference_baseline(C: int, skip: bool) -> dict:
     }
 
 
+def _probe_devices(timeout_s: float = 90.0):
+    """None if a jax array op completes in a fresh subprocess, else a
+    short reason string.
+
+    The environment's site hook registers an experimental device tunnel;
+    when that tunnel is wedged, ANY jax array op hangs the process forever
+    — including this bench, which would then produce nothing at all. The
+    probe runs in a subprocess so the hang is bounded by a timeout. A
+    crash (nonzero exit) is reported distinctly from a hang, with the
+    child's stderr tail surfaced.
+    """
+    import subprocess
+
+    code = ("import numpy as np, jax, jax.numpy as jnp;"
+            "np.asarray(jnp.ones(2) + 1)")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+    except subprocess.TimeoutExpired:
+        return f"probe hung (> {timeout_s:.0f}s)"
+    if r.returncode == 0:
+        return None
+    tail = r.stderr.decode(errors="replace").strip().splitlines()[-3:]
+    return (f"probe crashed (exit {r.returncode}): " + " | ".join(tail))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--small", action="store_true",
@@ -395,7 +423,30 @@ def main():
                          "(reference numerics) | high | default — below "
                          "highest is an opt-in speed/parity tradeoff")
     ap.add_argument("--skip-reference", action="store_true")
+    ap.add_argument("--no-device-probe", action="store_true",
+                    help="skip the pre-flight subprocess probe of the "
+                         "accelerator (and its CPU fallback)")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (cpu/tpu); skips the probe")
     args = ap.parse_args()
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
+    device_fallback = None
+    already_pinned = args.platform or (
+        "jax" in sys.modules
+        and sys.modules["jax"].config.jax_platforms == "cpu")
+    reason = None
+    if not args.no_device_probe and not already_pinned:
+        reason = _probe_devices()
+    if reason is not None:
+        # a wedged tunnel hangs every jax op; a bounded CPU measurement
+        # with an explicit marker beats an unbounded hang with no output
+        print(f"[bench] device {reason} — measuring on CPU with an "
+              "explicit marker", file=sys.stderr)
+        pin_platform("cpu")
+        device_fallback = f"{reason}; measured on CPU"
 
     if args.small:
         H, N, C, iters, chunk = 32, 2000, 10, 10, 1000
@@ -432,6 +483,7 @@ def main():
                     "linearity")},
         "devices": {k: ours[k] for k in
                     ("device_kind", "n_devices", "platform")},
+        "device_fallback": device_fallback,
         "compute": {k: ours[k] for k in
                     ("eig_mode", "eig_backend", "eig_precision",
                      "flops_per_step_analytic",
