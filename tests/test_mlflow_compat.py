@@ -1,10 +1,11 @@
-"""Round-trip the native tracking store through the real MLflow client.
+"""Round-trip the native tracking store through the MLflow client.
 
-Skipped when mlflow isn't installed (it is not in TPU images); wherever it
-is, this verifies the full reference workflow — our store -> export ->
-``mlflow ui``-ready backend — with the experiment/parent/child layout and
-metric series intact (reference ``README.md:45``,
-``scripts/aggregate_results.py`` consumers).
+The real-client test skips when mlflow isn't installed (it is not in TPU
+images); wherever it is, it verifies the full reference workflow — our
+store -> export -> ``mlflow ui``-ready backend — with the
+experiment/parent/child layout and metric series intact (reference
+``README.md:45``, ``scripts/aggregate_results.py`` consumers). The
+stub-client test below exercises the exporter's logic everywhere.
 """
 
 from __future__ import annotations
@@ -14,8 +15,6 @@ import os
 
 import numpy as np
 import pytest
-
-mlflow = pytest.importorskip("mlflow")
 
 
 def _export_module():
@@ -30,6 +29,7 @@ def _export_module():
 
 
 def test_export_roundtrip(tmp_path):
+    mlflow = pytest.importorskip("mlflow")
     from coda_tpu.tracking import TrackingStore
 
     db = str(tmp_path / "native.sqlite")
@@ -60,3 +60,97 @@ def test_export_roundtrip(tmp_path):
     assert [m.step for m in history] == [1, 2, 3, 4, 5]
     np.testing.assert_allclose([m.value for m in history], regret, atol=1e-9)
     assert child.info.status == "FINISHED"
+
+
+def test_export_logic_with_stub_client(tmp_path, monkeypatch):
+    """Exercise every exporter decision without mlflow installed: parent
+    runs exported before children, parentRunId remapped to the DEST run
+    ids, controlled tags set exactly once, params/metrics forwarded, runs
+    terminated with their source status. (The real-client round-trip test
+    above still runs wherever mlflow exists.)"""
+    import sys
+    import types
+
+    from coda_tpu.tracking import TrackingStore
+
+    # a tiny native store: one experiment, parent + 2 seed children
+    db = str(tmp_path / "native.sqlite")
+    store = TrackingStore(db)
+    with store.run("expA", "expA-coda", params={"method": "coda"}) as parent:
+        for s in range(2):
+            with store.run("expA", f"expA-coda-{s}", parent=parent,
+                           params={"seed": s}) as r:
+                r.log_metric_series("regret", [0.5, 0.25], start_step=1)
+    store.close()
+
+    class StubClient:
+        def __init__(self, tracking_uri):
+            self.uri = tracking_uri
+            self.created = []       # (exp, tags, run_name) in call order
+            self.batches = {}
+            self.terminated = {}
+            self._n = 0
+
+        def get_experiment_by_name(self, name):
+            return None
+
+        def create_experiment(self, name):
+            return f"dest-exp-{name}"
+
+        def create_run(self, exp, start_time, tags, run_name):
+            self._n += 1
+            rid = f"dest-run-{self._n}"
+            self.created.append((exp, dict(tags), run_name, rid))
+            info = types.SimpleNamespace(run_id=rid)
+            return types.SimpleNamespace(info=info)
+
+        def log_batch(self, run_id, metrics, params, tags):
+            self.batches[run_id] = (list(metrics), list(params), list(tags))
+
+        def set_terminated(self, run_id, status, end_time):
+            self.terminated[run_id] = status
+
+    holder = {}
+
+    def client_factory(tracking_uri):
+        holder["client"] = StubClient(tracking_uri)
+        return holder["client"]
+
+    fake_mlflow = types.ModuleType("mlflow")
+    fake_entities = types.ModuleType("mlflow.entities")
+    fake_entities.Metric = lambda k, v, ts, step: ("metric", k, v, step)
+    fake_entities.Param = lambda k, v: ("param", k, v)
+    fake_entities.RunTag = lambda k, v: ("tag", k, v)
+    fake_tracking = types.ModuleType("mlflow.tracking")
+    fake_tracking.MlflowClient = client_factory
+    fake_mlflow.entities = fake_entities
+    fake_mlflow.tracking = fake_tracking
+    for name, mod in [("mlflow", fake_mlflow),
+                      ("mlflow.entities", fake_entities),
+                      ("mlflow.tracking", fake_tracking)]:
+        monkeypatch.setitem(sys.modules, name, mod)
+
+    export = _export_module().export
+
+    counts = export(db, "stub://dest", progress=lambda s: None)
+    client = holder["client"]
+    assert counts == {"experiments": 1, "runs": 3, "metrics": 4}
+
+    # parent first; children carry the REMAPPED dest parent id. Children
+    # are keyed by run name, not creation order: equal-millisecond start
+    # times make the source ORDER BY a tie, and tie order is SQLite's
+    (exp0, tags0, name0, rid0) = client.created[0]
+    assert exp0 == "dest-exp-expA" and name0 == "expA-coda"
+    assert "mlflow.parentRunId" not in tags0
+    by_name = {name: (tags, rid) for _, tags, name, rid in client.created[1:]}
+    assert set(by_name) == {"expA-coda-0", "expA-coda-1"}
+    for tags, _ in by_name.values():
+        assert tags["mlflow.parentRunId"] == rid0
+
+    # params/metrics forwarded; every run terminated with its source status
+    rid_seed0 = by_name["expA-coda-0"][1]
+    metrics1, params1, tags_b1 = client.batches[rid_seed0]
+    assert ("param", "seed", "0") in params1
+    assert [m[3] for m in metrics1] == [1, 2]  # steps
+    assert set(client.terminated) == {r[3] for r in client.created}
+    assert all(s == "FINISHED" for s in client.terminated.values())
