@@ -174,3 +174,41 @@ def test_suite_sharded_task_matches_unsharded():
         np.testing.assert_array_equal(
             np.asarray(r_plain[key].best_model),
             np.asarray(r_shard[key].best_model))
+
+
+def test_suite_width_divergent_eig_tiers(monkeypatch):
+    """When the 1-seed dedup probe fits the incremental cache but the
+    (seeds-1) batch does not, the two batches compile different EIG tiers
+    of the same integral; the concatenated result must stay consistent."""
+    import jax.numpy as jnp
+
+    import coda_tpu.selectors.coda as coda_mod
+    from coda_tpu.data import Dataset, make_synthetic_task
+    from coda_tpu.engine.suite import SuiteRunner
+    from coda_tpu.selectors import CODAHyperparams
+    from coda_tpu.selectors.coda import resolve_eig_mode
+
+    base = make_synthetic_task(seed=3, H=4, N=24, C=3)
+    # duplicate every point: EIG scores tie exactly, the probe reports
+    # stochastic=True, and the remaining-seeds batch actually runs
+    preds = jnp.concatenate([base.preds, base.preds], axis=1)
+    labels = jnp.concatenate([base.labels, base.labels])
+    task = Dataset(preds=preds, labels=labels, name="ties")
+    H, N, C = task.preds.shape
+
+    # budget: one (N, C, H) cache fits, four do not
+    one_cache = 4 * N * C * H
+    monkeypatch.setattr(coda_mod, "_INCR_CACHE_MAX_BYTES", 2 * one_cache)
+    assert resolve_eig_mode(
+        CODAHyperparams(n_parallel=1), H, N, C) == "incremental"
+    assert resolve_eig_mode(
+        CODAHyperparams(n_parallel=4), H, N, C) == "factored"
+
+    runner = SuiteRunner(iters=5, seeds=5)
+    res = runner.run_one("coda", task)
+    assert np.asarray(res.stochastic).all()
+    assert np.asarray(res.regret).shape == (5, 5)
+    assert np.isfinite(np.asarray(res.regret)).all()
+    # both widths were compiled (probe + rest), at their own tiers
+    widths = {k[2] for k in runner._jitted}
+    assert widths == {1, 4}
