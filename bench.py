@@ -231,6 +231,11 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     eig_opts = {**{k: defaults[k] for k in
                    ("eig_mode", "eig_backend", "eig_precision")},
                 **(eig_opts or {})}
+    # _mad of a single rep is 0, which would floor the noise at 1e-12 and
+    # let any positive wall-clock delta pass linear_ok; the guard only
+    # discriminates with >= 2 reps (same reasoning as profile_step.py's
+    # marginal_ms "resolved" logic).
+    reps = max(reps, 2)
     half_iters = max(1, iters // 2)
     fn, data = _build_fn(H, N, C, iters, eig_chunk, eig_opts)
     compiled = _compile(fn, data)

@@ -20,7 +20,9 @@ TPU-native differences:
 
 from __future__ import annotations
 
+import functools
 import os
+import sys
 from dataclasses import dataclass
 from typing import Optional
 
@@ -141,7 +143,7 @@ class Dataset:
                    filenames=filenames, class_names=class_names)
 
 
-def _place_preds(preds_np, sharding, unsharded_fallback, name, warn=print):
+def _place_preds(preds_np, sharding, unsharded_fallback, name, warn=None):
     """Device placement of a host ``(H, N, C)`` array.
 
     With a ``sharding``, ``device_put`` goes straight from host memory into
@@ -150,7 +152,8 @@ def _place_preds(preds_np, sharding, unsharded_fallback, name, warn=print):
     sharding exists to serve). A ``NamedSharding`` needs even shards; with
     ``unsharded_fallback`` a shape that doesn't divide the mesh degrades to
     unsharded placement with a warning (so a heterogeneous sweep doesn't
-    abort on one awkward N) instead of raising.
+    abort on one awkward N) instead of raising. The warning goes to stderr:
+    suite runners emit machine-readable JSON on stdout.
     """
     if sharding is None:
         return jnp.asarray(preds_np)
@@ -162,6 +165,9 @@ def _place_preds(preds_np, sharding, unsharded_fallback, name, warn=print):
         # matching needed
         if not unsharded_fallback:
             raise
+        if warn is None:
+            # resolve sys.stderr at call time so redirect_stderr/capsys see it
+            warn = functools.partial(print, file=sys.stderr)
         warn(f"[data] {name}: sharded placement failed ({e}); "
              "loading unsharded")
         return jnp.asarray(preds_np)

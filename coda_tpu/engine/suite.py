@@ -91,7 +91,18 @@ class SuiteRunner:
         return self._jitted[key]
 
     def run_one(self, method: str, dataset, method_args: Optional[dict] = None):
-        """One task-method pair, all seeds batched. Returns ExperimentResult."""
+        """One task-method pair, all seeds batched. Returns ExperimentResult.
+
+        Under ``dedup_seeds`` the seed-0 probe (width 1) and the remaining
+        seeds (width ``seeds - 1``) are separate jit programs, and under the
+        ``eig_mode='auto'`` budget the two widths can resolve to DIFFERENT
+        kernel tiers. The tiers are score-parity-tested against each other,
+        but a near-tie EIG argmax can still diverge between tiers, so for
+        stochastic methods seed 0's trace is not strictly exchangeable with
+        the other seeds'. Deliberate: total device work stays exactly
+        ``seeds`` experiments; pin ``eig_mode`` explicitly if strict
+        cross-seed tier homogeneity matters more than the auto budget.
+        """
         if self.dedup_seeds and self.seeds > 1:
             fn = self._fn_for(method, method_args, dataset.name, width=1)
             # seed 0 runs alone; deterministic -> broadcast, stochastic ->
