@@ -1,0 +1,65 @@
+"""Reconcile analytic per-step FLOPs vs XLA cost-model numbers.
+
+Compiles (CPU) three programs at the headline config and prints their XLA
+cost-analysis flops/transcendentals:
+  A. full experiment at iters=50  (what bench.py reports as scan_body_once)
+  B. full experiment at iters=25  (confirm body counted once)
+  C. the single scan STEP alone   (the true per-round work, XLA's count)
+"""
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from coda_tpu.data import make_synthetic_task
+from coda_tpu.engine.loop import build_experiment_fn, make_step_fn
+from coda_tpu.oracle import true_losses
+from coda_tpu.selectors import CODAHyperparams, make_coda
+
+H, N, C = 1000, 50000, 10
+task = make_synthetic_task(seed=0, H=H, N=N, C=C)
+hp = CODAHyperparams()
+
+def cost(fn, *args):
+    c = fn.lower(*args).compile().cost_analysis()
+    if isinstance(c, (list, tuple)):
+        c = c[0] if c else {}
+    return float(c.get("flops", 0)), float(c.get("transcendentals", 0)), float(c.get("bytes accessed", 0))
+
+preds, labels = task.preds, task.labels
+tl = true_losses(preds, labels)
+
+def full(iters):
+    def run(preds, labels, key):
+        return build_experiment_fn(make_coda(preds, hp), labels, true_losses(preds, labels), iters=iters)(key)
+    return jax.jit(run)
+
+key = jax.random.PRNGKey(0)
+fA = cost(full(50), preds, labels, key)
+fB = cost(full(25), preds, labels, key)
+print("full iters=50:", fA)
+print("full iters=25:", fB)
+
+def step_only(preds, labels, key):
+    sel = make_coda(preds, hp)
+    step = make_step_fn(sel, labels, true_losses(preds, labels))
+    k_init, k_s = jax.random.split(key)
+    state0 = sel.init(k_init)
+    carry, out = step((state0, jnp.asarray(0.0, jnp.float32)), k_s)
+    return out
+
+# init-only program, so (init+step) - init = step body by XLA's own count
+def init_only(preds, labels, key):
+    sel = make_coda(preds, hp)
+    k_init, _ = jax.random.split(key)
+    return sel.init(k_init)
+
+fC = cost(jax.jit(step_only), preds, labels, key)
+fD = cost(jax.jit(init_only), preds, labels, key)
+print("init+1step:", fC)
+print("init only :", fD)
+print("XLA step body (diff):", tuple(a-b for a,b in zip(fC, fD)))
+
+from bench import _analytic_step_flops, _analytic_step_bytes
+print("analytic:", _analytic_step_flops(H, N, C), _analytic_step_bytes(H, N, C))

@@ -47,6 +47,13 @@ def test_analytic_flops_follows_resolver():
     assert m_big == "factored"
     assert f_big > f_fac
 
+    # pin both models to the documented kernel shapes: incremental pays the
+    # one-column pi-hat refresh (update_pi_hat_column), factored the full
+    # C^2 pass (update_pi_hat)
+    H, N, C, G = 1000, 50_000, 10, 256
+    assert f_inc == 6.0 * N * H * G + 2.0 * H * N * C + 10.0 * N * C * H
+    assert f_fac == 6.0 * N * C * H * G + 2.0 * H * C * C * N
+
 
 def test_reference_baseline_cache_roundtrip(tmp_path, monkeypatch):
     # pre-seed the cache with all three sizes: no measurement should run
