@@ -238,3 +238,87 @@ def test_incremental_cache_shards_over_data_axis():
     n_shard_bytes = state.pbest_hyp.addressable_shards[0].data.nbytes
     total = 4 * 64 * 4 * 8
     assert n_shard_bytes <= total // 4, (n_shard_bytes, total)
+
+
+def test_auto_resolver_large_c_shapes():
+    """The auto tier resolution at the REAL large-C shapes (pure function of
+    (hp, H, N, C) — no tensors exist here): the VERDICT-item-4 config
+    resolves factored once seed replicas share the chip, and the
+    C=1000 x H=2000+ HF zero-shot pool pushes past the table budget into
+    rowscan."""
+    from coda_tpu.selectors import CODAHyperparams
+    from coda_tpu.selectors.coda import resolve_eig_mode
+
+    # H=128, N=4096, C=1000: the (N, C, H) cache is 2.0 GiB -> up to two
+    # replicas fit incremental, four do not (and their 2.0 GiB of tables
+    # still fit -> factored)
+    assert resolve_eig_mode(CODAHyperparams(), 128, 4096, 1000) == "incremental"
+    assert resolve_eig_mode(
+        CODAHyperparams(n_parallel=4), 128, 4096, 1000) == "factored"
+    # ImageNet-scale reference config: 93 GiB cache is out, 1.9 GiB of
+    # tables fit -> factored; a second replica pushes into rowscan
+    assert resolve_eig_mode(
+        CODAHyperparams(), 500, 50_000, 1000) == "factored"
+    assert resolve_eig_mode(
+        CODAHyperparams(n_parallel=2), 500, 50_000, 1000) == "rowscan"
+    # the big HF pool blows the table budget outright -> rowscan
+    assert resolve_eig_mode(
+        CODAHyperparams(), 2048, 50_000, 1000) == "rowscan"
+
+
+@pytest.mark.parametrize("tier,budgets", [
+    # shrink the auto budgets so the SAME resolver logic routes this
+    # CPU-executable C=1000 config to each large-C tier end-to-end
+    ("factored", {"_INCR_CACHE_MAX_BYTES": 1 << 20}),
+    ("rowscan", {"_INCR_CACHE_MAX_BYTES": 1 << 20,
+                 "_TABLES_MAX_BYTES": 1 << 20}),
+])
+def test_large_c_sharded_execution_parity(tier, budgets, monkeypatch):
+    """VERDICT item 4: a C=1000-class experiment EXECUTES sharded
+    data=4,model=2 and matches the single-device trace, with the auto
+    resolver (not a pin) choosing the large-C tier.
+
+    The true large-C shapes are not CPU-executable (factored EIG at
+    H=128, N=4096, C=1000 is ~1e14 FLOPs/round), so the executed config is
+    C=1000 at CPU-feasible H/N with the auto BUDGETS shrunk until the
+    resolver makes the same choice it makes at scale (the shape-level
+    routing at the real sizes is pinned by test_auto_resolver_large_c_shapes
+    above, and the 100 GB AOT memory analysis covers the compiled artifact).
+    """
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors import coda as coda_mod
+
+    for name, val in budgets.items():
+        monkeypatch.setattr(coda_mod, name, val)
+
+    H, N, C = 16, 512, 1000
+    hp = CODAHyperparams(eig_chunk=128, num_points=32)
+    assert coda_mod.resolve_eig_mode(hp, H, N, C) == tier
+
+    # sharpness: at C=1000 the default 4.0 leaves ~3% softmax mass on the
+    # predicted class — predictions are near-uniform, every EIG score is
+    # fp32 noise (~1e-6) and argmax parity is meaningless. 12.0 gives
+    # confident models and real EIG signal (margins >> reduction noise).
+    task = make_synthetic_task(seed=13, H=H, N=N, C=C, sharpness=12.0)
+    mesh = mesh_from_spec("data=4,model=2")
+
+    idx1, best1, reg1 = _trace(lambda p, **kw: make_coda(p, hp), task,
+                               iters=4)
+    idx8, best8, reg8 = _trace(lambda p, **kw: make_coda(p, hp),
+                               _sharded_task(task, mesh), iters=4)
+
+    # chosen-point parity is at SET level: psum reduction noise can move a
+    # pair of near-tie scores across the isclose tie boundary, swapping the
+    # order of two picks (observed on the rowscan tier: steps 3/4 transpose
+    # points 12/302) — the framework's own semantics flag such picks
+    # stochastic. The labeled set must agree, and the per-step observables
+    # (best model, regret) must agree at every step where the two runs have
+    # seen the same evidence — after a transposed pick they legitimately
+    # differ for a step, then must reconverge once the sets realign.
+    np.testing.assert_array_equal(np.sort(idx1), np.sort(idx8))
+    same_evidence = np.array([set(idx1[:k + 1]) == set(idx8[:k + 1])
+                              for k in range(len(idx1))])
+    assert same_evidence[-1], "labeled sets never realigned"
+    np.testing.assert_array_equal(best1[same_evidence], best8[same_evidence])
+    np.testing.assert_allclose(reg1[same_evidence], reg8[same_evidence],
+                               rtol=1e-6, atol=1e-7)
