@@ -167,6 +167,11 @@ def assemble_pool(
     ``demo/app.py:137-172``) — index order in the npz IS the filename order.
     """
     H, N, C = len(json_paths), len(images), len(classes)
+    if labels is not None and len(labels) != N:
+        raise ValueError(
+            f"labels length {len(labels)} != {N} images — a mismatched "
+            "labels file would silently corrupt oracle accuracy downstream"
+        )
     preds = np.full((H, N, C), 1.0 / C, np.float32)
     names = [os.path.basename(p) for p in images]
     for h, jp in enumerate(json_paths):
@@ -225,9 +230,14 @@ def main(argv=None):
     p.add_argument("--out", required=True,
                    help="output task path (writes <out>.npz)")
     p.add_argument("--models", nargs="+", default=DEFAULT_MODELS)
+    p.add_argument("--labels", default=None,
+                   help="optional .npy of int labels in image (sorted-"
+                        "filename) order; stored in the npz so the task "
+                        "runs under the benchmark oracle")
     args = p.parse_args(argv)
+    labels = np.load(args.labels) if args.labels else None
     preds = build_pool(args.images_dir, args.classes, args.out,
-                       models=args.models)
+                       models=args.models, labels=labels)
     print(f"pool shape {preds.shape} -> {args.out}.npz")
 
 

@@ -1,0 +1,283 @@
+"""Train REAL (tiny) CLIP checkpoints offline and render the digit images.
+
+The reference's model pool is built by running pretrained HF zero-shot
+models over an image folder (reference ``demo/hf_zeroshot.py:170-219``).
+This environment has zero egress — no pretrained checkpoint is fetchable —
+so this script produces the same *kind* of artifact from first principles:
+
+  * renders sklearn's bundled NIST digits (real 8x8 scans) to PNG files,
+    split exactly like ``scripts/make_real_task.py`` (same
+    ``train_test_split(test_size=0.5, random_state=0, stratify)``), so the
+    eval images are the same 899 points the ``digits`` task scores;
+  * builds a genuine ``transformers.CLIPModel`` (2-layer ViT over 32x32
+    renders + 2-layer text transformer, BPE tokenizer trained on the
+    caption template) and trains it CONTRASTIVELY on the train-half
+    captions ``"This is a photo of <digit>."`` — the standard CLIP
+    objective, one image per class per batch so the in-batch negatives are
+    clean;
+  * saves each variant as a complete HF checkpoint directory
+    (config + safetensors + processor + tokenizer) that
+    ``transformers.pipeline("zero-shot-image-classification", model=dir)``
+    loads exactly like a hub checkpoint — which is how
+    ``demo/hf_zeroshot.py``'s ``_hf_pipeline_scorer`` then consumes it.
+
+The variants span a real accuracy range (well-trained / second seed /
+undertrained), giving the assembled pool genuine model-selection structure.
+
+Usage:
+  python scripts/train_tiny_clip.py                 # checkpoints + images
+  python demo/hf_zeroshot.py --images-dir demo/digit_images \
+      --classes 0 1 2 3 4 5 6 7 8 9 \
+      --models demo/models/tiny-clip-a demo/models/tiny-clip-b \
+               demo/models/tiny-clip-under --out data/digits_clip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+TEMPLATE = "This is a photo of {}."
+CLASSES = [str(d) for d in range(10)]
+
+
+def digit_split(seed: int = 0, test_frac: float = 0.5):
+    """The SAME split as scripts/make_real_task.py's digits task."""
+    import sklearn.datasets
+    from sklearn.model_selection import train_test_split
+
+    data = sklearn.datasets.load_digits()
+    idx = np.arange(len(data.target))
+    x_tr, x_ev, y_tr, y_ev, i_tr, i_ev = train_test_split(
+        data.data.astype(np.float32), data.target.astype(np.int32), idx,
+        test_size=test_frac, random_state=seed, stratify=data.target,
+    )
+    return (x_tr, y_tr, i_tr), (x_ev, y_ev, i_ev)
+
+
+def render_png(vec8x8: np.ndarray, path: str, upscale: int = 4) -> None:
+    """One 64-dim digits row (0..16 ints) -> a 32x32 grayscale PNG."""
+    from PIL import Image
+
+    img = (vec8x8.reshape(8, 8) / 16.0 * 255.0).astype(np.uint8)
+    Image.fromarray(img, mode="L").resize(
+        (8 * upscale, 8 * upscale), Image.NEAREST
+    ).save(path)
+
+
+def render_eval_images(out_dir: str) -> tuple[list[str], np.ndarray]:
+    """All eval-half digits as PNGs named by eval position (stable order:
+    ``list_images`` sorts lexicographically, so zero-padded names keep the
+    npz row order == filename order invariant the demo relies on)."""
+    (_, _, _), (x_ev, y_ev, _) = digit_split()
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for n, vec in enumerate(x_ev):
+        p = os.path.join(out_dir, f"digit_{n:04d}.png")
+        if not os.path.exists(p):
+            render_png(vec, p)
+        paths.append(p)
+    return paths, y_ev
+
+
+def build_tokenizer(save_dir: str):
+    """A real BPE tokenizer over the caption charset, CLIP-style specials."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from tokenizers.processors import TemplateProcessing
+    from transformers import PreTrainedTokenizerFast
+
+    corpus = [TEMPLATE.format(c) for c in CLASSES] + CLASSES
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=128, special_tokens=["<unk>"],
+    )
+    tok.train_from_iterator(corpus, trainer)
+    # bos/eos are appended AFTER training so eos gets the LARGEST vocab id:
+    # CLIPTextModel's legacy pooling branch (eos_token_id == 2 checkpoints)
+    # pools at input_ids.argmax(-1) — the original CLIP vocab kept eos as
+    # the max id — and the modern branch searches for eos_token_id; putting
+    # eos last satisfies both, otherwise the pooled feature reads a
+    # constant mid-sentence token and every caption embeds identically
+    # (loss freezes at ln C).
+    tok.add_special_tokens(["<|startoftext|>", "<|endoftext|>"])
+    bos = tok.token_to_id("<|startoftext|>")
+    eos = tok.token_to_id("<|endoftext|>")
+    assert eos == tok.get_vocab_size() - 1
+    tok.post_processor = TemplateProcessing(
+        single="<|startoftext|> $A <|endoftext|>",
+        special_tokens=[("<|startoftext|>", bos), ("<|endoftext|>", eos)],
+    )
+    # the generic fast-tokenizer wrapper: CLIPTokenizerFast rejects any
+    # backend that isn't byte-level-BPE-converted from the original
+    # checkpoint format, but the pipeline only needs AutoTokenizer to
+    # produce input_ids ending in eos (the position CLIP's text pooler
+    # reads) — which the TemplateProcessing above guarantees
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        bos_token="<|startoftext|>", eos_token="<|endoftext|>",
+        unk_token="<unk>", pad_token="<|endoftext|>",
+        model_max_length=16,
+        # CLIPModel.forward has no token_type_ids; the generic wrapper
+        # would emit them and break the pipeline call
+        model_input_names=["input_ids", "attention_mask"],
+    )
+    fast.save_pretrained(save_dir)
+    return fast
+
+
+def build_model(tokenizer, vision_layers: int, seed: int):
+    import torch
+    from transformers import CLIPConfig, CLIPModel
+
+    torch.manual_seed(seed)
+    vocab = len(tokenizer)  # INCLUDING post-train added specials (bos/eos)
+    cfg = CLIPConfig.from_text_vision_configs
+    from transformers import CLIPTextConfig, CLIPVisionConfig
+
+    text_cfg = CLIPTextConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=16,
+        bos_token_id=tokenizer.bos_token_id,
+        eos_token_id=tokenizer.eos_token_id,
+        pad_token_id=tokenizer.pad_token_id,
+    )
+    vision_cfg = CLIPVisionConfig(
+        image_size=32, patch_size=8, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=vision_layers, num_attention_heads=2,
+        num_channels=3,
+    )
+    config = cfg(text_cfg, vision_cfg, projection_dim=32)
+    return CLIPModel(config)
+
+
+def make_processor(save_dir: str):
+    from transformers import CLIPImageProcessor
+
+    proc = CLIPImageProcessor(
+        size={"shortest_edge": 32}, crop_size={"height": 32, "width": 32},
+        do_resize=True, do_center_crop=True, do_normalize=True,
+        image_mean=[0.5, 0.5, 0.5], image_std=[0.5, 0.5, 0.5],
+    )
+    proc.save_pretrained(save_dir)
+    return proc
+
+
+def train_variant(
+    name: str,
+    out_root: str,
+    steps: int,
+    vision_layers: int,
+    seed: int,
+    lr: float = 1e-3,  # 3e-3 collapses this scale to the uniform optimum
+) -> dict:
+    """Contrastive training of one checkpoint; returns eval metadata."""
+    import torch
+    from PIL import Image
+
+    save_dir = os.path.join(out_root, name)
+    if os.path.exists(os.path.join(save_dir, "model.safetensors")):
+        print(f"[train] {name}: exists, skipping")
+        with open(os.path.join(save_dir, "train_meta.json")) as f:
+            return json.load(f)
+
+    (x_tr, y_tr, _), (x_ev, y_ev, _) = digit_split()
+    tokenizer = build_tokenizer(save_dir)
+    processor = make_processor(save_dir)
+    model = build_model(tokenizer, vision_layers, seed)
+
+    # precompute pixel_values once (PIL path == exactly what the pipeline
+    # does at inference: 8x8 -> 32x32 nearest, L->RGB, normalize)
+    def to_pixels(rows: np.ndarray) -> "torch.Tensor":
+        imgs = []
+        for vec in rows:
+            a = (vec.reshape(8, 8) / 16.0 * 255.0).astype(np.uint8)
+            imgs.append(
+                Image.fromarray(a, "L").resize((32, 32), Image.NEAREST)
+                .convert("RGB")
+            )
+        return processor(images=imgs, return_tensors="pt")["pixel_values"]
+
+    pix_tr = to_pixels(x_tr)
+    captions = [TEMPLATE.format(c) for c in CLASSES]
+    text = tokenizer(captions, padding=True, return_tensors="pt")
+
+    rng = np.random.default_rng(seed)
+    by_class = [np.flatnonzero(y_tr == c) for c in range(10)]
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+    model.train()
+    for step in range(steps):
+        # one random image per class: 10 clean in-batch negatives
+        batch_idx = np.array([rng.choice(ix) for ix in by_class])
+        out = model(
+            input_ids=text["input_ids"],
+            attention_mask=text["attention_mask"],
+            pixel_values=pix_tr[batch_idx],
+            return_loss=True,
+        )
+        opt.zero_grad()
+        out.loss.backward()
+        opt.step()
+        if step % 200 == 0:
+            print(f"[train] {name} step {step}: loss {out.loss.item():.4f}")
+
+    # zero-shot eval on the eval half (the same math the pipeline runs)
+    model.eval()
+    with torch.no_grad():
+        tfeat = model.get_text_features(
+            input_ids=text["input_ids"],
+            attention_mask=text["attention_mask"],
+        )
+        tfeat = tfeat / tfeat.norm(dim=-1, keepdim=True)
+        correct = 0
+        for lo in range(0, len(x_ev), 256):
+            ifeat = model.get_image_features(
+                pixel_values=to_pixels(x_ev[lo:lo + 256]))
+            ifeat = ifeat / ifeat.norm(dim=-1, keepdim=True)
+            pred = (ifeat @ tfeat.T).argmax(-1).numpy()
+            correct += int((pred == y_ev[lo:lo + 256]).sum())
+    acc = correct / len(y_ev)
+
+    model.save_pretrained(save_dir)
+    meta = {"name": name, "steps": steps, "vision_layers": vision_layers,
+            "seed": seed, "zero_shot_eval_acc": acc}
+    with open(os.path.join(save_dir, "train_meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    print(f"[train] {name}: zero-shot eval acc {acc:.4f} -> {save_dir}")
+    return meta
+
+
+VARIANTS = [
+    # (name, steps, vision_layers, seed): a real accuracy spread
+    ("tiny-clip-a", 4000, 2, 0),
+    ("tiny-clip-b", 4000, 3, 1),
+    ("tiny-clip-under", 250, 2, 2),
+]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out-root", default=os.path.join(REPO, "demo", "models"))
+    ap.add_argument("--images-dir",
+                    default=os.path.join(REPO, "demo", "digit_images"))
+    args = ap.parse_args(argv)
+
+    paths, y_ev = render_eval_images(args.images_dir)
+    print(f"[images] {len(paths)} eval PNGs in {args.images_dir}")
+    np.save(os.path.join(args.images_dir, "labels.npy"), y_ev)
+
+    metas = [train_variant(n, args.out_root, s, vl, sd)
+             for n, s, vl, sd in VARIANTS]
+    print(json.dumps(metas, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -285,3 +285,65 @@ def test_build_pool_unavailable_backend_is_gated(image_dir, tmp_path):
                        models=["gone/model"])
     finally:
         hf_zeroshot.make_scorer = orig
+
+
+# ---------------------------------------------------------------------------
+# the REAL transformers path, using the committed locally-trained checkpoint
+# ---------------------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TINY_CLIP = os.path.join(REPO, "demo", "models", "tiny-clip-a")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(_TINY_CLIP, "model.safetensors"))
+    or not os.path.exists(
+        os.path.join(REPO, "demo", "digit_images", "labels.npy")),
+    reason="committed tiny-clip checkpoint or digit images not present",
+)
+def test_hf_pipeline_scorer_real_checkpoint():
+    """`make_scorer` -> `_hf_pipeline_scorer` -> transformers pipeline on the
+    COMMITTED locally-trained CLIP checkpoint (scripts/train_tiny_clip.py):
+    the exact code path the reference runs against hub checkpoints
+    (reference ``demo/hf_zeroshot.py:170-219``), with no injected fakes. The
+    committed pool data/digits_clip.npz was produced by this same path."""
+    pytest.importorskip("transformers")
+    from demo.hf_zeroshot import make_scorer
+
+    img_dir = os.path.join(REPO, "demo", "digit_images")
+    imgs = sorted(f for f in os.listdir(img_dir) if f.endswith(".png"))[:4]
+    labels = np.load(os.path.join(img_dir, "labels.npy"))
+
+    scorer = make_scorer(_TINY_CLIP)
+    classes = [str(d) for d in range(10)]
+    hits = 0
+    for name in imgs:
+        scores = scorer(os.path.join(img_dir, name), classes)
+        assert len(scores) == 10
+        assert abs(sum(scores) - 1.0) < 1e-6
+        n = int(name[len("digit_"):-len(".png")])
+        hits += int(int(np.argmax(scores)) == int(labels[n]))
+    # tiny-clip-a is 90.5% accurate on this split; 4 images are a smoke
+    # check, not a statistical claim — require it beats guessing overall
+    assert hits >= 2
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REPO, "data", "digits_clip.npz")),
+    reason="committed CLIP pool not present",
+)
+def test_committed_clip_pool_loads_as_dataset():
+    """The committed real-model pool is a first-class task: loads through
+    Dataset.from_file with labels, filenames and class names intact."""
+    from coda_tpu.data import Dataset
+
+    ds = Dataset.from_file(os.path.join(REPO, "data", "digits_clip.npz"))
+    H, N, C = ds.preds.shape
+    assert (H, N, C) == (3, 899, 10)
+    assert ds.labels is not None and ds.labels.shape == (N,)
+    assert ds.class_names == [str(d) for d in range(10)]
+    assert ds.filenames[0] == "digit_0000.png"
+    accs = (np.asarray(ds.preds).argmax(-1) ==
+            np.asarray(ds.labels)[None]).mean(-1)
+    # the three committed checkpoints' zero-shot accuracies (train_meta.json)
+    np.testing.assert_allclose(accs, [0.9055, 0.8687, 0.4983], atol=2e-3)
