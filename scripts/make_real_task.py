@@ -12,7 +12,10 @@ like any reference task tensor.
 
 Datasets: ``digits`` (1797 8x8 scans, C=10), ``breast_cancer`` (569 points,
 C=2 — the binary case that exercises the Beta/diag-prior edge on real
-data), ``wine`` (178 points, C=3).
+data), ``wine`` (178 points, C=3), ``iris`` (150 points, C=3; build with
+``--test-frac 0.7`` so the 100-round budget fits), and ``digits_shift``
+(models train on CLEAN scans, the eval half is rotated + noise-corrupted —
+the reference benchmark's train-domain != eval-domain structure).
 
 Usage: python scripts/make_real_task.py [--dataset digits] [--out data/digits.npz]
 """
@@ -69,21 +72,56 @@ DATASETS = {
     "digits": ("load_digits", 16.0),
     "breast_cancer": ("load_breast_cancer", None),  # None -> standardize
     "wine": ("load_wine", None),
+    "iris": ("load_iris", None),
+    # distribution shift: models train on CLEAN scans, the eval half is
+    # corrupted (rotation + pixel noise) — the structure of the reference's
+    # DomainNet/WILDS families (train domain != eval domain), where model
+    # ranking under shift is the thing the selector must discover
+    "digits_shift": ("load_digits", 16.0),
 }
+
+
+def stratified_split(x: np.ndarray, y: np.ndarray, test_frac: float = 0.5,
+                     seed: int = 0):
+    """THE train/eval split, shared with scripts/train_tiny_clip.py so the
+    `digits` task tensors and the rendered digit images can never
+    desynchronize. Returns (x_tr, x_ev, y_tr, y_ev, i_tr, i_ev)."""
+    from sklearn.model_selection import train_test_split
+
+    idx = np.arange(len(y))
+    return train_test_split(
+        x, y.astype(np.int32), idx,
+        test_size=test_frac, random_state=seed, stratify=y,
+    )
+
+
+def shift_digits(x_ev: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Rotate each real 8x8 scan by a random +/-25..40 degrees and add
+    pixel noise — a reproducible domain shift on real data."""
+    from scipy.ndimage import rotate
+
+    rng = np.random.default_rng(seed + 17)
+    out = np.empty_like(x_ev)
+    for i, vec in enumerate(x_ev):
+        ang = rng.uniform(25.0, 40.0) * rng.choice([-1.0, 1.0])
+        img = rotate(vec.reshape(8, 8), ang, reshape=False, order=1,
+                     mode="constant", cval=0.0)
+        img = img + rng.normal(0.0, 1.5, size=img.shape)
+        out[i] = np.clip(img, 0.0, 16.0).reshape(-1)
+    return out.astype(np.float32)
 
 
 def build(out: str, test_frac: float = 0.5, seed: int = 0,
           dataset: str = "digits") -> dict:
     import sklearn.datasets
-    from sklearn.model_selection import train_test_split
 
     loader, scale = DATASETS[dataset]
     data = getattr(sklearn.datasets, loader)()
     x = data.data.astype(np.float32)
-    x_tr, x_ev, y_tr, y_ev = train_test_split(
-        x, data.target.astype(np.int32),
-        test_size=test_frac, random_state=seed, stratify=data.target,
-    )
+    x_tr, x_ev, y_tr, y_ev, _, _ = stratified_split(
+        x, data.target, test_frac, seed)
+    if dataset == "digits_shift":  # corrupt the eval half BEFORE scaling
+        x_ev = shift_digits(x_ev, seed)
     if scale:  # digits pixels are 0..16 (fixed scale)
         x_tr, x_ev = x_tr / scale, x_ev / scale
     else:  # tabular sets standardize with TRAIN statistics only (no

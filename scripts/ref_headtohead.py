@@ -1,0 +1,55 @@
+"""Head-to-head: PyTorch reference CODA vs ours on iris / digits_shift."""
+import sys, numpy as np
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/reference")
+import jax; jax.config.update("jax_platforms", "cpu")
+
+task_name = sys.argv[1]
+rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 60
+
+import torch
+from coda.coda import CODA as RefCODA
+from coda.oracle import Oracle as RefOracle
+import coda.options as ref_options
+
+z = np.load(f"/root/repo/data/{task_name}.npz")
+preds_np, labels_np = z["preds"], z["labels"]
+
+class DS:
+    def __init__(s):
+        s.preds = torch.from_numpy(preds_np.copy())
+        s.labels = torch.from_numpy(labels_np.astype(np.int64).copy())
+        s.device = torch.device("cpu")
+ds = DS()
+
+import argparse
+ref_args = argparse.Namespace(alpha=0.9, learning_rate=0.01, multiplier=2.0,
+                              prefilter_n=0, no_diag_prior=False, q="eig")
+sel = RefCODA.from_args(ds, ref_args)
+oracle = RefOracle(ds, ref_options.LOSS_FNS["acc"])
+tl = oracle.true_losses(ds.preds)
+best_loss = tl.min().item()
+
+np.random.seed(0); torch.manual_seed(0)
+import random; random.seed(0)
+ref_regret, ref_idx = [], []
+for m in range(rounds):
+    idx, prob = sel.get_next_item_to_label()
+    tc = oracle(idx)
+    sel.add_label(idx, tc, prob)
+    best = sel.get_best_model_prediction()
+    ref_regret.append(tl[best].item() - best_loss)
+    ref_idx.append(int(idx))
+print(f"ref  {task_name}: cum regret x100 @ {rounds} = {100*sum(ref_regret):.1f}")
+
+# ours
+from coda_tpu.data import Dataset
+from coda_tpu.engine import run_experiment
+from coda_tpu.selectors import make_coda, CODAHyperparams
+dsj = Dataset.from_file(f"/root/repo/data/{task_name}.npz")
+res = run_experiment(make_coda(dsj.preds, CODAHyperparams()), dsj, iters=rounds, seed=0)
+ours_cum = float(np.asarray(res.cumulative_regret)[-1])
+print(f"ours {task_name}: cum regret x100 @ {rounds} = {100*ours_cum:.1f}")
+oi = np.asarray(res.chosen_idx)
+same = int((oi[:len(ref_idx)] == np.array(ref_idx)).sum())
+print(f"selection agreement: {same}/{rounds}")

@@ -49,16 +49,16 @@ CLASSES = [str(d) for d in range(10)]
 
 
 def digit_split(seed: int = 0, test_frac: float = 0.5):
-    """The SAME split as scripts/make_real_task.py's digits task."""
+    """The digits task's split, via the ONE shared helper
+    (scripts/make_real_task.py::stratified_split) so the pool tensors and
+    the rendered images can never desynchronize."""
     import sklearn.datasets
-    from sklearn.model_selection import train_test_split
+
+    from scripts.make_real_task import stratified_split
 
     data = sklearn.datasets.load_digits()
-    idx = np.arange(len(data.target))
-    x_tr, x_ev, y_tr, y_ev, i_tr, i_ev = train_test_split(
-        data.data.astype(np.float32), data.target.astype(np.int32), idx,
-        test_size=test_frac, random_state=seed, stratify=data.target,
-    )
+    x_tr, x_ev, y_tr, y_ev, i_tr, i_ev = stratified_split(
+        data.data.astype(np.float32), data.target, test_frac, seed)
     return (x_tr, y_tr, i_tr), (x_ev, y_ev, i_ev)
 
 
@@ -79,10 +79,12 @@ def render_eval_images(out_dir: str) -> tuple[list[str], np.ndarray]:
     (_, _, _), (x_ev, y_ev, _) = digit_split()
     os.makedirs(out_dir, exist_ok=True)
     paths = []
+    # render UNCONDITIONALLY: a skip-if-exists here would pair stale pixels
+    # with a freshly rewritten labels.npy after any split change — the
+    # silent image/label desync the pool's length guard cannot catch
     for n, vec in enumerate(x_ev):
         p = os.path.join(out_dir, f"digit_{n:04d}.png")
-        if not os.path.exists(p):
-            render_png(vec, p)
+        render_png(vec, p)
         paths.append(p)
     return paths, y_ev
 
@@ -184,7 +186,10 @@ def train_variant(
     from PIL import Image
 
     save_dir = os.path.join(out_root, name)
-    if os.path.exists(os.path.join(save_dir, "model.safetensors")):
+    # gate the resume on train_meta.json — it is written LAST, so a run
+    # interrupted after save_pretrained but before the meta write retrains
+    # instead of crashing on the missing meta
+    if os.path.exists(os.path.join(save_dir, "train_meta.json")):
         print(f"[train] {name}: exists, skipping")
         with open(os.path.join(save_dir, "train_meta.json")) as f:
             return json.load(f)
