@@ -56,10 +56,11 @@ _PEAK_FLOPS = {
 }
 
 # published HBM bandwidth per chip (bytes/s). The incremental EIG is
-# bandwidth-bound: its per-round FLOP/byte ratio is ~21 at the headline
-# config (9.2e10 FLOPs / 4.4e9 bytes), far below the ~240 FLOP/byte
-# machine balance of a v5e — so MBU against this peak, not MFU against
-# the matmul peak, is the roofline that describes it.
+# bandwidth-bound: its per-round FLOP/byte ratio is ~32 at the headline
+# config (8.3e10 FLOPs / 2.6e9 bytes with the delta pi-hat path), still
+# far below the ~240 FLOP/byte machine balance of a v5e — so MBU against
+# this peak, not MFU against the matmul peak, is the roofline that
+# describes it.
 _PEAK_HBM_BPS = {
     "TPU v4": 1228e9,
     "TPU v5 lite": 819e9,
@@ -179,8 +180,8 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
     Incremental EIG:
       * cache row refresh: three (N,H)x(H,G)-shaped einsums  -> 6·N·H·G
         (``update_eig_cache`` touches ONE class row per round)
-      * pi-hat column refresh: einsum hs,hns->n              -> 2·H·N·C
-        (``update_pi_hat_column`` — one column, NOT the full C² pass)
+      * pi-hat delta refresh: gather + sum over models       -> 2·H·N
+        (``update_pi_hat_column_delta``, the pi_update='delta' default)
       * cache scoring (elementwise mixture entropies)        -> ~10·N·C·H
     Factored / rowscan EIG: the three einsums span all C class rows
     (identical FLOPs, different temps)                       -> 6·N·C·H·G
@@ -192,24 +193,33 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
     mode = resolve_eig_mode(
         CODAHyperparams(eig_mode=mode, num_points=G), H, N, C)
     if mode == "incremental":
-        return 6.0 * N * H * G + 2.0 * H * N * C + 10.0 * N * C * H, mode
+        return 6.0 * N * H * G + 2.0 * H * N + 10.0 * N * C * H, mode
     return 6.0 * N * C * H * G + 2.0 * H * C * C * N, mode
 
 
-def _analytic_step_bytes(H: int, N: int, C: int) -> float:
+def _analytic_step_bytes(H: int, N: int, C: int, mode: str) -> float:
     """Analytic HBM traffic per round (bytes), for the bandwidth roofline.
 
+    ``mode`` must be the ALREADY-RESOLVED tier (take it from
+    :func:`_analytic_step_flops`'s return, so the FLOP and byte models can
+    never describe different kernels).
+
     Incremental EIG per round: the scoring pass streams the (N, C, H) fp32
-    cache once; the pi-hat column refresh streams the (H, N, C) preds once;
+    cache once; the pi-hat DELTA refresh (pi_update='delta', the default)
+    gathers H contiguous N-rows from the loop-constant (C, H, N) layout —
+    4·H·N bytes, the C-fold cut that replaced streaming the full tensor;
     the cache row refresh reads the (N, H) int32 hard preds and writes the
-    (N, H) fp32 row. The factored/rowscan tiers stream the same-shaped
-    (N, C, H) hypothetical tensor as intermediates instead of reading a
-    cache, so the same expression is the right order for every tier.
+    (N, H) fp32 row. The factored/rowscan tiers recompute from the full
+    (H, N, C) tensor and stream the same-shaped hypothetical intermediates.
     """
-    cache_or_hyp = 4.0 * N * C * H
-    preds = 4.0 * H * N * C
     row = 8.0 * N * H
-    return cache_or_hyp + preds + row
+    if mode == "incremental":
+        cache = 4.0 * N * C * H
+        pi_gather = 4.0 * H * N
+        return cache + pi_gather + row
+    hyp = 4.0 * N * C * H
+    preds = 4.0 * H * N * C
+    return hyp + preds + row
 
 
 def _mad(xs: list[float]) -> float:
@@ -275,7 +285,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     dev = jax.devices()[0]
     peak = _PEAK_FLOPS.get(dev.device_kind)
     peak_bw = _PEAK_HBM_BPS.get(dev.device_kind)
-    bytes_per_step = _analytic_step_bytes(H, N, C)
+    bytes_per_step = _analytic_step_bytes(H, N, C, mode=mode)
     achieved = (flops_per_step / marginal_step_s
                 if linear_ok and marginal_step_s > 0 else 0.0)
     achieved_bps = (bytes_per_step / marginal_step_s

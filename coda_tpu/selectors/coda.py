@@ -83,6 +83,27 @@ class CODAHyperparams(NamedTuple):
     #                               1-pass bf16. Anything below highest can
     #                               reorder near-tie EIG argmaxes on TPU —
     #                               opt-in speed, not reference semantics.
+    pi_update: str = "delta"      # delta | exact — incremental-mode pi-hat
+    #                               column refresh. "delta" adds the exact
+    #                               linear increment lr*preds[h,n,s_h] via a
+    #                               contiguous gather from a once-transposed
+    #                               (C, H, N) layout: O(H*N) bytes/round
+    #                               instead of streaming the full (H, N, C)
+    #                               tensor (C-fold traffic cut; the pi-hat
+    #                               stream was HALF the round's HBM
+    #                               traffic). Identical math — what differs
+    #                               is float ACCUMULATION ORDER
+    #                               (~1e-7/round), the same class of
+    #                               deviation sharded psum reduction order
+    #                               introduces by design; the full
+    #                               reference-length trace is pinned equal
+    #                               to "exact" in
+    #                               test_pi_delta_matches_exact_recompute.
+    #                               "exact" recomputes the column einsum
+    #                               each round (strict reference float
+    #                               choreography; also halves the
+    #                               incremental tier's HBM footprint —
+    #                               see resolve_eig_mode's budget).
 
 
 # "auto" picks the incremental EIG only while its (N, C, H) fp32 cache fits
@@ -109,6 +130,11 @@ def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
     """
     full_pool_eig = (hp.q == "eig"
                      and not (hp.prefilter_n and hp.prefilter_n < N))
+    # the delta pi-hat path keeps a second preds-sized tensor (the (C, H, N)
+    # transposed layout) resident next to the (N, C, H) cache, so its
+    # incremental footprint is ~2x — the auto budget must charge for it or
+    # "fits comfortably on one chip" silently becomes an OOM
+    incr_copies = 2 if hp.pi_update == "delta" else 1
     if hp.eig_mode != "auto":
         if hp.eig_mode == "incremental" and not full_pool_eig:
             raise ValueError(
@@ -119,7 +145,8 @@ def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
             )
         return hp.eig_mode
     par = max(1, hp.n_parallel)
-    if full_pool_eig and par * 4 * N * C * H <= _INCR_CACHE_MAX_BYTES:
+    if (full_pool_eig
+            and par * incr_copies * 4 * N * C * H <= _INCR_CACHE_MAX_BYTES):
         return "incremental"
     if par * 16 * C * H * hp.num_points <= _TABLES_MAX_BYTES:
         return "factored"
@@ -190,6 +217,35 @@ def update_pi_hat_column(
     d_t = jnp.take(dirichlets, true_class, axis=1)     # (H, C)
     col = jnp.einsum("hs,hns->n", d_t, preds, precision=_PRECISION)  # (N,)
     unnorm = pi_xi_unnorm.at[:, true_class].set(col)
+    pi_xi, pi = _normalize_pi(unnorm)
+    return pi_xi, pi, unnorm
+
+
+def update_pi_hat_column_delta(
+    true_class: jnp.ndarray,    # scalar int
+    pred_classes: jnp.ndarray,  # (H,) int32 — each model's hard pred at idx
+    preds_by_class: jnp.ndarray,  # (C, H, N) — preds transposed once
+    pi_xi_unnorm: jnp.ndarray,  # (N, C) unnormalized cache
+    update_strength: float,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Exact linear increment of the pi-hat column (the bandwidth-lean path).
+
+    The labeling round adds ``lr * 1[s == s_h]`` to Dirichlet row
+    ``true_class`` of every model h (``s_h`` = model h's hard prediction at
+    the labeled point), and ``unnorm[n, c] = Σ_{h,s} d[h,c,s]·preds[h,n,s]``
+    is linear in d — so the column moves by exactly
+    ``lr · Σ_h preds[h, n, s_h]``. Gathering that from the (C, H, N)
+    transposed layout reads H contiguous N-rows (O(H·N) bytes) instead of
+    re-streaming the full (H, N, C) tensor the way the column einsum does
+    (:func:`update_pi_hat_column`). Identical math; only float accumulation
+    order differs (drift ~1e-7/round, pinned by
+    ``test_pi_delta_matches_exact_recompute``).
+    """
+    sel = jnp.take_along_axis(
+        preds_by_class, pred_classes[None, :, None], axis=0
+    )[0]                                              # (H, N)
+    delta = update_strength * sel.sum(0)              # (N,)
+    unnorm = pi_xi_unnorm.at[:, true_class].add(delta)
     pi_xi, pi = _normalize_pi(unnorm)
     return pi_xi, pi, unnorm
 
@@ -602,6 +658,9 @@ def make_coda(
     prior_strength = 1.0 - hp.alpha
     update_strength = hp.learning_rate
 
+    if hp.pi_update not in ("delta", "exact"):
+        raise ValueError(f"unknown pi_update {hp.pi_update!r} "
+                         "(use 'delta' or 'exact')")
     # statics (functions of preds only)
     hard_preds = preds.argmax(-1).T.astype(jnp.int32)     # (N, H)
     disagree = _disagreement_mask(hard_preds, C)          # (N,)
@@ -626,6 +685,11 @@ def make_coda(
     # the direct kernel takes no precision parameter (see guard above)
     eig_kwargs = {} if eig_mode == "direct" else {"precision": eig_precision}
     incremental = eig_mode == "incremental"
+    # (C, H, N) layout for the delta pi-hat gather, built OUTSIDE the scan
+    # step so it is a loop constant (materialized once per experiment), not
+    # re-transposed every round; only the incremental tier reads it
+    preds_by_class = (jnp.transpose(preds, (2, 0, 1))
+                      if incremental and hp.pi_update == "delta" else None)
     if hp.eig_backend not in ("jnp", "pallas"):
         raise ValueError(f"unknown eig_backend {hp.eig_backend!r} "
                          "(use 'jnp' or 'pallas')")
@@ -801,9 +865,15 @@ def make_coda(
             update_strength * onehot
         )
         if incremental:
-            pi_xi, pi, unnorm = update_pi_hat_column(
-                dirichlets, true_class, preds, state.pi_xi_unnorm
-            )
+            if hp.pi_update == "delta":
+                pi_xi, pi, unnorm = update_pi_hat_column_delta(
+                    true_class, hard_preds[idx], preds_by_class,
+                    state.pi_xi_unnorm, update_strength,
+                )
+            else:
+                pi_xi, pi, unnorm = update_pi_hat_column(
+                    dirichlets, true_class, preds, state.pi_xi_unnorm
+                )
             rows, hyp = update_eig_cache(dirichlets, true_class, hard_preds,
                                          state.pbest_rows, state.pbest_hyp,
                                          num_points=hp.num_points,

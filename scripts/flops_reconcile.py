@@ -62,4 +62,5 @@ print("init only :", fD)
 print("XLA step body (diff):", tuple(a-b for a,b in zip(fC, fD)))
 
 from bench import _analytic_step_flops, _analytic_step_bytes
-print("analytic:", _analytic_step_flops(H, N, C), _analytic_step_bytes(H, N, C))
+flops, mode = _analytic_step_flops(H, N, C)
+print("analytic:", (flops, mode), _analytic_step_bytes(H, N, C, mode=mode))

@@ -51,7 +51,7 @@ def test_analytic_flops_follows_resolver():
     # one-column pi-hat refresh (update_pi_hat_column), factored the full
     # C^2 pass (update_pi_hat)
     H, N, C, G = 1000, 50_000, 10, 256
-    assert f_inc == 6.0 * N * H * G + 2.0 * H * N * C + 10.0 * N * C * H
+    assert f_inc == 6.0 * N * H * G + 2.0 * H * N + 10.0 * N * C * H
     assert f_fac == 6.0 * N * C * H * G + 2.0 * H * C * C * N
 
 
@@ -85,19 +85,23 @@ def test_reference_baseline_skip_without_cache(tmp_path, monkeypatch):
 
 def test_analytic_step_bytes_matches_documented_traffic():
     """The bytes model feeds the reported MBU; pin it to the documented
-    per-round traffic (cache/hyp stream + preds stream + row write+read)."""
+    per-round traffic per tier: incremental = cache stream + delta pi-hat
+    gather + row write+read (the pi_update='delta' path), factored = hyp
+    stream + full preds stream + row."""
     from bench import _analytic_step_bytes
 
     H, N, C = 1000, 50_000, 10
-    expected = 4.0 * N * C * H + 4.0 * H * N * C + 8.0 * N * H
-    assert _analytic_step_bytes(H, N, C) == expected
+    expected = 4.0 * N * C * H + 4.0 * H * N + 8.0 * N * H
+    assert _analytic_step_bytes(H, N, C, mode="incremental") == expected
+    expected_fac = 4.0 * N * C * H + 4.0 * H * N * C + 8.0 * N * H
+    assert _analytic_step_bytes(H, N, C, mode="factored") == expected_fac
     # arithmetic intensity stays far below a v5e's ~240 FLOP/byte balance:
     # the kernel is bandwidth-bound and MBU is the honest roofline
     from bench import _analytic_step_flops
 
     flops, mode = _analytic_step_flops(H, N, C)
     assert mode == "incremental"
-    assert flops / _analytic_step_bytes(H, N, C) < 60
+    assert flops / _analytic_step_bytes(H, N, C, mode=mode) < 60
 
 
 def test_mbu_reported_against_known_chip():

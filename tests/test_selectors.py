@@ -568,12 +568,62 @@ def test_auto_eig_mode_accounts_for_vmapped_replicas():
     )
 
     H, C = 1000, 10
-    # one cache just under the budget
-    N = _INCR_CACHE_MAX_BYTES // (4 * C * H) - 1
+    # the delta pi-hat default carries TWO preds-sized tensors (cache +
+    # transposed layout), so one replica is budgeted at 2 copies; size the
+    # cache just under budget/2
+    N = _INCR_CACHE_MAX_BYTES // (2 * 4 * C * H) - 1
     assert resolve_eig_mode(CODAHyperparams(), H, N, C) == "incremental"
     assert resolve_eig_mode(
         CODAHyperparams(n_parallel=5), H, N, C) == "factored"
+    # pi_update='exact' keeps only the cache resident: twice the N fits
+    N2 = _INCR_CACHE_MAX_BYTES // (4 * C * H) - 1
+    assert resolve_eig_mode(
+        CODAHyperparams(pi_update="exact"), H, N2, C) == "incremental"
+    assert resolve_eig_mode(CODAHyperparams(), H, N2, C) == "factored"
     # explicit mode is never overridden by the budget
     assert resolve_eig_mode(
         CODAHyperparams(n_parallel=5, eig_mode="incremental"), H, N, C
     ) == "incremental"
+
+
+def test_pi_delta_matches_exact_recompute(task):
+    """The bandwidth-lean delta pi-hat path (pi_update='delta', the
+    incremental default) must track the exact column recompute over a LONG
+    run: same selection/best trace on this (non-degenerate) task, and the
+    accumulated unnormalized cache must stay within float-drift tolerance
+    of a from-scratch recompute after every round."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.engine import run_experiment
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.coda import pi_unnorm
+
+    from coda_tpu.data import make_synthetic_task
+
+    # a dedicated task big enough for the FULL reference experiment length
+    task = make_synthetic_task(seed=3, H=8, N=200, C=4)
+    iters = 100
+    res = {}
+    for mode in ("delta", "exact"):
+        sel = make_coda(task.preds, CODAHyperparams(
+            eig_mode="incremental", eig_chunk=1000, pi_update=mode))
+        res[mode] = run_experiment(sel, task, iters=iters, seed=0)
+    np.testing.assert_array_equal(np.asarray(res["delta"].chosen_idx),
+                                  np.asarray(res["exact"].chosen_idx))
+    np.testing.assert_array_equal(np.asarray(res["delta"].best_model),
+                                  np.asarray(res["exact"].best_model))
+
+    # drift bound after 100 accumulated deltas: replay the delta run's state
+    # and compare its unnorm cache to a from-scratch contraction
+    sel = make_coda(task.preds, CODAHyperparams(
+        eig_mode="incremental", eig_chunk=1000, pi_update="delta"))
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    update = jax.jit(sel.update)
+    labels = np.asarray(task.labels)
+    for idx in np.asarray(res["delta"].chosen_idx):
+        state = update(state, jnp.asarray(int(idx)),
+                       jnp.asarray(int(labels[idx])), jnp.asarray(0.0))
+    fresh = pi_unnorm(state.dirichlets, task.preds)
+    np.testing.assert_allclose(np.asarray(state.pi_xi_unnorm),
+                               np.asarray(fresh), rtol=2e-5, atol=1e-6)
