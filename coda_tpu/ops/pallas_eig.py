@@ -50,13 +50,28 @@ def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
 _VMEM_TILE_BYTES = 8 << 20  # target VMEM footprint of one (B, C, H) tile
 
 
+def _padded_row_bytes(C: int, H: int) -> int:
+    """Physical VMEM bytes of ONE N-row of the (B, C, H) fp32 tile.
+
+    Mosaic lays vector memory out in (8, 128) fp32 tiles over the two minor
+    dims, so a (C, H) slice occupies ceil(C/8)*8 x ceil(H/128)*128 elements
+    regardless of the logical shape — at the headline (C=10, H=1000) that
+    is 16 x 1024 = 1.6x the logical bytes. Budgeting with logical sizes
+    would overshoot VMEM by exactly that factor on the first hardware run.
+    """
+    Cp = -(-C // 8) * 8
+    Hp = -(-H // 128) * 128
+    return 4 * Cp * Hp
+
+
 def choose_block(N: int, C: int, H: int, block: int = 0) -> int:
     """The N-tile size: sublane-aligned (x8) under the VMEM budget, or all
     of N when it fits — the two shapes Mosaic accepts for the (B, C) /
-    (B, 1) blocks without host-padding the cache. The x8 hardware minimum
-    wins over a smaller caller ``block`` cap (a cap below 8 cannot lower
-    the tile's VMEM footprint further)."""
-    vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, 4 * C * H))
+    (B, 1) blocks without host-padding the cache. The budget is computed
+    against the PADDED physical tile (see :func:`_padded_row_bytes`). The
+    x8 hardware minimum wins over a smaller caller ``block`` cap (a cap
+    below 8 cannot lower the tile's VMEM footprint further)."""
+    vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, _padded_row_bytes(C, H)))
     cap = min(block, vmem_cap) if block else vmem_cap
     if N <= max(cap, 8):
         return N

@@ -130,3 +130,22 @@ def test_cli_rejects_pallas_with_mesh(tmp_path):
                        "--eig-backend", "pallas", "--mesh", "data=2"])
     with pytest.raises(SystemExit, match="single-device"):
         build_selector_factory(args, "synthetic")
+
+
+def test_choose_block_budgets_padded_vmem():
+    """The VMEM budget must use the PHYSICAL (8, 128)-tiled footprint: at
+    the headline (C=10, H=1000) the padded row is 16*1024*4 B = 1.6x the
+    logical 10*1000*4 B, so the N-tile must be correspondingly smaller."""
+    from coda_tpu.ops.pallas_eig import (
+        _VMEM_TILE_BYTES,
+        _padded_row_bytes,
+        choose_block,
+    )
+
+    C, H = 10, 1000
+    assert _padded_row_bytes(C, H) == 4 * 16 * 1024
+    B = choose_block(50_000, C, H)
+    assert B * _padded_row_bytes(C, H) <= _VMEM_TILE_BYTES
+    assert B % 8 == 0
+    # a logical-bytes budget would have chosen ~1.6x more rows
+    assert B < _VMEM_TILE_BYTES // (4 * C * H)
