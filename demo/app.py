@@ -373,6 +373,24 @@ def default_factory(args):
                 image_paths=resolve_image_paths(
                     ds, getattr(args, "images_dir", None)),
             )
+        # no task requested: prefer the committed REAL pool (CLIP
+        # checkpoints scored over the NIST digit scans) with its images —
+        # the out-of-the-box demo is then the reference's experience
+        # (real images + a 3-model zero-shot pool) with zero setup
+        here = os.path.dirname(os.path.abspath(__file__))
+        real_pool = os.path.join(here, "..", "data", "digits_clip.npz")
+        real_imgs = os.path.join(here, "digit_images")
+        if os.path.exists(real_pool) and os.path.isdir(real_imgs):
+            from coda_tpu.data import Dataset
+
+            ds = Dataset.from_file(real_pool)
+            return DemoSession(
+                ds.preds, ds.labels,
+                class_names=[f"digit {c}" for c in ds.class_names],
+                model_names=["tiny-clip-a", "tiny-clip-b",
+                             "tiny-clip-under"],
+                image_paths=resolve_image_paths(ds, real_imgs),
+            )
         # offline fallback: small seeded pool, 3 models x 5 classes like the
         # reference's iWildCam subset (demo/app.py README)
         from coda_tpu.data import make_synthetic_task
