@@ -1,0 +1,136 @@
+"""Validate the fused pallas EIG scorer on REAL TPU silicon.
+
+Round-3 verdict: the kernel had only ever run in interpret mode — Mosaic
+compilation, real tiling, and on-device numerics were unverified. This
+script is the hardware half of that proof, run the moment the tunnel is
+healthy:
+
+  1. Mosaic-compile `eig_scores_cache_pallas` (interpret=False) at the
+     headline incremental shape and at a ragged/non-aligned shape.
+  2. Compare scores against the jnp reference path ON DEVICE (same cache
+     tensors): max abs diff and argmax agreement.
+  3. Time both paths with the loop-in-jit discipline (fori_loop with a
+     data dependence, marginal cost between two loop lengths — a bare
+     block_until_ready through the axon tunnel returns before the queue
+     drains).
+
+Prints one JSON line; non-zero exit if compilation or numerics fail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+def _timed_loop(fn_scores, rows, hyp, pi, pi_xi, n: int) -> float:
+    """Wall-clock of n dependent applications, result materialized."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def loop(rows, hyp, pi, pi_xi):
+        def body(_, carry):
+            acc, pi = carry
+            s = fn_scores(rows, hyp, pi, pi_xi)
+            # thread a data dependence through pi so iterations can't be
+            # collapsed or reordered; keep it tiny so numerics stay sane
+            pi = pi + 1e-12 * s[: pi.shape[0]]
+            return acc + s.sum(), pi
+
+        acc, _ = jax.lax.fori_loop(
+            0, n, body, (jnp.asarray(0.0, jnp.float32), pi))
+        return acc
+
+    t0 = time.perf_counter()
+    out = loop(rows, hyp, pi, pi_xi)
+    np.asarray(out)  # materialize through the tunnel
+    return time.perf_counter() - t0
+
+
+def run_shape(N: int, C: int, H: int, reps_hi: int = 8,
+              reps_lo: int = 2) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.ops.pallas_eig import choose_block, eig_scores_cache_pallas
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    rows = jax.nn.softmax(jax.random.normal(k1, (C, H)), axis=-1)
+    hyp = jax.nn.softmax(jax.random.normal(k2, (N, C, H)), axis=-1)
+    pi = jax.nn.softmax(jax.random.normal(k3, (C,)))
+    pi_xi = jax.nn.softmax(jax.random.normal(k4, (N, C)), axis=-1)
+
+    B = choose_block(N, C, H)
+    rec: dict = {"shape": {"N": N, "C": C, "H": H}, "block": B}
+
+    # 1. Mosaic compile + run (interpret=False on TPU)
+    t0 = time.perf_counter()
+    s_pl = np.asarray(eig_scores_cache_pallas(rows, hyp, pi, pi_xi))
+    rec["mosaic_compile_and_first_run_s"] = round(time.perf_counter() - t0, 3)
+
+    # 2. numerics vs the jnp path, on device
+    s_jnp = np.asarray(eig_scores_from_cache(rows, hyp, pi, pi_xi))
+    rec["max_abs_diff"] = float(np.max(np.abs(s_pl - s_jnp)))
+    rec["argmax_agree"] = bool(s_pl.argmax() == s_jnp.argmax())
+    rec["scale"] = float(np.abs(s_jnp).mean())
+
+    # 3. marginal timing, loop-in-jit (both paths, same discipline)
+    def jnp_fn(r, h, p, px):
+        return eig_scores_from_cache(r, h, p, px)
+
+    def pl_fn(r, h, p, px):
+        return eig_scores_cache_pallas(r, h, p, px)
+
+    for name, fn in (("jnp", jnp_fn), ("pallas", pl_fn)):
+        _timed_loop(fn, rows, hyp, pi, pi_xi, reps_lo)  # warm
+        hi = _timed_loop(fn, rows, hyp, pi, pi_xi, reps_hi)
+        lo = _timed_loop(fn, rows, hyp, pi, pi_xi, reps_lo)
+        rec[f"{name}_marginal_ms"] = round(
+            1e3 * (hi - lo) / (reps_hi - reps_lo), 3)
+    return rec
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--tol", type=float, default=2e-5,
+                    help="max abs score diff vs the jnp path")
+    args = ap.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    out = {"device": dev.device_kind, "platform": dev.platform,
+           "interpret": not on_tpu, "shapes": []}
+    # On TPU: the headline incremental shape + a deliberately ragged one
+    # (N % 8 != 0, C not x8, H not x128) to exercise Mosaic's edge
+    # handling. Off-TPU the kernel runs in the per-element interpreter,
+    # where headline shapes are infeasible — small shapes smoke the script
+    # itself (the hardware claims are TPU-only anyway).
+    shapes = ([(50_000, 10, 1000), (1013, 7, 130)] if on_tpu
+              else [(512, 10, 96), (101, 7, 130)])
+    for (N, C, H) in shapes:
+        out["shapes"].append(run_shape(N, C, H))
+
+    ok = all(s["max_abs_diff"] <= args.tol and s["argmax_agree"]
+             for s in out["shapes"])
+    out["ok"] = ok
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
