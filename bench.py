@@ -458,6 +458,10 @@ def main():
                     help="EIG table-einsum matmul precision: highest "
                          "(reference numerics) | high | default — below "
                          "highest is an opt-in speed/parity tradeoff")
+    ap.add_argument("--eig-chunk", type=int, default=0,
+                    help="override the scoring-pass block size (0 = the "
+                         "config default; the tuning knob for the "
+                         "cache-stream pass)")
     ap.add_argument("--skip-reference", action="store_true")
     ap.add_argument("--no-device-probe", action="store_true",
                     help="skip the pre-flight subprocess probe of the "
@@ -491,6 +495,8 @@ def main():
         H, N, C, iters, chunk = 32, 2000, 10, 10, 1000
     else:
         H, N, C, iters, chunk = 1000, 50_000, 10, 50, 2048
+    if args.eig_chunk:
+        chunk = args.eig_chunk
 
     # one retry if the linearity guard trips: a single tunnel hiccup can
     # blow the noise floor of one rep set, and re-measuring is cheaper and
