@@ -167,7 +167,18 @@ def main(argv=None):
         _, _, u2 = update_pi_hat_column(dir0, i % C, preds, u)
         return u2
 
-    stage("update:pi-hat column", body_pi, unnorm)
+    stage("update:pi-hat column (exact)", body_pi, unnorm)
+
+    from coda_tpu.selectors.coda import update_pi_hat_column_delta
+
+    preds_by_class = jnp.transpose(preds, (2, 0, 1))
+
+    def body_pi_delta(u, i):
+        _, _, u2 = update_pi_hat_column_delta(
+            i % C, hard[i % N], preds_by_class, u, hp0.learning_rate)
+        return u2
+
+    stage("update:pi-hat column (delta)", body_pi_delta, unnorm)
 
     scores0 = jax.jit(
         lambda: eig_scores_from_cache(rows, hyp, pi, pi_xi, chunk=CH)
