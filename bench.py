@@ -170,7 +170,8 @@ def _flops_of(compiled) -> float:
 
 
 def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
-                         mode: str = "auto") -> tuple:
+                         mode: str = "auto",
+                         eig_cache_dtype: str = "float32") -> tuple:
     """(flops_per_step, resolved_mode) from the kernels' documented shapes.
 
     The mode is resolved by the SAME function ``make_coda`` uses
@@ -190,36 +191,43 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
     from coda_tpu.selectors import CODAHyperparams
     from coda_tpu.selectors.coda import resolve_eig_mode
 
+    # resolve with the SAME hyperparams the benched selector uses — the
+    # cache dtype changes the auto budget, so omitting it here could
+    # report a different tier than the one that ran
     mode = resolve_eig_mode(
-        CODAHyperparams(eig_mode=mode, num_points=G), H, N, C)
+        CODAHyperparams(eig_mode=mode, num_points=G,
+                        eig_cache_dtype=eig_cache_dtype), H, N, C)
     if mode == "incremental":
         return 6.0 * N * H * G + 2.0 * H * N + 10.0 * N * C * H, mode
     return 6.0 * N * C * H * G + 2.0 * H * C * C * N, mode
 
 
-def _analytic_step_bytes(H: int, N: int, C: int, mode: str) -> float:
+def _analytic_step_bytes(H: int, N: int, C: int, mode: str,
+                         cache_bytes: int = 4) -> float:
     """Analytic HBM traffic per round (bytes), for the bandwidth roofline.
 
     ``mode`` must be the ALREADY-RESOLVED tier (take it from
     :func:`_analytic_step_flops`'s return, so the FLOP and byte models can
     never describe different kernels).
 
-    Incremental EIG per round: the scoring pass streams the (N, C, H) fp32
-    cache once; the pi-hat DELTA refresh (pi_update='delta', the default)
-    gathers H contiguous N-rows from the loop-constant (C, H, N) layout —
-    4·H·N bytes, the C-fold cut that replaced streaming the full tensor;
-    the cache row refresh reads the (N, H) int32 hard preds and writes the
-    (N, H) fp32 row. The factored/rowscan tiers recompute from the full
-    (H, N, C) tensor and stream the same-shaped hypothetical intermediates.
+    Incremental EIG per round: the scoring pass streams the (N, C, H)
+    cache once at its storage width (``cache_bytes``: 4 fp32, 2 when
+    eig_cache_dtype='bfloat16'); the pi-hat DELTA refresh
+    (pi_update='delta', the default) gathers H contiguous N-rows from the
+    loop-constant (C, H, N) fp32 layout — 4·H·N bytes, the C-fold cut that
+    replaced streaming the full tensor; the cache row refresh reads the
+    (N, H) int32 hard preds and writes the (N, H) row at cache width. The
+    factored/rowscan tiers recompute from the full (H, N, C) tensor and
+    stream the same-shaped fp32 hypothetical intermediates.
     """
-    row = 8.0 * N * H
     if mode == "incremental":
-        cache = 4.0 * N * C * H
+        cache = float(cache_bytes) * N * C * H
         pi_gather = 4.0 * H * N
+        row = (4.0 + cache_bytes) * N * H
         return cache + pi_gather + row
     hyp = 4.0 * N * C * H
     preds = 4.0 * H * N * C
-    return hyp + preds + row
+    return hyp + preds + 8.0 * N * H
 
 
 def _mad(xs: list[float]) -> float:
@@ -251,7 +259,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     # metadata can never drift from what the selector actually ran with
     defaults = CODAHyperparams()._asdict()
     eig_opts = {**{k: defaults[k] for k in
-                   ("eig_mode", "eig_backend", "eig_precision")},
+                   ("eig_mode", "eig_backend", "eig_precision",
+                    "eig_cache_dtype")},
                 **(eig_opts or {})}
     # _mad of a single rep is 0, which would floor the noise at 1e-12 and
     # let any positive wall-clock delta pass linear_ok; the guard only
@@ -280,12 +289,15 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     overhead_s = wall - iters * marginal_step_s
 
     flops_per_step, mode = _analytic_step_flops(
-        H, N, C, mode=eig_opts["eig_mode"])
+        H, N, C, mode=eig_opts["eig_mode"],
+        eig_cache_dtype=eig_opts["eig_cache_dtype"])
 
     dev = jax.devices()[0]
     peak = _PEAK_FLOPS.get(dev.device_kind)
     peak_bw = _PEAK_HBM_BPS.get(dev.device_kind)
-    bytes_per_step = _analytic_step_bytes(H, N, C, mode=mode)
+    bytes_per_step = _analytic_step_bytes(
+        H, N, C, mode=mode,
+        cache_bytes=np.dtype(eig_opts["eig_cache_dtype"]).itemsize)
     achieved = (flops_per_step / marginal_step_s
                 if linear_ok and marginal_step_s > 0 else 0.0)
     achieved_bps = (bytes_per_step / marginal_step_s
@@ -310,6 +322,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         "eig_mode": mode,
         "eig_backend": eig_opts["eig_backend"],
         "eig_precision": eig_opts["eig_precision"],
+        "eig_cache_dtype": eig_opts["eig_cache_dtype"],
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
         # MFU/MBU denominators are the ANALYTIC per-step models: the XLA
@@ -458,6 +471,11 @@ def main():
                     help="EIG table-einsum matmul precision: highest "
                          "(reference numerics) | high | default — below "
                          "highest is an opt-in speed/parity tradeoff")
+    ap.add_argument("--eig-cache-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="storage dtype of the incremental P(best) cache "
+                         "(bfloat16 halves the dominant HBM stream; "
+                         "opt-in numerics like --eig-precision)")
     ap.add_argument("--eig-chunk", type=int, default=0,
                     help="override the scoring-pass block size (0 = the "
                          "config default; the tuning knob for the "
@@ -504,7 +522,8 @@ def main():
     # the protocol genuinely can't resolve the per-step cost — report
     # invalid as before.
     eig_opts = {"eig_mode": args.eig_mode, "eig_backend": args.eig_backend,
-                "eig_precision": args.eig_precision}
+                "eig_precision": args.eig_precision,
+                "eig_cache_dtype": args.eig_cache_dtype}
     for attempt in range(2):
         ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
                           reps=args.reps, eig_opts=eig_opts)
@@ -531,6 +550,7 @@ def main():
         "device_fallback": device_fallback,
         "compute": {k: ours[k] for k in
                     ("eig_mode", "eig_backend", "eig_precision",
+                     "eig_cache_dtype",
                      "flops_per_step_analytic", "flop_accounting",
                      "flops_xla_scan_body_once", "achieved_flops_per_sec",
                      "peak_flops_per_sec", "mfu",

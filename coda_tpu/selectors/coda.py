@@ -83,6 +83,17 @@ class CODAHyperparams(NamedTuple):
     #                               1-pass bf16. Anything below highest can
     #                               reorder near-tie EIG argmaxes on TPU —
     #                               opt-in speed, not reference semantics.
+    eig_cache_dtype: str = "float32"  # float32 | bfloat16 — storage dtype
+    #                               of the incremental (N, C, H) P(best)
+    #                               cache. bfloat16 HALVES the dominant
+    #                               HBM stream of the scoring pass (the
+    #                               cache read) and the tier's footprint;
+    #                               scores are computed in fp32 after
+    #                               upcast, but the stored probabilities
+    #                               carry ~3 decimal digits, so near-tie
+    #                               EIG orderings can change — opt-in
+    #                               speed, not reference semantics (same
+    #                               contract as eig_precision).
     pi_update: str = "delta"      # delta | exact — incremental-mode pi-hat
     #                               column refresh. "delta" adds the exact
     #                               linear increment lr*preds[h,n,s_h] via a
@@ -130,11 +141,13 @@ def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
     """
     full_pool_eig = (hp.q == "eig"
                      and not (hp.prefilter_n and hp.prefilter_n < N))
-    # the delta pi-hat path keeps a second preds-sized tensor (the (C, H, N)
-    # transposed layout) resident next to the (N, C, H) cache, so its
-    # incremental footprint is ~2x — the auto budget must charge for it or
-    # "fits comfortably on one chip" silently becomes an OOM
-    incr_copies = 2 if hp.pi_update == "delta" else 1
+    # per-replica resident bytes of the incremental tier, per (N*C*H)
+    # element: the P(best) cache at its storage dtype, plus the fp32
+    # (C, H, N) transposed preds layout the delta pi-hat path keeps
+    # resident — the auto budget must charge for both or "fits comfortably
+    # on one chip" silently becomes an OOM
+    cache_bytes = jnp.dtype(hp.eig_cache_dtype).itemsize
+    incr_bytes_per_elem = cache_bytes + (4 if hp.pi_update == "delta" else 0)
     if hp.eig_mode != "auto":
         if hp.eig_mode == "incremental" and not full_pool_eig:
             raise ValueError(
@@ -146,7 +159,8 @@ def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
         return hp.eig_mode
     par = max(1, hp.n_parallel)
     if (full_pool_eig
-            and par * incr_copies * 4 * N * C * H <= _INCR_CACHE_MAX_BYTES):
+            and par * incr_bytes_per_elem * N * C * H
+            <= _INCR_CACHE_MAX_BYTES):
         return "incremental"
     if par * 16 * C * H * hp.num_points <= _TABLES_MAX_BYTES:
         return "factored"
@@ -365,12 +379,16 @@ def build_eig_cache(
     num_points: int = 256,
     chunk: int = 256,
     precision=_PRECISION,
+    cache_dtype=jnp.float32,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Full (pbest_rows, pbest_hyp) cache for the incremental EIG.
 
     One factored pass over all N items and C class rows — the same math as
     :func:`eig_scores_factored`'s table+einsum stage, run once at selector
     init (and never again: ``update_eig_cache`` refreshes single rows).
+    ``cache_dtype`` is the STORAGE dtype of the (N, C, H) hypothetical
+    tensor (all math stays fp32; bfloat16 storage halves the scoring
+    pass's HBM stream — the eig_cache_dtype knob).
     """
     H, C, _ = dirichlets.shape
     N = hard_preds.shape[0]
@@ -385,7 +403,8 @@ def build_eig_cache(
 
     def blk(pred_b):                                 # (B, H) -> (B, C, H)
         eq = (pred_b[:, None, :] == class_range[None, :, None]).astype(x.dtype)
-        return _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz, precision)
+        out = _pbest_hyp_block(eq, S0, dlogcdf, F_u, dF, w_trapz, precision)
+        return out.astype(cache_dtype)
 
     B = min(chunk, N)
     if B >= N:
@@ -427,7 +446,9 @@ def update_eig_cache(
     row_t = compute_pbest(a_t, b_t, num_points=num_points)       # (H,)
     return (
         pbest_rows.at[true_class].set(row_t),
-        pbest_hyp.at[:, true_class, :].set(hyp_t),
+        # store at the cache's own dtype (fp32 math, bf16 storage when the
+        # eig_cache_dtype knob is on)
+        pbest_hyp.at[:, true_class, :].set(hyp_t.astype(pbest_hyp.dtype)),
     )
 
 
@@ -546,6 +567,9 @@ def eig_scores_from_cache(
 
     def item(args):
         hyp_n, pi_xi_n = args                        # (C, H), (C,)
+        # upcast per block: storage may be bf16 (eig_cache_dtype); the
+        # mixture/entropy math always runs fp32
+        hyp_n = hyp_n.astype(mixture0.dtype)
         mix_new = mixture0[None] + pi_hat[:, None] * (hyp_n - pbest_rows)
         h_after = entropy2(mix_new, axis=-1)         # (C,)
         return h_before - (pi_xi_n * h_after).sum()
@@ -690,10 +714,19 @@ def make_coda(
     # re-transposed every round; only the incremental tier reads it
     preds_by_class = (jnp.transpose(preds, (2, 0, 1))
                       if incremental and hp.pi_update == "delta" else None)
+    if hp.eig_cache_dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown eig_cache_dtype {hp.eig_cache_dtype!r} "
+                         "(use 'float32' or 'bfloat16')")
+    cache_dtype = jnp.dtype(hp.eig_cache_dtype)
     if hp.eig_backend not in ("jnp", "pallas"):
         raise ValueError(f"unknown eig_backend {hp.eig_backend!r} "
                          "(use 'jnp' or 'pallas')")
     if hp.eig_backend == "pallas":
+        if hp.eig_cache_dtype != "float32":
+            raise ValueError(
+                "eig_backend='pallas' currently reads an fp32 cache; "
+                "combine eig_cache_dtype='bfloat16' with the jnp backend"
+            )
         if not incremental:
             raise ValueError(
                 "eig_backend='pallas' accelerates the incremental scoring "
@@ -724,7 +757,8 @@ def make_coda(
         rows, hyp = (
             build_eig_cache(dirichlets0, hard_preds,
                             num_points=hp.num_points, chunk=hp.eig_chunk,
-                            precision=eig_precision)
+                            precision=eig_precision,
+                            cache_dtype=cache_dtype)
             if incremental else (None, None)
         )
         return CODAState(

@@ -627,3 +627,54 @@ def test_pi_delta_matches_exact_recompute(task):
     fresh = pi_unnorm(state.dirichlets, task.preds)
     np.testing.assert_allclose(np.asarray(state.pi_xi_unnorm),
                                np.asarray(fresh), rtol=2e-5, atol=1e-6)
+
+
+def test_bf16_cache_scores_and_budget(task):
+    """eig_cache_dtype='bfloat16': (a) the cache is stored bf16 and scores
+    stay within bf16 quantization of the fp32 path (math is fp32 after
+    upcast); (b) the auto budget charges half the cache bytes; (c) the
+    pallas backend rejects the combination (it reads an fp32 cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.coda import (
+        _INCR_CACHE_MAX_BYTES,
+        eig_scores_from_cache,
+        resolve_eig_mode,
+    )
+
+    states = {}
+    for dt in ("float32", "bfloat16"):
+        sel = make_coda(task.preds, CODAHyperparams(
+            eig_mode="incremental", eig_chunk=1000, eig_cache_dtype=dt))
+        states[dt] = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    assert states["bfloat16"].pbest_hyp.dtype == jnp.bfloat16
+    assert states["float32"].pbest_hyp.dtype == jnp.float32
+
+    s32 = np.asarray(eig_scores_from_cache(
+        states["float32"].pbest_rows, states["float32"].pbest_hyp,
+        states["float32"].pi_hat, states["float32"].pi_hat_xi))
+    s16 = np.asarray(eig_scores_from_cache(
+        states["bfloat16"].pbest_rows, states["bfloat16"].pbest_hyp,
+        states["bfloat16"].pi_hat, states["bfloat16"].pi_hat_xi))
+    # stored probabilities carry ~2^-8 relative error; entropies are O(log H)
+    assert np.max(np.abs(s32 - s16)) < 0.05
+    # the ordering signal survives quantization on a non-degenerate task:
+    # the fp32 top pick stays in the bf16 top-5
+    assert int(s32.argmax()) in np.argsort(s16)[-5:]
+
+    # budget: with the exact pi path (no delta layout), a bf16 cache fits
+    # at TWICE the N the fp32 cache does
+    H, C = 1000, 10
+    n_fp32 = _INCR_CACHE_MAX_BYTES // (4 * C * H) - 1
+    assert resolve_eig_mode(CODAHyperparams(
+        pi_update="exact"), H, 2 * n_fp32, C) == "factored"
+    assert resolve_eig_mode(CODAHyperparams(
+        pi_update="exact", eig_cache_dtype="bfloat16"),
+        H, 2 * n_fp32, C) == "incremental"
+
+    with pytest.raises(ValueError, match="fp32 cache"):
+        make_coda(task.preds, CODAHyperparams(
+            eig_mode="incremental", eig_backend="pallas",
+            eig_cache_dtype="bfloat16"))
