@@ -38,7 +38,8 @@ def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
     """
     mixture0 = mixture0_ref[0, :]                    # (H,)
     pi_hat = pi_hat_ref[0, :]                        # (C,)
-    hyp = hyp_ref[:]                                 # (B, C, H)
+    # storage may be bf16 (eig_cache_dtype); all math runs fp32
+    hyp = hyp_ref[:].astype(mixture0.dtype)          # (B, C, H)
     delta = hyp - rows_ref[:][None]                  # (B, C, H)
     mix = mixture0[None, None, :] + pi_hat[None, :, None] * delta
     p = jnp.maximum(mix, _ENTROPY_FLOOR)
@@ -50,28 +51,38 @@ def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
 _VMEM_TILE_BYTES = 8 << 20  # target VMEM footprint of one (B, C, H) tile
 
 
-def _padded_row_bytes(C: int, H: int) -> int:
-    """Physical VMEM bytes of ONE N-row of the (B, C, H) fp32 tile.
+def _padded_row_bytes(C: int, H: int, itemsize: int = 4) -> int:
+    """Physical VMEM bytes of ONE N-row of the (B, C, H) cache tile.
 
-    Mosaic lays vector memory out in (8, 128) fp32 tiles over the two minor
-    dims, so a (C, H) slice occupies ceil(C/8)*8 x ceil(H/128)*128 elements
-    regardless of the logical shape — at the headline (C=10, H=1000) that
-    is 16 x 1024 = 1.6x the logical bytes. Budgeting with logical sizes
-    would overshoot VMEM by exactly that factor on the first hardware run.
+    Mosaic lays vector memory out in (8, 128) fp32 / (16, 128) bf16 tiles
+    over the two minor dims, so a (C, H) slice occupies
+    ceil(C/sub)*sub x ceil(H/128)*128 elements regardless of the logical
+    shape — at the headline (C=10, H=1000) fp32 that is 16 x 1024 = 1.6x
+    the logical bytes. Budgeting with logical sizes would overshoot VMEM
+    by exactly that factor on the first hardware run.
     """
-    Cp = -(-C // 8) * 8
+    sub = 16 if itemsize == 2 else 8
+    Cp = -(-C // sub) * sub
     Hp = -(-H // 128) * 128
-    return 4 * Cp * Hp
+    return itemsize * Cp * Hp
 
 
-def choose_block(N: int, C: int, H: int, block: int = 0) -> int:
+def choose_block(N: int, C: int, H: int, block: int = 0,
+                 itemsize: int = 4) -> int:
     """The N-tile size: sublane-aligned (x8) under the VMEM budget, or all
     of N when it fits — the two shapes Mosaic accepts for the (B, C) /
     (B, 1) blocks without host-padding the cache. The budget is computed
-    against the PADDED physical tile (see :func:`_padded_row_bytes`). The
-    x8 hardware minimum wins over a smaller caller ``block`` cap (a cap
-    below 8 cannot lower the tile's VMEM footprint further)."""
-    vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, _padded_row_bytes(C, H)))
+    against the PADDED physical tile (see :func:`_padded_row_bytes`) at
+    the cache's ``itemsize``. The x8 hardware minimum wins over a smaller
+    caller ``block`` cap (a cap below 8 cannot lower the tile's VMEM
+    footprint further)."""
+    # budget against the FP32 COMPUTE footprint even for bf16 storage: the
+    # kernel upcasts the whole tile (delta/mix/entropy run fp32), so a
+    # bf16-sized cap would double B and blow VMEM on hardware — bf16's win
+    # is the halved HBM stream, not a bigger tile
+    vmem_cap = max(
+        8, _VMEM_TILE_BYTES
+        // max(1, _padded_row_bytes(C, H, max(itemsize, 4))))
     cap = min(block, vmem_cap) if block else vmem_cap
     if N <= max(cap, 8):
         return N
@@ -92,7 +103,8 @@ def eig_scores_cache_pallas(
     Matches ``eig_scores_from_cache`` numerics: same mixture-delta, the same
     1e-12 entropy floor, log2 via ln·log2(e) (the same lowering XLA emits
     for ``jnp.log2``). ``block`` is a CAP on the N-tile; the actual tile
-    targets ~8 MB of VMEM per (B, C, H) fp32 block (block=0 means "derive
+    targets ~8 MB of VMEM per (B, C, H) block (fp32 compute footprint
+    regardless of storage dtype) (block=0 means "derive
     from VMEM alone"). The x8 sublane minimum floors the tile at 8 rows =
     32*C*H bytes, which exceeds the target once C*H > ~256k elements and
     keeps growing linearly with C*H — that regime is exercised only in
@@ -110,7 +122,7 @@ def eig_scores_cache_pallas(
     if interpret is None:  # Mosaic compiles only on real TPUs
         interpret = jax.default_backend() != "tpu"
     N, C, H = pbest_hyp.shape
-    B = choose_block(N, C, H, block)
+    B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize)
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
     pc = jnp.clip(mixture0, _ENTROPY_FLOOR, None)
     h_before = -(pc * jnp.log2(pc)).sum()
@@ -119,7 +131,7 @@ def eig_scores_cache_pallas(
 
     out = pl.pallas_call(
         _score_block_kernel,
-        out_shape=jax.ShapeDtypeStruct((N, 1), pbest_hyp.dtype),
+        out_shape=jax.ShapeDtypeStruct((N, 1), mixture0.dtype),
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((1, H), lambda i: (0, 0)),          # mixture0

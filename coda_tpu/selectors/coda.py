@@ -722,11 +722,6 @@ def make_coda(
         raise ValueError(f"unknown eig_backend {hp.eig_backend!r} "
                          "(use 'jnp' or 'pallas')")
     if hp.eig_backend == "pallas":
-        if hp.eig_cache_dtype != "float32":
-            raise ValueError(
-                "eig_backend='pallas' currently reads an fp32 cache; "
-                "combine eig_cache_dtype='bfloat16' with the jnp backend"
-            )
         if not incremental:
             raise ValueError(
                 "eig_backend='pallas' accelerates the incremental scoring "

@@ -633,7 +633,8 @@ def test_bf16_cache_scores_and_budget(task):
     """eig_cache_dtype='bfloat16': (a) the cache is stored bf16 and scores
     stay within bf16 quantization of the fp32 path (math is fp32 after
     upcast); (b) the auto budget charges half the cache bytes; (c) the
-    pallas backend rejects the combination (it reads an fp32 cache)."""
+    pallas backend reads the bf16 cache too (in-kernel upcast) and its
+    interpret-mode scores match the jnp path."""
     import jax
     import jax.numpy as jnp
 
@@ -674,7 +675,11 @@ def test_bf16_cache_scores_and_budget(task):
         pi_update="exact", eig_cache_dtype="bfloat16"),
         H, 2 * n_fp32, C) == "incremental"
 
-    with pytest.raises(ValueError, match="fp32 cache"):
-        make_coda(task.preds, CODAHyperparams(
-            eig_mode="incremental", eig_backend="pallas",
-            eig_cache_dtype="bfloat16"))
+    # the pallas backend reads the bf16 cache too (upcast in-kernel):
+    # interpret-mode scores must match the jnp path on the same state
+    from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
+
+    st = states["bfloat16"]
+    s_pl = np.asarray(eig_scores_cache_pallas(
+        st.pbest_rows, st.pbest_hyp, st.pi_hat, st.pi_hat_xi))
+    np.testing.assert_allclose(s_pl, s16, rtol=1e-5, atol=1e-6)
