@@ -163,6 +163,26 @@ def main(argv=None):
 
     stage("update:eig-cache row refresh", body_upd, (rows, hyp))
 
+    # pure DUS cost of the cache-carry update, two layouts: if XLA cannot
+    # alias the middle-axis dynamic-update-slice in the loop carry it
+    # degrades to a full (N, C, H) copy per round (~5 ms at headline on a
+    # v5e) — the leading-axis variant is the classic in-place-safe pattern,
+    # so a large gap between these two stages localizes that copy without
+    # any einsum compute in the way
+    def body_dus_mid(h, i):
+        row = h[:, (i + 1) % C, :] * jnp.float32(0.999)
+        return h.at[:, i % C, :].set(row)
+
+    stage("carry:DUS mid-axis (N,C,H)", body_dus_mid, hyp)
+
+    hypT = jnp.transpose(hyp, (1, 0, 2))             # (C, N, H)
+
+    def body_dus_lead(h, i):
+        row = h[(i + 1) % C] * jnp.float32(0.999)
+        return h.at[i % C].set(row)
+
+    stage("carry:DUS leading-axis (C,N,H)", body_dus_lead, hypT)
+
     def body_pi(u, i):
         _, _, u2 = update_pi_hat_column(dir0, i % C, preds, u)
         return u2
