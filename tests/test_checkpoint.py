@@ -180,3 +180,17 @@ def test_stale_state_layout_fails_loudly(setup, tmp_path):
     with pytest.raises(ValueError, match="layout change"):
         run_experiment_resumable(sel, task.labels, losses, iters=12, seed=0,
                                  ckpt_dir=ckpt, every=3)
+
+
+def test_resumable_bf16_cache_roundtrips(setup, tmp_path):
+    """The bf16 EIG cache must survive the orbax snapshot/restore cycle:
+    a resumed run equals the single-scan run with eig_cache_dtype set."""
+    task, losses = setup
+    sel = make_coda(task.preds, CODAHyperparams(
+        eig_chunk=16, eig_mode="incremental", eig_cache_dtype="bfloat16"))
+    want = run_experiment(sel, task, iters=10, seed=3, model_losses=losses)
+    got = run_experiment_resumable(
+        sel, task.labels, losses, iters=10, seed=3,
+        ckpt_dir=str(tmp_path / "ck16"), every=4,
+    )
+    _assert_results_equal(want, got)
