@@ -94,6 +94,16 @@ def parse_args(argv=None):
                    help="matmul precision of the EIG table einsums: highest "
                         "= reference numerics (parity-tested default); "
                         "lower tiers trade trace parity for MXU throughput")
+    p.add_argument("--eig-cache-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="storage dtype of the incremental P(best) cache: "
+                        "bfloat16 halves the scoring pass's HBM stream "
+                        "(opt-in numerics, like --eig-precision)")
+    p.add_argument("--pi-update", default="delta",
+                   choices=["delta", "exact"],
+                   help="incremental pi-hat refresh: delta = bandwidth-lean "
+                        "exact increment (default); exact = strict "
+                        "reference float choreography")
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
                    help="shard the (H,N,C) tensor, e.g. 'data=4' or 'data=4,model=2'")
     p.add_argument("--platform", default=None,
@@ -182,6 +192,8 @@ def build_selector_factory(args, task_name: str):
             eig_mode=getattr(args, "eig_mode", "auto"),
             eig_backend=getattr(args, "eig_backend", "jnp"),
             eig_precision=getattr(args, "eig_precision", "highest"),
+            eig_cache_dtype=getattr(args, "eig_cache_dtype", "float32"),
+            pi_update=getattr(args, "pi_update", "delta"),
             # vmapped seeds each carry their own incremental cache; the
             # auto eig_mode budget must see the whole batch. Runners with a
             # different execution width (the suite's dedup batches, future
