@@ -67,7 +67,7 @@ def _run_realisations(hard_preds_sub, oracle_sub, C, gamma, budget, key,
         def step(carry, k_step):
             unlabeled, posterior, counts = carry
             k_sel, k_best = jax.random.split(k_step)
-            ent = expected_entropies(hp, posterior, gamma, C, chunk=P)
+            ent = expected_entropies(hp, posterior, gamma, C)
             cand = disagree & unlabeled
             cand = jnp.where(cand.any(), cand, unlabeled)
             idx, _ = masked_argmin_tiebreak(k_sel, ent, cand)

@@ -435,8 +435,16 @@ def test_modelpicker_static_trim_matches_full_scoring(task):
     agree = ~np.asarray((hard != hard[:, :1]).any(axis=1))
     assert agree.any() and not agree.all()
     # at full-agreement points, full scoring equals the posterior's entropy
-    np.testing.assert_array_equal(
-        full[agree], float(entropy2(state.posterior)))
+    # (same math through the bucketed closed form, so equal only up to
+    # float accumulation order — and identical ACROSS agreement points,
+    # which is what keeps the trim's tie semantics exact)
+    np.testing.assert_allclose(
+        full[agree], float(entropy2(state.posterior)), rtol=0, atol=1e-6)
+    # agreement points follow the same arithmetic up to the position of the
+    # consensus column in the class mean, so they agree to ~ulp (the trim
+    # path substitutes ONE shared scalar, which is what makes its tie
+    # semantics exact by construction)
+    assert np.ptp(full[agree]) <= 5e-7
 
     # trace of the trimmed selector == trace of a forced-full-scoring run
     # (tracer path: build the selector inside jit via a preds argument)
