@@ -48,7 +48,15 @@ def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
     out_ref[:] = scores[:, None]
 
 
-_VMEM_TILE_BYTES = 8 << 20  # target VMEM footprint of one (B, C, H) tile
+_SCOPED_VMEM_BYTES = 16 << 20  # Mosaic's default scoped-vmem limit
+_VMEM_MARGIN_BYTES = 1 << 20   # stack + the single-buffered broadcast refs
+# the pipelined grid operands (hyp tile, pi_xi tile, out tile) are DOUBLE-
+# buffered by pallas; the budget below models 2x their padded footprint.
+# First hardware run (round 4) proved the point: an 8 MB tile target that
+# ignored double buffering landed at 16.12 MB scoped — 128.5 KB over the
+# 16 MB limit (2x8 MB hyp + 2x64 KB padded out + small refs), and Mosaic
+# refused to compile.
+_VMEM_TILE_BYTES = (_SCOPED_VMEM_BYTES - _VMEM_MARGIN_BYTES) // 2
 
 
 def _padded_row_bytes(C: int, H: int, itemsize: int = 4) -> int:
@@ -80,9 +88,11 @@ def choose_block(N: int, C: int, H: int, block: int = 0,
     # kernel upcasts the whole tile (delta/mix/entropy run fp32), so a
     # bf16-sized cap would double B and blow VMEM on hardware — bf16's win
     # is the halved HBM stream, not a bigger tile
-    vmem_cap = max(
-        8, _VMEM_TILE_BYTES
-        // max(1, _padded_row_bytes(C, H, max(itemsize, 4))))
+    # pi_xi (B, C) and out (B, 1) rows, padded to the 128-lane minor dim
+    xi_row = 4 * (-(-C // 128) * 128)
+    out_row = 4 * 128
+    per_row = _padded_row_bytes(C, H, max(itemsize, 4)) + xi_row + out_row
+    vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, per_row))
     cap = min(block, vmem_cap) if block else vmem_cap
     if N <= max(cap, 8):
         return N
@@ -103,9 +113,11 @@ def eig_scores_cache_pallas(
     Matches ``eig_scores_from_cache`` numerics: same mixture-delta, the same
     1e-12 entropy floor, log2 via ln·log2(e) (the same lowering XLA emits
     for ``jnp.log2``). ``block`` is a CAP on the N-tile; the actual tile
-    targets ~8 MB of VMEM per (B, C, H) block (fp32 compute footprint
-    regardless of storage dtype) (block=0 means "derive
-    from VMEM alone"). The x8 sublane minimum floors the tile at 8 rows =
+    targets ~7.5 MB of VMEM per (B, C, H) block — half the 16 MB scoped
+    limit minus a margin, because pallas double-buffers the pipelined
+    operands (fp32 compute footprint regardless of storage dtype; block=0
+    means "derive from VMEM alone"). The x8 sublane minimum floors the
+    tile at 8 rows =
     32*C*H bytes, which exceeds the target once C*H > ~256k elements and
     keeps growing linearly with C*H — that regime is exercised only in
     interpret-mode tests, not on hardware (the jnp path is the safe choice

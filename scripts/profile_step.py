@@ -27,11 +27,25 @@ import time
 sys.path.insert(0, ".")
 
 
-def marginal_ms(body, carry0, n_hi: int, n_lo: int, reps: int) -> dict:
+def marginal_ms(body, carry0, n_hi: int, n_lo: int, reps: int,
+                ops: tuple = (), setup=None) -> dict:
     """Median marginal per-iteration cost of ``body`` in milliseconds.
 
-    ``body(carry, i) -> carry`` must thread a data dependence through the
-    carry (multiply-by-tiny, add — anything XLA cannot fold away).
+    ``body(carry, i, *ops) -> carry`` must thread a data dependence through
+    the carry (multiply-by-tiny, add — anything XLA cannot fold away).
+
+    ``ops`` are the loop-invariant tensors the body reads. They MUST be
+    passed here — not closed over — so they lower as jit *arguments*:
+    closure-captured arrays become HLO constants, and through the axon
+    tunnel the remote-compile request then ships the full tensor bytes
+    (2 GB at headline), which demonstrably breaks the tunnel transport
+    (round-4 log: `remote_compile ... Broken pipe`).
+
+    ``setup(*ops) -> body2`` optionally builds the per-iteration body
+    ONCE inside the jitted program but OUTSIDE the loop (the engine's
+    selector_factory pattern): one-time construction work (prior build,
+    cache init) is traced outside the While body so it cannot be charged
+    to the marginal even if XLA declines to hoist it.
     """
     import jax
     import numpy as np
@@ -39,15 +53,17 @@ def marginal_ms(body, carry0, n_hi: int, n_lo: int, reps: int) -> dict:
 
     def run(n: int) -> list:
         @jax.jit
-        def f(c0):
-            return lax.fori_loop(0, n, lambda i, c: body(c, i), c0)
+        def f(c0, *ops):
+            b = setup(*ops) if setup is not None else (
+                lambda c, i: body(c, i, *ops))
+            return lax.fori_loop(0, n, lambda i, c: b(c, i), c0)
 
-        out = f(carry0)
+        out = f(carry0, *ops)
         jax.tree.map(np.asarray, out)  # warm-up, forced to completion
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            jax.tree.map(np.asarray, f(carry0))
+            jax.tree.map(np.asarray, f(carry0, *ops))
             ts.append(time.perf_counter() - t0)
         return ts
 
@@ -132,10 +148,11 @@ def main(argv=None):
     eps = jnp.float32(1e-20)  # runtime value: XLA cannot fold the dependence
     results = {}
 
-    def stage(name, body, carry0):
+    def stage(name, body, carry0, ops=(), setup=None):
         if name.split(":")[0] in skip:
             return
-        r = marginal_ms(body, carry0, args.n_hi, args.n_lo, args.reps)
+        r = marginal_ms(body, carry0, args.n_hi, args.n_lo, args.reps,
+                        ops=ops, setup=setup)
         results[name] = {"ms_per_iter": round(r["ms_per_iter"], 3),
                          "resolved": r["resolved"]}
         flag = "" if r["resolved"] else "  [below noise floor]"
@@ -143,25 +160,28 @@ def main(argv=None):
               f"(hi={r['wall_hi_s']}s lo={r['wall_lo_s']}s){flag}",
               file=sys.stderr)
 
-    def body_score(c, i):
+    def body_score(c, i, rows, hyp, pi, pi_xi):
         s = eig_scores_from_cache(rows, hyp, pi + c * eps, pi_xi, chunk=CH)
         return c + s[0] * eps
 
-    stage(f"score:jnp chunk={CH}", body_score, jnp.float32(0))
+    stage(f"score:jnp chunk={CH}", body_score, jnp.float32(0),
+          ops=(rows, hyp, pi, pi_xi))
 
-    def body_pallas(c, i):
+    def body_pallas(c, i, rows, hyp, pi, pi_xi):
         from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
 
         s = eig_scores_cache_pallas(rows, hyp, pi + c * eps, pi_xi, block=CH)
         return c + s[0] * eps
 
-    stage("pallas:score", body_pallas, jnp.float32(0))
+    stage("pallas:score", body_pallas, jnp.float32(0),
+          ops=(rows, hyp, pi, pi_xi))
 
-    def body_upd(carry, i):
+    def body_upd(carry, i, dir0, hard):
         r, h = carry
         return update_eig_cache(dir0, i % C, hard, r, h, num_points=G)
 
-    stage("update:eig-cache row refresh", body_upd, (rows, hyp))
+    stage("update:eig-cache row refresh", body_upd, (rows, hyp),
+          ops=(dir0, hard))
 
     # pure DUS cost of the cache-carry update, two layouts: if XLA cannot
     # alias the middle-axis dynamic-update-slice in the loop carry it
@@ -183,56 +203,71 @@ def main(argv=None):
 
     stage("carry:DUS leading-axis (C,N,H)", body_dus_lead, hypT)
 
-    def body_pi(u, i):
+    def body_pi(u, i, dir0, preds):
         _, _, u2 = update_pi_hat_column(dir0, i % C, preds, u)
         return u2
 
-    stage("update:pi-hat column (exact)", body_pi, unnorm)
+    stage("update:pi-hat column (exact)", body_pi, unnorm, ops=(dir0, preds))
 
     from coda_tpu.selectors.coda import update_pi_hat_column_delta
 
     preds_by_class = jnp.transpose(preds, (2, 0, 1))
 
-    def body_pi_delta(u, i):
+    def body_pi_delta(u, i, hard, preds_by_class):
         _, _, u2 = update_pi_hat_column_delta(
             i % C, hard[i % N], preds_by_class, u, hp0.learning_rate)
         return u2
 
-    stage("update:pi-hat column (delta)", body_pi_delta, unnorm)
+    stage("update:pi-hat column (delta)", body_pi_delta, unnorm,
+          ops=(hard, preds_by_class))
 
     scores0 = jax.jit(
-        lambda: eig_scores_from_cache(rows, hyp, pi, pi_xi, chunk=CH)
-    )()
+        lambda r, h, p, px: eig_scores_from_cache(r, h, p, px, chunk=CH)
+    )(rows, hyp, pi, pi_xi)
     cand = jnp.ones((N,), bool)
 
-    def body_am(c, i):
+    def body_am(c, i, scores0, cand):
         idx, _ = masked_argmax_tiebreak(
             jax.random.PRNGKey(0), scores0 + c * eps, cand,
             rtol=1e-8, atol=1e-8,
         )
         return c + idx.astype(jnp.float32) * eps
 
-    stage("select:masked argmax", body_am, jnp.float32(0))
+    stage("select:masked argmax", body_am, jnp.float32(0),
+          ops=(scores0, cand))
 
     # the full scan step, for the unexplained-residual check: the sum of
     # the stages above should account for most of this. Setup (sel.init
     # rebuilds its own (N, C, H) cache, ~2 GB at headline scale) only runs
     # when the stage isn't skipped.
     if "full" not in skip:
-        sel = make_coda(preds, hp0)
-        labels = task.labels
-        state0 = sel.init(jax.random.PRNGKey(0))
+        labels = jax.device_put(jnp.asarray(task.labels))
+        state0 = jax.jit(
+            lambda p, k: make_coda(p, hp0).init(k)
+        )(preds, jax.random.PRNGKey(0))
+        jax.tree.map(np.asarray, state0)
 
-        def body_full(carry, i):
-            state, c = carry
-            res = sel.select(state,
-                             jax.random.fold_in(jax.random.PRNGKey(1), i))
-            state = sel.update(state, res.idx, labels[res.idx], res.prob)
-            best, _ = sel.best(state, jax.random.PRNGKey(2))
-            return state, c + best.astype(jnp.float32) * eps
+        # build the selector from ``preds`` INSIDE the traced program (the
+        # engine's selector_factory pattern) so the 2 GB tensor lowers as
+        # an argument, not an HLO constant — and OUTSIDE the loop via the
+        # setup hook so the one-time prior construction cannot be charged
+        # to the marginal
+        def setup_full(preds, labels):
+            sel = make_coda(preds, hp0)
 
-        stage("full:select+update+best step", body_full,
-              (state0, jnp.float32(0)))
+            def body_full(carry, i):
+                state, c = carry
+                res = sel.select(
+                    state, jax.random.fold_in(jax.random.PRNGKey(1), i))
+                state = sel.update(state, res.idx, labels[res.idx], res.prob)
+                best, _ = sel.best(state, jax.random.PRNGKey(2))
+                return state, c + best.astype(jnp.float32) * eps
+
+            return body_full
+
+        stage("full:select+update+best step", None,
+              (state0, jnp.float32(0)), ops=(preds, labels),
+              setup=setup_full)
 
     print(json.dumps({"shape": [H, N, C], "eig_chunk": CH, "num_points": G,
                       "backend": jax.default_backend(),
