@@ -48,6 +48,7 @@ def marginal_ms(body, carry0, n_hi: int, n_lo: int, reps: int,
     to the marginal even if XLA declines to hoist it.
     """
     import jax
+    import jax.numpy as jnp
     import numpy as np
     from jax import lax
 
@@ -56,14 +57,23 @@ def marginal_ms(body, carry0, n_hi: int, n_lo: int, reps: int,
         def f(c0, *ops):
             b = setup(*ops) if setup is not None else (
                 lambda c, i: body(c, i, *ops))
-            return lax.fori_loop(0, n, lambda i, c: b(c, i), c0)
+            out = lax.fori_loop(0, n, lambda i, c: b(c, i), c0)
+            # reduce the final carry to ONE scalar on device: materializing
+            # a 2 GB cache carry to host costs ~75 s (with multi-second
+            # jitter) through the axon tunnel, swamping any marginal. The
+            # full-tree sum forces every carried tensor to be computed, and
+            # the reduction runs once OUTSIDE the loop, so its cost cancels
+            # in (hi - lo).
+            leaves = [x.astype(jnp.float32).sum() if hasattr(x, "astype")
+                      else jnp.float32(x) for x in jax.tree.leaves(out)]
+            return sum(leaves, jnp.float32(0))
 
         out = f(carry0, *ops)
-        jax.tree.map(np.asarray, out)  # warm-up, forced to completion
+        np.asarray(out)  # warm-up, forced to completion
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            jax.tree.map(np.asarray, f(carry0, *ops))
+            np.asarray(f(carry0, *ops))
             ts.append(time.perf_counter() - t0)
         return ts
 
