@@ -56,8 +56,9 @@ _PEAK_FLOPS = {
 }
 
 # published HBM bandwidth per chip (bytes/s). The incremental EIG is
-# bandwidth-bound: its per-round FLOP/byte ratio is ~32 at the headline
-# config (8.3e10 FLOPs / 2.6e9 bytes with the delta pi-hat path), still
+# bandwidth-bound: its per-round FLOP/byte ratio is ~19-32 at the headline
+# config (8.3e10 FLOPs over 4.4e9 bytes with the exact pi-hat path that
+# 'auto' picks on TPU, 2.6e9 with the delta path it picks on CPU), still
 # far below the ~240 FLOP/byte machine balance of a v5e — so MBU against
 # this peak, not MFU against the matmul peak, is the roofline that
 # describes it.
@@ -171,60 +172,71 @@ def _flops_of(compiled) -> float:
 
 def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
                          mode: str = "auto",
-                         eig_cache_dtype: str = "float32") -> tuple:
-    """(flops_per_step, resolved_mode) from the kernels' documented shapes.
+                         eig_cache_dtype: str = "float32",
+                         pi_update: str = "auto") -> tuple:
+    """(flops_per_step, resolved_mode, resolved_pi_update) from the
+    kernels' documented shapes.
 
-    The mode is resolved by the SAME function ``make_coda`` uses
-    (``coda_tpu.selectors.coda.resolve_eig_mode``), so the reported FLOPs
-    always describe the kernel that actually ran. Per round:
+    The mode and pi-hat path are resolved by the SAME functions
+    ``make_coda`` uses (``resolve_eig_mode`` / ``resolve_pi_update``), so
+    the reported FLOPs always describe the kernels that actually ran. Per
+    round:
 
     Incremental EIG:
       * cache row refresh: three (N,H)x(H,G)-shaped einsums  -> 6·N·H·G
         (``update_eig_cache`` touches ONE class row per round)
-      * pi-hat delta refresh: gather + sum over models       -> 2·H·N
-        (``update_pi_hat_column_delta``, the pi_update='delta' default)
+      * pi-hat refresh: delta gather + sum over models       -> 2·H·N
+        (``update_pi_hat_column_delta``), or the exact column
+        einsum hs,hns->n over the full tensor                -> 2·H·N·C
+        (``update_pi_hat_column``, the TPU resolution of 'auto')
       * cache scoring (elementwise mixture entropies)        -> ~10·N·C·H
     Factored / rowscan EIG: the three einsums span all C class rows
     (identical FLOPs, different temps)                       -> 6·N·C·H·G
     plus the full pi-hat re-estimate hcs,hns->nc             -> 2·H·C²·N.
     """
     from coda_tpu.selectors import CODAHyperparams
-    from coda_tpu.selectors.coda import resolve_eig_mode
+    from coda_tpu.selectors.coda import resolve_eig_mode, resolve_pi_update
 
     # resolve with the SAME hyperparams the benched selector uses — the
     # cache dtype changes the auto budget, so omitting it here could
     # report a different tier than the one that ran
-    mode = resolve_eig_mode(
-        CODAHyperparams(eig_mode=mode, num_points=G,
-                        eig_cache_dtype=eig_cache_dtype), H, N, C)
+    hp = CODAHyperparams(eig_mode=mode, num_points=G,
+                         eig_cache_dtype=eig_cache_dtype,
+                         pi_update=pi_update)
+    mode = resolve_eig_mode(hp, H, N, C)
+    pi_res = resolve_pi_update(hp)
     if mode == "incremental":
-        return 6.0 * N * H * G + 2.0 * H * N + 10.0 * N * C * H, mode
-    return 6.0 * N * C * H * G + 2.0 * H * C * C * N, mode
+        pi_flops = 2.0 * H * N if pi_res == "delta" else 2.0 * H * N * C
+        return 6.0 * N * H * G + pi_flops + 10.0 * N * C * H, mode, pi_res
+    return 6.0 * N * C * H * G + 2.0 * H * C * C * N, mode, pi_res
 
 
-def _analytic_step_bytes(H: int, N: int, C: int, mode: str,
-                         cache_bytes: int = 4) -> float:
+def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
+                         cache_bytes: int = 4,
+                         pi_update: str) -> float:
     """Analytic HBM traffic per round (bytes), for the bandwidth roofline.
 
-    ``mode`` must be the ALREADY-RESOLVED tier (take it from
-    :func:`_analytic_step_flops`'s return, so the FLOP and byte models can
-    never describe different kernels).
+    ``mode`` and ``pi_update`` must be the ALREADY-RESOLVED tier and
+    pi-hat path (take them from :func:`_analytic_step_flops`'s return, so
+    the FLOP and byte models can never describe different kernels).
 
     Incremental EIG per round: the scoring pass streams the (N, C, H)
     cache once at its storage width (``cache_bytes``: 4 fp32, 2 when
-    eig_cache_dtype='bfloat16'); the pi-hat DELTA refresh
-    (pi_update='delta', the default) gathers H contiguous N-rows from the
-    loop-constant (C, H, N) fp32 layout — 4·H·N bytes, the C-fold cut that
-    replaced streaming the full tensor; the cache row refresh reads the
-    (N, H) int32 hard preds and writes the (N, H) row at cache width. The
-    factored/rowscan tiers recompute from the full (H, N, C) tensor and
-    stream the same-shaped fp32 hypothetical intermediates.
+    eig_cache_dtype='bfloat16'); the pi-hat refresh either gathers H
+    contiguous N-rows from the loop-constant (C, H, N) fp32 layout
+    (delta: 4·H·N bytes) or re-streams the full (H, N, C) tensor through
+    the exact column einsum (exact: 4·H·N·C bytes — measured at ~88% of a
+    v5e's HBM peak, which is why 'auto' picks it there); the cache row
+    refresh reads the (N, H) int32 hard preds and writes the (N, H) row at
+    cache width. The factored/rowscan tiers recompute from the full
+    (H, N, C) tensor and stream the same-shaped fp32 hypothetical
+    intermediates.
     """
     if mode == "incremental":
         cache = float(cache_bytes) * N * C * H
-        pi_gather = 4.0 * H * N
+        pi_bytes = 4.0 * H * N if pi_update == "delta" else 4.0 * H * N * C
         row = (4.0 + cache_bytes) * N * H
-        return cache + pi_gather + row
+        return cache + pi_bytes + row
     hyp = 4.0 * N * C * H
     preds = 4.0 * H * N * C
     return hyp + preds + 8.0 * N * H
@@ -260,7 +272,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     defaults = CODAHyperparams()._asdict()
     eig_opts = {**{k: defaults[k] for k in
                    ("eig_mode", "eig_backend", "eig_precision",
-                    "eig_cache_dtype")},
+                    "eig_cache_dtype", "pi_update")},
                 **(eig_opts or {})}
     # _mad of a single rep is 0, which would floor the noise at 1e-12 and
     # let any positive wall-clock delta pass linear_ok; the guard only
@@ -288,16 +300,25 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     marginal_step_s = dw / d_iters if d_iters else float("nan")
     overhead_s = wall - iters * marginal_step_s
 
-    flops_per_step, mode = _analytic_step_flops(
+    flops_per_step, mode, pi_res = _analytic_step_flops(
         H, N, C, mode=eig_opts["eig_mode"],
-        eig_cache_dtype=eig_opts["eig_cache_dtype"])
+        eig_cache_dtype=eig_opts["eig_cache_dtype"],
+        pi_update=eig_opts["pi_update"])
+    # resolve the scoring backend with the SAME function make_coda uses
+    # (and the same hyperparams _build_fn constructed) so the reported
+    # metadata names the kernel that actually ran
+    from coda_tpu.selectors.coda import resolve_eig_backend
+
+    backend_res = resolve_eig_backend(
+        CODAHyperparams(eig_chunk=eig_chunk, **eig_opts), mode)
 
     dev = jax.devices()[0]
     peak = _PEAK_FLOPS.get(dev.device_kind)
     peak_bw = _PEAK_HBM_BPS.get(dev.device_kind)
     bytes_per_step = _analytic_step_bytes(
         H, N, C, mode=mode,
-        cache_bytes=np.dtype(eig_opts["eig_cache_dtype"]).itemsize)
+        cache_bytes=np.dtype(eig_opts["eig_cache_dtype"]).itemsize,
+        pi_update=pi_res)
     achieved = (flops_per_step / marginal_step_s
                 if linear_ok and marginal_step_s > 0 else 0.0)
     achieved_bps = (bytes_per_step / marginal_step_s
@@ -320,9 +341,10 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
             "ok": linear_ok,
         },
         "eig_mode": mode,
-        "eig_backend": eig_opts["eig_backend"],
+        "eig_backend": backend_res,
         "eig_precision": eig_opts["eig_precision"],
         "eig_cache_dtype": eig_opts["eig_cache_dtype"],
+        "pi_update": pi_res,
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
         # MFU/MBU denominators are the ANALYTIC per-step models: the XLA
@@ -463,9 +485,10 @@ def main():
     ap.add_argument("--eig-mode", default="auto",
                     help="force a CODA EIG kernel tier (for comparisons); "
                          "auto = incremental when its cache fits")
-    ap.add_argument("--eig-backend", default="jnp",
-                    help="incremental-EIG scoring backend: jnp | pallas "
-                         "(fused single-HBM-pass TPU kernel)")
+    ap.add_argument("--eig-backend", default="auto",
+                    help="incremental-EIG scoring backend: auto (pallas on "
+                         "a single-chip TPU process, jnp elsewhere) | jnp | "
+                         "pallas (fused single-HBM-pass TPU kernel)")
     ap.add_argument("--eig-precision", default="highest",
                     choices=["highest", "high", "default"],
                     help="EIG table-einsum matmul precision: highest "
@@ -480,6 +503,10 @@ def main():
                     help="override the scoring-pass block size (0 = the "
                          "config default; the tuning knob for the "
                          "cache-stream pass)")
+    ap.add_argument("--pi-update", default="auto",
+                    choices=["auto", "delta", "exact"],
+                    help="incremental pi-hat refresh: auto (default) = "
+                         "exact on TPU / delta elsewhere")
     ap.add_argument("--skip-reference", action="store_true")
     ap.add_argument("--no-device-probe", action="store_true",
                     help="skip the pre-flight subprocess probe of the "
@@ -523,7 +550,8 @@ def main():
     # invalid as before.
     eig_opts = {"eig_mode": args.eig_mode, "eig_backend": args.eig_backend,
                 "eig_precision": args.eig_precision,
-                "eig_cache_dtype": args.eig_cache_dtype}
+                "eig_cache_dtype": args.eig_cache_dtype,
+                "pi_update": args.pi_update}
     for attempt in range(2):
         ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
                           reps=args.reps, eig_opts=eig_opts)
@@ -550,7 +578,7 @@ def main():
         "device_fallback": device_fallback,
         "compute": {k: ours[k] for k in
                     ("eig_mode", "eig_backend", "eig_precision",
-                     "eig_cache_dtype",
+                     "eig_cache_dtype", "pi_update",
                      "flops_per_step_analytic", "flop_accounting",
                      "flops_xla_scan_body_once", "achieved_flops_per_sec",
                      "peak_flops_per_sec", "mfu",

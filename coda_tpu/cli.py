@@ -85,10 +85,12 @@ def parse_args(argv=None):
                    help="EIG kernel: auto picks incremental (cached "
                         "per-class P(best), C-fold fewer FLOPs/round) when "
                         "its cache fits, else factored, else rowscan")
-    p.add_argument("--eig-backend", default="jnp",
-                   choices=["jnp", "pallas"],
+    p.add_argument("--eig-backend", default="auto",
+                   choices=["auto", "jnp", "pallas"],
                    help="incremental-EIG scoring backend: pallas = fused "
-                        "single-HBM-pass TPU kernel (interpreted off-TPU)")
+                        "single-HBM-pass TPU kernel (interpreted off-TPU); "
+                        "auto (default) = pallas on a single-chip TPU "
+                        "process, jnp elsewhere")
     p.add_argument("--eig-precision", default="highest",
                    choices=["highest", "high", "default"],
                    help="matmul precision of the EIG table einsums: highest "
@@ -99,11 +101,12 @@ def parse_args(argv=None):
                    help="storage dtype of the incremental P(best) cache: "
                         "bfloat16 halves the scoring pass's HBM stream "
                         "(opt-in numerics, like --eig-precision)")
-    p.add_argument("--pi-update", default="delta",
-                   choices=["delta", "exact"],
-                   help="incremental pi-hat refresh: delta = bandwidth-lean "
-                        "exact increment (default); exact = strict "
-                        "reference float choreography")
+    p.add_argument("--pi-update", default="auto",
+                   choices=["auto", "delta", "exact"],
+                   help="incremental pi-hat refresh: auto (default) = exact "
+                        "on TPU / delta elsewhere; delta = bandwidth-lean "
+                        "exact increment; exact = strict reference float "
+                        "choreography")
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
                    help="shard the (H,N,C) tensor, e.g. 'data=4' or 'data=4,model=2'")
     p.add_argument("--platform", default=None,
@@ -190,10 +193,10 @@ def build_selector_factory(args, task_name: str):
             q=args.q,
             eig_chunk=args.eig_chunk,
             eig_mode=getattr(args, "eig_mode", "auto"),
-            eig_backend=getattr(args, "eig_backend", "jnp"),
+            eig_backend=getattr(args, "eig_backend", "auto"),
             eig_precision=getattr(args, "eig_precision", "highest"),
             eig_cache_dtype=getattr(args, "eig_cache_dtype", "float32"),
-            pi_update=getattr(args, "pi_update", "delta"),
+            pi_update=getattr(args, "pi_update", "auto"),
             # vmapped seeds each carry their own incremental cache; the
             # auto eig_mode budget must see the whole batch. Runners with a
             # different execution width (the suite's dedup batches, future

@@ -62,5 +62,6 @@ print("init only :", fD)
 print("XLA step body (diff):", tuple(a-b for a,b in zip(fC, fD)))
 
 from bench import _analytic_step_flops, _analytic_step_bytes
-flops, mode = _analytic_step_flops(H, N, C)
-print("analytic:", (flops, mode), _analytic_step_bytes(H, N, C, mode=mode))
+flops, mode, pi_res = _analytic_step_flops(H, N, C)
+print("analytic:", (flops, mode, pi_res),
+      _analytic_step_bytes(H, N, C, mode=mode, pi_update=pi_res))

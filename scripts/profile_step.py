@@ -213,6 +213,43 @@ def main(argv=None):
 
     stage("carry:DUS leading-axis (C,N,H)", body_dus_lead, hypT)
 
+    # composed row-refresh + scoring, per backend, carrying the cache like
+    # the real scan does: if a backend's score call cannot alias the
+    # DUS-updated carry buffer (e.g. an opaque custom call forcing a
+    # layout/copy), the composition costs MORE than the sum of its
+    # isolated stages — exactly the regression signature to look for
+    def _compose(score_fn, order: str):
+        """order='update_first' mirrors an update->score chain;
+        'score_first' mirrors the real scan (select reads the carried
+        cache, update DUSes it afterwards)."""
+        def body(carry, i, dir0, hard, pi, pi_xi):
+            rows_c, hyp_c, c = carry
+            if order == "update_first":
+                rows2, hyp2 = update_eig_cache(dir0, i % C, hard,
+                                               rows_c, hyp_c, num_points=G)
+                s = score_fn(rows2, hyp2, pi + c * eps, pi_xi)
+            else:
+                s = score_fn(rows_c, hyp_c, pi + c * eps, pi_xi)
+                rows2, hyp2 = update_eig_cache(dir0, i % C, hard,
+                                               rows_c, hyp_c, num_points=G)
+            return rows2, hyp2, c + s[0] * eps
+
+        return body
+
+    def _score_jnp(r, h, p, px):
+        return eig_scores_from_cache(r, h, p, px, chunk=CH)
+
+    def _score_pallas(r, h, p, px):
+        from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
+
+        return eig_scores_cache_pallas(r, h, p, px, block=CH)
+
+    for order in ("update_first", "score_first"):
+        stage(f"compose:{order} jnp", _compose(_score_jnp, order),
+              (rows, hyp, jnp.float32(0)), ops=(dir0, hard, pi, pi_xi))
+        stage(f"compose:{order} pallas", _compose(_score_pallas, order),
+              (rows, hyp, jnp.float32(0)), ops=(dir0, hard, pi, pi_xi))
+
     def body_pi(u, i, dir0, preds):
         _, _, u2 = update_pi_hat_column(dir0, i % C, preds, u)
         return u2
@@ -251,6 +288,12 @@ def main(argv=None):
     # rebuilds its own (N, C, H) cache, ~2 GB at headline scale) only runs
     # when the stage isn't skipped.
     if "full" not in skip:
+        # free the standalone-stage tensors first: hyp + hypT + the
+        # selector state's own cache + a loop-carry copy + preds is >10 GB
+        # at headline — over a v5e's 16 GB HBM (observed ResourceExhausted)
+        for buf in (hyp, hypT, rows, unnorm, scores0, preds_by_class):
+            buf.delete()
+        del hyp, hypT, rows, unnorm, scores0, preds_by_class
         labels = jax.device_put(jnp.asarray(task.labels))
         state0 = jax.jit(
             lambda p, k: make_coda(p, hp0).init(k)

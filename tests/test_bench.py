@@ -34,25 +34,38 @@ def test_analytic_flops_follows_resolver():
 
     # headline config resolves incremental; flops must be the row-refresh
     # model, ~C-fold below the factored count
-    f_inc, m_inc = bench._analytic_step_flops(1000, 50_000, 10)
+    f_inc, m_inc, pi_inc = bench._analytic_step_flops(1000, 50_000, 10)
     assert m_inc == resolve_eig_mode(CODAHyperparams(), 1000, 50_000, 10)
     assert m_inc == "incremental"
-    f_fac, m_fac = bench._analytic_step_flops(1000, 50_000, 10,
-                                              mode="factored")
+    f_fac, m_fac, _ = bench._analytic_step_flops(1000, 50_000, 10,
+                                                 mode="factored")
     assert m_fac == "factored"
     assert f_fac / f_inc > 5  # C=10 cuts the dominant einsums ~10x
 
     # past the cache budget auto must fall back -> factored FLOPs
-    f_big, m_big = bench._analytic_step_flops(1000, 200_000, 10)
+    f_big, m_big, _ = bench._analytic_step_flops(1000, 200_000, 10)
     assert m_big == "factored"
     assert f_big > f_fac
 
-    # pin both models to the documented kernel shapes: incremental pays the
-    # one-column pi-hat refresh (update_pi_hat_column), factored the full
-    # C^2 pass (update_pi_hat)
+    # pin both models to the documented kernel shapes: incremental pays
+    # the resolved pi-hat refresh (delta gather on CPU, the exact column
+    # einsum on TPU), factored the full C^2 pass (update_pi_hat)
     H, N, C, G = 1000, 50_000, 10, 256
-    assert f_inc == 6.0 * N * H * G + 2.0 * H * N + 10.0 * N * C * H
+    pi_flops = 2.0 * H * N if pi_inc == "delta" else 2.0 * H * N * C
+    assert f_inc == 6.0 * N * H * G + pi_flops + 10.0 * N * C * H
     assert f_fac == 6.0 * N * C * H * G + 2.0 * H * C * C * N
+
+    # the pi_update resolution follows the explicit override
+    f_d, _, pi_d = bench._analytic_step_flops(1000, 50_000, 10,
+                                              pi_update="delta")
+    f_e, _, pi_e = bench._analytic_step_flops(1000, 50_000, 10,
+                                              pi_update="exact")
+    assert (pi_d, pi_e) == ("delta", "exact")
+    assert f_e - f_d == 2.0 * H * N * C - 2.0 * H * N
+    # and the byte model prices exact as the full-tensor stream
+    b_d = bench._analytic_step_bytes(H, N, C, "incremental", pi_update="delta")
+    b_e = bench._analytic_step_bytes(H, N, C, "incremental", pi_update="exact")
+    assert b_e - b_d == 4.0 * H * N * C - 4.0 * H * N
 
 
 def test_reference_baseline_cache_roundtrip(tmp_path, monkeypatch):
@@ -92,16 +105,19 @@ def test_analytic_step_bytes_matches_documented_traffic():
 
     H, N, C = 1000, 50_000, 10
     expected = 4.0 * N * C * H + 4.0 * H * N + 8.0 * N * H
-    assert _analytic_step_bytes(H, N, C, mode="incremental") == expected
+    assert _analytic_step_bytes(
+        H, N, C, mode="incremental", pi_update="delta") == expected
     expected_fac = 4.0 * N * C * H + 4.0 * H * N * C + 8.0 * N * H
-    assert _analytic_step_bytes(H, N, C, mode="factored") == expected_fac
+    assert _analytic_step_bytes(
+        H, N, C, mode="factored", pi_update="delta") == expected_fac
     # arithmetic intensity stays far below a v5e's ~240 FLOP/byte balance:
     # the kernel is bandwidth-bound and MBU is the honest roofline
     from bench import _analytic_step_flops
 
-    flops, mode = _analytic_step_flops(H, N, C)
+    flops, mode, pi_res = _analytic_step_flops(H, N, C)
     assert mode == "incremental"
-    assert flops / _analytic_step_bytes(H, N, C, mode=mode) < 60
+    assert flops / _analytic_step_bytes(
+        H, N, C, mode=mode, pi_update=pi_res) < 60
 
 
 def test_mbu_reported_against_known_chip():
