@@ -213,7 +213,7 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
 
 def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
                          cache_bytes: int = 4,
-                         pi_update: str) -> float:
+                         pi_update: str, backend: str = "jnp") -> float:
     """Analytic HBM traffic per round (bytes), for the bandwidth roofline.
 
     ``mode`` and ``pi_update`` must be the ALREADY-RESOLVED tier and
@@ -235,6 +235,13 @@ def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
     if mode == "incremental":
         cache = float(cache_bytes) * N * C * H
         pi_bytes = 4.0 * H * N if pi_update == "delta" else 4.0 * H * N * C
+        if backend == "pallas":
+            # fused refresh+score kernel: the donated cache is read AND
+            # fully rewritten each round (full-tile write), and the
+            # replacement row makes one extra write+read round trip
+            # ((N, H) fp32 out of the refresh einsums, into the kernel);
+            # the hard-pred read feeds the refresh einsums as before
+            return 2.0 * cache + pi_bytes + 12.0 * N * H
         row = (4.0 + cache_bytes) * N * H
         return cache + pi_bytes + row
     hyp = 4.0 * N * C * H
@@ -318,7 +325,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     bytes_per_step = _analytic_step_bytes(
         H, N, C, mode=mode,
         cache_bytes=np.dtype(eig_opts["eig_cache_dtype"]).itemsize,
-        pi_update=pi_res)
+        pi_update=pi_res, backend=backend_res)
     achieved = (flops_per_step / marginal_step_s
                 if linear_ok and marginal_step_s > 0 else 0.0)
     achieved_bps = (bytes_per_step / marginal_step_s

@@ -506,6 +506,30 @@ def update_eig_cache(
     full kernel's O(N·C·H·G), the C-fold saving that makes the EIG
     incremental.
     """
+    row_t, hyp_t = update_eig_cache_parts(
+        dirichlets, true_class, hard_preds, update_weight, num_points,
+        precision)
+    return (
+        pbest_rows.at[true_class].set(row_t),
+        # store at the cache's own dtype (fp32 math, bf16 storage when the
+        # eig_cache_dtype knob is on)
+        pbest_hyp.at[:, true_class, :].set(hyp_t.astype(pbest_hyp.dtype)),
+    )
+
+
+def update_eig_cache_parts(
+    dirichlets: jnp.ndarray,   # (H, C, C) — ALREADY holding the new label
+    true_class: jnp.ndarray,   # scalar int
+    hard_preds: jnp.ndarray,   # (N, H) int32
+    update_weight: float = 1.0,
+    num_points: int = 256,
+    precision=_PRECISION,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The refreshed class-row values WITHOUT writing them into the cache:
+    ``(row_t (H,), hyp_t (N, H))``. The jnp path DUSes them in
+    (:func:`update_eig_cache`); the fused pallas path hands ``hyp_t`` to
+    the refresh+score kernel, which writes the row while scoring so the
+    cache never round-trips through an XLA copy."""
     a_cc, b_cc = dirichlet_to_beta(dirichlets)       # (H, C)
     a_t = jnp.take(a_cc, true_class, axis=1)         # (H,)
     b_t = jnp.take(b_cc, true_class, axis=1)
@@ -513,12 +537,7 @@ def update_eig_cache(
     hyp_t = _pbest_hyp_row(a_t, b_t, eq_t, update_weight, num_points,
                            precision)
     row_t = compute_pbest(a_t, b_t, num_points=num_points)       # (H,)
-    return (
-        pbest_rows.at[true_class].set(row_t),
-        # store at the cache's own dtype (fp32 math, bf16 storage when the
-        # eig_cache_dtype knob is on)
-        pbest_hyp.at[:, true_class, :].set(hyp_t.astype(pbest_hyp.dtype)),
-    )
+    return row_t, hyp_t
 
 
 def _pbest_hyp_row(a_t, b_t, eq_t, update_weight: float, num_points: int,
@@ -977,11 +996,25 @@ def make_coda(
                 pi_xi, pi, unnorm = update_pi_hat_column(
                     dirichlets, true_class, preds, state.pi_xi_unnorm
                 )
-            rows, hyp = update_eig_cache(dirichlets, true_class, hard_preds,
-                                         state.pbest_rows, state.pbest_hyp,
-                                         num_points=hp.num_points,
-                                         precision=eig_precision)
-            scores = _score_cache(rows, hyp, pi, pi_xi)
+            if eig_backend == "pallas":
+                # fused refresh+score: the cache is donated through the
+                # kernel, so the scan carry never pays the XLA defensive
+                # copy a DUS + opaque-custom-call sequence provokes
+                from coda_tpu.ops.pallas_eig import eig_scores_refresh_pallas
+
+                row_t, hyp_t = update_eig_cache_parts(
+                    dirichlets, true_class, hard_preds,
+                    num_points=hp.num_points, precision=eig_precision)
+                rows = state.pbest_rows.at[true_class].set(row_t)
+                scores, hyp = eig_scores_refresh_pallas(
+                    rows, state.pbest_hyp, hyp_t, true_class, pi, pi_xi,
+                    block=hp.eig_chunk)
+            else:
+                rows, hyp = update_eig_cache(
+                    dirichlets, true_class, hard_preds,
+                    state.pbest_rows, state.pbest_hyp,
+                    num_points=hp.num_points, precision=eig_precision)
+                scores = _score_cache(rows, hyp, pi, pi_xi)
         else:
             pi_xi, pi = update_pi_hat(dirichlets, preds)
             unnorm = rows = hyp = scores = None

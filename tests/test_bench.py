@@ -130,3 +130,18 @@ def test_mbu_reported_against_known_chip():
     from bench import _PEAK_FLOPS
 
     assert set(_PEAK_HBM_BPS) == set(_PEAK_FLOPS)
+
+
+def test_analytic_bytes_prices_fused_pallas_backend():
+    """The fused refresh+score kernel reads AND rewrites the donated cache
+    (full-tile write) — the byte model must charge both, or MBU on TPU is
+    silently understated ~1.5x."""
+    from bench import _analytic_step_bytes
+
+    H, N, C = 1000, 50_000, 10
+    jnp_b = _analytic_step_bytes(H, N, C, "incremental", pi_update="exact")
+    pal_b = _analytic_step_bytes(H, N, C, "incremental", pi_update="exact",
+                                 backend="pallas")
+    cache = 4.0 * N * C * H
+    assert pal_b == 2.0 * cache + 4.0 * H * N * C + 12.0 * N * H
+    assert pal_b > jnp_b

@@ -154,3 +154,57 @@ def test_choose_block_budgets_padded_vmem():
     assert B % 8 == 0
     # a logical-bytes budget would have chosen ~1.6x more rows
     assert B < _VMEM_TILE_BYTES // (4 * C * H)
+
+
+def test_fused_refresh_score_matches_dus_then_score():
+    """The fused refresh+score kernel == DUS the new row in, then score —
+    scores AND the returned cache, including a ragged final block."""
+    from coda_tpu.ops.pallas_eig import eig_scores_refresh_pallas
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    for (N, C, H, blk) in [(300, 5, 12, 64), (77, 4, 9, 32)]:
+        rows, hyp, pi, pi_xi = _random_cache(jax.random.PRNGKey(2), N, C, H)
+        hyp_t = jax.random.uniform(jax.random.PRNGKey(3), (N, H)) + 0.1
+        hyp_t /= hyp_t.sum(-1, keepdims=True)
+        c = jnp.int32(C - 1)
+
+        hyp_ref = hyp.at[:, c, :].set(hyp_t)
+        ref = np.asarray(eig_scores_from_cache(rows, hyp_ref, pi, pi_xi,
+                                               chunk=blk))
+        scores, hyp_out = eig_scores_refresh_pallas(
+            rows, hyp, hyp_t, c, pi, pi_xi, block=blk, interpret=True)
+        np.testing.assert_allclose(ref, np.asarray(scores),
+                                   rtol=1e-4, atol=1e-6)
+        assert int(ref.argmax()) == int(np.asarray(scores).argmax())
+        np.testing.assert_array_equal(np.asarray(hyp_ref),
+                                      np.asarray(hyp_out))
+
+
+def test_fused_refresh_score_bf16_cache():
+    """bf16 storage: the returned cache keeps the storage dtype and the
+    refreshed row equals the bf16-rounded replacement values."""
+    from coda_tpu.ops.pallas_eig import eig_scores_refresh_pallas
+
+    rows, hyp, pi, pi_xi = _random_cache(jax.random.PRNGKey(4), 96, 3, 10)
+    hyp16 = hyp.astype(jnp.bfloat16)
+    hyp_t = jax.random.uniform(jax.random.PRNGKey(5), (96, 10)) + 0.1
+    hyp_t /= hyp_t.sum(-1, keepdims=True)
+    c = jnp.int32(1)
+    scores, hyp_out = eig_scores_refresh_pallas(
+        rows, hyp16, hyp_t, c, pi, pi_xi, block=32, interpret=True)
+    assert hyp_out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(hyp_out[:, 1, :]),
+        np.asarray(hyp_t.astype(jnp.bfloat16)))
+    # untouched rows carry over bitwise
+    np.testing.assert_array_equal(np.asarray(hyp_out[:, 0, :]),
+                                  np.asarray(hyp16[:, 0, :]))
+    # SCORE parity with DUS-then-score: the kernel must score the
+    # bf16-ROUNDED replacement row, not the raw fp32 values
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    ref = np.asarray(eig_scores_from_cache(
+        rows, hyp16.at[:, 1, :].set(hyp_t.astype(jnp.bfloat16)),
+        pi, pi_xi, chunk=32))
+    np.testing.assert_allclose(ref, np.asarray(scores),
+                               rtol=1e-4, atol=1e-6)
