@@ -139,6 +139,38 @@ def eig_scores_cache_pallas(
     """
     if interpret is None:  # Mosaic compiles only on real TPUs
         interpret = jax.default_backend() != "tpu"
+
+    # under vmap, fall back to the jnp path: a batched pallas_call turns
+    # the batch into an extra grid/block dimension whose (8, 128) padding
+    # inflates the small (B, 1)/(B, C) tiles into full lane-rows — the
+    # suite's width-1 seed probe hit scoped-VMEM OOM exactly this way on a
+    # v5e (16.44M vs the 16M limit at the msv shape) — and batched runs
+    # are multi-experiment workloads where the XLA path is the right tier
+    # anyway (same reasoning as resolve_eig_backend's n_parallel guard)
+    from jax import custom_batching
+
+    @custom_batching.custom_vmap
+    def _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi):
+        return _scores_impl(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi,
+                            block, interpret)
+
+    @_call.def_vmap
+    def _call_vmap(axis_size, in_batched, rows_b, hyp_b, pi_b, pi_xi_b):
+        from coda_tpu.selectors.coda import eig_scores_from_cache
+
+        in_axes = [0 if b else None for b in in_batched]
+        out = jax.vmap(
+            lambda r, h, p, px: eig_scores_from_cache(
+                r, h, p, px, chunk=block or 2048),
+            in_axes=in_axes,
+        )(rows_b, hyp_b, pi_b, pi_xi_b)
+        return out, True
+
+    return _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
+
+
+def _scores_impl(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi,
+                 block: int, interpret: bool) -> jnp.ndarray:
     N, C, H = pbest_hyp.shape
     B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize)
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
@@ -234,6 +266,40 @@ def eig_scores_refresh_pallas(
     """
     if interpret is None:  # Mosaic compiles only on real TPUs
         interpret = jax.default_backend() != "tpu"
+
+    # same vmap fallback as eig_scores_cache_pallas: batched pallas tiles
+    # pad pathologically, so a vmapped caller gets the equivalent
+    # DUS-then-score jnp composition instead
+    from jax import custom_batching
+
+    @custom_batching.custom_vmap
+    def _call(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat, pi_hat_xi):
+        return _refresh_impl(pbest_rows, pbest_hyp, hyp_t, true_class,
+                             pi_hat, pi_hat_xi, block, interpret)
+
+    @_call.def_vmap
+    def _call_vmap(axis_size, in_batched, rows_b, hyp_b, hyp_t_b, c_b,
+                   pi_b, pi_xi_b):
+        from coda_tpu.selectors.coda import eig_scores_from_cache
+
+        in_axes = [0 if b else None for b in in_batched]
+
+        def one(rows, hyp, hyp_t, c, pi, pi_xi):
+            hyp2 = hyp.at[:, c, :].set(hyp_t.astype(hyp.dtype))
+            scores = eig_scores_from_cache(rows, hyp2, pi, pi_xi,
+                                           chunk=block or 2048)
+            return scores, hyp2
+
+        out = jax.vmap(one, in_axes=in_axes)(
+            rows_b, hyp_b, hyp_t_b, c_b, pi_b, pi_xi_b)
+        return out, (True, True)
+
+    return _call(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat,
+                 pi_hat_xi)
+
+
+def _refresh_impl(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat,
+                  pi_hat_xi, block: int, interpret: bool):
     N, C, H = pbest_hyp.shape
     B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize,
                      n_cache_streams=2)

@@ -208,3 +208,46 @@ def test_fused_refresh_score_bf16_cache():
         pi, pi_xi, chunk=32))
     np.testing.assert_allclose(ref, np.asarray(scores),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_pallas_kernels_vmap_fallback():
+    """vmapped pallas scorers must fall back to the jnp path (batched
+    pallas tiles pad pathologically on TPU — observed scoped-VMEM OOM on
+    the suite's width-1 seed probe) and still match per-element results."""
+    from coda_tpu.ops.pallas_eig import (
+        eig_scores_cache_pallas,
+        eig_scores_refresh_pallas,
+    )
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    B = 3
+    keys = jax.random.split(jax.random.PRNGKey(7), B)
+    packs = [_random_cache(k, 64, 4, 10) for k in keys]
+    rows = jnp.stack([p[0] for p in packs])
+    hyp = jnp.stack([p[1] for p in packs])
+    pi = jnp.stack([p[2] for p in packs])
+    pi_xi = jnp.stack([p[3] for p in packs])
+
+    out = jax.vmap(
+        lambda r, h, p, px: eig_scores_cache_pallas(r, h, p, px, block=32)
+    )(rows, hyp, pi, pi_xi)
+    ref = jax.vmap(
+        lambda r, h, p, px: eig_scores_from_cache(r, h, p, px, chunk=32)
+    )(rows, hyp, pi, pi_xi)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-4, atol=1e-6)
+
+    hyp_t = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(8), (B, 64, 10)), axis=-1)
+    cs = jnp.arange(B, dtype=jnp.int32) % 4
+    s_f, hyp_f = jax.vmap(
+        lambda r, h, ht, c, p, px: eig_scores_refresh_pallas(
+            r, h, ht, c, p, px, block=32)
+    )(rows, hyp, hyp_t, cs, pi, pi_xi)
+    for b in range(B):
+        hyp2 = hyp[b].at[:, cs[b], :].set(hyp_t[b])
+        ref_b = eig_scores_from_cache(rows[b], hyp2, pi[b], pi_xi[b],
+                                      chunk=32)
+        np.testing.assert_allclose(np.asarray(ref_b), np.asarray(s_f[b]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(hyp2), np.asarray(hyp_f[b]))
