@@ -220,9 +220,12 @@ def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
     pi-hat path (take them from :func:`_analytic_step_flops`'s return, so
     the FLOP and byte models can never describe different kernels).
 
-    Incremental EIG per round: the scoring pass streams the (N, C, H)
+    Incremental EIG per round: the scoring pass streams the (C, N, H)
     cache once at its storage width (``cache_bytes``: 4 fp32, 2 when
-    eig_cache_dtype='bfloat16'); the pi-hat refresh either gathers H
+    eig_cache_dtype='bfloat16') — and with the (C, N, H) layout the
+    physical HBM bytes match the logical count to ~H/ceil128(H) (the old
+    (N, C, H) layout's 16-sublane pad at headline C=10 taxed every pass
+    with 1.6x the logical bytes); the pi-hat refresh either gathers H
     contiguous N-rows from the loop-constant (C, H, N) fp32 layout
     (delta: 4·H·N bytes) or re-streams the full (H, N, C) tensor through
     the exact column einsum (exact: 4·H·N·C bytes — measured at ~88% of a
@@ -236,12 +239,14 @@ def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
         cache = float(cache_bytes) * N * C * H
         pi_bytes = 4.0 * H * N if pi_update == "delta" else 4.0 * H * N * C
         if backend == "pallas":
-            # fused refresh+score kernel: the donated cache is read AND
-            # fully rewritten each round (full-tile write), and the
-            # replacement row makes one extra write+read round trip
-            # ((N, H) fp32 out of the refresh einsums, into the kernel);
-            # the hard-pred read feeds the refresh einsums as before
-            return 2.0 * cache + pi_bytes + 12.0 * N * H
+            # fused refresh+score kernel: the donated cache is READ once;
+            # only the refreshed (N, H) class row is written back (the
+            # row-only aliased write — scalar-prefetch indexed BlockSpec),
+            # and the replacement row makes one extra write+read round
+            # trip ((N, H) fp32 out of the refresh einsums, into the
+            # kernel); the hard-pred read feeds the refresh einsums as
+            # before: 4 (hard preds) + 4 out + 4 in + cache_bytes written
+            return cache + pi_bytes + (12.0 + cache_bytes) * N * H
         row = (4.0 + cache_bytes) * N * H
         return cache + pi_bytes + row
     hyp = 4.0 * N * C * H

@@ -1,17 +1,41 @@
-"""Pallas TPU kernel for the incremental-EIG scoring pass.
+"""Pallas TPU kernels for the incremental-EIG scoring pass.
 
 The incremental CODA selector scores a round by streaming the cached
-``(N, C, H)`` hypothetical-P(best) tensor once and reducing it to ``(N,)``
+``(C, N, H)`` hypothetical-P(best) tensor once and reducing it to ``(N,)``
 expected-entropy drops (see ``coda_tpu.selectors.coda.eig_scores_from_cache``
 — identical math). At the headline config the cache is 2 GB, so the pass is
-HBM-bandwidth-bound; this kernel tiles N into VMEM-resident blocks and fuses
+HBM-bandwidth-bound; these kernels tile N into VMEM-resident blocks and fuse
 the whole chain — mixture delta, clamp, log2 entropy, class mixture — into
-one read of each cache element, with no intermediate (B, C, H) tensors ever
-returning to HBM.
+one read of each cache element, with no intermediate tensors ever returning
+to HBM.
+
+Layout: the cache is carried ``(C, N, H)`` — N and H in the two minor dims —
+so the (8, 128) physical tiling pads only H (1000 -> 1024, +2.4%). The
+previous ``(N, C, H)`` layout put C in the sublane dim, and at the headline
+C=10 the pad to 16 sublanes taxed EVERY HBM pass with 1.6x the logical
+bytes (measured round 4: the fused kernel moved 6.7 GB physical for 4.2 GB
+logical). The row refresh also becomes a leading-index update, and the fused
+kernel writes ONLY the refreshed class row — a ``(1, N, H)`` slice — via
+scalar-prefetch block indexing instead of rewriting the whole cache.
+
+Kernel-shape notes (hardware-calibrated on a v5e, round 4): the bodies are
+fully vectorized over the (C, B, H) tile — a per-class Python loop with
+``pi_xi_ref[:, ci]`` lane extracts and 1-D (B,) intermediates lowered to
+relayout-heavy Mosaic code that ran SLOWER than the XLA jnp path (10.1 vs
+6.2 ms at headline). Every broadcast operand is pre-shaped in XLA (pi_hat
+``(C, 1, 1)``, rows ``(C, 1, H)``, pi_xi transposed to ``(C, N, 1)``) so
+the kernel contains no transposes or relayouts: the weighted class
+reduction is ``(pi_xi_t * h_after).sum(axis=0)`` on ``(C, B, 1)`` operands,
+whose output IS the ``(B, 1)`` score block. The ``(C, N, 1)`` pi_xi layout
+is legal tiling because its LANE dim is the size-1 axis (lane dim must be a
+multiple of 128 or span the array), while a ``(C, B)`` tile of a ``(C, N)``
+array would put B in the lane dim and be rejected for B % 128 != 0.
 
 The jnp reference path remains the default everywhere; the kernel is opt-in
 via ``CODAHyperparams(eig_backend="pallas")`` / ``--eig-backend pallas``. On
-non-TPU backends it runs in interpreter mode (tests exercise it on CPU).
+non-TPU backends it runs in interpreter mode (tests exercise it on CPU,
+including the row-only aliased write: interpret mode preserves the donated
+buffer's unwritten blocks, verified in tests/test_pallas_eig.py).
 Single-device only: ``pallas_call`` is an opaque custom call that GSPMD
 cannot partition, so ``make_coda`` rejects the combination of this backend
 with a multi-device-sharded prediction tensor.
@@ -23,92 +47,95 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _ENTROPY_FLOOR = 1e-12  # reference clamp, see ops/masked.py entropy2
-
-
-def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
-                        hyp_ref, pi_xi_ref, out_ref):
-    """One N-tile: (B, C, H) cache block -> (B, 1) scores.
-
-    Refs: mixture0 (1, H); h_before (1, 1); pi_hat (1, C); rows (C, H);
-    hyp (B, C, H); pi_xi (B, C); out (B, 1) — 2-D so the N-tile only needs
-    sublane (x8) alignment, not the x128 lane alignment a 1-D out would.
-    """
-    mixture0 = mixture0_ref[0, :]                    # (H,)
-    pi_hat = pi_hat_ref[0, :]                        # (C,)
-    # storage may be bf16 (eig_cache_dtype); all math runs fp32
-    hyp = hyp_ref[:].astype(mixture0.dtype)          # (B, C, H)
-    delta = hyp - rows_ref[:][None]                  # (B, C, H)
-    mix = mixture0[None, None, :] + pi_hat[None, :, None] * delta
-    p = jnp.maximum(mix, _ENTROPY_FLOOR)
-    h_after = -(p * (jnp.log(p) * 1.4426950408889634)).sum(axis=-1)  # (B, C)
-    scores = h_before_ref[0, 0] - (pi_xi_ref[:] * h_after).sum(axis=-1)
-    out_ref[:] = scores[:, None]
-
+_LOG2E = 1.4426950408889634
 
 _SCOPED_VMEM_BYTES = 16 << 20  # Mosaic's default scoped-vmem limit
-_VMEM_MARGIN_BYTES = 1 << 20   # stack + the single-buffered broadcast refs
-# the pipelined grid operands (hyp tile, pi_xi tile, out tile) are DOUBLE-
-# buffered by pallas; the budget below models 2x their padded footprint.
-# First hardware run (round 4) proved the point: an 8 MB tile target that
-# ignored double buffering landed at 16.12 MB scoped — 128.5 KB over the
-# 16 MB limit (2x8 MB hyp + 2x64 KB padded out + small refs), and Mosaic
-# refused to compile.
-_VMEM_TILE_BYTES = (_SCOPED_VMEM_BYTES - _VMEM_MARGIN_BYTES) // 2
+_VMEM_MARGIN_BYTES = 3 << 19   # 1.5 MB: the single-buffered broadcast refs
+#                                (mixture0/pi_hat/rows) + fixed stack slop
+# the pipelined grid operands (cache tile, row tiles, score tile) are DOUBLE-
+# buffered by pallas — the budget models 2x their padded footprint — and the
+# kernel body's live fp32 vector temporaries land on the scoped-vmem STACK
+# (single-buffered). Both terms are hardware-calibrated on a v5e (round 4):
+# an 8 MB tile target that ignored double buffering landed 128.5 KB over
+# the 16 MB limit, and a budget that ignored the stack temps landed 1.45 MB
+# over at a ragged shape.
+_TEMP_TILES = 2  # live fp32 (C, B, Hp)-shaped kernel temporaries (the
+#                  delta/mix chain before the entropy reduce), per unit of B
 
 
-def _padded_row_bytes(C: int, H: int, itemsize: int = 4) -> int:
-    """Physical VMEM bytes of ONE N-row of the (B, C, H) cache tile.
-
-    Mosaic lays vector memory out in (8, 128) fp32 / (16, 128) bf16 tiles
-    over the two minor dims, so a (C, H) slice occupies
-    ceil(C/sub)*sub x ceil(H/128)*128 elements regardless of the logical
-    shape — at the headline (C=10, H=1000) fp32 that is 16 x 1024 = 1.6x
-    the logical bytes. Budgeting with logical sizes would overshoot VMEM
-    by exactly that factor on the first hardware run.
-    """
-    sub = 16 if itemsize == 2 else 8
-    Cp = -(-C // sub) * sub
-    Hp = -(-H // 128) * 128
-    return itemsize * Cp * Hp
+def _lane_padded(H: int) -> int:
+    """H rounded up to the 128-lane minor-dim tile."""
+    return -(-H // 128) * 128
 
 
 def choose_block(N: int, C: int, H: int, block: int = 0,
-                 itemsize: int = 4, n_cache_streams: int = 1) -> int:
-    """The N-tile size: sublane-aligned (x8) under the VMEM budget, or all
-    of N when it fits — the two shapes Mosaic accepts for the (B, C) /
-    (B, 1) blocks without host-padding the cache. The budget is computed
-    against the PADDED physical tile (see :func:`_padded_row_bytes`) at
-    the cache's ``itemsize``. The x8 hardware minimum wins over a smaller
-    caller ``block`` cap (a cap below 8 cannot lower the tile's VMEM
-    footprint further)."""
-    # budget against the FP32 COMPUTE footprint even for bf16 storage: the
-    # kernel upcasts the whole tile (delta/mix/entropy run fp32), so a
-    # bf16-sized cap would double B and blow VMEM on hardware — bf16's win
-    # is the halved HBM stream, not a bigger tile
-    # pi_xi (B, C) and out (B, 1) rows, padded to the 128-lane minor dim
-    xi_row = 4 * (-(-C // 128) * 128)
-    out_row = 4 * 128
-    # n_cache_streams: how many (B, C, H)-shaped tiles the kernel pipelines
-    # per N-row — 1 for the score-only kernel, 2 for the fused
-    # refresh+score kernel (cache in + aliased cache out), which also
-    # streams the (B, H) replacement-row tile
-    hyp_t_row = 4 * (-(-H // 128) * 128) if n_cache_streams > 1 else 0
-    per_row = (n_cache_streams * _padded_row_bytes(C, H, max(itemsize, 4))
-               + hyp_t_row + xi_row + out_row)
-    vmem_cap = max(8, _VMEM_TILE_BYTES // max(1, per_row))
+                 itemsize: int = 4, fused: bool = False) -> int:
+    """The N-tile size: sublane-aligned under the VMEM budget, or all of N
+    when it fits.
+
+    The cache tile is ``(C, B, H)`` — B in the sublane dim, so it must be a
+    multiple of the hardware sublane tile (8 fp32 / 16 bf16) or span N; H
+    pads to the 128-lane tile. Per unit of B the pipelined streams cost
+    ``itemsize*C*Hp`` (cache tile) plus, for the fused kernel, the fp32
+    ``hyp_t`` row in and the storage-width refreshed row out, plus the
+    lane-padded ``(C, B, 1)`` pi_xi and ``(B, 1)`` score rows; the fp32
+    compute temporaries add ``_TEMP_TILES`` single-buffered (C, B, Hp)
+    tiles. The x8/x16 hardware minimum wins over a smaller caller ``block``
+    cap (a cap below the sublane tile cannot lower the VMEM footprint
+    further)."""
+    sub = 16 if itemsize == 2 else 8
+    Hp = _lane_padded(H)
+    stream_row = itemsize * C * Hp
+    if fused:
+        stream_row += (4 + itemsize) * Hp    # hyp_t in (fp32) + row out
+    stream_row += 4 * 128 * C + 4 * 128      # pi_xi_t rows + score row
+    # solve 2*B*stream_row (double-buffered pipeline) + B*temp_row (stack
+    # temps, single-buffered) + margin <= the scoped limit for B
+    temp_row = _TEMP_TILES * 4 * C * Hp
+    budget = _SCOPED_VMEM_BYTES - _VMEM_MARGIN_BYTES
+    vmem_cap = max(sub, budget // max(1, 2 * stream_row + temp_row))
     cap = min(block, vmem_cap) if block else vmem_cap
-    if N <= max(cap, 8):
+    if N <= max(cap, sub):
         return N
-    return max(8, (cap // 8) * 8)
+    return max(sub, (cap // sub) * sub)
+
+
+def _weighted_entropy_scores(hyp, mixture0_ref, h_before_ref, pi_hat_ref,
+                             rows_ref, pi_xi_t_ref):
+    """(B, 1) scores from a fp32 (C, B, H) tile — the shared kernel tail.
+
+    All math fp32, fully vectorized; reduction order matches the jnp
+    path's (entropy over H, then weighted class sum over axis 0)."""
+    delta = hyp - rows_ref[:]                            # (C, B, H)-(C,1,H)
+    mix = mixture0_ref[:] + pi_hat_ref[:] * delta
+    p = jnp.maximum(mix, _ENTROPY_FLOOR)
+    h_after = -(p * (jnp.log(p) * _LOG2E)).sum(axis=-1, keepdims=True)
+    return h_before_ref[0, 0] - (pi_xi_t_ref[:] * h_after).sum(axis=0)
+
+
+def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
+                        hyp_ref, pi_xi_t_ref, out_ref):
+    """One N-tile: (C, B, H) cache block -> (B, 1) scores.
+
+    Refs: mixture0 (1, 1, H); h_before (1, 1); pi_hat (C, 1, 1); rows
+    (C, 1, H); hyp (C, B, H); pi_xi_t (C, B, 1); out (B, 1) — 2-D so the
+    N-tile only needs sublane (x8) alignment. Storage may be bf16
+    (eig_cache_dtype); all math runs fp32.
+    """
+    hyp = hyp_ref[:].astype(jnp.float32)
+    out_ref[:] = _weighted_entropy_scores(
+        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
 def eig_scores_cache_pallas(
     pbest_rows: jnp.ndarray,   # (C, H)
-    pbest_hyp: jnp.ndarray,    # (N, C, H)
+    pbest_hyp: jnp.ndarray,    # (C, N, H)
     pi_hat: jnp.ndarray,       # (C,)
     pi_hat_xi: jnp.ndarray,    # (N, C)
     block: int = 0,
@@ -118,35 +145,28 @@ def eig_scores_cache_pallas(
 
     Matches ``eig_scores_from_cache`` numerics: same mixture-delta, the same
     1e-12 entropy floor, log2 via ln·log2(e) (the same lowering XLA emits
-    for ``jnp.log2``). ``block`` is a CAP on the N-tile; the actual tile
-    targets ~7.5 MB of VMEM per (B, C, H) block — half the 16 MB scoped
-    limit minus a margin, because pallas double-buffers the pipelined
-    operands (fp32 compute footprint regardless of storage dtype; block=0
-    means "derive from VMEM alone"). The x8 sublane minimum floors the
-    tile at 8 rows =
-    32*C*H bytes, which exceeds the target once C*H > ~256k elements and
-    keeps growing linearly with C*H — that regime is exercised only in
-    interpret-mode tests, not on hardware (the jnp path is the safe choice
-    there).
+    for ``jnp.log2``), same reduction order. ``block`` is a CAP on the
+    N-tile; the actual tile is derived from the VMEM budget (see
+    :func:`choose_block`; block=0 means "derive from VMEM alone").
 
     Blocking obeys the TPU tiling rules (a block dim must be a multiple of
-    its hardware tile or span the whole array dim): the (C, H) minor dims
-    always span the array, the N-tile is sublane-aligned (x8) — legal for
-    the (B, C) pi_xi block and the (B, 1) out block — and a ragged final
-    block is left to pallas' edge masking rather than host-padding the
-    cache (a jnp.pad here would copy the whole 2 GB tensor every round, on
-    a pass whose point is a single HBM read).
+    its hardware tile or span the whole array dim): the H minor dim always
+    spans the array, the N-tile is sublane-aligned (x8 fp32 / x16 bf16) —
+    legal for the (C, B, 1) pi_xi block and the (B, 1) out block — and a
+    ragged final block is left to pallas' edge masking rather than
+    host-padding the cache (a jnp.pad here would copy the whole 2 GB tensor
+    every round, on a pass whose point is a single HBM read).
     """
     if interpret is None:  # Mosaic compiles only on real TPUs
         interpret = jax.default_backend() != "tpu"
 
     # under vmap, fall back to the jnp path: a batched pallas_call turns
     # the batch into an extra grid/block dimension whose (8, 128) padding
-    # inflates the small (B, 1)/(B, C) tiles into full lane-rows — the
-    # suite's width-1 seed probe hit scoped-VMEM OOM exactly this way on a
-    # v5e (16.44M vs the 16M limit at the msv shape) — and batched runs
-    # are multi-experiment workloads where the XLA path is the right tier
-    # anyway (same reasoning as resolve_eig_backend's n_parallel guard)
+    # inflates the small (B, 1) tiles into full lane-rows — the suite's
+    # width-1 seed probe hit scoped-VMEM OOM exactly this way on a v5e —
+    # and batched runs are multi-experiment workloads where the XLA path
+    # is the right tier anyway (same reasoning as resolve_eig_backend's
+    # n_parallel guard)
     from jax import custom_batching
 
     @custom_batching.custom_vmap
@@ -169,78 +189,79 @@ def eig_scores_cache_pallas(
     return _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
 
 
-def _scores_impl(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi,
-                 block: int, interpret: bool) -> jnp.ndarray:
-    N, C, H = pbest_hyp.shape
-    B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize)
+def _mixture_stats(pbest_rows, pi_hat):
+    """(mixture0 (1,1,H), h_before (1,1)) — the cheap pre-kernel scalars."""
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
     pc = jnp.clip(mixture0, _ENTROPY_FLOOR, None)
     h_before = -(pc * jnp.log2(pc)).sum()
+    return mixture0[None, None, :], h_before[None, None]
 
+
+def _scores_impl(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi,
+                 block: int, interpret: bool) -> jnp.ndarray:
+    C, N, H = pbest_hyp.shape
+    B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize)
+    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat)
     n_blocks = -(-N // B)
 
     out = pl.pallas_call(
         _score_block_kernel,
-        out_shape=jax.ShapeDtypeStruct((N, 1), mixture0.dtype),
+        out_shape=jax.ShapeDtypeStruct((N, 1), jnp.float32),
         grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((1, H), lambda i: (0, 0)),          # mixture0
+            pl.BlockSpec((1, 1, H), lambda i: (0, 0, 0)),    # mixture0
             pl.BlockSpec((1, 1), lambda i: (0, 0)),          # h_before
-            pl.BlockSpec((1, C), lambda i: (0, 0)),          # pi_hat
-            pl.BlockSpec((C, H), lambda i: (0, 0)),          # rows
-            pl.BlockSpec((B, C, H), lambda i: (i, 0, 0)),    # hyp tile
-            pl.BlockSpec((B, C), lambda i: (i, 0)),          # pi_xi tile
+            pl.BlockSpec((C, 1, 1), lambda i: (0, 0, 0)),    # pi_hat
+            pl.BlockSpec((C, 1, H), lambda i: (0, 0, 0)),    # rows
+            pl.BlockSpec((C, B, H), lambda i: (0, i, 0)),    # cache tile
+            pl.BlockSpec((C, B, 1), lambda i: (0, i, 0)),    # pi_xi_t tile
         ],
         out_specs=pl.BlockSpec((B, 1), lambda i: (i, 0)),
         interpret=interpret,
     )(
-        mixture0[None, :],
-        h_before[None, None],
-        pi_hat[None, :],
-        pbest_rows,
+        mixture0,
+        h_before,
+        pi_hat[:, None, None],
+        pbest_rows[:, None, :],
         pbest_hyp,
-        pi_hat_xi,
+        pi_hat_xi.T[:, :, None],
     )
     return out[:, 0]
 
 
-def _refresh_score_kernel(c_ref, mixture0_ref, h_before_ref, pi_hat_ref,
-                          rows_ref, hyp_t_ref, pi_xi_ref, hyp_ref,
-                          score_ref, hyp_out_ref):
+def _refresh_score_kernel(c_sp_ref, mixture0_ref, h_before_ref, pi_hat_ref,
+                          rows_ref, hyp_t_ref, pi_xi_t_ref, hyp_ref,
+                          score_ref, row_out_ref):
     """One N-tile of the fused refresh+score pass.
 
-    Replaces class row ``c`` of the (B, C, H) cache tile with the
-    freshly-computed ``hyp_t`` values IN-REGISTER, scores the updated
-    tile (same math as :func:`_score_block_kernel`), and writes both the
-    scores and the updated tile — the output cache buffer is aliased to
-    the input, so the cache flows through the call without the defensive
-    whole-tensor copy XLA inserts when an opaque custom call follows an
-    in-place dynamic-update-slice on a loop carry (profiled: +~9 ms/round
-    at headline on a v5e).
+    Scores the (C, B, H) cache tile with class row ``c`` read from the
+    freshly-computed ``hyp_t`` values IN-REGISTER (same math as
+    :func:`_score_block_kernel`), and writes ONLY the refreshed row: the
+    output cache buffer is aliased to the input and the row-out BlockSpec
+    targets ``(c, i, 0)`` via the scalar-prefetched class index, so the
+    other C-1 rows never move — neither the defensive whole-tensor copy
+    XLA inserts when an opaque custom call follows an in-place
+    dynamic-update-slice on a loop carry (profiled: +~9 ms/round at
+    headline on a v5e), nor the full-cache writeback the first fused
+    kernel paid.
     """
-    c = c_ref[0, 0]
-    mixture0 = mixture0_ref[0, :]                    # (H,)
-    pi_hat = pi_hat_ref[0, :]                        # (C,)
-    hyp = hyp_ref[:].astype(mixture0.dtype)          # (B, C, H) old rows
+    c = c_sp_ref[0]
     # round the replacement row through the STORAGE dtype first: the
     # DUS-then-score contract (and the jnp backend) scores the bf16-rounded
     # row when eig_cache_dtype='bfloat16', not the raw fp32 values
-    row_new = hyp_t_ref[:].astype(hyp_ref.dtype).astype(mixture0.dtype)
-    cls = jax.lax.broadcasted_iota(jnp.int32, (1, hyp.shape[1], 1), 1)
-    upd = jnp.where(cls == c, row_new[:, None, :], hyp)
-    hyp_out_ref[:] = upd.astype(hyp_ref.dtype)
-    delta = upd - rows_ref[:][None].astype(mixture0.dtype)
-    mix = mixture0[None, None, :] + pi_hat[None, :, None] * delta
-    p = jnp.maximum(mix, _ENTROPY_FLOOR)
-    h_after = -(p * (jnp.log(p) * 1.4426950408889634)).sum(axis=-1)  # (B, C)
-    scores = h_before_ref[0, 0] - (pi_xi_ref[:] * h_after).sum(axis=-1)
-    score_ref[:] = scores[:, None]
+    row_store = hyp_t_ref[:].astype(hyp_ref.dtype)       # (B, H)
+    row_out_ref[:] = row_store[None]
+    row_new = row_store.astype(jnp.float32)
+    cls = lax.broadcasted_iota(jnp.int32, (hyp_ref.shape[0], 1, 1), 0)
+    hyp = jnp.where(cls == c, row_new[None], hyp_ref[:].astype(jnp.float32))
+    score_ref[:] = _weighted_entropy_scores(
+        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
 def eig_scores_refresh_pallas(
     pbest_rows: jnp.ndarray,   # (C, H) — ALREADY holding the refreshed row
-    pbest_hyp: jnp.ndarray,    # (N, C, H) — still holding the OLD row
+    pbest_hyp: jnp.ndarray,    # (C, N, H) — still holding the OLD row
     hyp_t: jnp.ndarray,        # (N, H) replacement values for class row c
     true_class: jnp.ndarray,   # scalar int
     pi_hat: jnp.ndarray,       # (C,)
@@ -248,21 +269,26 @@ def eig_scores_refresh_pallas(
     block: int = 0,
     interpret: bool | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Fused cache-row refresh + EIG scoring: one HBM pass over the cache.
+    """Fused cache-row refresh + EIG scoring: one HBM read of the cache,
+    one row write.
 
-    Returns ``(scores (N,), updated cache (N, C, H))``. Numerically equal
-    to ``pbest_hyp.at[:, c, :].set(hyp_t)`` followed by
+    Returns ``(scores (N,), updated cache (C, N, H))``. Numerically equal
+    to ``pbest_hyp.at[c].set(hyp_t)`` followed by
     :func:`eig_scores_cache_pallas` — what changes is the dataflow: the
-    update happens in-register inside the scoring pass and the cache
-    buffer is DONATED through the call (``input_output_aliases``), so a
-    scan carrying the cache never pays the XLA defensive copy that the
-    separate DUS + opaque-custom-call sequence provokes (see
-    ``_refresh_score_kernel``). ``pbest_rows`` must already hold the
-    refreshed row (it is (C, H) — the DUS on it is trivially cheap in
-    XLA); ``pbest_hyp`` must hold the pre-update rows.
+    update happens in-register inside the scoring pass, the cache buffer
+    is DONATED through the call (``input_output_aliases``), and only the
+    refreshed ``(1, N, H)`` row is written back (the row-out BlockSpec's
+    index map reads the scalar-prefetched class index), so a scan carrying
+    the cache pays one 2 GB read + one 0.2 GB write per round instead of
+    the read + full write + defensive copy the separate DUS + opaque-call
+    sequence provokes. ``pbest_rows`` must already hold the refreshed row
+    (it is (C, H) — the DUS on it is trivially cheap in XLA); ``pbest_hyp``
+    must hold the pre-update rows.
 
-    Every output element is written (full-tile write), so interpret-mode
-    semantics match hardware exactly and the CPU tests remain valid.
+    Interpret-mode semantics match hardware for the unwritten blocks too:
+    the aliased (donated) buffer keeps the input's values wherever the
+    grid never writes, on both paths (pinned by
+    tests/test_pallas_eig.py::test_refresh_preserves_untouched_rows).
     """
     if interpret is None:  # Mosaic compiles only on real TPUs
         interpret = jax.default_backend() != "tpu"
@@ -285,7 +311,7 @@ def eig_scores_refresh_pallas(
         in_axes = [0 if b else None for b in in_batched]
 
         def one(rows, hyp, hyp_t, c, pi, pi_xi):
-            hyp2 = hyp.at[:, c, :].set(hyp_t.astype(hyp.dtype))
+            hyp2 = hyp.at[c].set(hyp_t.astype(hyp.dtype))
             scores = eig_scores_from_cache(rows, hyp2, pi, pi_xi,
                                            chunk=block or 2048)
             return scores, hyp2
@@ -300,47 +326,50 @@ def eig_scores_refresh_pallas(
 
 def _refresh_impl(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat,
                   pi_hat_xi, block: int, interpret: bool):
-    N, C, H = pbest_hyp.shape
+    C, N, H = pbest_hyp.shape
     B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize,
-                     n_cache_streams=2)
-    mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
-    pc = jnp.clip(mixture0, _ENTROPY_FLOOR, None)
-    h_before = -(pc * jnp.log2(pc)).sum()
-
+                     fused=True)
+    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat)
     n_blocks = -(-N // B)
 
-    scores, hyp_out = pl.pallas_call(
-        _refresh_score_kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((N, 1), mixture0.dtype),
-            jax.ShapeDtypeStruct(pbest_hyp.shape, pbest_hyp.dtype),
-        ),
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # true_class
-            pl.BlockSpec((1, H), lambda i: (0, 0)),          # mixture0
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),          # h_before
-            pl.BlockSpec((1, C), lambda i: (0, 0)),          # pi_hat
-            pl.BlockSpec((C, H), lambda i: (0, 0)),          # rows
-            pl.BlockSpec((B, H), lambda i: (i, 0)),          # hyp_t tile
-            pl.BlockSpec((B, C), lambda i: (i, 0)),          # pi_xi tile
-            pl.BlockSpec((B, C, H), lambda i: (i, 0, 0)),    # hyp tile
+            pl.BlockSpec((1, 1, H), lambda i, c: (0, 0, 0)),  # mixture0
+            pl.BlockSpec((1, 1), lambda i, c: (0, 0)),        # h_before
+            pl.BlockSpec((C, 1, 1), lambda i, c: (0, 0, 0)),  # pi_hat
+            pl.BlockSpec((C, 1, H), lambda i, c: (0, 0, 0)),  # rows
+            pl.BlockSpec((B, H), lambda i, c: (i, 0)),        # hyp_t tile
+            pl.BlockSpec((C, B, 1), lambda i, c: (0, i, 0)),  # pi_xi_t
+            pl.BlockSpec((C, B, H), lambda i, c: (0, i, 0)),  # cache tile
         ],
         out_specs=(
-            pl.BlockSpec((B, 1), lambda i: (i, 0)),
-            pl.BlockSpec((B, C, H), lambda i: (i, 0, 0)),
+            pl.BlockSpec((B, 1), lambda i, c: (i, 0)),
+            # the refreshed class row ONLY — indexed by the prefetched
+            # scalar, so the write lands at (c, i*B, 0)
+            pl.BlockSpec((1, B, H), lambda i, c: (c[0], i, 0)),
         ),
-        # donate the cache: input 7 (hyp) aliases output 1 (hyp_out)
+    )
+    scores, hyp_out = pl.pallas_call(
+        _refresh_score_kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct(pbest_hyp.shape, pbest_hyp.dtype),
+        ),
+        # donate the cache: input 7 (cache, counting the scalar-prefetch
+        # operand at 0) aliases output 1 (the updated cache)
         input_output_aliases={7: 1},
         interpret=interpret,
     )(
-        jnp.asarray(true_class, jnp.int32)[None, None],
-        mixture0[None, :],
-        h_before[None, None],
-        pi_hat[None, :],
-        pbest_rows,
+        jnp.asarray(true_class, jnp.int32)[None],
+        mixture0,
+        h_before,
+        pi_hat[:, None, None],
+        pbest_rows[:, None, :],
         hyp_t,
-        pi_hat_xi,
+        pi_hat_xi.T[:, :, None],
         pbest_hyp,
     )
     return scores[:, 0], hyp_out

@@ -194,24 +194,24 @@ def main(argv=None):
           ops=(dir0, hard))
 
     # pure DUS cost of the cache-carry update, two layouts: if XLA cannot
-    # alias the middle-axis dynamic-update-slice in the loop carry it
-    # degrades to a full (N, C, H) copy per round (~5 ms at headline on a
-    # v5e) — the leading-axis variant is the classic in-place-safe pattern,
-    # so a large gap between these two stages localizes that copy without
-    # any einsum compute in the way
-    def body_dus_mid(h, i):
-        row = h[:, (i + 1) % C, :] * jnp.float32(0.999)
-        return h.at[:, i % C, :].set(row)
-
-    stage("carry:DUS mid-axis (N,C,H)", body_dus_mid, hyp)
-
-    hypT = jnp.transpose(hyp, (1, 0, 2))             # (C, N, H)
-
+    # alias the dynamic-update-slice in the loop carry it degrades to a
+    # full cache copy per round (~5 ms at headline on a v5e). The carried
+    # layout is (C, N, H) — leading-axis DUS, the classic in-place-safe
+    # pattern; the (N, C, H) mid-axis variant is kept as the comparison
+    # point (it also pays the 16-sublane pad at small C)
     def body_dus_lead(h, i):
         row = h[(i + 1) % C] * jnp.float32(0.999)
         return h.at[i % C].set(row)
 
-    stage("carry:DUS leading-axis (C,N,H)", body_dus_lead, hypT)
+    stage("carry:DUS leading-axis (C,N,H)", body_dus_lead, hyp)
+
+    hypT = jnp.transpose(hyp, (1, 0, 2))             # (N, C, H)
+
+    def body_dus_mid(h, i):
+        row = h[:, (i + 1) % C, :] * jnp.float32(0.999)
+        return h.at[:, i % C, :].set(row)
+
+    stage("carry:DUS mid-axis (N,C,H)", body_dus_mid, hypT)
 
     # composed row-refresh + scoring, per backend, carrying the cache like
     # the real scan does: if a backend's score call cannot alias the
@@ -249,6 +249,25 @@ def main(argv=None):
               (rows, hyp, jnp.float32(0)), ops=(dir0, hard, pi, pi_xi))
         stage(f"compose:{order} pallas", _compose(_score_pallas, order),
               (rows, hyp, jnp.float32(0)), ops=(dir0, hard, pi, pi_xi))
+
+    # the PRODUCTION pallas path: refresh einsums feed the fused kernel,
+    # which scores while writing ONLY the refreshed row through the
+    # donated cache (row-only aliased write) — compare against the
+    # compose: stages to see what the fusion + row-write save
+    def body_fused(carry, i, dir0, hard, pi, pi_xi):
+        from coda_tpu.ops.pallas_eig import eig_scores_refresh_pallas
+        from coda_tpu.selectors.coda import update_eig_cache_parts
+
+        rows_c, hyp_c, c = carry
+        row_t, hyp_t = update_eig_cache_parts(dir0, i % C, hard,
+                                              num_points=G)
+        rows2 = rows_c.at[i % C].set(row_t)
+        s, hyp2 = eig_scores_refresh_pallas(
+            rows2, hyp_c, hyp_t, i % C, pi + c * eps, pi_xi, block=CH)
+        return rows2, hyp2, c + s[0] * eps
+
+    stage("pallas:fused refresh+score", body_fused,
+          (rows, hyp, jnp.float32(0)), ops=(dir0, hard, pi, pi_xi))
 
     def body_pi(u, i, dir0, preds):
         _, _, u2 = update_pi_hat_column(dir0, i % C, preds, u)

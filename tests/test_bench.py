@@ -133,9 +133,10 @@ def test_mbu_reported_against_known_chip():
 
 
 def test_analytic_bytes_prices_fused_pallas_backend():
-    """The fused refresh+score kernel reads AND rewrites the donated cache
-    (full-tile write) — the byte model must charge both, or MBU on TPU is
-    silently understated ~1.5x."""
+    """The fused refresh+score kernel reads the donated cache once and
+    writes back ONLY the refreshed class row (row-only aliased write); the
+    byte model must charge the row roundtrip through the kernel but NOT a
+    full-cache rewrite."""
     from bench import _analytic_step_bytes
 
     H, N, C = 1000, 50_000, 10
@@ -143,5 +144,8 @@ def test_analytic_bytes_prices_fused_pallas_backend():
     pal_b = _analytic_step_bytes(H, N, C, "incremental", pi_update="exact",
                                  backend="pallas")
     cache = 4.0 * N * C * H
-    assert pal_b == 2.0 * cache + 4.0 * H * N * C + 12.0 * N * H
-    assert pal_b > jnp_b
+    assert pal_b == cache + 4.0 * H * N * C + 16.0 * N * H
+    # vs the jnp path: the kernel adds the (N, H) fp32 row roundtrip but
+    # saves the defensive copy XLA inserts around the DUS (not priced —
+    # the model charges pure algorithmic traffic for both backends)
+    assert pal_b == jnp_b + 8.0 * N * H

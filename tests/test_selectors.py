@@ -379,11 +379,12 @@ def test_coda_incremental_cache_row_refresh_exact(task):
         np.testing.assert_allclose(np.asarray(state.pbest_hyp),
                                    np.asarray(hyp_full),
                                    rtol=1e-5, atol=1e-7)
-        # untouched class rows are carried over bitwise
+        # untouched class rows are carried over bitwise ((C, N, H) layout:
+        # class rows lead)
         untouched = [c for c in range(task.preds.shape[2]) if c != tc]
         np.testing.assert_array_equal(
-            np.asarray(state.pbest_hyp)[:, untouched],
-            prev_hyp[:, untouched])
+            np.asarray(state.pbest_hyp)[untouched],
+            prev_hyp[untouched])
 
 
 def test_coda_auto_mode_resolution():

@@ -213,9 +213,10 @@ def test_imagenet_scale_aot_memory_analysis():
 
 
 def test_incremental_cache_shards_over_data_axis():
-    """The incremental-EIG state cache (N, C, H) must inherit the data-axis
-    sharding of the prediction tensor — replicating it would double every
-    device's footprint at headline scale (the cache is as large as preds)."""
+    """The incremental-EIG state cache (C, N, H) must inherit the data-axis
+    sharding of the prediction tensor on its N axis — replicating it would
+    double every device's footprint at headline scale (the cache is as
+    large as preds)."""
     from coda_tpu.selectors import CODAHyperparams, make_coda
 
     task = make_synthetic_task(seed=9, H=8, N=64, C=4)
@@ -232,9 +233,11 @@ def test_incremental_cache_shards_over_data_axis():
     state = init_of(preds, jax.random.PRNGKey(0))
     assert state.pbest_hyp is not None
     spec = state.pbest_hyp.sharding.spec
-    # leading (N) axis split over the data mesh axis; no dimension may be
-    # sharded in a way that replicates N per device
-    assert spec[0] == DATA_AXIS or spec[0] == (DATA_AXIS,), spec
+    # the N axis (dim 1 of the (C, N, H) layout) split over the data mesh
+    # axis; no dimension may be sharded in a way that replicates N per
+    # device
+    assert len(spec) > 1 and (
+        spec[1] == DATA_AXIS or spec[1] == (DATA_AXIS,)), spec
     n_shard_bytes = state.pbest_hyp.addressable_shards[0].data.nbytes
     total = 4 * 64 * 4 * 8
     assert n_shard_bytes <= total // 4, (n_shard_bytes, total)
