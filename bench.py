@@ -186,9 +186,11 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
       * cache row refresh: three (N,H)x(H,G)-shaped einsums  -> 6·N·H·G
         (``update_eig_cache`` touches ONE class row per round)
       * pi-hat refresh: delta gather + sum over models       -> 2·H·N
-        (``update_pi_hat_column_delta``), or the exact column
-        einsum hs,hns->n over the full tensor                -> 2·H·N·C
-        (``update_pi_hat_column``, the TPU resolution of 'auto')
+        (``update_pi_hat_column_delta`` — 'auto' everywhere but
+        multi-device TPU: pallas DMA gather on one chip, XLA
+        take-along on CPU), or the exact column einsum
+        hs,hns->n over the full tensor                       -> 2·H·N·C
+        (``update_pi_hat_column``, 'auto' on multi-device TPU)
       * cache scoring (elementwise mixture entropies)        -> ~10·N·C·H
     Factored / rowscan EIG: the three einsums span all C class rows
     (identical FLOPs, different temps)                       -> 6·N·C·H·G
@@ -204,9 +206,10 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
                          eig_cache_dtype=eig_cache_dtype,
                          pi_update=pi_update)
     mode = resolve_eig_mode(hp, H, N, C)
-    pi_res = resolve_pi_update(hp)
+    pi_res = resolve_pi_update(hp, N)
     if mode == "incremental":
-        pi_flops = 2.0 * H * N if pi_res == "delta" else 2.0 * H * N * C
+        pi_flops = (2.0 * H * N if pi_res.startswith("delta")
+                    else 2.0 * H * N * C)
         return 6.0 * N * H * G + pi_flops + 10.0 * N * C * H, mode, pi_res
     return 6.0 * N * C * H * G + 2.0 * H * C * C * N, mode, pi_res
 
@@ -237,7 +240,8 @@ def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
     """
     if mode == "incremental":
         cache = float(cache_bytes) * N * C * H
-        pi_bytes = 4.0 * H * N if pi_update == "delta" else 4.0 * H * N * C
+        pi_bytes = (4.0 * H * N if pi_update.startswith("delta")
+                    else 4.0 * H * N * C)
         if backend == "pallas":
             # fused refresh+score kernel: the donated cache is READ once;
             # only the refreshed (N, H) class row is written back (the
@@ -518,7 +522,9 @@ def main():
     ap.add_argument("--pi-update", default="auto",
                     choices=["auto", "delta", "exact"],
                     help="incremental pi-hat refresh: auto (default) = "
-                         "exact on TPU / delta elsewhere")
+                         "delta (pallas DMA gather on a single TPU chip, "
+                         "XLA take-along on CPU) / exact on multi-device "
+                         "TPU")
     ap.add_argument("--skip-reference", action="store_true")
     ap.add_argument("--no-device-probe", action="store_true",
                     help="skip the pre-flight subprocess probe of the "

@@ -102,16 +102,19 @@ class CODAHyperparams(NamedTuple):
     #                               contract as eig_precision).
     pi_update: str = "auto"       # auto | delta | exact — incremental-mode
     #                               pi-hat column refresh. "auto" resolves
-    #                               by backend (resolve_pi_update): "exact"
-    #                               on TPU — the delta path's cross-model
-    #                               gather runs ~28 GB/s effective on a
-    #                               v5e (7.1 ms at headline, measured
-    #                               round 4) while the exact column einsum
-    #                               streams the full tensor through the
-    #                               MXU at ~88% of HBM peak (2.8 ms) —
-    #                               and "delta" elsewhere (on CPU the
-    #                               gather is ~90x cheaper than the
-    #                               einsum). "delta" adds the exact
+    #                               by backend (resolve_pi_update):
+    #                               "delta" on CPU (the XLA gather is ~90x
+    #                               cheaper than the einsum there) AND on
+    #                               a single-chip TPU, where the pallas
+    #                               DMA-gather kernel reads the H rows at
+    #                               DMA bandwidth (ops/pallas_gather.py —
+    #                               XLA's own TPU gather lowering runs
+    #                               ~28 GB/s effective, 7.1 ms at headline
+    #                               on a v5e, slower than the exact
+    #                               einsum's full-tensor MXU stream at
+    #                               2.8 ms); "exact" on multi-device TPU
+    #                               processes, where the opaque pallas
+    #                               call cannot shard. "delta" adds the exact
     #                               linear increment lr*preds[h,n,s_h] via a
     #                               contiguous gather from a once-transposed
     #                               (C, H, N) layout: O(H*N) bytes/round
@@ -146,21 +149,48 @@ _INCR_CACHE_MAX_BYTES = 4 << 30
 _TABLES_MAX_BYTES = 2 << 30
 
 
-def resolve_pi_update(hp: "CODAHyperparams") -> str:
-    """The concrete pi-hat refresh for this backend (shared with bench.py).
+def resolve_pi_update(hp: "CODAHyperparams", N: int | None = None) -> str:
+    """The concrete pi-hat refresh LOWERING for this config (shared with
+    bench.py): "exact" | "delta" (XLA take-along-axis) | "delta_pallas"
+    (the DMA-gather kernel, ``ops/pallas_gather.py``). This is the ONE
+    place the lowering predicate lives — make_coda wires the gather it
+    names, bench prices the bytes it names.
 
-    auto -> "exact" on TPU, "delta" elsewhere: the delta path's
-    take-along-axis gather across models is gather-bound on TPU (slower
-    than streaming the full tensor through the exact MXU einsum), while on
-    CPU it is the decisive win (O(H·N) bytes vs the full O(H·N·C) stream).
-    Resolution reads ``jax.default_backend()`` at selector-build time — a
-    host-side config decision, identical across hosts of a multi-host mesh.
+    auto -> delta everywhere the gather has a fast lowering: (a) CPU,
+    where XLA's take-along-axis is the decisive win (O(H·N) bytes vs the
+    full O(H·N·C) stream), and (b) a SINGLE-chip TPU process running ONE
+    experiment, where the pallas kernel reads the H rows at DMA bandwidth
+    — XLA's own TPU gather lowering runs ~28 GB/s effective on a v5e
+    (7.1 ms at headline, measured round 4), slower than streaming the
+    full tensor through the exact MXU einsum (2.8 ms), so every TPU
+    context where the kernel can't engage resolves to "exact" instead:
+    multi-device processes (the opaque pallas call cannot shard), vmapped
+    batches (``n_parallel`` > 1 — the kernel's custom_vmap rule would
+    fall back to the slow XLA gather, same guard as
+    ``resolve_eig_backend``), and N past the kernel's single-tile VMEM
+    cap. An EXPLICIT "delta" keeps delta semantics and still gets the
+    kernel exactly when it is viable. Resolution reads
+    ``jax.default_backend()`` at selector-build time — a host-side config
+    decision, identical across hosts of a multi-host mesh.
     """
-    if hp.pi_update != "auto":
-        return hp.pi_update
+    if hp.pi_update == "exact":
+        return "exact"
     import jax
 
-    return "exact" if jax.default_backend() == "tpu" else "delta"
+    from coda_tpu.ops.pallas_gather import _MAX_TILE_N
+
+    pallas_viable = (
+        jax.default_backend() == "tpu"
+        and jax.device_count() == 1
+        and hp.n_parallel <= 1
+        and (N is None or N <= _MAX_TILE_N)
+    )
+    if hp.pi_update == "delta":
+        return "delta_pallas" if pallas_viable else "delta"
+    # auto
+    if jax.default_backend() != "tpu":
+        return "delta"
+    return "delta_pallas" if pallas_viable else "exact"
 
 
 def resolve_eig_backend(hp: "CODAHyperparams", eig_mode: str) -> str:
@@ -208,7 +238,7 @@ def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
     # on one chip" silently becomes an OOM
     cache_bytes = jnp.dtype(hp.eig_cache_dtype).itemsize
     incr_bytes_per_elem = cache_bytes + (
-        4 if resolve_pi_update(hp) == "delta" else 0)
+        4 if resolve_pi_update(hp, N).startswith("delta") else 0)
     if hp.eig_mode != "auto":
         if hp.eig_mode == "incremental" and not full_pool_eig:
             raise ValueError(
@@ -316,6 +346,7 @@ def update_pi_hat_column_delta(
     preds_by_class: jnp.ndarray,  # (C, H, N) — preds transposed once
     pi_xi_unnorm: jnp.ndarray,  # (N, C) unnormalized cache
     update_strength: float,
+    gather_fn=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Exact linear increment of the pi-hat column (the bandwidth-lean path).
 
@@ -326,14 +357,19 @@ def update_pi_hat_column_delta(
     ``lr · Σ_h preds[h, n, s_h]``. Gathering that from the (C, H, N)
     transposed layout reads H contiguous N-rows (O(H·N) bytes) instead of
     re-streaming the full (H, N, C) tensor the way the column einsum does
-    (:func:`update_pi_hat_column`). Identical math; only float accumulation
-    order differs (drift ~1e-7/round, pinned by
-    ``test_pi_delta_matches_exact_recompute``).
+    (:func:`update_pi_hat_column`). ``gather_fn`` picks the lowering of
+    that gather — and owns ``preds_by_class``'s layout: the default XLA
+    take-along-axis over (C, H, N) (fast on CPU), or the pallas DMA-gather
+    kernel over the flat (C·H, 1, Np) layout on a single-chip TPU
+    (``ops/pallas_gather.gather_rows_sum_prepped`` — make_coda wires both
+    sides). Identical math; only float accumulation order differs (drift
+    ~1e-7/round, pinned by ``test_pi_delta_matches_exact_recompute``).
     """
-    sel = jnp.take_along_axis(
-        preds_by_class, pred_classes[None, :, None], axis=0
-    )[0]                                              # (H, N)
-    delta = update_strength * sel.sum(0)              # (N,)
+    if gather_fn is None:
+        from coda_tpu.ops.pallas_gather import gather_rows_sum_xla
+
+        gather_fn = gather_rows_sum_xla
+    delta = update_strength * gather_fn(preds_by_class, pred_classes)
     unnorm = pi_xi_unnorm.at[:, true_class].add(delta)
     pi_xi, pi = _normalize_pi(unnorm)
     return pi_xi, pi, unnorm
@@ -796,7 +832,14 @@ def make_coda(
     if hp.pi_update not in ("auto", "delta", "exact"):
         raise ValueError(f"unknown pi_update {hp.pi_update!r} "
                          "(use 'auto', 'delta' or 'exact')")
-    pi_update = resolve_pi_update(hp)
+    # resolve_pi_update names the concrete lowering; this just wires it
+    pi_update = resolve_pi_update(hp, N)
+    pi_gather = None
+    if pi_update == "delta_pallas":
+        from coda_tpu.ops.pallas_gather import gather_rows_sum_prepped
+
+        def pi_gather(flat, s, _N=N):
+            return gather_rows_sum_prepped(flat, s, _N)
     # statics (functions of preds only)
     hard_preds = preds.argmax(-1).T.astype(jnp.int32)     # (N, H)
     disagree = _disagreement_mask(hard_preds, C)          # (N,)
@@ -823,9 +866,18 @@ def make_coda(
     incremental = eig_mode == "incremental"
     # (C, H, N) layout for the delta pi-hat gather, built OUTSIDE the scan
     # step so it is a loop constant (materialized once per experiment), not
-    # re-transposed every round; only the incremental tier reads it
-    preds_by_class = (jnp.transpose(preds, (2, 0, 1))
-                      if incremental and pi_update == "delta" else None)
+    # re-transposed every round; only the incremental tier reads it. The
+    # pallas DMA-gather consumes the flat (C·H, 1, Np) variant instead
+    # (prep_gather_layout — Mosaic cannot slice single sublane rows out of
+    # the tiled 3-D buffer); ``preds_by_class``'s layout is owned by
+    # whichever gather the update uses
+    preds_by_class = None
+    if incremental and pi_update.startswith("delta"):
+        preds_by_class = jnp.transpose(preds, (2, 0, 1))
+        if pi_gather is not None:
+            from coda_tpu.ops.pallas_gather import prep_gather_layout
+
+            preds_by_class = prep_gather_layout(preds_by_class)
     if hp.eig_cache_dtype not in ("float32", "bfloat16"):
         raise ValueError(f"unknown eig_cache_dtype {hp.eig_cache_dtype!r} "
                          "(use 'float32' or 'bfloat16')")
@@ -1010,10 +1062,11 @@ def make_coda(
             update_strength * onehot
         )
         if incremental:
-            if pi_update == "delta":
+            if pi_update.startswith("delta"):
                 pi_xi, pi, unnorm = update_pi_hat_column_delta(
                     true_class, hard_preds[idx], preds_by_class,
                     state.pi_xi_unnorm, update_strength,
+                    gather_fn=pi_gather,
                 )
             else:
                 pi_xi, pi, unnorm = update_pi_hat_column(

@@ -287,6 +287,22 @@ def main(argv=None):
     stage("update:pi-hat column (delta)", body_pi_delta, unnorm,
           ops=(hard, preds_by_class))
 
+    from coda_tpu.ops.pallas_gather import (
+        gather_rows_sum_prepped,
+        prep_gather_layout,
+    )
+
+    preds_flat = jax.jit(prep_gather_layout)(preds_by_class)
+
+    def body_pi_delta_pallas(u, i, hard, preds_flat):
+        _, _, u2 = update_pi_hat_column_delta(
+            i % C, hard[i % N], preds_flat, u, hp0.learning_rate,
+            gather_fn=lambda f, s: gather_rows_sum_prepped(f, s, N))
+        return u2
+
+    stage("pallas:pi-hat delta (DMA gather)", body_pi_delta_pallas, unnorm,
+          ops=(hard, preds_flat))
+
     scores0 = jax.jit(
         lambda r, h, p, px: eig_scores_from_cache(r, h, p, px, chunk=CH)
     )(rows, hyp, pi, pi_xi)
