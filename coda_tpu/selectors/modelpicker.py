@@ -63,6 +63,8 @@ TASK_EPS = {
     "iris": 0.36,            # 200 realisations x pool 80 x budget 60 on the
     #                           committed 0.7-eval-split build (N=105)
     "digits_shift": 0.44,
+    "pyfiles": 0.36,         # document-type text task (C=5, N=500)
+    "digits_h80": 0.36,      # 80-model MSV-shaped pool on the NIST scans
 }
 DEFAULT_EPS = 0.46
 

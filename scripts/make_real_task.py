@@ -68,8 +68,83 @@ def model_pool(seed: int = 0):
     ]
 
 
+def model_pool_large(seed: int = 0):
+    """An 80-model pool — the shape of the reference's MSV family
+    (H=80, C=10, ``/root/reference/paper/fig3.py``): broad hyperparameter
+    grids across eight families, spanning strong to deliberately weak."""
+    from sklearn.discriminant_analysis import (
+        LinearDiscriminantAnalysis,
+        QuadraticDiscriminantAnalysis,
+    )
+    from sklearn.ensemble import (
+        GradientBoostingClassifier,
+        RandomForestClassifier,
+    )
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.naive_bayes import GaussianNB
+    from sklearn.neighbors import KNeighborsClassifier
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.svm import SVC
+    from sklearn.tree import DecisionTreeClassifier
+
+    pool = []
+    for c in np.logspace(-3, 3, 8):
+        pool.append((f"logreg_c{c:.3g}", LogisticRegression(
+            C=float(c), max_iter=2000)))
+    for i, size in enumerate([(8,), (16,), (32,), (64,), (128,), (64, 32),
+                              (32, 16), (128, 64), (16, 8), (256,),
+                              (8, 8), (64, 64)]):
+        pool.append((f"mlp_{'x'.join(map(str, size))}", MLPClassifier(
+            size, max_iter=400, random_state=seed + i)))
+    for depth in (2, 3, 5, 8, None):
+        for n_est in (20, 100):
+            pool.append((f"rf_d{depth}_n{n_est}", RandomForestClassifier(
+                n_estimators=n_est, max_depth=depth, random_state=seed)))
+    for depth in (1, 2, 3):
+        for n_est in (20, 60):
+            pool.append((f"gb_d{depth}_n{n_est}",
+                         GradientBoostingClassifier(
+                             n_estimators=n_est, max_depth=depth,
+                             random_state=seed)))
+    for k in (1, 3, 5, 9, 15, 25, 45, 75):
+        pool.append((f"knn_{k}", KNeighborsClassifier(k)))
+    for k in (3, 9, 25, 75):
+        pool.append((f"knn_{k}_dist", KNeighborsClassifier(
+            k, weights="distance")))
+    for depth in (2, 3, 4, 6, 8, None):
+        pool.append((f"tree_d{depth}", DecisionTreeClassifier(
+            max_depth=depth, random_state=seed)))
+    for vs in (1e-9, 1e-6, 1e-3, 1e-1, 1.0):
+        pool.append((f"gnb_vs{vs:g}", GaussianNB(var_smoothing=vs)))
+    for c in (0.1, 1.0, 10.0):
+        for gamma in ("scale", 0.01, 0.1):
+            pool.append((f"svc_c{c:g}_g{gamma}", SVC(
+                C=c, gamma=gamma, probability=True, random_state=seed)))
+    pool.append(("lda", LinearDiscriminantAnalysis()))
+    pool.append(("qda", QuadraticDiscriminantAnalysis(reg_param=0.1)))
+    from sklearn.ensemble import AdaBoostClassifier, ExtraTreesClassifier
+    from sklearn.naive_bayes import BernoulliNB
+
+    for n_est in (20, 50, 100):
+        pool.append((f"ada_n{n_est}", AdaBoostClassifier(
+            n_estimators=n_est, random_state=seed)))
+    for depth in (3, 8, None):
+        pool.append((f"xtree_d{depth}", ExtraTreesClassifier(
+            n_estimators=50, max_depth=depth, random_state=seed)))
+    for b in (0.25, 0.5):
+        pool.append((f"bnb_b{b:g}", BernoulliNB(binarize=b)))
+    for i in (100, 200):
+        pool.append((f"mlp_32_s{i}", MLPClassifier(
+            (32,), max_iter=400, random_state=seed + i)))
+    assert len(pool) == 80, len(pool)
+    return pool
+
+
 DATASETS = {
     "digits": ("load_digits", 16.0),
+    # the MSV-family shape (H=80 genuinely different models, C=10) on the
+    # same real NIST scans — the reference benchmark's widest model axis
+    "digits_h80": ("load_digits", 16.0),
     "breast_cancer": ("load_breast_cancer", None),  # None -> standardize
     "wine": ("load_wine", None),
     "iris": ("load_iris", None),
@@ -129,7 +204,8 @@ def build(out: str, test_frac: float = 0.5, seed: int = 0,
         mu, sd = x_tr.mean(0), np.clip(x_tr.std(0), 1e-6, None)
         x_tr, x_ev = (x_tr - mu) / sd, (x_ev - mu) / sd
 
-    pool = model_pool(seed)
+    pool = (model_pool_large(seed) if dataset == "digits_h80"
+            else model_pool(seed))
     C = len(data.target_names)
     preds = np.zeros((len(pool), len(y_ev), C), dtype=np.float32)
     accs = {}

@@ -627,6 +627,43 @@ def test_coda_real_binary_independent_trace_parity():
     _independent_trace_parity(task, RefDS(task), iters=8)
 
 
+def test_coda_real_widepool_independent_trace_parity():
+    """The H=80 pool on real scans (digits_h80, see REAL_TASK.md): the
+    widest model axis in the real-task set — the per-model Beta structure
+    and the P(best) mixture have 80 genuinely different components. N is
+    subset for the reference's per-round Python-loop speed."""
+    import os
+
+    from coda_tpu.data import Dataset
+
+    path = os.path.join(os.path.dirname(__file__), "..", "data",
+                        "digits_h80.npz")
+    if not os.path.exists(path):
+        pytest.skip("digits_h80.npz not committed")
+    full = Dataset.from_file(path)
+    task = Dataset(preds=full.preds[:, :160, :], labels=full.labels[:160],
+                   name="digits_h80_sub")
+    _independent_trace_parity(task, RefDS(task), iters=8)
+
+
+def test_coda_real_text_independent_trace_parity():
+    """The C=5 document-type text task (pyfiles, the GLUE-shaped family
+    member, see REAL_TASK.md): real TF-IDF text models produce flatter,
+    more-correlated posteriors than the image pools."""
+    import os
+
+    from coda_tpu.data import Dataset
+
+    path = os.path.join(os.path.dirname(__file__), "..", "data",
+                        "pyfiles.npz")
+    if not os.path.exists(path):
+        pytest.skip("pyfiles.npz not committed")
+    full = Dataset.from_file(path)
+    task = Dataset(preds=full.preds[:, :220, :], labels=full.labels[:220],
+                   name="pyfiles_sub")
+    _independent_trace_parity(task, RefDS(task), iters=8)
+
+
 def test_uncertainty_real_digits_scores_parity(digits_task):
     from coda_tpu.selectors.uncertainty import uncertainty_scores
 
