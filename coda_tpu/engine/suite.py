@@ -56,38 +56,49 @@ class SuiteRunner:
         )
         self._jax = jax
 
-    def _fn_for(self, method: str, method_args: Optional[dict],
-                task_name: str, width: int = 1):
-        from coda_tpu.cli import build_selector_factory, parse_args
+    def _resolved_args(self, method: str, method_args: Optional[dict],
+                       task_name: str) -> dict:
+        """Method hyperparams with task-dependent values resolved.
 
-        # Task-dependent hyperparams must be resolved BEFORE the cache key is
-        # formed: ``build_selector_factory`` bakes them into the jitted
-        # closure, so two tasks with different tuned values must not share an
-        # executable (but tasks resolving to the same value still do).
-        # ``width`` = how many seed replicas this executable batches (the
-        # dedup path runs batches of 1 and seeds-1): it keys the cache and
-        # feeds the auto eig_mode memory budget, so the 1-seed probe is
-        # never forced off the incremental kernel by replicas that don't
-        # share its program.
+        Task-dependent hyperparams must be resolved BEFORE a jit-cache key
+        is formed: ``build_selector_factory`` bakes them into the jitted
+        closure, so two tasks with different tuned values must not share an
+        executable (but tasks resolving to the same value still do)."""
         resolved = dict(method_args or {})
         if method == "model_picker" and "epsilon" not in resolved:
             from coda_tpu.selectors import TASK_EPS
             from coda_tpu.selectors.modelpicker import DEFAULT_EPS
 
             resolved["epsilon"] = TASK_EPS.get(task_name, DEFAULT_EPS)
-        key = (method, tuple(sorted(resolved.items())), width)
+        return resolved
+
+    def _fn_for(self, method: str, method_args: Optional[dict],
+                task_name: str, width: int = 1, n_tasks: int = 0):
+        # ``width`` = how many seed replicas this executable batches (the
+        # dedup path runs batches of 1 and seeds-1): it keys the cache and
+        # feeds the auto eig_mode memory budget, so the 1-seed probe is
+        # never forced off the incremental kernel by replicas that don't
+        # share its program. ``n_tasks`` > 0 wraps the experiment in a
+        # second vmap over a leading TASK axis (the run_batched path) —
+        # the budget then sees width x n_tasks replicas.
+        from coda_tpu.cli import build_selector_factory, parse_args
+
+        resolved = self._resolved_args(method, method_args, task_name)
+        key = (method, tuple(sorted(resolved.items())), width, n_tasks)
         if key not in self._jitted:
             args = parse_args([])
             args.method = method
             args.loss = [k for k, v in LOSS_FNS.items() if v is self.loss_fn][0]
             args.iters = self.iters
-            args.n_parallel = max(1, width)
+            args.n_parallel = max(1, width * max(1, n_tasks))
             for k, v in resolved.items():
                 setattr(args, k, v)
             factory = build_selector_factory(args, task_name)
-            self._jitted[key] = self._jax.jit(
-                make_batched_experiment_fn(factory, self.iters, self.loss_fn)
-            )
+            fn = make_batched_experiment_fn(factory, self.iters, self.loss_fn)
+            if n_tasks:
+                # (T, H, N, C) preds, (T, N) labels, shared seed keys
+                fn = self._jax.vmap(fn, in_axes=(0, 0, None))
+            self._jitted[key] = self._jax.jit(fn)
         return self._jitted[key]
 
     def run_one(self, method: str, dataset, method_args: Optional[dict] = None):
@@ -194,6 +205,126 @@ class SuiteRunner:
                            "compute_s": t_compute, "pairs": pairs}
         progress(f"suite: {len(results)} task-method pairs in {total:.2f}s "
                  f"(compute {t_compute:.2f}s, data load {t_load:.2f}s)")
+        return results
+
+    def run_batched(
+        self,
+        groups: Sequence[Sequence],
+        methods: Sequence[str],
+        store=None,
+        method_args: Optional[dict] = None,
+        progress: Callable[[str], None] = print,
+    ) -> dict:
+        """The sweep with same-shape tasks BATCHED into one program.
+
+        ``groups``: lists of datasets-or-loaders; within a group every task
+        must share its (H, N, C) shape and resolve identical method
+        hyperparams (model_picker's per-task ε — mixed groups raise).
+        Each (group, method) pair costs TWO program dispatches (the width-1
+        seed probe over all T tasks, then the remaining seeds), instead of
+        ``run``'s one-or-two per task — the dispatch-count lever for hosts
+        where per-program latency dominates the suite (measured round 4:
+        the 156-pair sweep on a tunneled v5e was ~80% per-dispatch floor).
+
+        Semantics match ``run`` + ``dedup_seeds`` exactly: per task, a
+        deterministic probe broadcasts and the rest-batch result is
+        DISCARDED (the rest batch is computed unconditionally here — the
+        price of batching is wasted rest-compute for deterministic tasks,
+        cheap on an accelerator; the statistical contract is unchanged).
+        Tasks inside a group share one vmapped executable, so the auto
+        eig_mode budget sees T x width replicas and may resolve a
+        different tier than ``run`` would — the tiers are
+        score-parity-tested, same caveat as ``run_one``'s dedup note.
+        Sharded prediction tensors are not supported here (the task axis
+        would need its own mesh dimension); use ``run``.
+        """
+        results: dict = {}
+        t_start = time.perf_counter()
+        t_load = 0.0
+        t_compute = 0.0
+        pairs: list = []
+        seen_shapes: set = set()
+        for group in groups:
+            t0 = time.perf_counter()
+            datasets = [d() if callable(d) else d for d in group]
+            t_load += time.perf_counter() - t0
+            shapes = {tuple(d.shape) for d in datasets}
+            if len(shapes) != 1:
+                raise ValueError(
+                    f"run_batched group mixes shapes {sorted(shapes)}; "
+                    "group tasks by shape"
+                )
+            preds = self._jax.numpy.stack([d.preds for d in datasets])
+            labels = self._jax.numpy.stack([d.labels for d in datasets])
+            T = len(datasets)
+            names = [d.name for d in datasets]
+            for method in methods:
+                todo = [
+                    i for i, n in enumerate(names)
+                    if not (store is not None and _finished(
+                        store, n, method, self.seeds))
+                ]
+                if not todo:
+                    for n in names:
+                        progress(f"skip {n}/{method} (finished)")
+                    continue
+                resolved = [self._resolved_args(method, method_args, n)
+                            for n in names]
+                if any(r != resolved[0] for r in resolved[1:]):
+                    raise ValueError(
+                        f"run_batched: method {method!r} resolves different "
+                        f"hyperparams across the group {names} (e.g. "
+                        "per-task TASK_EPS values); run these tasks "
+                        "unbatched"
+                    )
+                shape_key = (method, tuple(datasets[0].shape), T)
+                cold = shape_key not in seen_shapes
+                seen_shapes.add(shape_key)
+                t0 = time.perf_counter()
+                probe_fn = self._fn_for(method, method_args, names[0],
+                                        width=1, n_tasks=T)
+                r0 = probe_fn(preds, labels, self._keys[:1])
+                rest = None
+                if self.seeds > 1:
+                    rest_fn = self._fn_for(method, method_args, names[0],
+                                           width=self.seeds - 1, n_tasks=T)
+                    rest = rest_fn(preds, labels, self._keys[1:])
+                r0 = _to_host(r0)
+                rest = _to_host(rest) if rest is not None else None
+                dt = time.perf_counter() - t0
+                t_compute += dt
+                for t, name in enumerate(names):
+                    r0_t = type(r0)(*[x[t] for x in r0])
+                    if rest is None or not bool(np.asarray(
+                            r0_t.stochastic)[0]):
+                        res = type(r0)(*[
+                            np.repeat(np.asarray(x), self.seeds, axis=0)
+                            for x in r0_t
+                        ])
+                    else:
+                        res = type(r0)(*[
+                            np.concatenate(
+                                [np.asarray(a), np.asarray(b)[t]], axis=0)
+                            for a, b in zip(r0_t, rest)
+                        ])
+                    results[(name, method)] = res
+                    pairs.append({"task": name, "method": method,
+                                  "shape": list(datasets[0].shape),
+                                  "seconds": dt / T, "cold": cold,
+                                  "batched": T})
+                    if store is not None and t in todo:
+                        _log(store, name, method, res, self.seeds,
+                             self.iters)
+                progress(f"[batch x{T}] {'/'.join(names[:3])}"
+                         f"{'...' if T > 3 else ''}/{method}: "
+                         f"{self.seeds} seeds x {self.iters} iters in "
+                         f"{dt:.2f}s{' (incl. compile)' if cold else ''}")
+        total = time.perf_counter() - t_start
+        self.last_stats = {"total_s": total, "load_s": t_load,
+                           "compute_s": t_compute, "pairs": pairs}
+        progress(f"suite[batched]: {len(results)} task-method pairs in "
+                 f"{total:.2f}s (compute {t_compute:.2f}s, data load "
+                 f"{t_load:.2f}s)")
         return results
 
 

@@ -60,7 +60,21 @@ def main(argv=None):
     p.add_argument("--out", default=None, metavar="BENCH_SUITE.json",
                    help="also write the full per-method/per-pair breakdown "
                         "to this JSON file")
+    p.add_argument("--task-batch", action="store_true",
+                   help="run same-shape (same-family) tasks as ONE vmapped "
+                        "program per method (SuiteRunner.run_batched): two "
+                        "dispatches per family-method instead of one-or-two "
+                        "per task-method — the lever for hosts where "
+                        "per-program dispatch latency dominates (e.g. a "
+                        "tunneled device). Incompatible with --mesh.")
+    p.add_argument("--batch-cap", type=int, default=0,
+                   help="with --task-batch: max tasks per batched group "
+                        "(0 = whole family) — the HBM valve for big "
+                        "families")
     args = p.parse_args(argv)
+    if args.task_batch and args.mesh:
+        p.error("--task-batch is single-device (the task axis would need "
+                "its own mesh dimension); drop one of the flags")
     if args.warm_reps is not None and args.warm_reps < 1:
         p.error("--warm-reps must be >= 1")
 
@@ -84,9 +98,11 @@ def main(argv=None):
 
     fams = SMALL_FAMILIES if args.small else FAMILIES
     loaders = []
+    groups = []  # per family, for --task-batch
     for fam, count, H, N, C in fams:
+        fam_loaders = []
         for i in range(count):
-            loaders.append(
+            fam_loaders.append(
                 # stable across processes (hash() is PYTHONHASHSEED-salted)
                 lambda fam=fam, i=i, H=H, N=N, C=C: make_synthetic_task(
                     seed=zlib.crc32(f"{fam}_{i}".encode()) % (2**31),
@@ -94,12 +110,20 @@ def main(argv=None):
                     unsharded_fallback=True,
                 )
             )
+        loaders += fam_loaders
+        cap = args.batch_cap or len(fam_loaders)
+        groups += [fam_loaders[j:j + cap]
+                   for j in range(0, len(fam_loaders), cap)]
 
     methods = args.methods.split(",")
     runner = SuiteRunner(iters=args.iters, seeds=args.seeds)
     t0 = time.perf_counter()
-    results = runner.run(loaders, methods,
-                         method_args={"eig_chunk": args.eig_chunk})
+    if args.task_batch:
+        results = runner.run_batched(
+            groups, methods, method_args={"eig_chunk": args.eig_chunk})
+    else:
+        results = runner.run(loaders, methods,
+                             method_args={"eig_chunk": args.eig_chunk})
     wall = time.perf_counter() - t0
     n_pairs = len(results)
     stats = getattr(runner, "last_stats", {})
@@ -131,6 +155,7 @@ def main(argv=None):
         "load_s": round(stats.get("load_s", 0.0), 2),
         "warm_pairs_s": round(warm_s, 2),
         "per_method_s": {k: v["seconds"] for k, v in per_method.items()},
+        "task_batched": bool(args.task_batch),
         "vs_baseline": 0.0,
     }
 
@@ -145,8 +170,12 @@ def main(argv=None):
         computes, walls = [], []
         for _ in range(max(1, args.warm_reps or 1)):
             t0 = time.perf_counter()
-            runner.run(loaders, methods,
-                       method_args={"eig_chunk": args.eig_chunk})
+            if args.task_batch:
+                runner.run_batched(
+                    groups, methods, method_args={"eig_chunk": args.eig_chunk})
+            else:
+                runner.run(loaders, methods,
+                           method_args={"eig_chunk": args.eig_chunk})
             walls.append(round(time.perf_counter() - t0, 2))
             computes.append(round(runner.last_stats.get("compute_s", 0.0), 2))
         line["steady_state_compute_s"] = statistics.median(computes)

@@ -59,6 +59,47 @@ def test_suite_seed_dedup(three_tasks):
     assert len(seqs) > 1
 
 
+def test_suite_batched_matches_unbatched(three_tasks):
+    """run_batched must reproduce run()'s per-task results EXACTLY for
+    same-shape groups — deterministic methods broadcast from the same
+    probe, stochastic methods use the same seed keys — while dispatching
+    one vmapped program pair per (group, method)."""
+    from coda_tpu.engine.suite import SuiteRunner
+
+    same_shape = three_tasks[:2]  # alpha + beta share (4, 40, 3)
+    methods = ["iid", "uncertainty", "coda"]
+    r_un = SuiteRunner(iters=4, seeds=3).run(
+        list(same_shape), methods, progress=lambda s: None)
+    r_ba = SuiteRunner(iters=4, seeds=3).run_batched(
+        [same_shape], methods, progress=lambda s: None)
+    assert set(r_un) == set(r_ba)
+    for key in r_un:
+        for a, b in zip(r_un[key], r_ba[key]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=str(key))
+
+
+def test_suite_batched_guards():
+    """Mixed shapes raise; mixed per-task hyperparams (model_picker's
+    TASK_EPS) raise with a message that points at the fix."""
+    import pytest as _pytest
+
+    from coda_tpu.data import Dataset, make_synthetic_task
+    from coda_tpu.engine.suite import SuiteRunner
+
+    t1 = make_synthetic_task(seed=1, H=4, N=40, C=3, name="alpha")
+    t3 = make_synthetic_task(seed=3, H=3, N=24, C=4, name="gamma")
+    runner = SuiteRunner(iters=2, seeds=2)
+    with _pytest.raises(ValueError, match="mixes shapes"):
+        runner.run_batched([[t1, t3]], ["iid"], progress=lambda s: None)
+    # wine (0.37) vs digits (0.39) resolve different tuned epsilons
+    ta = Dataset(preds=t1.preds, labels=t1.labels, name="wine")
+    tb = Dataset(preds=t1.preds, labels=t1.labels, name="digits")
+    with _pytest.raises(ValueError, match="unbatched"):
+        runner.run_batched([[ta, tb]], ["model_picker"],
+                           progress=lambda s: None)
+
+
 def test_suite_modelpicker_per_task_epsilon():
     """Task-dependent TASK_EPS must not leak across the compile cache:
     same-shape tasks with different tuned epsilons get different
