@@ -165,16 +165,28 @@ def make_batched_experiment_fn(
     iters: int,
     loss_fn: Callable = accuracy_loss,
 ):
-    """``(preds, labels, keys) -> ExperimentResult`` (seed axis leading).
+    """``(preds, labels, keys, *extra) -> ExperimentResult`` (seed axis
+    leading).
 
     Pure and preds-as-argument, so one ``jax.jit`` wrapper of the returned
     function serves *every same-shape task* from the compile cache — the
-    basis of the in-process suite runner.
+    basis of the in-process suite runner. ``extra`` forwards optional
+    runtime hyperparameters to the factory (``selector_factory(preds,
+    *extra)`` — e.g. ModelPicker's per-task ε as a traced scalar, so one
+    executable serves every task instead of compiling per tuned value).
     """
-    def fn(preds, labels, keys):
-        sel = selector_factory(preds)
+    def fn(preds, labels, keys, *extra):
+        sel = selector_factory(preds, *extra)
         losses = compute_true_losses(preds, labels, loss_fn)
-        return jax.vmap(build_experiment_fn(sel, labels, losses, iters))(keys)
+        exp = build_experiment_fn(sel, labels, losses, iters)
+        if keys.shape[0] == 1:
+            # width-1 batches (the suite's seed-0 probe) skip the seed vmap:
+            # under vmap both pallas kernels' custom_vmap rules fall back to
+            # the XLA composition even though a single replica needs no
+            # batching at all — the unwrapped call keeps the fast path
+            # (fused scorer + DMA gather) engaged on TPU
+            return jax.tree.map(lambda x: jnp.asarray(x)[None], exp(keys[0]))
+        return jax.vmap(exp)(keys)
 
     return fn
 

@@ -27,6 +27,13 @@ import numpy as np
 from coda_tpu.engine.loop import make_batched_experiment_fn
 from coda_tpu.losses import LOSS_FNS
 
+# Hyperparams passed to the jitted program as TRACED runtime scalars instead
+# of being baked into the executable: the per-task tuned values then share
+# one compile (and one task-batch group) across tasks. ModelPicker's ε is
+# the one task-dependent hyperparam in the benchmark (reference
+# ``coda/baselines/modelpicker.py:5-35``).
+RUNTIME_HYPERPARAMS = {"model_picker": ("epsilon",)}
+
 
 class SuiteRunner:
     """Runs (task, method) pairs, reusing compiled programs across tasks.
@@ -72,6 +79,26 @@ class SuiteRunner:
             resolved["epsilon"] = TASK_EPS.get(task_name, DEFAULT_EPS)
         return resolved
 
+    def _static_resolved(self, resolved: dict, method: str) -> dict:
+        """The subset of resolved hyperparams that keys an executable —
+        runtime-traced ones (ModelPicker's ε) are excluded."""
+        runtime = RUNTIME_HYPERPARAMS.get(method, ())
+        return {k: v for k, v in resolved.items() if k not in runtime}
+
+    def _extra_args(self, method: str, resolved_list: Sequence[dict],
+                    batched: bool = False):
+        """Runtime-hyperparam tuple for a call: each entry is a f32 scalar
+        (``run_one``) or a (T,) array (``run_batched`` — always rank 1,
+        the task-axis vmap maps it with in_axes=0 even at T=1)."""
+        runtime = RUNTIME_HYPERPARAMS.get(method, ())
+        jnp = self._jax.numpy
+        out = []
+        for k in runtime:
+            vals = [r[k] for r in resolved_list]
+            out.append(jnp.asarray(vals if batched else vals[0],
+                                   jnp.float32))
+        return tuple(out)
+
     def _fn_for(self, method: str, method_args: Optional[dict],
                 task_name: str, width: int = 1, n_tasks: int = 0):
         # ``width`` = how many seed replicas this executable batches (the
@@ -84,20 +111,30 @@ class SuiteRunner:
         from coda_tpu.cli import build_selector_factory, parse_args
 
         resolved = self._resolved_args(method, method_args, task_name)
-        key = (method, tuple(sorted(resolved.items())), width, n_tasks)
+        runtime = RUNTIME_HYPERPARAMS.get(method, ())
+        static = self._static_resolved(resolved, method)
+        key = (method, tuple(sorted(static.items())), width, n_tasks)
         if key not in self._jitted:
             args = parse_args([])
             args.method = method
             args.loss = [k for k, v in LOSS_FNS.items() if v is self.loss_fn][0]
             args.iters = self.iters
             args.n_parallel = max(1, width * max(1, n_tasks))
-            for k, v in resolved.items():
+            for k, v in static.items():
                 setattr(args, k, v)
-            factory = build_selector_factory(args, task_name)
+            if method == "model_picker" and "epsilon" in runtime:
+                from coda_tpu.selectors import make_modelpicker
+
+                def factory(preds, eps):
+                    return make_modelpicker(preds, epsilon=eps)
+            else:
+                factory = build_selector_factory(args, task_name)
             fn = make_batched_experiment_fn(factory, self.iters, self.loss_fn)
             if n_tasks:
-                # (T, H, N, C) preds, (T, N) labels, shared seed keys
-                fn = self._jax.vmap(fn, in_axes=(0, 0, None))
+                # (T, H, N, C) preds, (T, N) labels, shared seed keys,
+                # per-task runtime hyperparams (T,)
+                in_axes = (0, 0, None) + (0,) * len(runtime)
+                fn = self._jax.vmap(fn, in_axes=in_axes)
             self._jitted[key] = self._jax.jit(fn)
         return self._jitted[key]
 
@@ -114,6 +151,8 @@ class SuiteRunner:
         ``seeds`` experiments; pin ``eig_mode`` explicitly if strict
         cross-seed tier homogeneity matters more than the auto budget.
         """
+        extra = self._extra_args(
+            method, [self._resolved_args(method, method_args, dataset.name)])
         if self.dedup_seeds and self.seeds > 1:
             fn = self._fn_for(method, method_args, dataset.name, width=1)
             # seed 0 runs alone; deterministic -> broadcast, stochastic ->
@@ -121,7 +160,7 @@ class SuiteRunner:
             # is kept, never recomputed). Total device work is exactly
             # ``seeds`` experiments either way; two batch sizes (1, seeds-1)
             # get compiled per method instead of one.
-            r0 = fn(dataset.preds, dataset.labels, self._keys[:1])
+            r0 = fn(dataset.preds, dataset.labels, self._keys[:1], *extra)
             if not bool(np.asarray(r0.stochastic)[0]):
                 # deterministic run: every seed is identical — broadcast
                 return type(r0)(*[
@@ -129,13 +168,14 @@ class SuiteRunner:
                 ])
             rest_fn = self._fn_for(method, method_args, dataset.name,
                                    width=self.seeds - 1)
-            rest = rest_fn(dataset.preds, dataset.labels, self._keys[1:])
+            rest = rest_fn(dataset.preds, dataset.labels, self._keys[1:],
+                           *extra)
             return type(r0)(*[
                 np.concatenate([np.asarray(a), np.asarray(b)], axis=0)
                 for a, b in zip(r0, rest)
             ])
         fn = self._fn_for(method, method_args, dataset.name, width=self.seeds)
-        return fn(dataset.preds, dataset.labels, self._keys)
+        return fn(dataset.preds, dataset.labels, self._keys, *extra)
 
     def run(
         self,
@@ -181,7 +221,13 @@ class SuiteRunner:
                 ):
                     progress(f"skip {ds.name}/{method} (finished)")
                     continue
-                shape_key = (method, tuple(ds.shape))
+                # cold attribution mirrors the jit-cache granularity: the
+                # executable keys on (method, static resolved hyperparams,
+                # width) and re-specializes per shape — runtime-traced
+                # hyperparams (ModelPicker's ε) deliberately absent
+                shape_key = (method, tuple(sorted(self._static_resolved(
+                    self._resolved_args(method, method_args, ds.name),
+                    method).items())), tuple(ds.shape))
                 cold = shape_key not in seen_shapes  # first run pays compile
                 seen_shapes.add(shape_key)
                 t0 = time.perf_counter()
@@ -212,14 +258,16 @@ class SuiteRunner:
         groups: Sequence[Sequence],
         methods: Sequence[str],
         store=None,
+        force_rerun: bool = False,
         method_args: Optional[dict] = None,
         progress: Callable[[str], None] = print,
     ) -> dict:
         """The sweep with same-shape tasks BATCHED into one program.
 
         ``groups``: lists of datasets-or-loaders; within a group every task
-        must share its (H, N, C) shape and resolve identical method
-        hyperparams (model_picker's per-task ε — mixed groups raise).
+        must share its (H, N, C) shape and resolve identical *static* method
+        hyperparams (runtime-traced ones — ModelPicker's per-task ε — ride
+        along as a (T,) argument, so mixed tuned values batch fine).
         Each (group, method) pair costs TWO program dispatches (the width-1
         seed probe over all T tasks, then the remaining seeds), instead of
         ``run``'s one-or-two per task — the dispatch-count lever for hosts
@@ -231,6 +279,10 @@ class SuiteRunner:
         DISCARDED (the rest batch is computed unconditionally here — the
         price of batching is wasted rest-compute for deterministic tasks,
         cheap on an accelerator; the statistical contract is unchanged).
+        With a ``store``, only the UNFINISHED subset of a group is stacked
+        and dispatched (``run``'s resume semantics — finished tasks are
+        skipped, not recomputed; a partial subset keys a separate T so it
+        costs one extra compile per distinct todo-count).
         Tasks inside a group share one vmapped executable, so the auto
         eig_mode budget sees T x width replicas and may resolve a
         different tier than ``run`` would — the tiers are
@@ -256,44 +308,54 @@ class SuiteRunner:
                 )
             preds = self._jax.numpy.stack([d.preds for d in datasets])
             labels = self._jax.numpy.stack([d.labels for d in datasets])
-            T = len(datasets)
             names = [d.name for d in datasets]
             for method in methods:
                 todo = [
                     i for i, n in enumerate(names)
-                    if not (store is not None and _finished(
+                    if force_rerun or not (store is not None and _finished(
                         store, n, method, self.seeds))
                 ]
-                if not todo:
-                    for n in names:
+                for i, n in enumerate(names):
+                    if i not in todo:
                         progress(f"skip {n}/{method} (finished)")
+                if not todo:
                     continue
-                resolved = [self._resolved_args(method, method_args, n)
-                            for n in names]
-                if any(r != resolved[0] for r in resolved[1:]):
+                resolved = [self._resolved_args(method, method_args,
+                                                names[i]) for i in todo]
+                statics = [self._static_resolved(r, method) for r in resolved]
+                if any(s != statics[0] for s in statics[1:]):
                     raise ValueError(
                         f"run_batched: method {method!r} resolves different "
-                        f"hyperparams across the group {names} (e.g. "
-                        "per-task TASK_EPS values); run these tasks "
+                        f"static hyperparams across the group "
+                        f"{[names[i] for i in todo]}; run these tasks "
                         "unbatched"
                     )
-                shape_key = (method, tuple(datasets[0].shape), T)
+                T = len(todo)
+                if T < len(names):
+                    sub = self._jax.numpy.asarray(todo)
+                    preds_m, labels_m = preds[sub], labels[sub]
+                else:
+                    preds_m, labels_m = preds, labels
+                names_m = [names[i] for i in todo]
+                extra = self._extra_args(method, resolved, batched=True)
+                shape_key = (method, tuple(sorted(statics[0].items())),
+                             tuple(datasets[0].shape), T)
                 cold = shape_key not in seen_shapes
                 seen_shapes.add(shape_key)
                 t0 = time.perf_counter()
-                probe_fn = self._fn_for(method, method_args, names[0],
+                probe_fn = self._fn_for(method, method_args, names_m[0],
                                         width=1, n_tasks=T)
-                r0 = probe_fn(preds, labels, self._keys[:1])
+                r0 = probe_fn(preds_m, labels_m, self._keys[:1], *extra)
                 rest = None
                 if self.seeds > 1:
-                    rest_fn = self._fn_for(method, method_args, names[0],
+                    rest_fn = self._fn_for(method, method_args, names_m[0],
                                            width=self.seeds - 1, n_tasks=T)
-                    rest = rest_fn(preds, labels, self._keys[1:])
+                    rest = rest_fn(preds_m, labels_m, self._keys[1:], *extra)
                 r0 = _to_host(r0)
                 rest = _to_host(rest) if rest is not None else None
                 dt = time.perf_counter() - t0
                 t_compute += dt
-                for t, name in enumerate(names):
+                for t, name in enumerate(names_m):
                     r0_t = type(r0)(*[x[t] for x in r0])
                     if rest is None or not bool(np.asarray(
                             r0_t.stochastic)[0]):
@@ -312,10 +374,10 @@ class SuiteRunner:
                                   "shape": list(datasets[0].shape),
                                   "seconds": dt / T, "cold": cold,
                                   "batched": T})
-                    if store is not None and t in todo:
+                    if store is not None:
                         _log(store, name, method, res, self.seeds,
                              self.iters)
-                progress(f"[batch x{T}] {'/'.join(names[:3])}"
+                progress(f"[batch x{T}] {'/'.join(names_m[:3])}"
                          f"{'...' if T > 3 else ''}/{method}: "
                          f"{self.seeds} seeds x {self.iters} iters in "
                          f"{dt:.2f}s{' (incl. compile)' if cold else ''}")

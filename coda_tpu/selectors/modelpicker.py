@@ -128,11 +128,17 @@ def expected_entropies(
 
 def make_modelpicker(
     preds: jnp.ndarray,
-    epsilon: float = DEFAULT_EPS,
+    epsilon=DEFAULT_EPS,
     name: str = "model_picker",
 ) -> Selector:
+    """``epsilon`` may be a Python float (baked into the program) or a
+    traced jnp scalar — the suite passes the per-task tuned ε as a runtime
+    argument so ONE executable serves all 26 tasks (ε enters only through
+    γ = (1-ε)/ε, which flows through the entropy/update math unchanged)."""
     H, N, C = preds.shape
-    epsilon = float(epsilon)
+    traced_eps = isinstance(epsilon, jax.core.Tracer)
+    if not traced_eps:
+        epsilon = float(epsilon)
     gamma = (1.0 - epsilon) / epsilon
     hard_preds = preds.argmax(-1).T.astype(jnp.int32)  # (N, H)
     # points where any model disagrees with model 0 (reference :46-48)
@@ -210,5 +216,5 @@ def make_modelpicker(
     return Selector(
         name=name, init=init, select=select, update=update, best=best,
         always_stochastic=True,
-        hyperparams={"epsilon": epsilon},
+        hyperparams={"epsilon": None if traced_eps else epsilon},
     )

@@ -18,6 +18,19 @@ import jax
 # tests on the virtual-device CPU backend regardless.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache: test wall-clock is dominated by XLA compiles of
+# shape-stable programs (every test re-jits the same tiny-shape experiment
+# programs), and the cache works on the CPU backend — a warm rerun of the
+# full suite skips nearly all of that. Override the location with
+# CODA_TEST_COMPILE_CACHE=; disable with CODA_TEST_COMPILE_CACHE=off.
+_cache = os.environ.get(
+    "CODA_TEST_COMPILE_CACHE",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_test_cache"),
+)
+if _cache != "off":
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np
 import pytest
 
