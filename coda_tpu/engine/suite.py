@@ -260,6 +260,7 @@ class SuiteRunner:
         store=None,
         force_rerun: bool = False,
         method_args: Optional[dict] = None,
+        batch_caps: Optional[dict] = None,
         progress: Callable[[str], None] = print,
     ) -> dict:
         """The sweep with same-shape tasks BATCHED into one program.
@@ -283,6 +284,12 @@ class SuiteRunner:
         and dispatched (``run``'s resume semantics — finished tasks are
         skipped, not recomputed; a partial subset keys a separate T so it
         costs one extra compile per distinct todo-count).
+        ``batch_caps`` maps method -> max tasks per dispatch (an int, or a
+        callable ``(H, N, C) -> int`` evaluated per group shape):
+        memory-heavy methods (CODA's per-replica incremental cache is as
+        large as the prediction tensor itself) split a group into
+        sub-batches so the auto eig_mode budget keeps the fast tier, while
+        cheap methods still batch the whole group.
         Tasks inside a group share one vmapped executable, so the auto
         eig_mode budget sees T x width replicas and may resolve a
         different tier than ``run`` would — the tiers are
@@ -320,67 +327,17 @@ class SuiteRunner:
                         progress(f"skip {n}/{method} (finished)")
                 if not todo:
                     continue
-                resolved = [self._resolved_args(method, method_args,
-                                                names[i]) for i in todo]
-                statics = [self._static_resolved(r, method) for r in resolved]
-                if any(s != statics[0] for s in statics[1:]):
-                    raise ValueError(
-                        f"run_batched: method {method!r} resolves different "
-                        f"static hyperparams across the group "
-                        f"{[names[i] for i in todo]}; run these tasks "
-                        "unbatched"
-                    )
-                T = len(todo)
-                if T < len(names):
-                    sub = self._jax.numpy.asarray(todo)
-                    preds_m, labels_m = preds[sub], labels[sub]
-                else:
-                    preds_m, labels_m = preds, labels
-                names_m = [names[i] for i in todo]
-                extra = self._extra_args(method, resolved, batched=True)
-                shape_key = (method, tuple(sorted(statics[0].items())),
-                             tuple(datasets[0].shape), T)
-                cold = shape_key not in seen_shapes
-                seen_shapes.add(shape_key)
-                t0 = time.perf_counter()
-                probe_fn = self._fn_for(method, method_args, names_m[0],
-                                        width=1, n_tasks=T)
-                r0 = probe_fn(preds_m, labels_m, self._keys[:1], *extra)
-                rest = None
-                if self.seeds > 1:
-                    rest_fn = self._fn_for(method, method_args, names_m[0],
-                                           width=self.seeds - 1, n_tasks=T)
-                    rest = rest_fn(preds_m, labels_m, self._keys[1:], *extra)
-                r0 = _to_host(r0)
-                rest = _to_host(rest) if rest is not None else None
-                dt = time.perf_counter() - t0
-                t_compute += dt
-                for t, name in enumerate(names_m):
-                    r0_t = type(r0)(*[x[t] for x in r0])
-                    if rest is None or not bool(np.asarray(
-                            r0_t.stochastic)[0]):
-                        res = type(r0)(*[
-                            np.repeat(np.asarray(x), self.seeds, axis=0)
-                            for x in r0_t
-                        ])
-                    else:
-                        res = type(r0)(*[
-                            np.concatenate(
-                                [np.asarray(a), np.asarray(b)[t]], axis=0)
-                            for a, b in zip(r0_t, rest)
-                        ])
-                    results[(name, method)] = res
-                    pairs.append({"task": name, "method": method,
-                                  "shape": list(datasets[0].shape),
-                                  "seconds": dt / T, "cold": cold,
-                                  "batched": T})
-                    if store is not None:
-                        _log(store, name, method, res, self.seeds,
-                             self.iters)
-                progress(f"[batch x{T}] {'/'.join(names_m[:3])}"
-                         f"{'...' if T > 3 else ''}/{method}: "
-                         f"{self.seeds} seeds x {self.iters} iters in "
-                         f"{dt:.2f}s{' (incl. compile)' if cold else ''}")
+                cap = (batch_caps or {}).get(method)
+                if callable(cap):
+                    cap = cap(*datasets[0].shape)
+                cap = cap or len(todo)
+                for chunk in (todo[j:j + cap]
+                              for j in range(0, len(todo), cap)):
+                    self._dispatch_batch(
+                        chunk, names, preds, labels, method, method_args,
+                        datasets[0].shape, store, seen_shapes, pairs,
+                        results, progress)
+                    t_compute += pairs[-1]["seconds"] * pairs[-1]["batched"]
         total = time.perf_counter() - t_start
         self.last_stats = {"total_s": total, "load_s": t_load,
                            "compute_s": t_compute, "pairs": pairs}
@@ -388,6 +345,73 @@ class SuiteRunner:
                  f"{total:.2f}s (compute {t_compute:.2f}s, data load "
                  f"{t_load:.2f}s)")
         return results
+
+    def _dispatch_batch(self, todo, names, preds, labels, method,
+                        method_args, shape, store, seen_shapes, pairs,
+                        results, progress) -> None:
+        """One stacked dispatch of ``todo``'s tasks for one method (the
+        run_batched inner body: probe + rest, broadcast/concat per task,
+        logging, timing records)."""
+        resolved = [self._resolved_args(method, method_args,
+                                        names[i]) for i in todo]
+        statics = [self._static_resolved(r, method) for r in resolved]
+        if any(s != statics[0] for s in statics[1:]):
+            raise ValueError(
+                f"run_batched: method {method!r} resolves different "
+                f"static hyperparams across the group "
+                f"{[names[i] for i in todo]}; run these tasks "
+                "unbatched"
+            )
+        T = len(todo)
+        if T < len(names):
+            sub = self._jax.numpy.asarray(todo)
+            preds_m, labels_m = preds[sub], labels[sub]
+        else:
+            preds_m, labels_m = preds, labels
+        names_m = [names[i] for i in todo]
+        extra = self._extra_args(method, resolved, batched=True)
+        shape_key = (method, tuple(sorted(statics[0].items())),
+                     tuple(shape), T)
+        cold = shape_key not in seen_shapes
+        seen_shapes.add(shape_key)
+        t0 = time.perf_counter()
+        probe_fn = self._fn_for(method, method_args, names_m[0],
+                                width=1, n_tasks=T)
+        r0 = probe_fn(preds_m, labels_m, self._keys[:1], *extra)
+        rest = None
+        if self.seeds > 1:
+            rest_fn = self._fn_for(method, method_args, names_m[0],
+                                   width=self.seeds - 1, n_tasks=T)
+            rest = rest_fn(preds_m, labels_m, self._keys[1:], *extra)
+        r0 = _to_host(r0)
+        rest = _to_host(rest) if rest is not None else None
+        dt = time.perf_counter() - t0
+        for t, name in enumerate(names_m):
+            r0_t = type(r0)(*[x[t] for x in r0])
+            if rest is None or not bool(np.asarray(
+                    r0_t.stochastic)[0]):
+                res = type(r0)(*[
+                    np.repeat(np.asarray(x), self.seeds, axis=0)
+                    for x in r0_t
+                ])
+            else:
+                res = type(r0)(*[
+                    np.concatenate(
+                        [np.asarray(a), np.asarray(b)[t]], axis=0)
+                    for a, b in zip(r0_t, rest)
+                ])
+            results[(name, method)] = res
+            pairs.append({"task": name, "method": method,
+                          "shape": list(shape),
+                          "seconds": dt / T, "cold": cold,
+                          "batched": T})
+            if store is not None:
+                _log(store, name, method, res, self.seeds,
+                     self.iters)
+        progress(f"[batch x{T}] {'/'.join(names_m[:3])}"
+                 f"{'...' if T > 3 else ''}/{method}: "
+                 f"{self.seeds} seeds x {self.iters} iters in "
+                 f"{dt:.2f}s{' (incl. compile)' if cold else ''}")
 
 
 def _to_host(res):

@@ -117,10 +117,24 @@ def main(argv=None):
 
     methods = args.methods.split(",")
     runner = SuiteRunner(iters=args.iters, seeds=args.seeds)
+
+    def coda_cap(H, N, C):
+        # CODA sub-batches within a family so the (seeds-1)-wide rest batch
+        # keeps every replica's (C, N, H) incremental cache inside the auto
+        # eig_mode budget — past it the tier falls to the stateless
+        # factored kernel, whose per-round transcendental tables cost far
+        # more than the extra dispatches (the large DomainNet family is the
+        # one this splits: cap 3 at the FULL shape)
+        from coda_tpu.selectors.coda import _INCR_CACHE_MAX_BYTES
+
+        per_task = max(1, args.seeds - 1) * 4 * H * N * C
+        return max(1, int(_INCR_CACHE_MAX_BYTES // per_task))
+
     t0 = time.perf_counter()
     if args.task_batch:
         results = runner.run_batched(
-            groups, methods, method_args={"eig_chunk": args.eig_chunk})
+            groups, methods, method_args={"eig_chunk": args.eig_chunk},
+            batch_caps={"coda": coda_cap})
     else:
         results = runner.run(loaders, methods,
                              method_args={"eig_chunk": args.eig_chunk})
@@ -172,7 +186,8 @@ def main(argv=None):
             t0 = time.perf_counter()
             if args.task_batch:
                 runner.run_batched(
-                    groups, methods, method_args={"eig_chunk": args.eig_chunk})
+                    groups, methods, method_args={"eig_chunk": args.eig_chunk},
+                    batch_caps={"coda": coda_cap})
             else:
                 runner.run(loaders, methods,
                            method_args={"eig_chunk": args.eig_chunk})

@@ -293,3 +293,28 @@ def test_suite_batched_single_task_group():
         for a, b in zip(r_un[key], r_ba[key]):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
                                           err_msg=str(key))
+
+
+def test_suite_batched_caps_split_dispatches(three_tasks):
+    """batch_caps sub-chunks a group per method (int or shape-callable);
+    results still match the unbatched run exactly."""
+    from coda_tpu.engine.suite import SuiteRunner
+
+    same_shape = three_tasks[:2]
+    r_un = SuiteRunner(iters=3, seeds=2).run(
+        list(same_shape), ["coda", "iid"], progress=lambda s: None)
+    runner = SuiteRunner(iters=3, seeds=2)
+    r_ba = runner.run_batched(
+        [same_shape], ["coda", "iid"],
+        batch_caps={"coda": 1, "iid": lambda H, N, C: 2},
+        progress=lambda s: None)
+    coda_pairs = [p for p in runner.last_stats["pairs"]
+                  if p["method"] == "coda"]
+    assert [p["batched"] for p in coda_pairs] == [1, 1]
+    iid_pairs = [p for p in runner.last_stats["pairs"]
+                 if p["method"] == "iid"]
+    assert [p["batched"] for p in iid_pairs] == [2, 2]
+    for key in r_un:
+        for a, b in zip(r_un[key], r_ba[key]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=str(key))
