@@ -160,13 +160,14 @@ def eig_scores_cache_pallas(
     if interpret is None:  # Mosaic compiles only on real TPUs
         interpret = jax.default_backend() != "tpu"
 
-    # under vmap, fall back to the jnp path: a batched pallas_call turns
-    # the batch into an extra grid/block dimension whose (8, 128) padding
-    # inflates the small (B, 1) tiles into full lane-rows — the suite's
-    # width-1 seed probe hit scoped-VMEM OOM exactly this way on a v5e —
-    # and batched runs are multi-experiment workloads where the XLA path
-    # is the right tier anyway (same reasoning as resolve_eig_backend's
-    # n_parallel guard)
+    # under vmap, dispatch to the EXPLICITLY batched kernel (grid over the
+    # batch axis, so each grid step keeps the unbatched tile shapes) when
+    # every operand carries the batch — pallas' AUTOMATIC vmap batching
+    # would instead add a block dimension whose (8, 128) padding inflates
+    # the small (B, 1) tiles into full lane-rows (the suite's width-1 seed
+    # probe hit scoped-VMEM OOM exactly this way on a v5e, round 4). A
+    # partially-batched call (some operand shared across the batch) falls
+    # back to the jnp composition.
     from jax import custom_batching
 
     @custom_batching.custom_vmap
@@ -176,6 +177,10 @@ def eig_scores_cache_pallas(
 
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, pi_b, pi_xi_b):
+        if all(in_batched):
+            return eig_scores_cache_pallas_batched(
+                rows_b, hyp_b, pi_b, pi_xi_b, block=block,
+                interpret=interpret), True
         from coda_tpu.selectors.coda import eig_scores_from_cache
 
         in_axes = [0 if b else None for b in in_batched]
@@ -187,6 +192,107 @@ def eig_scores_cache_pallas(
         return out, True
 
     return _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def eig_scores_cache_pallas_batched(
+    pbest_rows: jnp.ndarray,   # (S, C, H)
+    pbest_hyp: jnp.ndarray,    # (S, C, N, H)
+    pi_hat: jnp.ndarray,       # (S, C)
+    pi_hat_xi: jnp.ndarray,    # (S, N, C)
+    block: int = 0,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """(S, N) EIG scores for a BATCH of incremental caches in one kernel.
+
+    The batch (the suite's vmapped seeds / stacked tasks) is an extra
+    leading GRID dimension — each grid step processes one replica's
+    (C, B, H) tile with exactly the unbatched kernel's block shapes and
+    VMEM footprint, so batching multiplies grid steps, not tile padding.
+    Per-replica numerics identical to :func:`eig_scores_cache_pallas`.
+    Nested vmaps (tasks over seeds) flatten into the one batch axis via
+    the custom_vmap rule below.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    from jax import custom_batching
+
+    @custom_batching.custom_vmap
+    def _call(rows, hyp, pi, pi_xi):
+        return _scores_impl_batched(rows, hyp, pi, pi_xi, block, interpret)
+
+    @_call.def_vmap
+    def _call_vmap(axis_size, in_batched, rows_b, hyp_b, pi_b, pi_xi_b):
+        if not all(in_batched):
+            from coda_tpu.selectors.coda import eig_scores_from_cache
+
+            in_axes = [0 if b else None for b in in_batched]
+            out = jax.vmap(
+                lambda r, h, p, px: jax.vmap(
+                    lambda r2, h2, p2, px2: eig_scores_from_cache(
+                        r2, h2, p2, px2, chunk=block or 2048)
+                )(r, h, p, px),
+                in_axes=in_axes,
+            )(rows_b, hyp_b, pi_b, pi_xi_b)
+            return out, True
+        # flatten (T, S, ...) -> (T*S, ...) and recurse into the batched
+        # kernel — arbitrary vmap nesting collapses to one grid axis
+        T, S = rows_b.shape[0], rows_b.shape[1]
+
+        def flat(x):
+            return x.reshape((T * S,) + x.shape[2:])
+
+        out = eig_scores_cache_pallas_batched(
+            flat(rows_b), flat(hyp_b), flat(pi_b), flat(pi_xi_b),
+            block=block, interpret=interpret)
+        return out.reshape(T, S, -1), True
+
+    return _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
+
+
+def _batched_score_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
+                          hyp_ref, pi_xi_t_ref, out_ref):
+    """One (replica, N-tile) grid step: refs carry a leading size-1 batch
+    block; the math is :func:`_score_block_kernel`'s exactly."""
+    hyp = hyp_ref[0].astype(jnp.float32)
+    out_ref[0] = _weighted_entropy_scores(
+        hyp, mixture0_ref[0], h_before_ref[0], pi_hat_ref[0], rows_ref[0],
+        pi_xi_t_ref[0])
+
+
+def _scores_impl_batched(rows, hyp, pi, pi_xi, block: int,
+                         interpret: bool) -> jnp.ndarray:
+    S, C, N, H = hyp.shape
+    B = choose_block(N, C, H, block, itemsize=hyp.dtype.itemsize)
+    # _mixture_stats already emits (1, 1, H)/(1, 1) per replica, so the
+    # vmap lands exactly on the (S, 1, 1, H)/(S, 1, 1) operand shapes
+    mixture0, h_before = jax.vmap(_mixture_stats)(rows, pi)
+    n_blocks = -(-N // B)
+
+    out = pl.pallas_call(
+        _batched_score_kernel,
+        out_shape=jax.ShapeDtypeStruct((S, N, 1), jnp.float32),
+        grid=(S, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, H), lambda s, i: (s, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda s, i: (s, 0, 0)),
+            pl.BlockSpec((1, C, 1, 1), lambda s, i: (s, 0, 0, 0)),
+            pl.BlockSpec((1, C, 1, H), lambda s, i: (s, 0, 0, 0)),
+            pl.BlockSpec((1, C, B, H), lambda s, i: (s, 0, i, 0)),
+            pl.BlockSpec((1, C, B, 1), lambda s, i: (s, 0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, B, 1), lambda s, i: (s, i, 0)),
+        interpret=interpret,
+    )(
+        mixture0,                          # (S, 1, 1, H)
+        h_before,                          # (S, 1, 1)
+        pi[:, :, None, None],              # (S, C, 1, 1)
+        rows[:, :, None, :],               # (S, C, 1, H)
+        hyp,                               # (S, C, N, H)
+        jnp.swapaxes(pi_xi, 1, 2)[..., None],  # (S, C, N, 1)
+    )
+    return out[:, :, 0]
 
 
 def _mixture_stats(pbest_rows, pi_hat):
@@ -293,9 +399,10 @@ def eig_scores_refresh_pallas(
     if interpret is None:  # Mosaic compiles only on real TPUs
         interpret = jax.default_backend() != "tpu"
 
-    # same vmap fallback as eig_scores_cache_pallas: batched pallas tiles
-    # pad pathologically, so a vmapped caller gets the equivalent
-    # DUS-then-score jnp composition instead
+    # same vmap strategy as eig_scores_cache_pallas: a fully-batched call
+    # dispatches to the explicitly batched kernel (batch = extra grid
+    # axis, unbatched tile shapes); partial batching falls back to the
+    # equivalent DUS-then-score jnp composition
     from jax import custom_batching
 
     @custom_batching.custom_vmap
@@ -306,6 +413,10 @@ def eig_scores_refresh_pallas(
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, hyp_t_b, c_b,
                    pi_b, pi_xi_b):
+        if all(in_batched):
+            return eig_scores_refresh_pallas_batched(
+                rows_b, hyp_b, hyp_t_b, c_b, pi_b, pi_xi_b, block=block,
+                interpret=interpret), (True, True)
         from coda_tpu.selectors.coda import eig_scores_from_cache
 
         in_axes = [0 if b else None for b in in_batched]
@@ -322,6 +433,136 @@ def eig_scores_refresh_pallas(
 
     return _call(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat,
                  pi_hat_xi)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def eig_scores_refresh_pallas_batched(
+    pbest_rows: jnp.ndarray,   # (S, C, H) — ALREADY holding refreshed rows
+    pbest_hyp: jnp.ndarray,    # (S, C, N, H) — still holding the OLD rows
+    hyp_t: jnp.ndarray,        # (S, N, H) replacement rows
+    true_class: jnp.ndarray,   # (S,) int
+    pi_hat: jnp.ndarray,       # (S, C)
+    pi_hat_xi: jnp.ndarray,    # (S, N, C)
+    block: int = 0,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused refresh+score for a BATCH of caches: ``(scores (S, N),
+    updated cache (S, C, N, H))``.
+
+    Batch = leading grid axis (same tile shapes and VMEM budget as the
+    unbatched kernel); each replica's refreshed class row comes from its
+    own scalar-prefetched index, so the row-only aliased write works per
+    replica. Per-replica numerics identical to
+    :func:`eig_scores_refresh_pallas`.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    from jax import custom_batching
+
+    @custom_batching.custom_vmap
+    def _call(rows, hyp, hyp_t, cls, pi, pi_xi):
+        return _refresh_impl_batched(rows, hyp, hyp_t, cls, pi, pi_xi,
+                                     block, interpret)
+
+    @_call.def_vmap
+    def _call_vmap(axis_size, in_batched, rows_b, hyp_b, hyp_t_b, c_b,
+                   pi_b, pi_xi_b):
+        if not all(in_batched):
+            from coda_tpu.selectors.coda import eig_scores_from_cache
+
+            in_axes = [0 if b else None for b in in_batched]
+
+            def one(rows, hyp, hyp_t, cls, pi, pi_xi):
+                def one2(r, h, ht, c, p, px):
+                    h2 = h.at[c].set(ht.astype(h.dtype))
+                    return eig_scores_from_cache(
+                        r, h2, p, px, chunk=block or 2048), h2
+
+                return jax.vmap(one2)(rows, hyp, hyp_t, cls, pi, pi_xi)
+
+            out = jax.vmap(one, in_axes=in_axes)(
+                rows_b, hyp_b, hyp_t_b, c_b, pi_b, pi_xi_b)
+            return out, (True, True)
+        T, S = rows_b.shape[0], rows_b.shape[1]
+
+        def flat(x):
+            return x.reshape((T * S,) + x.shape[2:])
+
+        scores, hyp_out = eig_scores_refresh_pallas_batched(
+            flat(rows_b), flat(hyp_b), flat(hyp_t_b), flat(c_b),
+            flat(pi_b), flat(pi_xi_b), block=block, interpret=interpret)
+        return (scores.reshape((T, S) + scores.shape[1:]),
+                hyp_out.reshape((T, S) + hyp_out.shape[1:])), (True, True)
+
+    return _call(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat,
+                 pi_hat_xi)
+
+
+def _batched_refresh_kernel(c_sp_ref, mixture0_ref, h_before_ref,
+                            pi_hat_ref, rows_ref, hyp_t_ref, pi_xi_t_ref,
+                            hyp_ref, score_ref, row_out_ref):
+    """One (replica, N-tile) grid step of the batched fused pass — the
+    math of :func:`_refresh_score_kernel` on this replica's blocks."""
+    c = c_sp_ref[pl.program_id(0)]
+    row_store = hyp_t_ref[0].astype(hyp_ref.dtype)       # (B, H)
+    row_out_ref[0] = row_store[None]
+    row_new = row_store.astype(jnp.float32)
+    cls = lax.broadcasted_iota(jnp.int32, (hyp_ref.shape[1], 1, 1), 0)
+    hyp = jnp.where(cls == c, row_new[None],
+                    hyp_ref[0].astype(jnp.float32))
+    score_ref[0] = _weighted_entropy_scores(
+        hyp, mixture0_ref[0], h_before_ref[0], pi_hat_ref[0], rows_ref[0],
+        pi_xi_t_ref[0])
+
+
+def _refresh_impl_batched(rows, hyp, hyp_t, cls, pi, pi_xi, block: int,
+                          interpret: bool):
+    S, C, N, H = hyp.shape
+    B = choose_block(N, C, H, block, itemsize=hyp.dtype.itemsize,
+                     fused=True)
+    mixture0, h_before = jax.vmap(_mixture_stats)(rows, pi)
+    n_blocks = -(-N // B)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, H), lambda s, i, c: (s, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda s, i, c: (s, 0, 0)),
+            pl.BlockSpec((1, C, 1, 1), lambda s, i, c: (s, 0, 0, 0)),
+            pl.BlockSpec((1, C, 1, H), lambda s, i, c: (s, 0, 0, 0)),
+            pl.BlockSpec((1, B, H), lambda s, i, c: (s, i, 0)),  # hyp_t
+            pl.BlockSpec((1, C, B, 1), lambda s, i, c: (s, 0, i, 0)),
+            pl.BlockSpec((1, C, B, H), lambda s, i, c: (s, 0, i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, B, 1), lambda s, i, c: (s, i, 0)),
+            # each replica's refreshed class row only, at its own
+            # scalar-prefetched index
+            pl.BlockSpec((1, 1, B, H), lambda s, i, c: (s, c[s], i, 0)),
+        ),
+    )
+    scores, hyp_out = pl.pallas_call(
+        _batched_refresh_kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((S, N, 1), jnp.float32),
+            jax.ShapeDtypeStruct(hyp.shape, hyp.dtype),
+        ),
+        input_output_aliases={7: 1},
+        interpret=interpret,
+    )(
+        jnp.asarray(cls, jnp.int32),
+        mixture0,
+        h_before,
+        pi[:, :, None, None],
+        rows[:, :, None, :],
+        hyp_t,
+        jnp.swapaxes(pi_xi, 1, 2)[..., None],
+        hyp,
+    )
+    return scores[:, :, 0], hyp_out
 
 
 def _refresh_impl(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat,

@@ -41,7 +41,8 @@ def _timed_loop(fn_scores, rows, hyp, pi, pi_xi, n: int) -> float:
             s = fn_scores(rows, hyp, pi, pi_xi)
             # thread a data dependence through pi so iterations can't be
             # collapsed or reordered; keep it tiny so numerics stay sane
-            pi = pi + 1e-12 * s[: pi.shape[0]]
+            # (one scalar suffices, and broadcasts for any pi rank)
+            pi = pi + 1e-12 * s.reshape(-1)[0]
             return acc + s.sum(), pi
 
         acc, _ = jax.lax.fori_loop(
@@ -124,11 +125,90 @@ def run_shape(N: int, C: int, H: int, reps_hi: int = 8,
     return rec
 
 
+def run_batched_shape(S: int, N: int, C: int, H: int, reps_hi: int = 8,
+                      reps_lo: int = 2) -> dict:
+    """The BATCHED kernels (vmapped caller -> custom_vmap -> batch-grid
+    pallas): Mosaic compile, numerics vs the vmapped jnp path, and
+    marginal timing of both — the suite's vmapped-seed / stacked-task
+    production shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.ops.pallas_eig import (
+        eig_scores_cache_pallas,
+        eig_scores_refresh_pallas,
+    )
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    rows = jax.nn.softmax(jax.random.normal(ks[0], (S, C, H)), axis=-1)
+    hyp = jax.nn.softmax(jax.random.normal(ks[1], (S, C, N, H)), axis=-1)
+    pi = jax.nn.softmax(jax.random.normal(ks[2], (S, C)), axis=-1)
+    pi_xi = jax.nn.softmax(jax.random.normal(ks[3], (S, N, C)), axis=-1)
+
+    rec: dict = {"shape": {"S": S, "N": N, "C": C, "H": H}}
+    score_v = jax.jit(jax.vmap(
+        lambda r, h, p, px: eig_scores_cache_pallas(r, h, p, px)))
+    t0 = time.perf_counter()
+    s_pl = np.asarray(score_v(rows, hyp, pi, pi_xi))
+    rec["mosaic_compile_and_first_run_s"] = round(time.perf_counter() - t0, 3)
+    jnp_v = jax.jit(jax.vmap(
+        lambda r, h, p, px: eig_scores_from_cache(r, h, p, px)))
+    s_jnp = np.asarray(jnp_v(rows, hyp, pi, pi_xi))
+    rec["max_abs_diff"] = float(np.max(np.abs(s_pl - s_jnp)))
+    rec["argmax_agree"] = bool(
+        (s_pl.argmax(axis=1) == s_jnp.argmax(axis=1)).all())
+
+    def pl_fn(r, h, p, px):
+        return jax.vmap(
+            lambda r2, h2, p2, px2: eig_scores_cache_pallas(
+                r2, h2, p2, px2))(r, h, p, px).sum(0)
+
+    def jnp_fn(r, h, p, px):
+        return jax.vmap(
+            lambda r2, h2, p2, px2: eig_scores_from_cache(
+                r2, h2, p2, px2))(r, h, p, px).sum(0)
+
+    for name, fn in (("jnp", jnp_fn), ("pallas", pl_fn)):
+        _timed_loop(fn, rows, hyp, pi, pi_xi, reps_lo)
+        hi = _timed_loop(fn, rows, hyp, pi, pi_xi, reps_hi)
+        lo = _timed_loop(fn, rows, hyp, pi, pi_xi, reps_lo)
+        rec[f"{name}_marginal_ms"] = round(
+            1e3 * (hi - lo) / (reps_hi - reps_lo), 3)
+
+    # batched fused refresh+score
+    k5 = jax.random.PRNGKey(3)
+    hyp_t = jax.nn.softmax(jax.random.normal(k5, (S, N, H)), axis=-1)
+    cs = (jnp.arange(S, dtype=jnp.int32) * 7) % C
+    fused_v = jax.jit(jax.vmap(
+        lambda r, h, ht, c, p, px: eig_scores_refresh_pallas(
+            r, h, ht, c, p, px)))
+    t0 = time.perf_counter()
+    s_fu, hyp_fu = fused_v(rows, hyp, hyp_t, cs, pi, pi_xi)
+    s_fu = np.asarray(s_fu)
+    rec["fused_mosaic_compile_and_first_run_s"] = round(
+        time.perf_counter() - t0, 3)
+    hyp_ref2 = jax.vmap(lambda h, c, ht: h.at[c].set(ht))(hyp, cs, hyp_t)
+    s_ref2 = np.asarray(jnp_v(rows, hyp_ref2, pi, pi_xi))
+    rec["fused_max_abs_diff"] = float(np.max(np.abs(s_fu - s_ref2)))
+    rec["fused_argmax_agree"] = bool(
+        (s_fu.argmax(axis=1) == s_ref2.argmax(axis=1)).all())
+    rec["fused_row_updated"] = bool(np.asarray(jax.vmap(
+        lambda hf, c, ht: jnp.allclose(hf[c], ht, atol=0))(
+        hyp_fu, cs, hyp_t).all()))
+    rec["fused_rows_carried"] = bool(np.asarray(jax.vmap(
+        lambda hf, hr: jnp.array_equal(hf[0], hr[0]))(
+        hyp_fu, hyp_ref2).all()))
+    return rec
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--platform", default=None)
     ap.add_argument("--tol", type=float, default=2e-5,
                     help="max abs score diff vs the jnp path")
+    ap.add_argument("--batched-only", action="store_true",
+                    help="run only the batched-kernel section")
     args = ap.parse_args(argv)
 
     from coda_tpu.utils.platform import pin_platform

@@ -250,9 +250,11 @@ def test_fused_refresh_score_bf16_cache():
 
 
 def test_pallas_kernels_vmap_fallback():
-    """vmapped pallas scorers must fall back to the jnp path (batched
-    pallas tiles pad pathologically on TPU — observed scoped-VMEM OOM on
-    the suite's width-1 seed probe) and still match per-element results."""
+    """vmapped pallas scorers dispatch to the EXPLICITLY batched kernels
+    (batch = extra grid axis — pallas' automatic batching rule would pad
+    the small tiles pathologically on TPU; observed scoped-VMEM OOM on
+    the suite's width-1 seed probe, round 4) and must match the jnp path
+    per element."""
     from coda_tpu.ops.pallas_eig import (
         eig_scores_cache_pallas,
         eig_scores_refresh_pallas,
@@ -290,3 +292,53 @@ def test_pallas_kernels_vmap_fallback():
         np.testing.assert_allclose(np.asarray(ref_b), np.asarray(s_f[b]),
                                    rtol=1e-4, atol=1e-6)
         np.testing.assert_array_equal(np.asarray(hyp2), np.asarray(hyp_f[b]))
+
+
+def test_pallas_kernels_nested_vmap_flattens():
+    """Task-over-seed nesting (the run_batched production shape) must
+    flatten into the batched kernels' single grid axis and match the jnp
+    composition per (task, seed)."""
+    from coda_tpu.ops.pallas_eig import (
+        eig_scores_cache_pallas,
+        eig_scores_refresh_pallas,
+    )
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    T, S, N, C, H = 2, 3, 40, 4, 10
+    keys = jax.random.split(jax.random.PRNGKey(17), T * S)
+    packs = [_random_cache(k, N, C, H) for k in keys]
+
+    def stack(i):
+        return jnp.stack([p[i] for p in packs]).reshape(
+            (T, S) + packs[0][i].shape)
+
+    rows, hyp, pi, pi_xi = stack(0), stack(1), stack(2), stack(3)
+
+    score2 = jax.vmap(jax.vmap(
+        lambda r, h, p, px: eig_scores_cache_pallas(r, h, p, px, block=16)))
+    out = score2(rows, hyp, pi, pi_xi)
+    ref = jax.vmap(jax.vmap(
+        lambda r, h, p, px: eig_scores_from_cache(r, h, p, px, chunk=16)))(
+        rows, hyp, pi, pi_xi)
+    assert out.shape == (T, S, N)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-4, atol=1e-6)
+
+    hyp_t = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(18), (T, S, N, H)), axis=-1)
+    cs = (jnp.arange(T * S, dtype=jnp.int32) % C).reshape(T, S)
+    fused2 = jax.vmap(jax.vmap(
+        lambda r, h, ht, c, p, px: eig_scores_refresh_pallas(
+            r, h, ht, c, p, px, block=16)))
+    s_f, hyp_f = fused2(rows, hyp, hyp_t, cs, pi, pi_xi)
+    assert s_f.shape == (T, S, N) and hyp_f.shape == (T, S, C, N, H)
+    for t in range(T):
+        for s in range(S):
+            hyp2 = hyp[t, s].at[cs[t, s]].set(hyp_t[t, s])
+            ref_b = eig_scores_from_cache(rows[t, s], hyp2, pi[t, s],
+                                          pi_xi[t, s], chunk=16)
+            np.testing.assert_allclose(
+                np.asarray(ref_b), np.asarray(s_f[t, s]),
+                rtol=1e-4, atol=1e-6, err_msg=f"({t},{s})")
+            np.testing.assert_array_equal(np.asarray(hyp2),
+                                          np.asarray(hyp_f[t, s]))
