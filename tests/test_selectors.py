@@ -692,3 +692,35 @@ def test_bf16_cache_scores_and_budget(task):
     s_pl = np.asarray(eig_scores_cache_pallas(
         st.pbest_rows, st.pbest_hyp, st.pi_hat, st.pi_hat_xi))
     np.testing.assert_allclose(s_pl, s16, rtol=1e-5, atol=1e-6)
+
+
+def test_modelpicker_bucket_impls_agree():
+    """The scatter (CPU) and scan (TPU) bucket lowerings compute the same
+    t1/t2 sums — including under the suite's task x seed double vmap, the
+    configuration whose scatter lowering crashed the TPU worker."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.modelpicker import _bucket_sums
+
+    key = jax.random.PRNGKey(0)
+    N, H, C = 200, 7, 11
+    hard = jax.random.randint(key, (N, H), 0, C).astype(jnp.int32)
+    w = jax.random.uniform(jax.random.PRNGKey(1), (H,)) + 0.01
+    wlw = w * jnp.log(w)
+    t1_a, t2_a = _bucket_sums(hard, w, wlw, C, impl="scatter")
+    t1_b, t2_b = _bucket_sums(hard, w, wlw, C, impl="scan")
+    np.testing.assert_allclose(np.asarray(t1_a), np.asarray(t1_b),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(t2_a), np.asarray(t2_b),
+                               rtol=1e-6, atol=1e-7)
+
+    # double-vmapped (T, S) batch of posteriors over one prediction table
+    T, S = 2, 3
+    ws = jax.random.uniform(jax.random.PRNGKey(2), (T, S, H)) + 0.01
+    f = lambda impl: jax.vmap(jax.vmap(
+        lambda w_: _bucket_sums(hard, w_, w_ * jnp.log(w_), C, impl=impl)
+    ))(ws)
+    for a, b in zip(f("scatter"), f("scan")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
