@@ -174,16 +174,6 @@ def build_selector_factory(args, task_name: str):
     loss_fn = LOSS_FNS[args.loss]
     method = args.method
     if method.startswith("coda"):
-        if (getattr(args, "eig_backend", "jnp") == "pallas"
-                and getattr(args, "mesh", None)):
-            # preds is a traced jit argument on the mesh path, so make_coda's
-            # concrete-array sharding guard cannot fire there — reject the
-            # combination before the tensor is ever placed
-            raise SystemExit(
-                "--eig-backend pallas is single-device (GSPMD cannot "
-                "partition a pallas_call); drop --mesh or use the jnp "
-                "backend for sharded runs"
-            )
         hp = CODAHyperparams(
             prefilter_n=args.prefilter_n,
             alpha=args.alpha,
@@ -197,6 +187,11 @@ def build_selector_factory(args, task_name: str):
             eig_precision=getattr(args, "eig_precision", "highest"),
             eig_cache_dtype=getattr(args, "eig_cache_dtype", "float32"),
             pi_update=getattr(args, "pi_update", "auto"),
+            # a --mesh run declares its sharding so the pallas fast path
+            # can shard_map the kernels over the data axis (make_coda
+            # rejects specs the path can't support when pallas is explicit;
+            # 'auto' demotes to jnp on them)
+            shard_spec=getattr(args, "mesh", None) or "",
             # vmapped seeds each carry their own incremental cache; the
             # auto eig_mode budget must see the whole batch. Runners with a
             # different execution width (the suite's dedup batches, future

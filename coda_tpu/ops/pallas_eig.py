@@ -36,9 +36,16 @@ via ``CODAHyperparams(eig_backend="pallas")`` / ``--eig-backend pallas``. On
 non-TPU backends it runs in interpreter mode (tests exercise it on CPU,
 including the row-only aliased write: interpret mode preserves the donated
 buffer's unwritten blocks, verified in tests/test_pallas_eig.py).
-Single-device only: ``pallas_call`` is an opaque custom call that GSPMD
-cannot partition, so ``make_coda`` rejects the combination of this backend
-with a multi-device-sharded prediction tensor.
+
+``pallas_call`` is an opaque custom call that GSPMD cannot partition, so
+multi-device execution takes one of two EXPLICIT routes instead of silent
+demotion: (a) vmapped batches (suite seeds/tasks) dispatch via custom_vmap
+to the *batched* kernels — the batch is an extra grid axis with unbatched
+tile shapes; (b) a data-axis-sharded tensor whose mesh is DECLARED via
+``CODAHyperparams(shard_spec="data=K")`` runs the kernels per shard under
+``jax.shard_map`` (scoring is embarrassingly parallel over N, so the
+sharded wrappers need no collectives). An undeclared multi-device-sharded
+tensor still raises in ``make_coda``.
 """
 
 from __future__ import annotations
@@ -249,6 +256,84 @@ def eig_scores_cache_pallas_batched(
         return out.reshape(T, S, -1), True
 
     return _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
+
+
+def eig_scores_cache_pallas_sharded(
+    pbest_rows: jnp.ndarray,   # (C, H) — replicated
+    pbest_hyp: jnp.ndarray,    # (C, N, H) — N sharded over the data axis
+    pi_hat: jnp.ndarray,       # (C,) — replicated
+    pi_hat_xi: jnp.ndarray,    # (N, C) — N sharded
+    mesh,
+    block: int = 0,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """(N,) scores with the pallas kernel running PER DATA SHARD.
+
+    ``pallas_call`` is an opaque custom call GSPMD cannot partition, so a
+    multi-device run would all-gather the cache per chip; ``shard_map``
+    over the mesh's data axis instead hands each device its local
+    (C, N/d, H) block — the scoring pass is embarrassingly parallel over
+    N (scores reduce over nothing), so no collectives are needed at all;
+    the selection argmax happens outside on the sharded (N,) result.
+    Requires N divisible by the data-axis size (callers resolve to the
+    jnp path otherwise).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from coda_tpu.parallel.mesh import DATA_AXIS
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def local(rows, hyp, pi, pi_xi):
+        return _scores_impl(rows, hyp, pi, pi_xi, block, interpret)
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-
+    # axes annotation, which the default vma check rejects; the specs above
+    # state the sharding contract explicitly
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(None, DATA_AXIS, None), P(), P(DATA_AXIS, None)),
+        out_specs=P(DATA_AXIS), check_vma=False,
+    )(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
+
+
+def eig_scores_refresh_pallas_sharded(
+    pbest_rows: jnp.ndarray,   # (C, H) replicated — ALREADY refreshed
+    pbest_hyp: jnp.ndarray,    # (C, N, H) — N sharded, OLD row
+    hyp_t: jnp.ndarray,        # (N, H) — N sharded
+    true_class: jnp.ndarray,   # scalar, replicated
+    pi_hat: jnp.ndarray,       # (C,) replicated
+    pi_hat_xi: jnp.ndarray,    # (N, C) — N sharded
+    mesh,
+    block: int = 0,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused refresh+score per data shard: ``(scores (N,), cache)``.
+
+    Each device refreshes its own (1, N/d, H) slice of the class row and
+    scores its local block — the donated-cache row-only write works
+    per shard, and the carried cache stays sharded across scan rounds.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from coda_tpu.parallel.mesh import DATA_AXIS
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def local(rows, hyp, hyp_t, c, pi, pi_xi):
+        return _refresh_impl(rows, hyp, hyp_t, c, pi, pi_xi, block,
+                             interpret)
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(None, DATA_AXIS, None), P(DATA_AXIS, None), P(),
+                  P(), P(DATA_AXIS, None)),
+        out_specs=(P(DATA_AXIS), P(None, DATA_AXIS, None)),
+        check_vma=False,
+    )(pbest_rows, pbest_hyp, hyp_t, jnp.asarray(true_class, jnp.int32),
+      pi_hat, pi_hat_xi)
 
 
 def _batched_score_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,

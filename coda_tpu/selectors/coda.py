@@ -100,6 +100,18 @@ class CODAHyperparams(NamedTuple):
     #                               EIG orderings can change — opt-in
     #                               speed, not reference semantics (same
     #                               contract as eig_precision).
+    shard_spec: str = ""          # "" | "data=K" — declared mesh sharding
+    #                               of the (H, N, C) tensor for the pallas
+    #                               fast path. pallas_call is an opaque
+    #                               custom call GSPMD cannot partition, so
+    #                               sharded runs demote to jnp UNLESS the
+    #                               caller declares the mesh here: the
+    #                               scoring / fused-refresh passes then run
+    #                               per data shard under shard_map (no
+    #                               collectives — scoring is parallel over
+    #                               N; selection argmaxes the sharded
+    #                               result outside). Data-only meshes; N
+    #                               must divide by the axis size.
     pi_update: str = "auto"       # auto | delta | exact — incremental-mode
     #                               pi-hat column refresh. "auto" resolves
     #                               by backend (resolve_pi_update):
@@ -193,30 +205,62 @@ def resolve_pi_update(hp: "CODAHyperparams", N: int | None = None) -> str:
     return "delta_pallas" if pallas_viable else "exact"
 
 
-def resolve_eig_backend(hp: "CODAHyperparams", eig_mode: str) -> str:
+def shard_mesh_for(hp: "CODAHyperparams", N: int):
+    """The mesh of ``hp.shard_spec`` when the sharded pallas path is
+    viable for it, else None. Raises on meshes the path cannot support
+    (model axis > 1; N not divisible by the data axis)."""
+    if not hp.shard_spec:
+        return None
+    from coda_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, mesh_from_spec
+
+    mesh = mesh_from_spec(hp.shard_spec)
+    if mesh.shape[MODEL_AXIS] != 1:
+        raise ValueError(
+            "shard_spec with the pallas backend supports DATA-only meshes "
+            f"(scoring is parallel over N); got {hp.shard_spec!r} — the "
+            "P(best) exclusive product over a sharded model axis needs the "
+            "jnp backend's psum"
+        )
+    d = mesh.shape[DATA_AXIS]
+    if N % d != 0:
+        raise ValueError(
+            f"shard_spec {hp.shard_spec!r}: N={N} not divisible by the "
+            f"data axis ({d}); pad the task or use the jnp backend"
+        )
+    return mesh
+
+
+def resolve_eig_backend(hp: "CODAHyperparams", eig_mode: str,
+                        N: int | None = None) -> str:
     """The concrete scoring backend for this config (shared with bench.py).
 
-    auto -> "pallas" only on a SINGLE-chip TPU process running the
-    incremental tier — the one context where a sharded prediction tensor
-    is impossible, so the opaque-custom-call restriction (pallas_call
-    cannot be partitioned by GSPMD) can never bite. Everywhere else —
-    CPU/GPU, multi-device processes (even if this particular tensor is
-    unsharded), non-incremental tiers — auto stays "jnp". Validated on a
-    v5e in round 4 (PALLAS_TPU_VALIDATION_r04.json): max |Δscore| 2.9e-6,
-    argmax agreement, 3x the jnp scoring pass (6.0 vs 18.2 ms at
-    headline).
+    auto -> "pallas" on a TPU process running the incremental tier when
+    the opaque-custom-call restriction (pallas_call cannot be partitioned
+    by GSPMD) cannot bite: a SINGLE-chip process, or a multi-chip process
+    whose data-axis sharding is DECLARED via ``shard_spec`` (the kernels
+    then run per shard under shard_map — see
+    ``ops/pallas_eig.eig_scores_cache_pallas_sharded``). Vmapped batches
+    (``n_parallel`` > 1) dispatch to the explicitly batched kernels via
+    custom_vmap on a single chip; the sharded path stays single-replica.
+    Everywhere else — CPU/GPU, undeclared multi-device, non-incremental
+    tiers — auto stays "jnp". Single-chip validated on a v5e in round 4
+    (PALLAS_TPU_VALIDATION_r04.json): max |Δscore| 2.9e-6, argmax
+    agreement, 3x the jnp scoring pass.
     """
     if hp.eig_backend != "auto":
         return hp.eig_backend
     import jax
 
-    if (eig_mode == "incremental"
-            and hp.n_parallel <= 1  # vmapped batches keep the jnp path:
-            # pallas_call batching on TPU is unvalidated here, and the
-            # suite's vmapped seeds are exactly where it would engage
-            and jax.default_backend() == "tpu"
-            and jax.device_count() == 1):
+    if eig_mode != "incremental" or jax.default_backend() != "tpu":
+        return "jnp"
+    if hp.n_parallel <= 1 and jax.device_count() == 1:
         return "pallas"
+    if hp.shard_spec and hp.n_parallel <= 1:
+        try:  # an unsupported spec demotes auto to jnp instead of raising
+            if N is None or shard_mesh_for(hp, N) is not None:
+                return "pallas"
+        except ValueError:
+            return "jnp"
     return "jnp"
 
 
@@ -885,7 +929,8 @@ def make_coda(
     if hp.eig_backend not in ("auto", "jnp", "pallas"):
         raise ValueError(f"unknown eig_backend {hp.eig_backend!r} "
                          "(use 'auto', 'jnp' or 'pallas')")
-    eig_backend = resolve_eig_backend(hp, eig_mode)
+    eig_backend = resolve_eig_backend(hp, eig_mode, N)
+    shard_mesh = None
     if eig_backend == "pallas":
         if not incremental:
             raise ValueError(
@@ -893,26 +938,36 @@ def make_coda(
                 f"pass, but this config resolved to eig_mode={eig_mode!r} — "
                 "it would silently never run; use the jnp backend here"
             )
+        # a DECLARED data-axis sharding routes the kernels through
+        # shard_map (raises on unsupported specs when pallas is explicit)
+        shard_mesh = shard_mesh_for(hp, N)
         # NOTE: this guard only sees a CONCRETE array's sharding. Under the
         # preds-as-argument jit pattern preds is a tracer here and the
-        # sharding is unknowable at trace time — the CLI therefore rejects
-        # --eig-backend pallas together with --mesh (cli.py), and library
-        # users combining a sharded traced tensor with the pallas backend
-        # must shard_map it themselves.
+        # sharding is unknowable at trace time — library users combining a
+        # sharded traced tensor with the pallas backend must declare the
+        # mesh via hp.shard_spec (the CLI's --mesh does this).
         sharding = getattr(preds, "sharding", None)
-        if sharding is not None and getattr(
+        if shard_mesh is None and sharding is not None and getattr(
                 sharding, "num_devices", 1) > 1 and not getattr(
                 sharding, "is_fully_replicated", False):
             raise ValueError(
-                "eig_backend='pallas' is single-device: pallas_call is an "
-                "opaque custom call GSPMD cannot partition, so a sharded "
-                "(H, N, C) tensor would be all-gathered per device; use the "
-                "jnp backend for sharded runs"
+                "eig_backend='pallas' on a sharded (H, N, C) tensor needs "
+                "the mesh DECLARED via shard_spec: pallas_call is an opaque "
+                "custom call GSPMD cannot partition, so an undeclared "
+                "sharded tensor would be all-gathered per device"
             )
 
     def _score_cache(rows, hyp, pi, pi_xi):
         """The incremental scoring pass, backend-dispatched."""
         if eig_backend == "pallas":
+            if shard_mesh is not None:
+                from coda_tpu.ops.pallas_eig import (
+                    eig_scores_cache_pallas_sharded,
+                )
+
+                return eig_scores_cache_pallas_sharded(
+                    rows, hyp, pi, pi_xi, mesh=shard_mesh,
+                    block=hp.eig_chunk)
             from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
 
             return eig_scores_cache_pallas(rows, hyp, pi, pi_xi,
@@ -1076,15 +1131,26 @@ def make_coda(
                 # fused refresh+score: the cache is donated through the
                 # kernel, so the scan carry never pays the XLA defensive
                 # copy a DUS + opaque-custom-call sequence provokes
-                from coda_tpu.ops.pallas_eig import eig_scores_refresh_pallas
-
                 row_t, hyp_t = update_eig_cache_parts(
                     dirichlets, true_class, hard_preds,
                     num_points=hp.num_points, precision=eig_precision)
                 rows = state.pbest_rows.at[true_class].set(row_t)
-                scores, hyp = eig_scores_refresh_pallas(
-                    rows, state.pbest_hyp, hyp_t, true_class, pi, pi_xi,
-                    block=hp.eig_chunk)
+                if shard_mesh is not None:
+                    from coda_tpu.ops.pallas_eig import (
+                        eig_scores_refresh_pallas_sharded,
+                    )
+
+                    scores, hyp = eig_scores_refresh_pallas_sharded(
+                        rows, state.pbest_hyp, hyp_t, true_class, pi,
+                        pi_xi, mesh=shard_mesh, block=hp.eig_chunk)
+                else:
+                    from coda_tpu.ops.pallas_eig import (
+                        eig_scores_refresh_pallas,
+                    )
+
+                    scores, hyp = eig_scores_refresh_pallas(
+                        rows, state.pbest_hyp, hyp_t, true_class, pi,
+                        pi_xi, block=hp.eig_chunk)
             else:
                 rows, hyp = update_eig_cache(
                     dirichlets, true_class, hard_preds,

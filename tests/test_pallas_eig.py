@@ -158,20 +158,40 @@ def test_pallas_backend_config_guards():
         make_coda(t.preds, CODAHyperparams(eig_backend="pallas",
                                            eig_mode="factored"))
     if len(jax.devices()) >= 8:
+        # an UNDECLARED sharded tensor still raises; declaring the mesh
+        # (shard_spec) routes through the shard_map path instead
         sharded = jax.device_put(t.preds, preds_sharding(make_mesh(data=8)))
-        with pytest.raises(ValueError, match="single-device"):
+        with pytest.raises(ValueError, match="shard_spec"):
             make_coda(sharded, CODAHyperparams(eig_backend="pallas"))
+        assert make_coda(sharded, CODAHyperparams(
+            eig_backend="pallas", shard_spec="data=8")) is not None
 
 
-def test_cli_rejects_pallas_with_mesh(tmp_path):
+def test_cli_mesh_pallas_combinations(tmp_path):
+    """--mesh data=K + pallas is now the shard_map fast path; model-axis
+    meshes and non-dividing N still raise (at selector build, with a
+    message naming the constraint)."""
     import pytest
 
     from coda_tpu.cli import build_selector_factory, parse_args
+    from coda_tpu.data import make_synthetic_task
 
+    t = make_synthetic_task(seed=0, H=4, N=32, C=4)
     args = parse_args(["--synthetic", "4,32,4", "--method", "coda",
                        "--eig-backend", "pallas", "--mesh", "data=2"])
-    with pytest.raises(SystemExit, match="single-device"):
-        build_selector_factory(args, "synthetic")
+    sel = build_selector_factory(args, "synthetic")(t.preds)
+    assert sel is not None
+
+    args = parse_args(["--synthetic", "4,32,4", "--method", "coda",
+                       "--eig-backend", "pallas", "--mesh", "data=2,model=2"])
+    with pytest.raises(ValueError, match="DATA-only"):
+        build_selector_factory(args, "synthetic")(t.preds)
+
+    t33 = make_synthetic_task(seed=0, H=4, N=33, C=4)
+    args = parse_args(["--synthetic", "4,33,4", "--method", "coda",
+                       "--eig-backend", "pallas", "--mesh", "data=2"])
+    with pytest.raises(ValueError, match="not divisible"):
+        build_selector_factory(args, "synthetic")(t33.preds)
 
 
 def test_fused_refresh_score_matches_dus_then_score():

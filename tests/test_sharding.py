@@ -325,3 +325,57 @@ def test_large_c_sharded_execution_parity(tier, budgets, monkeypatch):
     np.testing.assert_array_equal(best1[same_evidence], best8[same_evidence])
     np.testing.assert_allclose(reg1[same_evidence], reg8[same_evidence],
                                rtol=1e-6, atol=1e-7)
+
+
+def test_sharded_pallas_trace_matches_single_device():
+    """The shard_map'd pallas scoring/fused-refresh path (shard_spec +
+    eig_backend='pallas') must reproduce the single-device jnp trace on a
+    data=8 mesh — the v5e-8 fast-path configuration (VERDICT r4 item 2).
+    Interpret-mode pallas per shard on the virtual CPU mesh; the same
+    code Mosaic-compiles per chip on real TPUs."""
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    task = make_synthetic_task(seed=13, H=6, N=64, C=4)
+    mesh = mesh_from_spec("data=8")
+    sharded = _sharded_task(task, mesh)
+
+    idx1, best1, reg1 = _trace(
+        lambda p: make_coda(p, CODAHyperparams(eig_mode="incremental")),
+        task)
+    idx8, best8, reg8 = _trace(
+        lambda p: make_coda(p, CODAHyperparams(
+            eig_mode="incremental", eig_backend="pallas",
+            shard_spec="data=8")),
+        sharded)
+    np.testing.assert_array_equal(idx1, idx8)
+    np.testing.assert_array_equal(best1, best8)
+    np.testing.assert_allclose(reg1, reg8, atol=1e-7)
+
+
+def test_sharded_pallas_scores_stay_sharded():
+    """The sharded pallas scoring pass must emit data-sharded scores (no
+    device gathers the full cache): check the out sharding of the
+    shard_map'd kernel directly."""
+    from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas_sharded
+
+    mesh = mesh_from_spec("data=8")
+    C, N, H = 4, 64, 6
+    key = jax.random.PRNGKey(0)
+    rows = jax.nn.softmax(jax.random.normal(key, (C, H)), axis=-1)
+    hyp = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(1), (C, N, H)), axis=-1)
+    pi = jnp.full((C,), 1.0 / C)
+    pi_xi = jnp.full((N, C), 1.0 / C)
+    hyp_sh = jax.device_put(
+        hyp, NamedSharding(mesh, P(None, DATA_AXIS, None)))
+    pi_xi_sh = jax.device_put(pi_xi, NamedSharding(mesh, P(DATA_AXIS, None)))
+
+    out = jax.jit(lambda r, h, p, px: eig_scores_cache_pallas_sharded(
+        r, h, p, px, mesh=mesh, interpret=True))(rows, hyp_sh, pi, pi_xi_sh)
+    spec = out.sharding.spec
+    assert spec and spec[0] in (DATA_AXIS, (DATA_AXIS,)), spec
+
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+    ref = eig_scores_from_cache(rows, hyp, pi, pi_xi)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-4, atol=1e-6)
