@@ -80,6 +80,31 @@ def _lane_padded(H: int) -> int:
     return -(-H // 128) * 128
 
 
+# The batched kernels' HBM operands carry the kernel's fixed layout, and XLA
+# materializes them PADDED before the custom call: the (S, C, N, 1) pi_xi
+# operand lane-pads 1 -> 128 (a 128x expansion — 14.4 GB at a 12-task
+# DomainNet batch, measured OOM on a 16 GB v5e), and the (S, C, N, H) cache
+# lane-pads H. The layout is tuned for the headline regime (C small, H
+# large); batched calls whose PHYSICAL operand footprint exceeds this
+# budget fall back to the jnp composition, whose layouts XLA chooses
+# per-shape.
+_BATCHED_PADDED_MAX_BYTES = 6 << 30
+
+
+def _batched_padded_bytes(S: int, C: int, N: int, H: int,
+                          itemsize: int) -> int:
+    """Physical HBM bytes of the batched kernels' two big operands."""
+    cache = S * C * N * _lane_padded(H) * itemsize
+    pi_xi = S * C * N * 128 * 4
+    return cache + pi_xi
+
+
+def batched_pallas_viable(S: int, C: int, N: int, H: int,
+                          itemsize: int = 4) -> bool:
+    return _batched_padded_bytes(S, C, N, H, itemsize) \
+        <= _BATCHED_PADDED_MAX_BYTES
+
+
 def choose_block(N: int, C: int, H: int, block: int = 0,
                  itemsize: int = 4, fused: bool = False) -> int:
     """The N-tile size: sublane-aligned under the VMEM budget, or all of N
@@ -184,7 +209,9 @@ def eig_scores_cache_pallas(
 
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, pi_b, pi_xi_b):
-        if all(in_batched):
+        if all(in_batched) and batched_pallas_viable(
+                hyp_b.shape[0], hyp_b.shape[1], hyp_b.shape[2],
+                hyp_b.shape[3], hyp_b.dtype.itemsize):
             return eig_scores_cache_pallas_batched(
                 rows_b, hyp_b, pi_b, pi_xi_b, block=block,
                 interpret=interpret), True
@@ -498,7 +525,9 @@ def eig_scores_refresh_pallas(
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, hyp_t_b, c_b,
                    pi_b, pi_xi_b):
-        if all(in_batched):
+        if all(in_batched) and batched_pallas_viable(
+                hyp_b.shape[0], hyp_b.shape[1], hyp_b.shape[2],
+                hyp_b.shape[3], hyp_b.dtype.itemsize):
             return eig_scores_refresh_pallas_batched(
                 rows_b, hyp_b, hyp_t_b, c_b, pi_b, pi_xi_b, block=block,
                 interpret=interpret), (True, True)

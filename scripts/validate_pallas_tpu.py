@@ -146,7 +146,13 @@ def run_batched_shape(S: int, N: int, C: int, H: int, reps_hi: int = 8,
     pi = jax.nn.softmax(jax.random.normal(ks[2], (S, C)), axis=-1)
     pi_xi = jax.nn.softmax(jax.random.normal(ks[3], (S, N, C)), axis=-1)
 
-    rec: dict = {"shape": {"S": S, "N": N, "C": C, "H": H}}
+    from coda_tpu.ops.pallas_eig import batched_pallas_viable
+
+    rec: dict = {"shape": {"S": S, "N": N, "C": C, "H": H},
+                 # False = the padded-operand budget routed this shape to
+                 # the jnp fallback (e.g. the DomainNet batch: the
+                 # (S, C, N, 1) operand's 128x lane pad OOMed a v5e)
+                 "pallas_engaged": batched_pallas_viable(S, C, N, H, 4)}
     score_v = jax.jit(jax.vmap(
         lambda r, h, p, px: eig_scores_cache_pallas(r, h, p, px)))
     t0 = time.perf_counter()
@@ -227,14 +233,25 @@ def main(argv=None):
     # itself (the hardware claims are TPU-only anyway).
     shapes = ([(50_000, 10, 1000), (1013, 7, 130)] if on_tpu
               else [(512, 10, 96), (101, 7, 130)])
-    for (N, C, H) in shapes:
-        out["shapes"].append(run_shape(N, C, H))
+    if not args.batched_only:
+        for (N, C, H) in shapes:
+            out["shapes"].append(run_shape(N, C, H))
+
+    # batched shapes: the suite's production configurations — a DomainNet
+    # family probe batch (T=12 tasks x width 1), its rest batch (cap 3 x
+    # width 4), and a small-batch headline-like shape (2 x 2 GB caches)
+    out["batched_shapes"] = []
+    bshapes = ([(12, 20000, 126, 30), (5, 10000, 10, 80),
+                (2, 50_000, 10, 1000)] if on_tpu
+               else [(3, 256, 5, 12)])
+    for (S, N, C, H) in bshapes:
+        out["batched_shapes"].append(run_batched_shape(S, N, C, H))
 
     ok = all(s["max_abs_diff"] <= args.tol and s["argmax_agree"]
              and s["fused_max_abs_diff"] <= args.tol
              and s["fused_argmax_agree"] and s["fused_row_updated"]
              and s["fused_rows_carried"]
-             for s in out["shapes"])
+             for s in out["shapes"] + out["batched_shapes"])
     out["ok"] = ok
     print(json.dumps(out))
     return 0 if ok else 1
