@@ -739,9 +739,16 @@ def eig_scores_from_cache(
     mixture temp stays a fraction of the cache itself. Matches
     :func:`eig_scores_factored`'s tail exactly (same mixture-delta and
     entropy expressions). Blocks are dynamic slices of axis 1 (the layout
-    keeps N second); a ragged final block is handled by XLA's slice
-    clamping — the last block re-covers the tail of the previous one and
-    recomputes identical values for the overlap.
+    keeps N second); a ragged final block re-covers the tail of the
+    previous one and recomputes identical values for the overlap. The
+    block start is clamped EXPLICITLY rather than left to
+    dynamic_slice's own out-of-bounds clamping: under vmap the batched
+    slice lowers to a gather, and out-of-bounds gather indices are
+    implementation-defined on TPU — the suite's vmapped seeds read
+    garbage in the ragged block (reproduced on a v5e, round 5: O(1)
+    score errors exactly when chunk did not divide N; every
+    N-divisible shape was bit-clean, which is why round 4's validation
+    missed it).
     """
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
     h_before = entropy2(mixture0)
@@ -749,7 +756,7 @@ def eig_scores_from_cache(
     B = min(chunk, N)
 
     def block(i, acc):
-        start = i * B
+        start = jnp.minimum(i * B, N - B)
         hyp_b = lax.dynamic_slice_in_dim(pbest_hyp, start, B, axis=1)
         pi_xi_b = lax.dynamic_slice_in_dim(pi_hat_xi, start, B, axis=0)
         # upcast per block: storage may be bf16 (eig_cache_dtype); the

@@ -724,3 +724,32 @@ def test_modelpicker_bucket_impls_agree():
     for a, b in zip(f("scatter"), f("scan")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_eig_scores_from_cache_vmap_ragged_chunk():
+    """Vmapped scoring with a chunk that does NOT divide N must equal the
+    per-replica computation. The ragged final block's start is clamped
+    explicitly: under vmap the dynamic slice lowers to a gather, and
+    out-of-bounds gather indices are implementation-defined on TPU — the
+    unclamped version read garbage there (v5e, round 5) while passing on
+    CPU, so this test guards the clamp's presence, and on TPU runs it
+    guards the actual behavior."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    S, N, C, H = 3, 100, 4, 6   # chunk 32 -> 4 blocks, ragged tail of 4
+    ks = jax.random.split(jax.random.PRNGKey(5), 4)
+    rows = jax.nn.softmax(jax.random.normal(ks[0], (S, C, H)), axis=-1)
+    hyp = jax.nn.softmax(jax.random.normal(ks[1], (S, C, N, H)), axis=-1)
+    pi = jax.nn.softmax(jax.random.normal(ks[2], (S, C)), axis=-1)
+    pi_xi = jax.nn.softmax(jax.random.normal(ks[3], (S, N, C)), axis=-1)
+    vm = jax.jit(jax.vmap(
+        lambda r, h, p, px: eig_scores_from_cache(r, h, p, px, chunk=32)))(
+        rows, hyp, pi, pi_xi)
+    for s in range(S):
+        ref = eig_scores_from_cache(rows[s], hyp[s], pi[s], pi_xi[s],
+                                    chunk=32)
+        np.testing.assert_allclose(np.asarray(vm[s]), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6, err_msg=str(s))
