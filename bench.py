@@ -216,7 +216,8 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
 
 def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
                          cache_bytes: int = 4,
-                         pi_update: str, backend: str = "jnp") -> float:
+                         pi_update: str, backend: str = "jnp",
+                         eig_refresh: str = "precomputed") -> float:
     """Analytic HBM traffic per round (bytes), for the bandwidth roofline.
 
     ``mode`` and ``pi_update`` must be the ALREADY-RESOLVED tier and
@@ -242,6 +243,12 @@ def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
         cache = float(cache_bytes) * N * C * H
         pi_bytes = (4.0 * H * N if pi_update.startswith("delta")
                     else 4.0 * H * N * C)
+        if backend == "pallas" and eig_refresh == "fused":
+            # fused-COMPUTE refresh: the replacement row is computed
+            # in-kernel from O(H·G) tables, so the (N, H) hyp_t round
+            # trip is gone; the kernel reads the hard preds (int32) and
+            # writes only the refreshed row at cache width
+            return cache + pi_bytes + (4.0 + cache_bytes) * N * H
         if backend == "pallas":
             # fused refresh+score kernel: the donated cache is READ once;
             # only the refreshed (N, H) class row is written back (the
@@ -288,7 +295,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     defaults = CODAHyperparams()._asdict()
     eig_opts = {**{k: defaults[k] for k in
                    ("eig_mode", "eig_backend", "eig_precision",
-                    "eig_cache_dtype", "pi_update")},
+                    "eig_cache_dtype", "eig_refresh", "pi_update")},
                 **(eig_opts or {})}
     # _mad of a single rep is 0, which would floor the noise at 1e-12 and
     # let any positive wall-clock delta pass linear_ok; the guard only
@@ -334,7 +341,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     bytes_per_step = _analytic_step_bytes(
         H, N, C, mode=mode,
         cache_bytes=np.dtype(eig_opts["eig_cache_dtype"]).itemsize,
-        pi_update=pi_res, backend=backend_res)
+        pi_update=pi_res, backend=backend_res,
+        eig_refresh=eig_opts["eig_refresh"])
     achieved = (flops_per_step / marginal_step_s
                 if linear_ok and marginal_step_s > 0 else 0.0)
     achieved_bps = (bytes_per_step / marginal_step_s
@@ -360,6 +368,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         "eig_backend": backend_res,
         "eig_precision": eig_opts["eig_precision"],
         "eig_cache_dtype": eig_opts["eig_cache_dtype"],
+        "eig_refresh": eig_opts["eig_refresh"],
         "pi_update": pi_res,
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
@@ -515,6 +524,12 @@ def main():
                     help="storage dtype of the incremental P(best) cache "
                          "(bfloat16 halves the dominant HBM stream; "
                          "opt-in numerics like --eig-precision)")
+    ap.add_argument("--eig-refresh", default="precomputed",
+                    choices=["precomputed", "fused"],
+                    help="incremental row-refresh: precomputed (XLA-"
+                         "HIGHEST einsums, reference numerics) | fused "
+                         "(in-kernel MXU dots overlap the cache read; "
+                         "opt-in numerics, pallas backend only)")
     ap.add_argument("--eig-chunk", type=int, default=0,
                     help="override the scoring-pass block size (0 = the "
                          "config default; the tuning knob for the "
@@ -569,6 +584,7 @@ def main():
     eig_opts = {"eig_mode": args.eig_mode, "eig_backend": args.eig_backend,
                 "eig_precision": args.eig_precision,
                 "eig_cache_dtype": args.eig_cache_dtype,
+                "eig_refresh": args.eig_refresh,
                 "pi_update": args.pi_update}
     for attempt in range(2):
         ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
@@ -596,7 +612,7 @@ def main():
         "device_fallback": device_fallback,
         "compute": {k: ours[k] for k in
                     ("eig_mode", "eig_backend", "eig_precision",
-                     "eig_cache_dtype", "pi_update",
+                     "eig_cache_dtype", "eig_refresh", "pi_update",
                      "flops_per_step_analytic", "flop_accounting",
                      "flops_xla_scan_body_once", "achieved_flops_per_sec",
                      "peak_flops_per_sec", "mfu",

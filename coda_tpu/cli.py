@@ -101,6 +101,13 @@ def parse_args(argv=None):
                    help="storage dtype of the incremental P(best) cache: "
                         "bfloat16 halves the scoring pass's HBM stream "
                         "(opt-in numerics, like --eig-precision)")
+    p.add_argument("--eig-refresh", default="precomputed",
+                   choices=["precomputed", "fused"],
+                   help="where the incremental row-refresh einsums run: "
+                        "precomputed = XLA-HIGHEST (reference numerics); "
+                        "fused = inside the pallas scoring kernel (fp32 "
+                        "MXU dots overlap the cache read — opt-in "
+                        "numerics, pallas backend only)")
     p.add_argument("--pi-update", default="auto",
                    choices=["auto", "delta", "exact"],
                    help="incremental pi-hat refresh: auto (default) = exact "
@@ -186,6 +193,7 @@ def build_selector_factory(args, task_name: str):
             eig_backend=getattr(args, "eig_backend", "auto"),
             eig_precision=getattr(args, "eig_precision", "highest"),
             eig_cache_dtype=getattr(args, "eig_cache_dtype", "float32"),
+            eig_refresh=getattr(args, "eig_refresh", "precomputed"),
             pi_update=getattr(args, "pi_update", "auto"),
             # a --mesh run declares its sharding so the pallas fast path
             # can shard_map the kernels over the data axis (make_coda

@@ -106,7 +106,8 @@ def batched_pallas_viable(S: int, C: int, N: int, H: int,
 
 
 def choose_block(N: int, C: int, H: int, block: int = 0,
-                 itemsize: int = 4, fused: bool = False) -> int:
+                 itemsize: int = 4, fused: bool = False,
+                 table_bytes: int = 0) -> int:
     """The N-tile size: sublane-aligned under the VMEM budget, or all of N
     when it fits.
 
@@ -119,7 +120,8 @@ def choose_block(N: int, C: int, H: int, block: int = 0,
     compute temporaries add ``_TEMP_TILES`` single-buffered (C, B, Hp)
     tiles. The x8/x16 hardware minimum wins over a smaller caller ``block``
     cap (a cap below the sublane tile cannot lower the VMEM footprint
-    further)."""
+    further). ``table_bytes``: grid-constant operand bytes (the
+    fused-compute kernel's Beta tables) deducted from the budget."""
     sub = 16 if itemsize == 2 else 8
     Hp = _lane_padded(H)
     stream_row = itemsize * C * Hp
@@ -129,7 +131,7 @@ def choose_block(N: int, C: int, H: int, block: int = 0,
     # solve 2*B*stream_row (double-buffered pipeline) + B*temp_row (stack
     # temps, single-buffered) + margin <= the scoped limit for B
     temp_row = _TEMP_TILES * 4 * C * Hp
-    budget = _SCOPED_VMEM_BYTES - _VMEM_MARGIN_BYTES
+    budget = _SCOPED_VMEM_BYTES - _VMEM_MARGIN_BYTES - table_bytes
     vmem_cap = max(sub, budget // max(1, 2 * stream_row + temp_row))
     cap = min(block, vmem_cap) if block else vmem_cap
     if N <= max(cap, sub):
@@ -283,6 +285,151 @@ def eig_scores_cache_pallas_batched(
         return out.reshape(T, S, -1), True
 
     return _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
+
+
+def _refresh_compute_score_kernel(c_sp_ref, mixture0_ref, h_before_ref,
+                                  pi_hat_ref, rows_ref, s0_ref, dlog_ref,
+                                  fu_t_ref, df_t_ref, wtr_ref, hp_ref,
+                                  pi_xi_t_ref, hyp_ref, score_ref,
+                                  row_out_ref):
+    """One N-tile of the fully-fused refresh: computes the replacement
+    class row IN-KERNEL from the Beta grid tables (three MXU dots per
+    tile — the work the precomputed path does as XLA einsums), then
+    scores the tile with the fresh row, writing only that row back.
+
+    Refs: c (1,) scalar-prefetch; mixture0 (1, 1, H); h_before (1, 1);
+    pi_hat (C, 1, 1); rows (C, 1, H); s0 (1, G); dlog/fu_t/df_t —
+    dlogcdf (H, G) and the F tables PRE-TRANSPOSED to (G, H) so the
+    kernel contains no transposes; wtr (1, G) trapezoid weights; hp
+    (B, H) int32 hard preds; pi_xi_t (C, B, 1); hyp (C, B, H) cache
+    tile. Out: score (B, 1), row_out (1, B, H).
+    """
+    c = c_sp_ref[0]
+    eq = (hp_ref[:] == c).astype(jnp.float32)            # (B, H)
+    # S[n, g] = S0[g] + eq @ dlogcdf  — fp32 MXU dot
+    s = s0_ref[:] + jax.lax.dot_general(
+        eq, dlog_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (B, G)
+    s = s - s.max(axis=-1, keepdims=True)
+    w_e = wtr_ref[:] * jnp.exp(s)                        # (B, G)
+    t_base = jax.lax.dot_general(
+        w_e, fu_t_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (B, H)
+    t_diff = jax.lax.dot_general(
+        w_e, df_t_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    unnorm = t_base + eq * t_diff
+    row_new = unnorm / jnp.clip(
+        unnorm.sum(-1, keepdims=True), 1e-30, None)
+    row_store = row_new.astype(hyp_ref.dtype)            # (B, H)
+    row_out_ref[:] = row_store[None]
+    row_f32 = row_store.astype(jnp.float32)
+    cls = lax.broadcasted_iota(jnp.int32, (hyp_ref.shape[0], 1, 1), 0)
+    hyp = jnp.where(cls == c, row_f32[None],
+                    hyp_ref[:].astype(jnp.float32))
+    score_ref[:] = _weighted_entropy_scores(
+        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_points", "block", "interpret"))
+def eig_scores_refresh_compute_pallas(
+    pbest_rows: jnp.ndarray,   # (C, H) — ALREADY holding the refreshed row
+    pbest_hyp: jnp.ndarray,    # (C, N, H) — still holding the OLD row
+    a_t: jnp.ndarray,          # (H,) diagonal-Beta a of the labeled class
+    b_t: jnp.ndarray,          # (H,)
+    hard_preds: jnp.ndarray,   # (N, H) int32
+    true_class: jnp.ndarray,   # scalar int
+    pi_hat: jnp.ndarray,       # (C,)
+    pi_hat_xi: jnp.ndarray,    # (N, C)
+    update_weight: float = 1.0,
+    num_points: int = 256,
+    block: int = 0,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fully-fused refresh+score: the replacement row is COMPUTED inside
+    the scoring kernel from O(H·G) Beta tables, so the refresh einsums
+    (6·N·H·G FLOPs — the largest remaining XLA stage, 3.2-3.7 ms at
+    headline, PROFILE_TPU_r04) overlap the 2 GB cache read instead of
+    preceding it, and the (N, H) hyp_t intermediate never exists.
+
+    OPT-IN numerics (``eig_refresh='fused'``): the in-kernel fp32 MXU
+    dots replace XLA-HIGHEST einsums, so refreshed cache VALUES can
+    differ by ulps from the precomputed path — same contract as
+    ``eig_precision``/``eig_cache_dtype``. No vmap/sharding variants:
+    the lever targets the single-chip headline; batched callers raise
+    (resolve via the precomputed path there).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    from coda_tpu.ops.pbest import pbest_grid
+    from coda_tpu.selectors.coda import _bump_tables, _trapz_weights
+
+    C, N, H = pbest_hyp.shape
+    G = num_points
+    x = pbest_grid(G)
+    dx = x[1] - x[0]
+    w_trapz = _trapz_weights(G, dx, x.dtype)
+    s0, dlogcdf, f_u, d_f = _bump_tables(a_t, b_t, x, dx, update_weight)
+    # F tables pre-transposed once (O(H·G), trivial next to the cache)
+    fu_t = f_u.T                                          # (G, H)
+    df_t = d_f.T
+    # grid-constant table operands, padded, double-buffer-conservative
+    tables = 2 * 4 * (H * G + 2 * G * _lane_padded(H) + 2 * G)
+    B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize,
+                     fused=True, table_bytes=tables)
+    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat)
+    n_blocks = -(-N // B)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((1, 1, H), lambda i, c: (0, 0, 0)),  # mixture0
+            pl.BlockSpec((1, 1), lambda i, c: (0, 0)),        # h_before
+            pl.BlockSpec((C, 1, 1), lambda i, c: (0, 0, 0)),  # pi_hat
+            pl.BlockSpec((C, 1, H), lambda i, c: (0, 0, 0)),  # rows
+            pl.BlockSpec((1, num_points), lambda i, c: (0, 0)),   # S0
+            pl.BlockSpec((H, num_points), lambda i, c: (0, 0)),   # dlogcdf
+            pl.BlockSpec((num_points, H), lambda i, c: (0, 0)),   # F_u^T
+            pl.BlockSpec((num_points, H), lambda i, c: (0, 0)),   # dF^T
+            pl.BlockSpec((1, num_points), lambda i, c: (0, 0)),   # w_trapz
+            pl.BlockSpec((B, H), lambda i, c: (i, 0)),        # hard preds
+            pl.BlockSpec((C, B, 1), lambda i, c: (0, i, 0)),  # pi_xi_t
+            pl.BlockSpec((C, B, H), lambda i, c: (0, i, 0)),  # cache tile
+        ],
+        out_specs=(
+            pl.BlockSpec((B, 1), lambda i, c: (i, 0)),
+            pl.BlockSpec((1, B, H), lambda i, c: (c[0], i, 0)),
+        ),
+    )
+    scores, hyp_out = pl.pallas_call(
+        _refresh_compute_score_kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct(pbest_hyp.shape, pbest_hyp.dtype),
+        ),
+        # cache operand (12th incl. the scalar prefetch at 0) aliases the
+        # updated-cache output
+        input_output_aliases={12: 1},
+        interpret=interpret,
+    )(
+        jnp.asarray(true_class, jnp.int32)[None],
+        mixture0,
+        h_before,
+        pi_hat[:, None, None],
+        pbest_rows[:, None, :],
+        s0[None, :],
+        dlogcdf,
+        fu_t,
+        df_t,
+        w_trapz[None, :],
+        hard_preds,
+        pi_hat_xi.T[:, :, None],
+        pbest_hyp,
+    )
+    return scores[:, 0], hyp_out
 
 
 def eig_scores_cache_pallas_sharded(

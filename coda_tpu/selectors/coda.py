@@ -100,6 +100,23 @@ class CODAHyperparams(NamedTuple):
     #                               EIG orderings can change — opt-in
     #                               speed, not reference semantics (same
     #                               contract as eig_precision).
+    eig_refresh: str = "precomputed"  # precomputed | fused — where the
+    #                               incremental row-refresh einsums run.
+    #                               "precomputed" (default, reference
+    #                               numerics): XLA-HIGHEST einsums emit
+    #                               the (N, H) replacement row, the
+    #                               pallas kernel streams it in. "fused"
+    #                               (opt-in, pallas backend only): the
+    #                               row is computed INSIDE the scoring
+    #                               kernel from O(H·G) Beta tables —
+    #                               three fp32 MXU dots per tile overlap
+    #                               the cache read, removing the largest
+    #                               remaining XLA stage (3.2-3.7 ms at
+    #                               headline) and the (N, H) round-trip.
+    #                               In-kernel dots are not XLA-HIGHEST:
+    #                               refreshed cache values can differ by
+    #                               ulps — same opt-in contract as
+    #                               eig_precision / eig_cache_dtype.
     shard_spec: str = ""          # "" | "data=K" — declared mesh sharding
     #                               of the (H, N, C) tensor for the pallas
     #                               fast path. pallas_call is an opaque
@@ -964,6 +981,20 @@ def make_coda(
                 "sharded tensor would be all-gathered per device"
             )
 
+    if hp.eig_refresh not in ("precomputed", "fused"):
+        raise ValueError(f"unknown eig_refresh {hp.eig_refresh!r} "
+                         "(use 'precomputed' or 'fused')")
+    fused_refresh = hp.eig_refresh == "fused"
+    if fused_refresh and (eig_backend != "pallas" or shard_mesh is not None
+                          or hp.n_parallel > 1):
+        raise ValueError(
+            "eig_refresh='fused' computes the replacement row inside the "
+            "single-chip pallas scoring kernel; it requires the pallas "
+            "backend and supports neither shard_spec nor vmapped batches "
+            f"(got backend={eig_backend!r}, shard_spec={hp.shard_spec!r}, "
+            f"n_parallel={hp.n_parallel})"
+        )
+
     def _score_cache(rows, hyp, pi, pi_xi):
         """The incremental scoring pass, backend-dispatched."""
         if eig_backend == "pallas":
@@ -1134,7 +1165,23 @@ def make_coda(
                 pi_xi, pi, unnorm = update_pi_hat_column(
                     dirichlets, true_class, preds, state.pi_xi_unnorm
                 )
-            if eig_backend == "pallas":
+            if eig_backend == "pallas" and fused_refresh:
+                # fully-fused: the replacement row is computed IN-KERNEL
+                # from the labeled class's Beta tables (opt-in numerics)
+                from coda_tpu.ops.pallas_eig import (
+                    eig_scores_refresh_compute_pallas,
+                )
+
+                a_cc, b_cc = dirichlet_to_beta(dirichlets)
+                a_t = jnp.take(a_cc, true_class, axis=1)
+                b_t = jnp.take(b_cc, true_class, axis=1)
+                rows = state.pbest_rows.at[true_class].set(
+                    compute_pbest(a_t, b_t, num_points=hp.num_points))
+                scores, hyp = eig_scores_refresh_compute_pallas(
+                    rows, state.pbest_hyp, a_t, b_t, hard_preds,
+                    true_class, pi, pi_xi, num_points=hp.num_points,
+                    block=hp.eig_chunk)
+            elif eig_backend == "pallas":
                 # fused refresh+score: the cache is donated through the
                 # kernel, so the scan carry never pays the XLA defensive
                 # copy a DUS + opaque-custom-call sequence provokes

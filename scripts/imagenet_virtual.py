@@ -63,16 +63,20 @@ def run_tier(eig_mode: str, H: int, N: int, C: int, iters: int,
         lambda p: make_coda(p, hp), iters=iters))
     keys = jnp.stack([jax.random.PRNGKey(0)])
 
+    print(f"[{eig_mode}] lowering+compiling...", flush=True)
     t0 = time.perf_counter()
     lowered = fn.lower(preds, labels, keys)
     compiled = lowered.compile()
     compile_s = time.perf_counter() - t0
     ma = compiled.memory_analysis()
 
+    print(f"[{eig_mode}] compiled in {compile_s:.1f}s; executing...",
+          flush=True)
     t0 = time.perf_counter()
     res = compiled(preds, labels, keys)
     regret = np.asarray(res.regret)
     run_s = time.perf_counter() - t0
+    print(f"[{eig_mode}] ran in {run_s:.1f}s", flush=True)
 
     G = hp.num_points
     return {
@@ -106,7 +110,11 @@ def main(argv=None):
     if args.small:
         H, N, C, chunk = 20, 256, 40, 64
     else:
-        H, N, C, chunk = 500, 1024, 1000, 256  # real pool, N scaled 50x
+        # real pool dims (C=1000, H=500); N scaled ~100x to keep the
+        # virtual-mesh EXECUTION tractable (8 virtual devices share one
+        # host's cores — NOTES_r04 documents the pathology at scale; the
+        # tier memory contract this verifies is N-independent)
+        H, N, C, chunk = 500, 512, 1000, 128
 
     out = {
         "config": "BASELINE.json configs[4]: ImageNet-1k scale pool "

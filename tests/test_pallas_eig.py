@@ -362,3 +362,84 @@ def test_pallas_kernels_nested_vmap_flattens():
                 rtol=1e-4, atol=1e-6, err_msg=f"({t},{s})")
             np.testing.assert_array_equal(np.asarray(hyp2),
                                           np.asarray(hyp_f[t, s]))
+
+
+def test_fused_compute_refresh_matches_precomputed():
+    """eig_refresh='fused' (the in-kernel row computation) must reproduce
+    the precomputed path's scores and refreshed cache up to the
+    documented opt-in tolerance (in-kernel fp32 dots vs XLA-HIGHEST
+    einsums), and the full experiment trace must match the jnp path on
+    tie-free synthetic data."""
+    import jax.numpy as jnp
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine import run_experiment
+    from coda_tpu.ops.pallas_eig import eig_scores_refresh_compute_pallas
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.coda import (
+        eig_scores_from_cache,
+        update_eig_cache_parts,
+    )
+    from coda_tpu.ops.beta import dirichlet_to_beta
+    from coda_tpu.ops.pbest import compute_pbest
+
+    # kernel-level: random dirichlets -> tables -> fused row+score
+    N, C, H = 77, 4, 10
+    key = jax.random.PRNGKey(3)
+    dir_ = jax.random.uniform(key, (H, C, C)) * 3.0 + 0.5
+    hard = jax.random.randint(jax.random.PRNGKey(4), (N, H), 0, C
+                              ).astype(jnp.int32)
+    a_cc, b_cc = dirichlet_to_beta(dir_)
+    c = jnp.int32(2)
+    a_t, b_t = a_cc[:, c], b_cc[:, c]
+    rows0 = compute_pbest(a_cc.T, b_cc.T)
+    rows = rows0.at[c].set(compute_pbest(a_t, b_t))
+    hyp = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(5), (C, N, H)), axis=-1)
+    pi_xi = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(6), (N, C)), axis=-1)
+    pi = pi_xi.mean(0) / pi_xi.mean(0).sum()
+
+    row_t, hyp_t = update_eig_cache_parts(dir_, c, hard)
+    hyp_ref = hyp.at[c].set(hyp_t)
+    s_ref = eig_scores_from_cache(rows, hyp_ref, pi, pi_xi, chunk=32)
+    s_fu, hyp_fu = eig_scores_refresh_compute_pallas(
+        rows, hyp, a_t, b_t, hard, c, pi, pi_xi, block=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(hyp_ref), np.asarray(hyp_fu),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(s_ref), np.asarray(s_fu),
+                               rtol=1e-3, atol=2e-5)
+
+    # experiment-level: same selection trace as the jnp default
+    task = make_synthetic_task(seed=4, H=6, N=64, C=4)
+    res_j = run_experiment(
+        make_coda(task.preds, CODAHyperparams(eig_mode="incremental")),
+        task, iters=10, seed=0)
+    res_f = run_experiment(
+        make_coda(task.preds, CODAHyperparams(
+            eig_mode="incremental", eig_backend="pallas",
+            eig_refresh="fused")),
+        task, iters=10, seed=0)
+    np.testing.assert_array_equal(np.asarray(res_j.chosen_idx),
+                                  np.asarray(res_f.chosen_idx))
+    np.testing.assert_array_equal(np.asarray(res_j.best_model),
+                                  np.asarray(res_f.best_model))
+
+
+def test_fused_compute_refresh_guards():
+    import pytest
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    t = make_synthetic_task(seed=1, H=4, N=32, C=4)
+    with pytest.raises(ValueError, match="unknown eig_refresh"):
+        make_coda(t.preds, CODAHyperparams(eig_refresh="Fused"))
+    # fused requires the pallas backend
+    with pytest.raises(ValueError, match="pallas"):
+        make_coda(t.preds, CODAHyperparams(eig_refresh="fused",
+                                           eig_backend="jnp"))
+    with pytest.raises(ValueError, match="vmapped"):
+        make_coda(t.preds, CODAHyperparams(eig_refresh="fused",
+                                           eig_backend="pallas",
+                                           n_parallel=4))
