@@ -753,3 +753,42 @@ def test_eig_scores_from_cache_vmap_ragged_chunk():
                                     chunk=32)
         np.testing.assert_allclose(np.asarray(vm[s]), np.asarray(ref),
                                    rtol=1e-6, atol=1e-6, err_msg=str(s))
+
+
+def test_streamed_pi_contraction_matches_einsum(monkeypatch):
+    """The beyond-budget streamed-over-H pi contractions must match the
+    one-shot HIGHEST einsums to DEFAULT-matmul-precision tolerance (on
+    the CPU test backend both run fp32 exactly, so the agreement is
+    tight; the branch exists because no HIGH/HIGHEST contraction of a
+    ~10 GiB operand compiles on the TPU stack)."""
+    import coda_tpu.ops.confusion as confusion
+    import coda_tpu.selectors.coda as coda_mod
+    from coda_tpu.selectors.coda import (
+        pi_unnorm,
+        update_pi_hat_column,
+    )
+
+    H, N, C = 6, 50, 4
+    key = jax.random.PRNGKey(9)
+    preds = jax.nn.softmax(jax.random.normal(key, (H, N, C)), axis=-1)
+    dirichlets = jax.random.uniform(
+        jax.random.PRNGKey(10), (H, C, C)) * 2 + 0.5
+    ref_unnorm = pi_unnorm(dirichlets, preds)
+    ens = jnp.zeros((N,), jnp.int32)
+    from coda_tpu.ops.confusion import create_confusion_matrices
+    ref_conf = create_confusion_matrices(ens, preds, mode="soft")
+    ref_col = update_pi_hat_column(dirichlets, jnp.int32(1), preds,
+                                   ref_unnorm)
+
+    monkeypatch.setattr(confusion, "PREDS_ONESHOT_MAX_BYTES", 1)
+    out_unnorm = pi_unnorm(dirichlets, preds)
+    out_conf = create_confusion_matrices(ens, preds, mode="soft")
+    out_col = update_pi_hat_column(dirichlets, jnp.int32(1), preds,
+                                   ref_unnorm)
+    np.testing.assert_allclose(np.asarray(ref_unnorm),
+                               np.asarray(out_unnorm), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref_conf),
+                               np.asarray(out_conf), rtol=1e-5)
+    for a, b in zip(ref_col, out_col):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5)
