@@ -273,11 +273,24 @@ def eig_scores_cache_pallas_batched(
             )(rows_b, hyp_b, pi_b, pi_xi_b)
             return out, True
         # flatten (T, S, ...) -> (T*S, ...) and recurse into the batched
-        # kernel — arbitrary vmap nesting collapses to one grid axis
+        # kernel — arbitrary vmap nesting collapses to one grid axis.
+        # The padded-operand budget must be re-checked at the FLATTENED
+        # batch size (the inner dispatch only saw S replicas).
         T, S = rows_b.shape[0], rows_b.shape[1]
+        TS, C2, N2, H2 = T * S, hyp_b.shape[2], hyp_b.shape[3], \
+            hyp_b.shape[4]
+        if not batched_pallas_viable(TS, C2, N2, H2,
+                                     hyp_b.dtype.itemsize):
+            from coda_tpu.selectors.coda import eig_scores_from_cache
+
+            out = jax.vmap(jax.vmap(
+                lambda r, h, p, px: eig_scores_from_cache(
+                    r, h, p, px, chunk=block or 2048)))(
+                rows_b, hyp_b, pi_b, pi_xi_b)
+            return out, True
 
         def flat(x):
-            return x.reshape((T * S,) + x.shape[2:])
+            return x.reshape((TS,) + x.shape[2:])
 
         out = eig_scores_cache_pallas_batched(
             flat(rows_b), flat(hyp_b), flat(pi_b), flat(pi_xi_b),
@@ -746,9 +759,23 @@ def eig_scores_refresh_pallas_batched(
                 rows_b, hyp_b, hyp_t_b, c_b, pi_b, pi_xi_b)
             return out, (True, True)
         T, S = rows_b.shape[0], rows_b.shape[1]
+        TS, C2, N2, H2 = T * S, hyp_b.shape[2], hyp_b.shape[3], \
+            hyp_b.shape[4]
+        if not batched_pallas_viable(TS, C2, N2, H2,
+                                     hyp_b.dtype.itemsize):
+            from coda_tpu.selectors.coda import eig_scores_from_cache
+
+            def one2(r, h, ht, c, p, px):
+                h2 = h.at[c].set(ht.astype(h.dtype))
+                return eig_scores_from_cache(
+                    r, h2, p, px, chunk=block or 2048), h2
+
+            out = jax.vmap(jax.vmap(one2))(
+                rows_b, hyp_b, hyp_t_b, c_b, pi_b, pi_xi_b)
+            return out, (True, True)
 
         def flat(x):
-            return x.reshape((T * S,) + x.shape[2:])
+            return x.reshape((TS,) + x.shape[2:])
 
         scores, hyp_out = eig_scores_refresh_pallas_batched(
             flat(rows_b), flat(hyp_b), flat(hyp_t_b), flat(c_b),

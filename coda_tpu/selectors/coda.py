@@ -253,16 +253,19 @@ def resolve_eig_backend(hp: "CODAHyperparams", eig_mode: str,
 
     auto -> "pallas" on a TPU process running the incremental tier when
     the opaque-custom-call restriction (pallas_call cannot be partitioned
-    by GSPMD) cannot bite: a SINGLE-chip process, or a multi-chip process
-    whose data-axis sharding is DECLARED via ``shard_spec`` (the kernels
-    then run per shard under shard_map — see
+    by GSPMD) cannot bite: an UNBATCHED single-chip process, or a
+    multi-chip process whose data-axis sharding is DECLARED via
+    ``shard_spec`` (the kernels then run per shard under shard_map — see
     ``ops/pallas_eig.eig_scores_cache_pallas_sharded``). Vmapped batches
-    (``n_parallel`` > 1) dispatch to the explicitly batched kernels via
-    custom_vmap on a single chip; the sharded path stays single-replica.
-    Everywhere else — CPU/GPU, undeclared multi-device, non-incremental
-    tiers — auto stays "jnp". Single-chip validated on a v5e in round 4
-    (PALLAS_TPU_VALIDATION_r04.json): max |Δscore| 2.9e-6, argmax
-    agreement, 3x the jnp scoring pass.
+    (``n_parallel`` > 1) resolve to "jnp" under auto: the batched
+    kernels exist and are silicon-validated
+    (PALLAS_TPU_VALIDATION_r05.json), but their fixed (C, ·, H)/(·, 1)
+    layouts pad pathologically at the suite's small-H family shapes
+    (see ``ops/pallas_eig.batched_pallas_viable``) and have not been
+    shown faster than XLA's per-shape layouts there — engage them
+    explicitly with eig_backend='pallas' where the shape suits them
+    (C small, H large). Everywhere else — CPU/GPU, undeclared
+    multi-device, non-incremental tiers — auto stays "jnp".
     """
     if hp.eig_backend != "auto":
         return hp.eig_backend
