@@ -99,7 +99,7 @@ def main(argv=None):
     ap.add_argument("--out", default=None)
     ap.add_argument("--small", action="store_true",
                     help="smoke-test shape (CI), not the artifact config")
-    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=1)
     args = ap.parse_args(argv)
 
     from coda_tpu.utils.platform import pin_platform
@@ -110,11 +110,14 @@ def main(argv=None):
     if args.small:
         H, N, C, chunk = 20, 256, 40, 64
     else:
-        # real pool dims (C=1000, H=500); N scaled ~100x to keep the
+        # real pool dims (C=1000, H=500); N scaled ~200x to keep the
         # virtual-mesh EXECUTION tractable (8 virtual devices share one
-        # host's cores — NOTES_r04 documents the pathology at scale; the
-        # tier memory contract this verifies is N-independent)
-        H, N, C, chunk = 500, 512, 1000, 128
+        # host's cores and serialize per-chunk collectives — NOTES_r04
+        # documents the pathology; an N=512 x 2-round factored run was
+        # still grinding after 15 min. The tier memory contract this
+        # artifact verifies — factored's (C, H, G) tables vs rowscan's
+        # O(H·G) — is N-independent)
+        H, N, C, chunk = 500, 256, 1000, 64
 
     out = {
         "config": "BASELINE.json configs[4]: ImageNet-1k scale pool "
