@@ -269,6 +269,30 @@ def main(argv=None):
     stage("pallas:fused refresh+score", body_fused,
           (rows, hyp, jnp.float32(0)), ops=(dir0, hard, pi, pi_xi))
 
+    # fused-COMPUTE refresh (round 5): the replacement row computed
+    # IN-KERNEL from the Beta tables — the refresh einsums disappear
+    # from XLA entirely (opt-in numerics, --eig-refresh fused)
+    def body_fusedcompute(carry, i, dir0, hard, pi, pi_xi):
+        from coda_tpu.ops.beta import dirichlet_to_beta
+        from coda_tpu.ops.pbest import compute_pbest
+        from coda_tpu.ops.pallas_eig import (
+            eig_scores_refresh_compute_pallas,
+        )
+
+        rows_c, hyp_c, c = carry
+        a_cc, b_cc = dirichlet_to_beta(dir0)
+        a_t = jnp.take(a_cc, i % C, axis=1)
+        b_t = jnp.take(b_cc, i % C, axis=1)
+        rows2 = rows_c.at[i % C].set(
+            compute_pbest(a_t, b_t, num_points=G))
+        s, hyp2 = eig_scores_refresh_compute_pallas(
+            rows2, hyp_c, a_t, b_t, hard, i % C, pi + c * eps, pi_xi,
+            num_points=G, block=CH)
+        return rows2, hyp2, c + s[0] * eps
+
+    stage("pallas:fused-compute refresh+score", body_fusedcompute,
+          (rows, hyp, jnp.float32(0)), ops=(dir0, hard, pi, pi_xi))
+
     def body_pi(u, i, dir0, preds):
         _, _, u2 = update_pi_hat_column(dir0, i % C, preds, u)
         return u2
