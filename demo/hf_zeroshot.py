@@ -66,6 +66,38 @@ def _hf_pipeline_scorer(model_name: str) -> Callable:
     return score
 
 
+def _manual_processor_scorer(model_name: str) -> Callable:
+    """Manual processor -> model -> softmax path (reference ``:118-168`` —
+    its SigLIP branch bypasses the generic pipeline and drives the
+    processor/model directly). Used for SigLIP-family checkpoints, and
+    exercisable against ANY dual-encoder checkpoint via
+    ``make_scorer(..., backend='manual')`` — the committed locally-trained
+    CLIP runs through it in tests, proving the non-pipeline path without
+    hub access. Same hypothesis template as the pipeline backend so the
+    two produce comparable scores.
+    """
+    import torch
+    from transformers import AutoModel, AutoProcessor
+
+    model = AutoModel.from_pretrained(model_name)
+    processor = AutoProcessor.from_pretrained(model_name)
+    model.eval()
+
+    def score(image_path: str, classes: Sequence[str]) -> list[float]:
+        from PIL import Image
+
+        img = Image.open(image_path).convert("RGB")
+        prompts = [f"This is a photo of {c}." for c in classes]
+        inputs = processor(text=prompts, images=img, return_tensors="pt",
+                           padding=True)
+        with torch.no_grad():
+            logits = model(**inputs).logits_per_image[0]
+        probs = torch.softmax(logits.float(), dim=-1)
+        return [float(p) for p in probs]
+
+    return score
+
+
 def _bioclip_scorer(model_name: str) -> Callable:
     """BioCLIP via pybioclip (reference ``:71-116``); gated on the import."""
     from bioclip import CustomLabelsClassifier  # not in this image: gated
@@ -84,9 +116,15 @@ def _bioclip_scorer(model_name: str) -> Callable:
     return score
 
 
-def make_scorer(model_name: str) -> Callable:
-    if "bioclip" in model_name.lower():
+def make_scorer(model_name: str, backend: str | None = None) -> Callable:
+    """``backend``: None (infer from the name — bioclip -> pybioclip,
+    siglip -> manual processor, else pipeline) | 'pipeline' | 'manual' |
+    'bioclip'."""
+    name = model_name.lower()
+    if backend == "bioclip" or (backend is None and "bioclip" in name):
         return _bioclip_scorer(model_name)
+    if backend == "manual" or (backend is None and "siglip" in name):
+        return _manual_processor_scorer(model_name)
     return _hf_pipeline_scorer(model_name)
 
 

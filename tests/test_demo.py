@@ -405,3 +405,31 @@ def test_demo_serves_real_clip_pool_end_to_end():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(_TINY_CLIP, "model.safetensors"))
+    or not os.path.exists(
+        os.path.join(REPO, "demo", "digit_images", "labels.npy")),
+    reason="committed tiny-clip checkpoint or digit images not present",
+)
+def test_manual_processor_scorer_real_checkpoint():
+    """The NON-pipeline backend (manual processor -> model -> softmax, the
+    reference's SigLIP branch ``demo/hf_zeroshot.py:118-168``) runs the
+    committed locally-trained CLIP checkpoint end-to-end and agrees with
+    the pipeline backend on the same images (same checkpoint, same
+    hypothesis template — the two paths must rank alike)."""
+    pytest.importorskip("transformers")
+    from demo.hf_zeroshot import make_scorer
+
+    img_dir = os.path.join(REPO, "demo", "digit_images")
+    imgs = sorted(f for f in os.listdir(img_dir) if f.endswith(".png"))[:3]
+    classes = [str(d) for d in range(10)]
+    manual = make_scorer(_TINY_CLIP, backend="manual")
+    pipe = make_scorer(_TINY_CLIP, backend="pipeline")
+    for name in imgs:
+        p = os.path.join(img_dir, name)
+        s_m = manual(p, classes)
+        s_p = pipe(p, classes)
+        assert len(s_m) == 10 and abs(sum(s_m) - 1.0) < 1e-5
+        assert int(np.argmax(s_m)) == int(np.argmax(s_p)), name
