@@ -123,9 +123,13 @@ def main(argv=None):
         "config": "BASELINE.json configs[4]: ImageNet-1k scale pool "
                   "(C=1000, H=500; N scaled to fit one host)",
         "devices": len(jax.devices()),
-        "tiers": [run_tier(m, H, N, C, args.iters, chunk)
-                  for m in ("factored", "rowscan")],
+        "tiers": [],
     }
+    for m in ("factored", "rowscan"):
+        out["tiers"].append(run_tier(m, H, N, C, args.iters, chunk))
+        if args.out:  # incremental: a killed run keeps finished tiers
+            with open(args.out + ".partial", "w") as f:
+                json.dump(out, f, indent=2)
     fac, row = out["tiers"]
     # the tier contract: same math, order-of-magnitude different temps
     out["rowscan_temp_fraction_of_factored"] = round(
