@@ -14,14 +14,14 @@ import jax.numpy as jnp
 
 _PRECISION = jax.lax.Precision.HIGHEST
 
-# Above this preds size the one-shot einsums stream the model axis in a
-# fori_loop of leading-index slices instead: XLA's layout assignment
-# materializes a RELAYOUT COPY of the full (H, N, C) operand for the
-# h,s-contracting einsums, and at the reference's true DomainNet scale
-# (9.4 GiB) two copies exceed a v5e's 16 GB HBM — the compile's memory
-# planner fails outright (reproduced round 5; 7 GiB compiles, 9.4 does
-# not). Leading-index slices need no relayout, and the loop's (N, C)
-# accumulator is trivially small. Shared by coda.pi_unnorm.
+# Above this preds size the big contractions demote to DEFAULT matmul
+# precision: no HIGH/HIGHEST contraction of a ~10 GiB fp32 operand
+# compiles on this TPU stack (the compile helper fails outright —
+# reproduced round 5 on a v5e; ~7 GiB compiles, 9.4 GiB does not,
+# einsum and per-slice-dot forms alike, while DEFAULT compiles and
+# runs). The einsum FORM is kept at every size — it partitions under
+# GSPMD, where each shard is small and keeps reference numerics.
+# Shared by coda.pi_unnorm / update_pi_hat_column.
 PREDS_ONESHOT_MAX_BYTES = 4 << 30
 
 
@@ -52,23 +52,16 @@ def create_confusion_matrices(
         p = model_predictions
     else:
         raise ValueError(mode)
-    if mode == "soft" and 4 * H * N * C > PREDS_ONESHOT_MAX_BYTES:
-        # stream models: per h one (C, N) x (N, C) MXU matmul — same
-        # contraction, no (H, N, C) relayout copy (see the constant above)
-        # DEFAULT matmul precision: HIGH/HIGHEST contractions of a
-        # ~10 GiB operand do not compile on this stack (see the coda.py
-        # streamed-branch note); soft-confusion entries are row-
-        # normalized sums of ~N softmax scores, ~1e-3-relative tolerant
-        t = true_one_hot.T                           # (C, N)
-
-        def body(h, acc):
-            return acc.at[h].set(jnp.dot(t, p[h]))
-
-        conf = jax.lax.fori_loop(
-            0, H, body, jnp.zeros((H, C, C), jnp.float32))
-    else:
-        conf = jnp.einsum("nc,hnj->hcj", true_one_hot, p,
-                          precision=_PRECISION)
+    # DEFAULT matmul precision past the one-shot budget: HIGH/HIGHEST
+    # contractions of a ~10 GiB operand do not compile on this stack (see
+    # coda.pi_unnorm); soft-confusion entries are row-normalized sums of
+    # ~N softmax scores, ~1e-3-relative tolerant. The einsum FORM is kept
+    # either way — it partitions under GSPMD (a streamed fori_loop over
+    # the model-sharded axis blew per-device temps 6x in the 100 GB AOT
+    # memory plan).
+    prec = (None if mode == "soft" and 4 * H * N * C
+            > PREDS_ONESHOT_MAX_BYTES else _PRECISION)
+    conf = jnp.einsum("nc,hnj->hcj", true_one_hot, p, precision=prec)
     return conf / jnp.clip(conf.sum(-1, keepdims=True), 1e-6, None)
 
 

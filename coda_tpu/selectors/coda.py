@@ -368,33 +368,33 @@ def update_pi_hat(
     return pi_xi, pi
 
 
+def _pi_precision(preds: jnp.ndarray) -> lax.Precision:
+    """HIGHEST for every in-budget shape; DEFAULT past the one-shot
+    budget, where nothing stricter compiles (see :func:`pi_unnorm`)."""
+    from coda_tpu.ops.confusion import PREDS_ONESHOT_MAX_BYTES
+
+    H, N, C = preds.shape
+    return (lax.Precision.DEFAULT
+            if 4 * H * N * C > PREDS_ONESHOT_MAX_BYTES else _PRECISION)
+
+
 def pi_unnorm(dirichlets: jnp.ndarray, preds: jnp.ndarray) -> jnp.ndarray:
     """Unnormalized (N, C) class scores — the ONE pi-hat contraction kernel
     (shared by the full recompute and the incremental column cache so the
     two paths can never desync numerically)."""
-    from coda_tpu.ops.confusion import PREDS_ONESHOT_MAX_BYTES
-
-    H, N, C = preds.shape
-    if 4 * H * N * C > PREDS_ONESHOT_MAX_BYTES:
-        # stream the model axis: per h one (N, s) x (s, c) MXU matmul
-        # accumulated into (N, C). DEFAULT matmul precision here, not
-        # HIGHEST: at the true ~10 GiB DomainNet scale NO HIGH/HIGHEST
-        # contraction of the tensor compiles on this stack (the TPU
-        # compile helper fails outright — reproduced round 5 on a v5e at
-        # H=400, N=50k, C=126, einsum AND per-slice dot forms alike,
-        # while DEFAULT compiles and runs). bf16 multiplies with fp32
-        # accumulation perturb pi-hat at ~1e-3 relative — confined to
-        # this beyond-one-chip scale; the sharded multi-chip path keeps
-        # HIGHEST per (small) shard, and every in-budget shape keeps the
-        # reference-parity einsum below.
-        def body(h, acc):
-            return acc + jnp.dot(preds[h], dirichlets[h].T)
-
-        return lax.fori_loop(0, H, body,
-                             jnp.zeros((N, C), preds.dtype))
     # contract models inside the einsum: the (H, N, C) adjusted tensor (2 GB
-    # at M=1k, N=50k) never materializes — one MXU pass straight to (N, C)
-    return jnp.einsum("hcs,hns->nc", dirichlets, preds, precision=_PRECISION)
+    # at M=1k, N=50k) never materializes — one MXU pass straight to (N, C).
+    # Precision demotes to DEFAULT past the one-shot budget: at the true
+    # ~10 GiB DomainNet scale NO HIGH/HIGHEST contraction of the tensor
+    # compiles on this stack (the TPU compile helper fails outright —
+    # reproduced round 5 on a v5e at H=400, N=50k, C=126, einsum and
+    # per-slice-dot forms alike, while the DEFAULT einsum compiles and
+    # runs). bf16 multiplies with fp32 accumulation perturb pi-hat at
+    # ~1e-3 relative — confined to this beyond-one-chip scale; every
+    # in-budget shape (and each shard of a sharded run, which partitions
+    # this same einsum) keeps the reference-parity HIGHEST.
+    return jnp.einsum("hcs,hns->nc", dirichlets, preds,
+                      precision=_pi_precision(preds))
 
 
 def _normalize_pi(unnorm: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -417,20 +417,10 @@ def update_pi_hat_column(
     column: one O(N·H·C) einsum instead of the full O(N·H·C²) pass.
     Returns ``(pi_hat_xi, pi_hat, new_unnorm)``.
     """
-    from coda_tpu.ops.confusion import PREDS_ONESHOT_MAX_BYTES
-
     d_t = jnp.take(dirichlets, true_class, axis=1)     # (H, C)
-    H, N, C = preds.shape
-    if 4 * H * N * C > PREDS_ONESHOT_MAX_BYTES:
-        # streamed, DEFAULT-precision (same compile-viability constraint
-        # and numerics note as pi_unnorm's streamed branch)
-        def body(h, acc):
-            return acc + jnp.dot(preds[h], d_t[h])
-
-        col = lax.fori_loop(0, H, body, jnp.zeros((N,), preds.dtype))
-    else:
-        col = jnp.einsum("hs,hns->n", d_t, preds,
-                         precision=_PRECISION)  # (N,)
+    # precision demotes past the one-shot budget (see pi_unnorm)
+    col = jnp.einsum("hs,hns->n", d_t, preds,
+                     precision=_pi_precision(preds))  # (N,)
     unnorm = pi_xi_unnorm.at[:, true_class].set(col)
     pi_xi, pi = _normalize_pi(unnorm)
     return pi_xi, pi, unnorm

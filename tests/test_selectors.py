@@ -756,11 +756,11 @@ def test_eig_scores_from_cache_vmap_ragged_chunk():
 
 
 def test_streamed_pi_contraction_matches_einsum(monkeypatch):
-    """The beyond-budget streamed-over-H pi contractions must match the
-    one-shot HIGHEST einsums to DEFAULT-matmul-precision tolerance (on
-    the CPU test backend both run fp32 exactly, so the agreement is
-    tight; the branch exists because no HIGH/HIGHEST contraction of a
-    ~10 GiB operand compiles on the TPU stack)."""
+    """Past the one-shot budget the pi/confusion contractions demote to
+    DEFAULT matmul precision (no HIGH/HIGHEST contraction of a ~10 GiB
+    operand compiles on the TPU stack); the einsum FORM is unchanged, so
+    on the CPU test backend (fp32 either way) results match the HIGHEST
+    path exactly — this pins that the demotion changes nothing else."""
     import coda_tpu.ops.confusion as confusion
     import coda_tpu.selectors.coda as coda_mod
     from coda_tpu.selectors.coda import (
