@@ -44,6 +44,14 @@ def main(argv=None):
     p.add_argument("--seeds", type=int, default=5)
     p.add_argument("--iters", type=int, default=100)
     p.add_argument("--eig-chunk", type=int, default=2048)
+    p.add_argument("--eig-backend", default=None,
+                   choices=[None, "auto", "jnp", "pallas"],
+                   help="force CODA's scoring backend (default: the auto "
+                        "resolver — jnp for vmapped batches). 'pallas' "
+                        "engages the BATCHED kernels where the "
+                        "padded-operand budget allows (msv/glue "
+                        "families); over-budget shapes fall back to jnp "
+                        "via the custom_vmap guard")
     p.add_argument("--compile-cache", default=".jax_cache")
     p.add_argument("--platform", default=None)
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
@@ -130,14 +138,16 @@ def main(argv=None):
         per_task = max(1, args.seeds - 1) * 4 * H * N * C
         return max(1, int(_INCR_CACHE_MAX_BYTES // per_task))
 
+    margs = {"eig_chunk": args.eig_chunk}
+    if args.eig_backend:
+        margs["eig_backend"] = args.eig_backend
     t0 = time.perf_counter()
     if args.task_batch:
         results = runner.run_batched(
-            groups, methods, method_args={"eig_chunk": args.eig_chunk},
+            groups, methods, method_args=margs,
             batch_caps={"coda": coda_cap})
     else:
-        results = runner.run(loaders, methods,
-                             method_args={"eig_chunk": args.eig_chunk})
+        results = runner.run(loaders, methods, method_args=margs)
     wall = time.perf_counter() - t0
     n_pairs = len(results)
     stats = getattr(runner, "last_stats", {})
@@ -186,11 +196,10 @@ def main(argv=None):
             t0 = time.perf_counter()
             if args.task_batch:
                 runner.run_batched(
-                    groups, methods, method_args={"eig_chunk": args.eig_chunk},
+                    groups, methods, method_args=margs,
                     batch_caps={"coda": coda_cap})
             else:
-                runner.run(loaders, methods,
-                           method_args={"eig_chunk": args.eig_chunk})
+                runner.run(loaders, methods, method_args=margs)
             walls.append(round(time.perf_counter() - t0, 2))
             computes.append(round(runner.last_stats.get("compute_s", 0.0), 2))
         line["steady_state_compute_s"] = statistics.median(computes)
