@@ -76,6 +76,7 @@ _SLOW_TESTS = {
     "test_demo_full_loop",
     "test_paper_scripts_end_to_end",
     "test_gather_matches_xla_path",
+    "test_fused_compute_refresh_real_data_trace",
 }
 
 

@@ -443,3 +443,34 @@ def test_fused_compute_refresh_guards():
         make_coda(t.preds, CODAHyperparams(eig_refresh="fused",
                                            eig_backend="pallas",
                                            n_parallel=4))
+
+
+def test_fused_compute_refresh_real_data_trace():
+    """eig_refresh='fused' reproduces the default path's full selection
+    trace on the committed REAL digits task (the strongest opt-in
+    evidence available off-silicon: 30 rounds of real-model predictions,
+    interpret-mode kernel)."""
+    import os
+
+    import pytest as _pytest
+
+    fp = os.path.join(os.path.dirname(__file__), "..", "data", "digits.npz")
+    if not os.path.exists(fp):
+        _pytest.skip("committed digits task not present")
+    from coda_tpu.data import Dataset
+    from coda_tpu.engine import run_experiment
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    ds = Dataset.from_file(fp)
+    r_def = run_experiment(
+        make_coda(ds.preds, CODAHyperparams(eig_mode="incremental")),
+        ds, iters=30, seed=0)
+    r_fus = run_experiment(
+        make_coda(ds.preds, CODAHyperparams(
+            eig_mode="incremental", eig_backend="pallas",
+            eig_refresh="fused")),
+        ds, iters=30, seed=0)
+    np.testing.assert_array_equal(np.asarray(r_def.chosen_idx),
+                                  np.asarray(r_fus.chosen_idx))
+    np.testing.assert_array_equal(np.asarray(r_def.best_model),
+                                  np.asarray(r_fus.best_model))
