@@ -742,17 +742,18 @@ def eig_scores_refresh_pallas_batched(
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, hyp_t_b, c_b,
                    pi_b, pi_xi_b):
-        if not all(in_batched):
+        def one2(r, h, ht, c, p, px):
+            # the shared jnp fallback: DUS the row, then score
             from coda_tpu.selectors.coda import eig_scores_from_cache
 
+            h2 = h.at[c].set(ht.astype(h.dtype))
+            return eig_scores_from_cache(
+                r, h2, p, px, chunk=block or 2048), h2
+
+        if not all(in_batched):
             in_axes = [0 if b else None for b in in_batched]
 
             def one(rows, hyp, hyp_t, cls, pi, pi_xi):
-                def one2(r, h, ht, c, p, px):
-                    h2 = h.at[c].set(ht.astype(h.dtype))
-                    return eig_scores_from_cache(
-                        r, h2, p, px, chunk=block or 2048), h2
-
                 return jax.vmap(one2)(rows, hyp, hyp_t, cls, pi, pi_xi)
 
             out = jax.vmap(one, in_axes=in_axes)(
@@ -763,13 +764,6 @@ def eig_scores_refresh_pallas_batched(
             hyp_b.shape[4]
         if not batched_pallas_viable(TS, C2, N2, H2,
                                      hyp_b.dtype.itemsize):
-            from coda_tpu.selectors.coda import eig_scores_from_cache
-
-            def one2(r, h, ht, c, p, px):
-                h2 = h.at[c].set(ht.astype(h.dtype))
-                return eig_scores_from_cache(
-                    r, h2, p, px, chunk=block or 2048), h2
-
             out = jax.vmap(jax.vmap(one2))(
                 rows_b, hyp_b, hyp_t_b, c_b, pi_b, pi_xi_b)
             return out, (True, True)

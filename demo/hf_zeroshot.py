@@ -125,6 +125,9 @@ def make_scorer(model_name: str, backend: str | None = None) -> Callable:
         return _bioclip_scorer(model_name)
     if backend == "manual" or (backend is None and "siglip" in name):
         return _manual_processor_scorer(model_name)
+    if backend not in (None, "pipeline"):
+        raise ValueError(f"unknown scorer backend {backend!r} "
+                         "(use pipeline/manual/bioclip)")
     return _hf_pipeline_scorer(model_name)
 
 

@@ -45,7 +45,7 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=100)
     p.add_argument("--eig-chunk", type=int, default=2048)
     p.add_argument("--eig-backend", default=None,
-                   choices=[None, "auto", "jnp", "pallas"],
+                   choices=["auto", "jnp", "pallas"],
                    help="force CODA's scoring backend (default: the auto "
                         "resolver — jnp for vmapped batches). 'pallas' "
                         "engages the BATCHED kernels where the "

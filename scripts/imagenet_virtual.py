@@ -142,6 +142,9 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=2)
+        partial = args.out + ".partial"
+        if os.path.exists(partial):
+            os.remove(partial)
     return 0 if out["ok"] else 1
 
 
