@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 import time
 
 import numpy as np
@@ -269,6 +270,15 @@ def _log_debug_viz(run, selector, result, seed: int, iters: int) -> None:
 
 
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # `python -m coda_tpu.cli serve ...`: the batched multi-session
+        # serving layer (many interactive sessions, one compiled step per
+        # dispatch) instead of a batch experiment run
+        from coda_tpu.serve.server import main as serve_main
+
+        return serve_main(argv[1:])
     args = parse_args(argv)
     from coda_tpu.utils.platform import pin_platform
 

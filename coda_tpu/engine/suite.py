@@ -313,8 +313,6 @@ class SuiteRunner:
                     f"run_batched group mixes shapes {sorted(shapes)}; "
                     "group tasks by shape"
                 )
-            preds = self._jax.numpy.stack([d.preds for d in datasets])
-            labels = self._jax.numpy.stack([d.labels for d in datasets])
             names = [d.name for d in datasets]
             for method in methods:
                 todo = [
@@ -334,7 +332,7 @@ class SuiteRunner:
                 for chunk in (todo[j:j + cap]
                               for j in range(0, len(todo), cap)):
                     self._dispatch_batch(
-                        chunk, names, preds, labels, method, method_args,
+                        chunk, names, datasets, method, method_args,
                         datasets[0].shape, store, seen_shapes, pairs,
                         results, progress)
                     t_compute += pairs[-1]["seconds"] * pairs[-1]["batched"]
@@ -346,7 +344,7 @@ class SuiteRunner:
                  f"{t_load:.2f}s)")
         return results
 
-    def _dispatch_batch(self, todo, names, preds, labels, method,
+    def _dispatch_batch(self, todo, names, datasets, method,
                         method_args, shape, store, seen_shapes, pairs,
                         results, progress) -> None:
         """One stacked dispatch of ``todo``'s tasks for one method (the
@@ -363,11 +361,18 @@ class SuiteRunner:
                 "unbatched"
             )
         T = len(todo)
-        if T < len(names):
-            sub = self._jax.numpy.asarray(todo)
-            preds_m, labels_m = preds[sub], labels[sub]
-        else:
-            preds_m, labels_m = preds, labels
+        # Stack exactly the todo subset from the per-task arrays, per
+        # dispatch. The former shape — stack the WHOLE group once, then
+        # device-gather `preds[todo]` for partial (resume) batches —
+        # transiently held up to ~2x the group's prediction-tensor
+        # footprint in HBM, exactly for the memory-heavy method families
+        # batch_caps exists to protect (ADVICE round 5). Here the stacked
+        # operand never exceeds the dispatched subset, at the cost of
+        # re-stacking per (method, chunk) when the group is dispatched
+        # whole.
+        jnp = self._jax.numpy
+        preds_m = jnp.stack([datasets[i].preds for i in todo])
+        labels_m = jnp.stack([datasets[i].labels for i in todo])
         names_m = [names[i] for i in todo]
         extra = self._extra_args(method, resolved, batched=True)
         shape_key = (method, tuple(sorted(statics[0].items())),

@@ -9,6 +9,8 @@ sensitive to low-precision accumulation (bf16 passes would perturb it).
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 
@@ -19,10 +21,46 @@ _PRECISION = jax.lax.Precision.HIGHEST
 # compiles on this TPU stack (the compile helper fails outright —
 # reproduced round 5 on a v5e; ~7 GiB compiles, 9.4 GiB does not,
 # einsum and per-slice-dot forms alike, while DEFAULT compiles and
-# runs). The einsum FORM is kept at every size — it partitions under
-# GSPMD, where each shard is small and keeps reference numerics.
+# runs). The threshold sits at the measured known-good bound. The einsum
+# FORM is kept at every size — it partitions under GSPMD, where each
+# shard is small and keeps reference numerics.
 # Shared by coda.pi_unnorm / update_pi_hat_column.
-PREDS_ONESHOT_MAX_BYTES = 4 << 30
+PREDS_ONESHOT_MAX_BYTES = 7 << 30
+
+# The demotion is a workaround for that TPU-stack compile failure ONLY: on
+# CPU, HIGHEST is fp32 anyway, and on GPU DEFAULT would enable tf32 and
+# silently break reference-parity numerics for operands where HIGHEST
+# compiles fine. Every other numerics knob in this codebase
+# (eig_precision, eig_cache_dtype, eig_refresh) is opt-in; this automatic
+# one stays scoped to the backend that forces it. (Module-level so tests
+# can widen it to exercise the demoted path on the CPU backend.)
+_DEMOTE_BACKENDS = ("tpu",)
+
+_warned_demotion = False
+
+
+def oneshot_precision(preds_bytes: int) -> jax.lax.Precision:
+    """Matmul precision for a one-shot contraction of a ``preds_bytes``-big
+    operand: HIGHEST everywhere except past the compile bound on the
+    backends that cannot compile it (see ``PREDS_ONESHOT_MAX_BYTES``).
+    Warns once per process when the demotion engages — it is the one
+    automatic numerics change in the codebase."""
+    global _warned_demotion
+    if (preds_bytes <= PREDS_ONESHOT_MAX_BYTES
+            or jax.default_backend() not in _DEMOTE_BACKENDS):
+        return _PRECISION
+    if not _warned_demotion:
+        _warned_demotion = True
+        warnings.warn(
+            f"prediction tensor ({preds_bytes / (1 << 30):.1f} GiB) exceeds "
+            f"the {PREDS_ONESHOT_MAX_BYTES >> 30} GiB one-shot HIGHEST-"
+            "precision compile bound on this backend; demoting its big "
+            "contractions (pi-hat, soft confusion) to DEFAULT matmul "
+            "precision (~1e-3-relative drift). Shard the tensor over a "
+            "mesh (--mesh data=K) to keep reference-parity HIGHEST.",
+            stacklevel=3,
+        )
+    return jax.lax.Precision.DEFAULT
 
 
 def ensemble_preds(preds: jnp.ndarray) -> jnp.ndarray:
@@ -52,15 +90,15 @@ def create_confusion_matrices(
         p = model_predictions
     else:
         raise ValueError(mode)
-    # DEFAULT matmul precision past the one-shot budget: HIGH/HIGHEST
-    # contractions of a ~10 GiB operand do not compile on this stack (see
-    # coda.pi_unnorm); soft-confusion entries are row-normalized sums of
-    # ~N softmax scores, ~1e-3-relative tolerant. The einsum FORM is kept
-    # either way — it partitions under GSPMD (a streamed fori_loop over
-    # the model-sharded axis blew per-device temps 6x in the 100 GB AOT
-    # memory plan).
-    prec = (None if mode == "soft" and 4 * H * N * C
-            > PREDS_ONESHOT_MAX_BYTES else _PRECISION)
+    # DEFAULT matmul precision past the one-shot budget, TPU only: HIGH/
+    # HIGHEST contractions of a ~10 GiB operand do not compile on that
+    # stack (see coda.pi_unnorm); soft-confusion entries are row-normalized
+    # sums of ~N softmax scores, ~1e-3-relative tolerant. The einsum FORM
+    # is kept either way — it partitions under GSPMD (a streamed fori_loop
+    # over the model-sharded axis blew per-device temps 6x in the 100 GB
+    # AOT memory plan).
+    prec = (oneshot_precision(4 * H * N * C) if mode == "soft"
+            else _PRECISION)
     conf = jnp.einsum("nc,hnj->hcj", true_one_hot, p, precision=prec)
     return conf / jnp.clip(conf.sum(-1, keepdims=True), 1e-6, None)
 

@@ -369,13 +369,13 @@ def update_pi_hat(
 
 
 def _pi_precision(preds: jnp.ndarray) -> lax.Precision:
-    """HIGHEST for every in-budget shape; DEFAULT past the one-shot
-    budget, where nothing stricter compiles (see :func:`pi_unnorm`)."""
-    from coda_tpu.ops.confusion import PREDS_ONESHOT_MAX_BYTES
+    """HIGHEST for every in-budget shape; DEFAULT past the one-shot budget
+    on the TPU backend, where nothing stricter compiles (see
+    :func:`pi_unnorm` and ``confusion.oneshot_precision``)."""
+    from coda_tpu.ops.confusion import oneshot_precision
 
     H, N, C = preds.shape
-    return (lax.Precision.DEFAULT
-            if 4 * H * N * C > PREDS_ONESHOT_MAX_BYTES else _PRECISION)
+    return oneshot_precision(4 * H * N * C)
 
 
 def pi_unnorm(dirichlets: jnp.ndarray, preds: jnp.ndarray) -> jnp.ndarray:

@@ -84,11 +84,14 @@ def _bucket_sums(hard_preds, w, wlw, C: int, impl: str | None = None):
         DomainNet shape (reproduced round 5 on a v5e; the scan runs the
         same 48-replica batch fine).
 
-    ``impl=None`` picks by backend at trace time.
+    ``impl=None`` picks by backend at trace time: ``scan`` ONLY on the TPU
+    whose scatters motivated it — on CPU and GPU scatter-add is the fast
+    path, and the serialized O(N·C·H) scan would be a regression
+    (ADVICE round 5).
     """
     N, H = hard_preds.shape
     if impl is None:
-        impl = "scatter" if jax.default_backend() == "cpu" else "scan"
+        impl = "scan" if jax.default_backend() == "tpu" else "scatter"
     if impl == "scatter":
         rows = jnp.broadcast_to(
             jnp.arange(N, dtype=jnp.int32)[:, None], (N, H))

@@ -1,0 +1,43 @@
+"""Batched multi-session serving of interactive active model selection.
+
+Multiplexes many concurrent human-in-the-loop selection sessions onto one
+accelerator: a fixed-capacity slab of vmapped selector carries
+(:mod:`~coda_tpu.serve.state`), a micro-batching dispatcher that executes
+one compiled masked step per tick (:mod:`~coda_tpu.serve.batcher`), a
+dependency-free HTTP/JSON front door with admission control
+(:mod:`~coda_tpu.serve.server`), and per-dispatch metrics
+(:mod:`~coda_tpu.serve.metrics`). See ARCHITECTURE.md §"Serving".
+"""
+
+from coda_tpu.serve.batcher import Batcher, Ticket
+from coda_tpu.serve.metrics import ServeMetrics
+from coda_tpu.serve.server import ServeApp, build_app, make_server
+from coda_tpu.serve.state import (
+    Bucket,
+    SelectorSpec,
+    Session,
+    SessionStore,
+    SlabFull,
+    SlotRequest,
+    SlotResult,
+    UnknownSession,
+    make_slab_step,
+)
+
+__all__ = [
+    "Batcher",
+    "Bucket",
+    "SelectorSpec",
+    "ServeApp",
+    "ServeMetrics",
+    "Session",
+    "SessionStore",
+    "SlabFull",
+    "SlotRequest",
+    "SlotResult",
+    "Ticket",
+    "UnknownSession",
+    "build_app",
+    "make_server",
+    "make_slab_step",
+]

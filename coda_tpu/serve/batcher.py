@@ -1,0 +1,218 @@
+"""Micro-batching dispatcher: many sessions' requests, one program launch.
+
+The host half of the serving layer. HTTP worker threads submit one request
+per user action (session start, oracle label) and block on a ticket; a
+single batcher thread drains the queue, coalesces everything that arrived
+within a ``max_wait`` window (up to ``max_batch``), groups by bucket, and
+executes ONE compiled masked slab step per bucket
+(:func:`coda_tpu.serve.state.make_slab_step`). Accelerator dispatch cost is
+thus amortized over every concurrent session instead of paid per click —
+the standard batched-inference serving move, applied to the paper's
+select/update/best loop.
+
+Two requests for the same slot never ride one tick (the second would read
+the first's pre-update state); the collision is requeued for the next tick.
+Closed-loop clients can't produce collisions (they wait for their reply),
+so this path only guards misbehaving open-loop callers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Ticket:
+    """One submitted request and its rendezvous."""
+
+    session: object                 # state.Session
+    do_update: bool
+    idx: int = 0
+    label: int = 0
+    prob: float = 0.0
+    submitted: float = field(default_factory=time.perf_counter)
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[dict] = None
+    error: Optional[BaseException] = None
+    cancelled: bool = False
+
+    def wait(self, timeout: Optional[float] = None) -> dict:
+        """Block for the result. On timeout the ticket is CANCELLED before
+        raising: a still-queued request must not fire later against a slot
+        the caller has given up on (it could have been freed and reassigned
+        — the dispatch would advance another session's PRNG stream — or,
+        for a label the client will retry, apply the same update twice).
+        Best-effort: a ticket already inside a dispatch completes."""
+        if not self.done.wait(timeout):
+            self.cancelled = True
+            raise TimeoutError("serve dispatch timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class Batcher:
+    """The dispatcher thread around a :class:`SessionStore`.
+
+    ``max_batch`` caps requests per tick; ``max_wait`` is how long the tick
+    lingers after the FIRST request for stragglers to coalesce (the
+    latency/occupancy dial). ``start()``/``stop()`` manage the thread;
+    ``pause()``/``resume()`` freeze ticking with the queue still accepting —
+    the deterministic-occupancy hook the lockstep load generator and the
+    batching tests use.
+    """
+
+    def __init__(self, store, metrics=None, max_batch: int = 256,
+                 max_wait: float = 0.002):
+        self.store = store
+        self.metrics = metrics
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait)
+        self.queue: queue.Queue = queue.Queue()
+        self._running = False
+        self._paused = threading.Event()
+        self._paused.set()  # set = not paused
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Batcher":
+        if self._thread is not None:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop ticking; with ``drain`` (default) finish queued work first."""
+        if self._thread is None:
+            return
+        if drain:
+            deadline = time.perf_counter() + timeout
+            while not self.queue.empty() and time.perf_counter() < deadline:
+                time.sleep(0.005)
+        self._running = False
+        self._paused.set()
+        self._thread.join(timeout=timeout)
+        self._thread = None
+        # fail any tickets stranded by a non-drained stop
+        while True:
+            try:
+                t = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            t.error = RuntimeError("server stopped")
+            t.done.set()
+
+    def pause(self) -> None:
+        self._paused.clear()
+
+    def resume(self) -> None:
+        self._paused.set()
+
+    # -- submission (HTTP worker threads) ----------------------------------
+    def submit(self, ticket: Ticket) -> Ticket:
+        self.queue.put(ticket)
+        return ticket
+
+    def submit_start(self, session) -> Ticket:
+        return self.submit(Ticket(session=session, do_update=False))
+
+    def submit_label(self, session, idx: int, label: int,
+                     prob: float) -> Ticket:
+        return self.submit(Ticket(session=session, do_update=True, idx=idx,
+                                  label=label, prob=prob))
+
+    # -- the tick ----------------------------------------------------------
+    def _collect(self) -> list:
+        """Block for the first ticket, then linger ``max_wait`` for more.
+
+        A pause() landing mid-collect (the thread may already hold a ticket
+        from its blocking get) HOLDS the partial batch and restarts the
+        linger window on resume, so everything submitted while paused still
+        rides this one dispatch — without this, lockstep's
+        one-dispatch-per-round guarantee would be a race against the first
+        submitter."""
+        try:
+            first = self.queue.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        deadline = time.perf_counter() + self.max_wait
+        while len(batch) < self.max_batch:
+            if not self._paused.is_set():
+                self._paused.wait()
+                deadline = time.perf_counter() + self.max_wait
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self.queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _loop(self) -> None:
+        while self._running:
+            self._paused.wait()
+            batch = self._collect()
+            if not batch:
+                continue
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list) -> None:
+        # group by bucket; at most one ticket per slot per tick. Cancelled
+        # tickets (wait-timeout) and tickets whose session closed while
+        # queued are dropped HERE, not dispatched — their slot may already
+        # belong to someone else (see Ticket.wait)
+        per_bucket: dict = {}
+        requeue: list = []
+        for t in batch:
+            if t.cancelled or not self.store.alive(t.session.sid):
+                t.error = RuntimeError("request cancelled (timeout or "
+                                       "session closed while queued)")
+                t.done.set()
+                continue
+            slots = per_bucket.setdefault(t.session.bucket, {})
+            if t.session.slot in slots:
+                requeue.append(t)  # same-slot collision -> next tick
+            else:
+                slots[t.session.slot] = t
+        depth = self.queue.qsize() + len(requeue)
+        for bucket, slots in per_bucket.items():
+            reqs = {
+                slot: {"do_update": t.do_update, "idx": t.idx,
+                       "label": t.label, "prob": t.prob}
+                for slot, t in slots.items()
+            }
+            t0 = time.perf_counter()
+            try:
+                # the bucket lock serializes the slab swap against THIS
+                # bucket's admission writes only — other buckets' dispatches
+                # and admissions proceed (see SessionStore docstring)
+                with bucket.lock:
+                    results = bucket.dispatch(reqs)
+            except BaseException as e:  # surface to every waiter, keep going
+                for t in slots.values():
+                    t.error = e
+                    t.done.set()
+                continue
+            dt = time.perf_counter() - t0
+            now = time.perf_counter()
+            for slot, t in slots.items():
+                t.result = results[slot]
+                t.session.last = results[slot]
+                if t.do_update:
+                    t.session.n_labeled += 1
+                if self.metrics is not None:
+                    self.metrics.record_request_latency(now - t.submitted)
+                t.done.set()
+            if self.metrics is not None:
+                self.metrics.record_dispatch(len(slots), depth, dt)
+        for t in requeue:
+            self.queue.put(t)

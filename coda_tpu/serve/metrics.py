@@ -1,0 +1,129 @@
+"""Serving metrics: per-dispatch counters and latency percentiles.
+
+The serving layer's one hot loop is the batcher tick (drain queue -> build
+masked inputs -> one compiled slab step), so the metrics that matter are
+per-dispatch: how many requests rode each program launch (batch occupancy —
+the whole point of the subsystem), how deep the queue ran, and how long a
+request waited end-to-end. Everything is recorded into fixed-size rings on
+the host — O(1) per event, no allocation in the request path — and reduced
+to percentiles only when a snapshot is asked for (the ``/stats`` endpoint,
+or an end-of-run flush into the MLflow-schema tracking store).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+# ring capacity: big enough that p99 over the recent window is stable, small
+# enough that a snapshot reduction is microseconds
+_RING = 4096
+
+
+def _percentiles(ring) -> dict:
+    """{p50, p99, mean, max} of a ring of seconds, as milliseconds."""
+    if not ring:
+        return {"p50_ms": None, "p99_ms": None, "mean_ms": None,
+                "max_ms": None}
+    a = np.asarray(ring, np.float64) * 1e3
+    return {
+        "p50_ms": float(np.percentile(a, 50)),
+        "p99_ms": float(np.percentile(a, 99)),
+        "mean_ms": float(a.mean()),
+        "max_ms": float(a.max()),
+    }
+
+
+class ServeMetrics:
+    """Thread-safe counters + latency rings for the serving layer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started = time.time()
+        # monotonically increasing counters
+        self.dispatches = 0
+        self.requests = 0
+        self.sessions_opened = 0
+        self.sessions_closed = 0
+        self.sessions_rejected = 0   # admission-control refusals (slab full)
+        self.requests_rejected = 0   # draining / bad-session refusals
+        # gauges / rings
+        self.max_occupancy = 0       # most requests ever served by one dispatch
+        self._occupancy = collections.deque(maxlen=_RING)   # reqs per dispatch
+        self._queue_depth = collections.deque(maxlen=_RING)  # at tick start
+        self._dispatch_s = collections.deque(maxlen=_RING)  # slab-step seconds
+        self._request_s = collections.deque(maxlen=_RING)   # submit->result
+
+    # -- recording (request path: O(1), no reductions) ---------------------
+    def record_dispatch(self, n_requests: int, queue_depth: int,
+                        seconds: float) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.requests += n_requests
+            self.max_occupancy = max(self.max_occupancy, n_requests)
+            self._occupancy.append(n_requests)
+            self._queue_depth.append(queue_depth)
+            self._dispatch_s.append(seconds)
+
+    def record_request_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._request_s.append(seconds)
+
+    def record_session(self, event: str) -> None:
+        with self._lock:
+            if event == "open":
+                self.sessions_opened += 1
+            elif event == "close":
+                self.sessions_closed += 1
+            elif event == "reject":
+                self.sessions_rejected += 1
+            elif event == "request_reject":
+                self.requests_rejected += 1
+            else:
+                raise ValueError(f"unknown session event {event!r}")
+
+    # -- reading -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """One JSON-able dict: counters + reduced percentiles (the /stats
+        payload and the loadgen report's server-side half)."""
+        with self._lock:
+            occ = list(self._occupancy)
+            depth = list(self._queue_depth)
+            snap = {
+                "uptime_s": time.time() - self.started,
+                "dispatches": self.dispatches,
+                "requests": self.requests,
+                "sessions_opened": self.sessions_opened,
+                "sessions_closed": self.sessions_closed,
+                "sessions_rejected": self.sessions_rejected,
+                "requests_rejected": self.requests_rejected,
+                "max_occupancy": self.max_occupancy,
+                "mean_occupancy": (float(np.mean(occ)) if occ else None),
+                "mean_queue_depth": (float(np.mean(depth)) if depth
+                                     else None),
+                "dispatch_latency": _percentiles(self._dispatch_s),
+                "request_latency": _percentiles(self._request_s),
+            }
+        return snap
+
+    def log_to_store(self, store, experiment: str = "serve",
+                     run_name: str | None = None, params: dict | None = None):
+        """Flush a snapshot into the tracking store (one run, flat metrics).
+
+        Uses the same experiment -> run layout the benchmark CLI writes, so
+        serving runs sit next to experiment runs in one sqlite DB and the
+        analysis SQL can join them. Returns the run_uuid."""
+        snap = self.snapshot()
+        name = run_name or f"{experiment}-metrics"
+        with store.run(experiment, name, params=params or {}) as run:
+            for key, val in snap.items():
+                if isinstance(val, dict):
+                    for sub, v in val.items():
+                        if v is not None:
+                            run.log_metric(f"{key}.{sub}", float(v))
+                elif val is not None:
+                    run.log_metric(key, float(val))
+        return run.run_uuid

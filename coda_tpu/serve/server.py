@@ -1,0 +1,360 @@
+"""Threaded HTTP/JSON API over the batched session store.
+
+The serving front door, in the dependency-free ``http.server`` style of
+``demo/app.py`` (gradio/flask are not in TPU images). Worker threads do
+pure host work — parse JSON, admission-control, enqueue a ticket, block on
+the rendezvous — while ALL accelerator work funnels through the single
+batcher thread, so N concurrent users cost one compiled slab step per tick,
+not N device round trips.
+
+    POST   /session                  {task?, seed?}    -> admit + first item
+    POST   /session/{id}/label       {label, idx?}     -> update, next item
+    GET    /session/{id}/best                          -> best (+ pbest)
+    DELETE /session/{id}                               -> close, free slot
+    GET    /stats                                      -> metrics snapshot
+    GET    /healthz                                    -> liveness/draining
+
+Admission control: a full slab answers 503 (the client's retry signal), as
+does a draining server. ``ServeApp.drain()`` stops admitting, finishes the
+queued work, and flushes metrics — the graceful-shutdown half of the
+contract.
+
+Run:  python -m coda_tpu.cli serve [--task T | --synthetic H,N,C] [--port P]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from coda_tpu.serve.batcher import Batcher
+from coda_tpu.serve.metrics import ServeMetrics
+from coda_tpu.serve.state import (
+    SelectorSpec,
+    SessionStore,
+    SlabFull,
+    UnknownSession,
+)
+
+# how long an HTTP worker waits on its ticket before giving up (a stuck
+# accelerator should surface as 504s, not piled-up threads)
+REQUEST_TIMEOUT_S = 60.0
+
+
+class ServeApp:
+    """Store + batcher + metrics + admission policy, bundled for the
+    handler (and for in-process embedding — tests and the load generator
+    drive a ServeApp directly)."""
+
+    def __init__(self, capacity: int = 64, bucket_n: int = 1,
+                 max_batch: int = 256, max_wait: float = 0.002,
+                 default_task: Optional[str] = None,
+                 spec: Optional[SelectorSpec] = None):
+        self.store = SessionStore(capacity=capacity, bucket_n=bucket_n)
+        self.metrics = ServeMetrics()
+        self.batcher = Batcher(self.store, self.metrics,
+                               max_batch=max_batch, max_wait=max_wait)
+        self.spec = spec or SelectorSpec.create("coda", n_parallel=capacity)
+        self.default_task = default_task
+        self.draining = False
+        self._seed_lock = threading.Lock()
+        self._next_seed = 0
+
+    def add_task(self, name: str, preds, class_names=None, model_names=None,
+                 default: bool = False) -> None:
+        self.store.register_task(name, preds, class_names=class_names,
+                                 model_names=model_names)
+        if default or self.default_task is None:
+            self.default_task = name
+
+    def start(self) -> "ServeApp":
+        self.batcher.start()
+        return self
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown: refuse new sessions, finish queued requests."""
+        self.draining = True
+        self.batcher.stop(drain=True, timeout=timeout)
+
+    def _auto_seed(self) -> int:
+        with self._seed_lock:
+            s = self._next_seed
+            self._next_seed += 1
+            return s
+
+    # -- the session verbs (shared by HTTP handler and in-process callers) -
+    def open_session(self, task: Optional[str] = None,
+                     seed: Optional[int] = None) -> dict:
+        if self.draining:
+            self.metrics.record_session("reject")
+            raise Draining()
+        task = task or self.default_task
+        if task is None:
+            raise KeyError("no task registered")
+        try:
+            sess = self.store.open(task, self.spec,
+                                   seed=self._auto_seed() if seed is None
+                                   else int(seed))
+        except SlabFull:
+            self.metrics.record_session("reject")
+            raise
+        self.metrics.record_session("open")
+        # first item + prior best come from the session's first dispatch;
+        # if it fails (stuck accelerator -> timeout, dispatch error) the
+        # client never learns the session id, so free the slot here or it
+        # leaks until restart
+        try:
+            res = self.batcher.submit_start(sess).wait(REQUEST_TIMEOUT_S)
+        except BaseException:
+            self.store.close(sess.sid)
+            self.metrics.record_session("close")
+            raise
+        return self._payload(sess, res)
+
+    def label(self, sid: str, label: int, idx: Optional[int] = None) -> dict:
+        sess = self.store.get(sid)
+        cur = sess.last
+        if not cur:
+            raise UnknownSession(sid)  # start dispatch never completed
+        if idx is not None and int(idx) != cur["next_idx"]:
+            raise StaleItem(
+                f"session {sid} proposed item {cur['next_idx']}, "
+                f"got a label for {idx}")
+        label = int(label)
+        if not 0 <= label < sess.bucket.n_classes:
+            raise ValueError(f"label {label} out of range "
+                             f"[0, {sess.bucket.n_classes})")
+        res = self.batcher.submit_label(
+            sess, idx=cur["next_idx"], label=label,
+            prob=cur["next_prob"]).wait(REQUEST_TIMEOUT_S)
+        return self._payload(sess, res)
+
+    def best(self, sid: str) -> dict:
+        sess = self.store.get(sid)
+        out = self._payload(sess, sess.last or None)
+        with sess.bucket.lock:
+            pbest = sess.bucket.pbest(sess.slot)
+        if pbest is not None:
+            out["pbest"] = pbest.tolist()
+        return out
+
+    def close_session(self, sid: str) -> dict:
+        self.store.close(sid)
+        self.metrics.record_session("close")
+        return {"closed": sid}
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["live_sessions"] = self.store.live_sessions()
+        snap["draining"] = self.draining
+        snap["buckets"] = [
+            {"task": b.task, "method": b.spec.method,
+             "shape": list(b.shape), "capacity": b.capacity, "live": b.live}
+            for b in self.store.buckets()
+        ]
+        return snap
+
+    def _payload(self, sess, res: Optional[dict]) -> dict:
+        out = {
+            "session": sess.sid,
+            "task": sess.task,
+            "n_labeled": sess.n_labeled,
+        }
+        if res:
+            out.update({
+                "idx": res["next_idx"],
+                "prob": res["next_prob"],
+                "best": res["best"],
+                "stochastic": res["stochastic"],
+            })
+        return out
+
+
+class Draining(RuntimeError):
+    """New sessions refused: the server is shutting down."""
+
+
+class StaleItem(ValueError):
+    """The labeled idx is not the item the session proposed."""
+
+
+_SESSION_RE = re.compile(r"^/session/([0-9a-f]+)(/(label|best))?$")
+
+
+class Handler(BaseHTTPRequestHandler):
+    app: ServeApp = None  # set by make_server
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, obj, code: int = 200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def _route(self, method: str):
+        app = self.app
+        path = self.path.split("?")[0]
+        m = _SESSION_RE.match(path)
+        if method == "POST" and path == "/session":
+            req = self._body()
+            return app.open_session(task=req.get("task"),
+                                    seed=req.get("seed"))
+        if m and method == "POST" and m.group(3) == "label":
+            req = self._body()
+            if "label" not in req:
+                raise ValueError("missing 'label'")
+            return app.label(m.group(1), req["label"], idx=req.get("idx"))
+        if m and method == "GET" and m.group(3) == "best":
+            return app.best(m.group(1))
+        if m and method == "DELETE" and m.group(3) is None:
+            return app.close_session(m.group(1))
+        if method == "GET" and path == "/stats":
+            return app.stats()
+        if method == "GET" and path == "/healthz":
+            return {"ok": not app.draining, "draining": app.draining}
+        return None
+
+    def _handle(self, method: str):
+        try:
+            out = self._route(method)
+        except Draining:
+            self._json({"error": "draining: not admitting new sessions"},
+                       503)
+        except SlabFull as e:
+            self._json({"error": f"busy: {e}"}, 503)
+        except UnknownSession as e:
+            self.app.metrics.record_session("request_reject")
+            self._json({"error": f"unknown session {e}"}, 404)
+        except StaleItem as e:
+            self.app.metrics.record_session("request_reject")
+            self._json({"error": str(e)}, 409)
+        except TimeoutError as e:
+            self._json({"error": str(e)}, 504)
+        except (ValueError, TypeError, KeyError) as e:
+            self._json({"error": f"bad request: {e}"}, 400)
+        except Exception as e:  # cancelled tickets, dispatch failures: the
+            # client must get a JSON error, never a dropped connection
+            self._json({"error": f"internal: {e}"}, 500)
+        else:
+            if out is None:
+                self._json({"error": "not found"}, 404)
+            else:
+                self._json(out)
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
+
+
+def make_server(app: ServeApp, port: int = 0,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Bind the HTTP server; ``port=0`` picks a free port (for tests)."""
+    handler = type("BoundHandler", (Handler,), {"app": app})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="batched multi-session serving of interactive active "
+                    "model selection")
+    p.add_argument("--task", default=None)
+    p.add_argument("--data-dir", default="data")
+    p.add_argument("--synthetic", default=None, metavar="H,N,C",
+                   help="serve a seeded synthetic task of this shape")
+    p.add_argument("--method", default="coda",
+                   help="selector behind every session "
+                        "{coda, iid, uncertainty, model_picker, ...}")
+    p.add_argument("--capacity", type=int, default=64,
+                   help="slab slots per bucket = max concurrent sessions "
+                        "per (task, config); admission past it answers 503")
+    p.add_argument("--bucket-n", type=int, default=1,
+                   help="pad task N up to this quantum so near-shaped tasks "
+                        "share one compiled program (1 = exact shapes)")
+    p.add_argument("--max-batch", type=int, default=256,
+                   help="max requests coalesced into one dispatch")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="linger after the first queued request before "
+                        "dispatching (the latency/occupancy dial)")
+    p.add_argument("--port", type=int, default=7861)
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (cpu/tpu) — same as main.py")
+    p.add_argument("--tracking-db", default=None,
+                   help="flush serving metrics into this MLflow-schema "
+                        "sqlite DB on shutdown")
+    return p.parse_args(argv)
+
+
+def build_app(args) -> ServeApp:
+    """ServeApp from parsed args (shared with the load generator)."""
+    from coda_tpu.cli import load_dataset
+
+    spec_kwargs = {}
+    if args.method.startswith("coda"):
+        # every slot carries its own incremental cache; the auto eig_mode
+        # budget must see the whole slab (cli.py sets the same hint from
+        # the seed-vmap width)
+        spec_kwargs["n_parallel"] = args.capacity
+    app = ServeApp(
+        capacity=args.capacity, bucket_n=args.bucket_n,
+        max_batch=args.max_batch, max_wait=args.max_wait_ms / 1e3,
+        spec=SelectorSpec.create(args.method, **spec_kwargs),
+    )
+    if args.task or args.synthetic:
+        ds = load_dataset(args)
+        app.add_task(ds.name, ds.preds, class_names=ds.class_names)
+    else:
+        from coda_tpu.data import make_synthetic_task
+
+        task = make_synthetic_task(seed=0, H=8, N=512, C=10)
+        app.add_task(task.name, task.preds)
+    return app
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
+
+    app = build_app(args).start()
+    srv = make_server(app, args.port)
+    print(f"serving {app.default_task!r} ({app.spec.method}) on "
+          f"http://127.0.0.1:{srv.server_address[1]}/ — capacity "
+          f"{app.store.capacity} sessions/bucket")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        print("\ndraining...")
+    finally:
+        app.drain()
+        srv.server_close()
+        if args.tracking_db:
+            from coda_tpu.tracking import TrackingStore
+
+            store = TrackingStore(args.tracking_db)
+            app.metrics.log_to_store(store, params={
+                "method": app.spec.method,
+                "capacity": app.store.capacity})
+            store.close()
+            print(f"metrics logged to {args.tracking_db}")
+
+
+if __name__ == "__main__":
+    main()

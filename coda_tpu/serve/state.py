@@ -1,0 +1,489 @@
+"""Session slabs: many interactive selection sessions as one vmapped carry.
+
+``demo/app.py`` drives exactly one ``InteractiveSelector`` per user, paying a
+host↔device round trip per click. But the paper's loop (score → pick →
+oracle label → posterior update → best) is embarrassingly batchable across
+independent sessions — the same insight that makes seeds a ``vmap`` axis in
+``engine/loop.py``. This module holds the device-side half of the serving
+layer:
+
+  * a **bucket** is a fixed-capacity slab of selector carries for one
+    (task, selector-config) pair: the state pytree with a leading SLOT axis,
+    a per-slot PRNG key array, and a host-side free list. One jit-compiled
+    **masked step** (update-if-requested + select + best, ``vmap`` over
+    slots) serves every session in the bucket per dispatch;
+  * the **SessionStore** multiplexes sessions onto buckets: admission takes
+    a free slot (or refuses — the backpressure signal the server turns into
+    HTTP 503), close returns the slot for reuse.
+
+Key-stream parity: a session's randomness is bit-identical to driving one
+``InteractiveSelector(selector, seed)`` by hand — init consumes one
+``jax.random.split``, each processed request consumes two (select, best) —
+so the batched path is testable against the sequential reference path
+(``tests/test_serve.py``).
+
+Shape buckets: ``bucket_n`` rounds a task's N up to a quantum, zero-padding
+the prediction tensor and marking the padded items as already-labeled via
+the selectors' shared ``unlabeled`` mask, so near-shaped tasks share one
+compiled program. The default quantum of 1 keeps shapes exact — padding
+perturbs nothing selectable, but changes XLA reduction extents, which
+forfeits the bitwise-parity guarantee; it is an opt-in compile-count lever.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+from dataclasses import dataclass, field
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+
+
+class SlabFull(RuntimeError):
+    """Admission refused: every slot of the bucket's slab is live."""
+
+
+class UnknownSession(KeyError):
+    """No live session with that id."""
+
+
+# ---------------------------------------------------------------------------
+# selector specs: a picklable/hashable description of a selector config
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SelectorSpec:
+    """Method name + hyperparams as a hashable bucket-key component.
+
+    ``kwargs`` is a sorted tuple of (name, value) pairs so equal configs
+    compare equal (dicts don't hash); use :meth:`create` to build one.
+    """
+
+    method: str = "coda"
+    kwargs: tuple = ()
+
+    @classmethod
+    def create(cls, method: str = "coda", **kwargs) -> "SelectorSpec":
+        return cls(method=method, kwargs=tuple(sorted(kwargs.items())))
+
+    def factory(self):
+        """``preds -> Selector`` (the cli.build_selector_factory contract,
+        minus the argparse namespace)."""
+        from coda_tpu.losses import LOSS_FNS
+        from coda_tpu.selectors import (
+            CODAHyperparams,
+            SELECTOR_FACTORIES,
+            make_coda,
+            make_modelpicker,
+        )
+
+        kw = dict(self.kwargs)
+        if self.method.startswith("coda"):
+            hp = CODAHyperparams(**kw)
+            return lambda preds: make_coda(preds, hp, name=self.method)
+        if self.method == "model_picker":
+            return lambda preds: make_modelpicker(preds, **kw)
+        if self.method not in SELECTOR_FACTORIES:
+            raise ValueError(f"unknown serve method {self.method!r}")
+        if "loss" in kw:  # risk-readout methods take a loss_fn callable
+            kw["loss_fn"] = LOSS_FNS[kw.pop("loss")]
+        return lambda preds: SELECTOR_FACTORIES[self.method](preds, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the masked batch step
+# ---------------------------------------------------------------------------
+
+class SlotRequest(NamedTuple):
+    """Per-slot inputs of one dispatch (leading axis = slot)."""
+
+    pending: Any    # (S,) bool — does this slot have a request this tick?
+    do_update: Any  # (S,) bool — apply the oracle label before selecting?
+    idx: Any        # (S,) int32 — labeled item (only read when do_update)
+    label: Any      # (S,) int32 — its oracle class
+    prob: Any       # (S,) float32 — the selection prob the label was drawn at
+
+
+class SlotResult(NamedTuple):
+    """Per-slot outputs of one dispatch (leading axis = slot)."""
+
+    next_idx: Any    # (S,) int32 — next most-informative item
+    next_prob: Any   # (S,) float32 — its selection probability / q-value
+    best: Any        # (S,) int32 — current best-model estimate
+    stochastic: Any  # (S,) bool — did RNG affect this slot's step?
+
+
+def _tree_where(flag, new, old):
+    """Per-slot masked carry: ``new`` where ``flag`` (a scalar bool inside
+    the slot vmap), else ``old``. None leaves (CODA's optional caches) must
+    be None in both."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda a, b: jnp.where(flag, a, b), new, old)
+
+
+def make_slab_step(selector, impl: Optional[str] = None):
+    """The bucket's one compiled program: masked update+select+best over
+    the slot axis.
+
+    Per slot:  ``(state, key, request) -> (state', key', SlotResult)``.
+    Slots without a pending request run the same computation (the price of a
+    single program) but carry their state AND key through unchanged, so an
+    idle session's stream of randomness is untouched — that is what makes a
+    slab session replayable against the sequential reference path. Key
+    consumption per processed request matches ``InteractiveSelector``'s
+    drive pattern exactly: one split for ``select``, one for ``best``.
+
+    Two lowerings of the same step (the ``modelpicker._bucket_sums``
+    pattern), both a SINGLE jitted program per dispatch:
+
+      * ``vmap`` — slots as a batch axis; the parallel-hardware lowering.
+        Batched contractions may reassociate float accumulation, so scores
+        can drift ~1e-7 from the sequential reference (selected indices and
+        best-model answers measured identical; pinned against ``map`` by
+        ``test_serve_vmap_matches_map``).
+      * ``map`` — ``lax.map`` over slots: each slot runs the UNBATCHED
+        per-session graph, which keeps results bitwise-identical to the
+        sequential ``InteractiveSelector`` path (the parity test), at the
+        cost of serializing slots within the dispatch.
+
+    ``impl=None`` resolves by backend at build time: ``map`` on CPU (where
+    serialized slots cost nothing and serving hosts want reference
+    numerics), ``vmap`` on TPU/GPU (where the slot axis feeds the parallel
+    units).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if impl is None:
+        impl = "map" if jax.default_backend() == "cpu" else "vmap"
+    if impl not in ("vmap", "map"):
+        raise ValueError(f"unknown slab-step impl {impl!r} "
+                         "(use 'vmap' or 'map')")
+
+    def one(state0, key0, req):
+        # masked oracle update: compute unconditionally (every slot runs one
+        # program), keep only where requested
+        updated = selector.update(
+            state0, req.idx, req.label, req.prob)
+        state1 = _tree_where(req.do_update, updated, state0)
+        # the reference key choreography (protocol.InteractiveSelector):
+        # _next_key() for select, _next_key() for best
+        key1, k_sel = jax.random.split(key0)
+        key2, k_best = jax.random.split(key1)
+        res = selector.select(state1, k_sel)
+        best, b_stoch = selector.best(state1, k_best)
+        state_out = _tree_where(req.pending, state1, state0)
+        key_out = jnp.where(req.pending, key2, key0)
+        return state_out, key_out, SlotResult(
+            next_idx=res.idx.astype(jnp.int32),
+            next_prob=res.prob.astype(jnp.float32),
+            best=best.astype(jnp.int32),
+            stochastic=res.stochastic | b_stoch,
+        )
+
+    if impl == "map":
+        return lambda states, keys, reqs: lax.map(
+            lambda t: one(*t), (states, keys, reqs))
+    return jax.vmap(one)
+
+
+def _deactivate_padded(state, n_valid: int):
+    """Mark a padded task's phantom items as already labeled.
+
+    Every selector state in this framework exposes the ``(N,) bool``
+    ``unlabeled`` mask (protocol convention), which is the single point all
+    ``select`` candidate sets pass through — clearing the padded tail makes
+    the padding unselectable without touching any method's math."""
+    import jax.numpy as jnp
+
+    if not hasattr(state, "unlabeled"):
+        raise ValueError(
+            f"selector state {type(state).__name__} has no 'unlabeled' "
+            "mask; shape-padded buckets (bucket_n > 1) need it to disable "
+            "the padded items — use bucket_n=1 for this method"
+        )
+    N = state.unlabeled.shape[0]
+    return state._replace(
+        unlabeled=state.unlabeled & (jnp.arange(N) < n_valid))
+
+
+# ---------------------------------------------------------------------------
+# bucket: one slab + its compiled step
+# ---------------------------------------------------------------------------
+
+class Bucket:
+    """Fixed-capacity slab of selector carries for one (task, spec) pair.
+
+    The selector is built ONCE from the bucket's concrete (padded)
+    prediction tensor, so its statics (hard argmax preds, consensus
+    pseudo-labels, Dirichlet priors) are computed at bucket creation — not
+    re-derived inside every dispatch — and the jitted step's numerics are
+    those of the reference ``InteractiveSelector`` path, which also jits
+    closures over a concrete tensor. The tensor is therefore baked into the
+    executable as a constant (fine at interactive-task scale; the
+    preds-as-argument pattern of ``engine/loop.py`` is the move if a served
+    task ever approaches HBM capacity).
+    """
+
+    def __init__(self, preds, spec: SelectorSpec, capacity: int,
+                 n_valid: Optional[int] = None, task: str = "",
+                 step_impl: Optional[str] = None):
+        import jax
+        import jax.numpy as jnp
+
+        self.task = task
+        self.spec = spec
+        self.capacity = int(capacity)
+        # serializes this bucket's slab swaps: allocate/release and the
+        # batcher's dispatch functionally replace the slab arrays, but only
+        # against each other — other buckets never contend on it
+        self.lock = threading.RLock()
+        self.preds = jnp.asarray(preds)
+        H, N, C = self.preds.shape
+        self.shape = (H, N, C)
+        self.n_valid = N if n_valid is None else int(n_valid)
+        self.n_classes = C
+        self.selector = spec.factory()(self.preds)
+        self._init = jax.jit(self.selector.init)
+        self._step = jax.jit(make_slab_step(self.selector, impl=step_impl))
+        get_pbest = self.selector.extras.get("get_pbest")
+        self._get_pbest = None if get_pbest is None else jax.jit(get_pbest)
+        # the slab: state pytree with a leading (capacity,) slot axis. All
+        # slots start from init(key=0) — real sessions overwrite their slot
+        # at admission, so the filler only fixes shapes/dtypes.
+        dummy = jnp.zeros((self.capacity, 2), jnp.uint32)
+        self.states = jax.jit(jax.vmap(self.selector.init))(dummy)
+        self.keys = jnp.zeros((self.capacity, 2), jnp.uint32)
+        # LIFO free list: a just-closed slot is the next one reused, which
+        # keeps the slab's live region dense and is trivially testable
+        self._free = list(range(self.capacity - 1, -1, -1))
+
+    # -- slot lifecycle (caller holds this bucket's lock) ------------------
+    def allocate(self, seed: int) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        if not self._free:
+            raise SlabFull(
+                f"bucket {self.task}/{self.spec.method}: all "
+                f"{self.capacity} slots live")
+        slot = self._free.pop()
+        # reference key stream: PRNGKey(seed); init() consumes one split
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        state = self._init(sub)
+        if self.n_valid < self.shape[1]:
+            state = _deactivate_padded(state, self.n_valid)
+        self.states = jax.tree.map(
+            lambda slab, x: slab.at[slot].set(x), self.states, state)
+        self.keys = self.keys.at[slot].set(key.astype(jnp.uint32))
+        return slot
+
+    def release(self, slot: int) -> None:
+        self._free.append(slot)
+
+    @property
+    def live(self) -> int:
+        return self.capacity - len(self._free)
+
+    # -- the dispatch (batcher thread, holding this bucket's lock) ---------
+    def dispatch(self, requests: dict) -> dict:
+        """Run ONE compiled masked step over the whole slab.
+
+        ``requests``: slot -> dict(do_update, idx, label, prob). Every slot
+        executes; only requesting slots advance state/keys and get a result
+        row back. Returns slot -> result dict (host scalars)."""
+        import jax
+        import jax.numpy as jnp
+
+        S = self.capacity
+        pending = np.zeros(S, bool)
+        do_update = np.zeros(S, bool)
+        idx = np.zeros(S, np.int32)
+        label = np.zeros(S, np.int32)
+        prob = np.zeros(S, np.float32)
+        for slot, r in requests.items():
+            pending[slot] = True
+            do_update[slot] = bool(r.get("do_update", False))
+            idx[slot] = r.get("idx", 0)
+            label[slot] = r.get("label", 0)
+            prob[slot] = r.get("prob", 0.0)
+        req = SlotRequest(
+            pending=jnp.asarray(pending), do_update=jnp.asarray(do_update),
+            idx=jnp.asarray(idx), label=jnp.asarray(label),
+            prob=jnp.asarray(prob))
+        self.states, self.keys, out = self._step(self.states, self.keys, req)
+        out = jax.tree.map(np.asarray, out)  # one host sync for the batch
+        return {
+            slot: {
+                "next_idx": int(out.next_idx[slot]),
+                "next_prob": float(out.next_prob[slot]),
+                "best": int(out.best[slot]),
+                "stochastic": bool(out.stochastic[slot]),
+            }
+            for slot in requests
+        }
+
+    # -- cheap per-session reads ------------------------------------------
+    def slot_state(self, slot: int):
+        import jax
+
+        return jax.tree.map(lambda x: x[slot], self.states)
+
+    def pbest(self, slot: int):
+        """P(model is best) for one slot, when the method exposes it (CODA's
+        ``get_pbest`` extra) — the cheap posterior read behind GET /best."""
+        if self._get_pbest is None:
+            return None
+        return np.asarray(self._get_pbest(self.slot_state(slot)))
+
+
+# ---------------------------------------------------------------------------
+# session store
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Session:
+    """Host-side record of one live interactive session."""
+
+    sid: str
+    task: str
+    bucket: Bucket
+    slot: int
+    seed: int
+    n_labeled: int = 0
+    last: dict = field(default_factory=dict)  # most recent SlotResult row
+
+
+def _round_up(n: int, quantum: int) -> int:
+    return ((n + quantum - 1) // quantum) * quantum
+
+
+class SessionStore:
+    """Multiplexes sessions onto per-(task, spec, shape) slabs.
+
+    ``capacity`` bounds EACH bucket's slab (admission past it raises
+    :class:`SlabFull` — the server's 503). ``bucket_n`` is the N-padding
+    quantum (see module docstring; 1 = exact shapes).
+    Thread safety, three tiers so one bucket's work never stalls another's:
+    the store lock guards only the host dicts (tasks/buckets/sessions —
+    microseconds); each BUCKET's lock serializes that bucket's slab swaps
+    (admission writes vs. the batcher's dispatch; admission to a busy
+    bucket waits out at most one in-flight dispatch — fine, since session
+    creation itself needs a dispatch to learn its first item); and bucket
+    CONSTRUCTION (selector statics + init compile, potentially seconds)
+    runs under a dedicated build lock with no other lock held, so standing
+    traffic keeps flowing while a new (task, spec) warms up.
+    """
+
+    def __init__(self, capacity: int = 64, bucket_n: int = 1,
+                 step_impl: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if bucket_n < 1:
+            raise ValueError("bucket_n must be >= 1")
+        self.capacity = capacity
+        self.bucket_n = bucket_n
+        self.step_impl = step_impl
+        self._tasks: dict[str, Any] = {}     # name -> (H, N, C) ndarray
+        self._meta: dict[str, dict] = {}     # name -> class/model names
+        self._buckets: dict[tuple, Bucket] = {}
+        self._sessions: dict[str, Session] = {}
+        self.lock = threading.RLock()
+        self._build_lock = threading.Lock()
+
+    # -- tasks -------------------------------------------------------------
+    def register_task(self, name: str, preds, class_names=None,
+                      model_names=None) -> None:
+        preds = np.asarray(preds, np.float32)
+        if preds.ndim != 3:
+            raise ValueError(f"preds must be (H, N, C), got {preds.shape}")
+        with self.lock:
+            self._tasks[name] = preds
+            H, N, C = preds.shape
+            self._meta[name] = {
+                "class_names": list(class_names
+                                    or [f"class {c}" for c in range(C)]),
+                "model_names": list(model_names
+                                    or [f"model {h}" for h in range(H)]),
+            }
+
+    def tasks(self) -> list[str]:
+        with self.lock:
+            return sorted(self._tasks)
+
+    def task_meta(self, name: str) -> dict:
+        with self.lock:
+            return dict(self._meta[name])
+
+    def _bucket_for(self, task: str, spec: SelectorSpec) -> Bucket:
+        with self.lock:
+            preds = self._tasks[task]
+        H, N, C = preds.shape
+        n_pad = _round_up(N, self.bucket_n)
+        key = (task, spec, (H, n_pad, C))
+        with self.lock:
+            b = self._buckets.get(key)
+        if b is not None:
+            return b
+        # the expensive part (selector statics, init compile) runs with no
+        # store/bucket lock held, so live traffic is untouched; the build
+        # lock just keeps two threads from compiling the same bucket twice
+        with self._build_lock:
+            with self.lock:
+                b = self._buckets.get(key)
+            if b is not None:
+                return b
+            if n_pad != N:
+                preds = np.pad(preds, ((0, 0), (0, n_pad - N), (0, 0)))
+            b = Bucket(preds, spec, self.capacity, n_valid=N, task=task,
+                       step_impl=self.step_impl)
+            with self.lock:
+                self._buckets[key] = b
+            return b
+
+    # -- sessions ----------------------------------------------------------
+    def open(self, task: str, spec: SelectorSpec, seed: int = 0) -> Session:
+        with self.lock:
+            if task not in self._tasks:
+                raise KeyError(f"unknown task {task!r}; registered: "
+                               f"{self.tasks()}")
+        bucket = self._bucket_for(task, spec)
+        with bucket.lock:
+            slot = bucket.allocate(seed)  # raises SlabFull when exhausted
+        sess = Session(sid=secrets.token_hex(8), task=task,
+                       bucket=bucket, slot=slot, seed=seed)
+        with self.lock:
+            self._sessions[sess.sid] = sess
+        return sess
+
+    def get(self, sid: str) -> Session:
+        with self.lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                raise UnknownSession(sid)
+            return sess
+
+    def alive(self, sid: str) -> bool:
+        with self.lock:
+            return sid in self._sessions
+
+    def close(self, sid: str) -> None:
+        with self.lock:
+            sess = self._sessions.pop(sid, None)
+        if sess is None:
+            raise UnknownSession(sid)
+        with sess.bucket.lock:
+            sess.bucket.release(sess.slot)
+
+    def live_sessions(self) -> int:
+        with self.lock:
+            return len(self._sessions)
+
+    def buckets(self) -> list[Bucket]:
+        with self.lock:
+            return list(self._buckets.values())

@@ -23,6 +23,12 @@ Re-architected for this framework:
 Run:  python demo/app.py [--task TASK --data-dir data] [--port 7860]
 Without a task file it falls back to a seeded synthetic pool so the demo
 always works offline.
+
+This app serves ONE selector session per user, one device round trip per
+click. For many concurrent sessions multiplexed onto one accelerator —
+micro-batched so each tick is a single compiled step over every active
+session — use the serving layer: ``python -m coda_tpu.cli serve``
+(``coda_tpu/serve/``, ARCHITECTURE.md §5).
 """
 
 from __future__ import annotations

@@ -1,0 +1,304 @@
+"""Closed-loop load generator for the serving layer -> BENCH_SERVE_*.json.
+
+Spins up an in-process :class:`coda_tpu.serve.ServeApp` + HTTP server (or
+targets a running one via ``--url``), then drives W closed-loop workers:
+each opens a session, labels ``--labels`` proposed items (answering
+``idx % C`` — the serving cost is label-independent), and closes. Reports
+sessions/sec, requests/sec, client-side latency percentiles, and the
+server's own dispatch metrics (batch occupancy — the number the subsystem
+exists to maximize) into one JSON artifact.
+
+Two arrival models:
+
+  * default — workers free-run; occupancy emerges from the batcher's
+    ``max_wait`` coalescing window (the realistic number);
+  * ``--lockstep`` — workers rendezvous at a barrier each round while the
+    batcher is paused, so every round's W requests ride ONE dispatch. This
+    is the deterministic-occupancy mode the tier-1 smoke test pins ≥16
+    sessions/dispatch with (in-process only).
+
+    python scripts/serve_loadgen.py --workers 32 --sessions 64 \
+        --synthetic 8,512,10 --out BENCH_SERVE_cpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+# importable from any cwd (the aggregate_results.py convention)
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# client: in-process (drives a ServeApp directly) or HTTP (urllib, stdlib)
+# ---------------------------------------------------------------------------
+
+class InprocClient:
+    def __init__(self, app):
+        self.app = app
+
+    def open(self, seed):
+        return self.app.open_session(seed=seed)
+
+    def label(self, sid, label):
+        return self.app.label(sid, label)
+
+    def close(self, sid):
+        return self.app.close_session(sid)
+
+    def stats(self):
+        return self.app.stats()
+
+
+class HttpClient:
+    def __init__(self, url):
+        self.url = url.rstrip("/")
+
+    def _req(self, method, path, body=None):
+        import urllib.request
+
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    def open(self, seed):
+        return self._req("POST", "/session", {"seed": seed})
+
+    def label(self, sid, label):
+        return self._req("POST", f"/session/{sid}/label", {"label": label})
+
+    def close(self, sid):
+        return self._req("DELETE", f"/session/{sid}")
+
+    def stats(self):
+        return self._req("GET", "/stats")
+
+
+# ---------------------------------------------------------------------------
+# the closed loop
+# ---------------------------------------------------------------------------
+
+def _free_run(client, n_classes, workers, sessions, labels_per_session,
+              latencies, errors):
+    """Default arrival model: W workers race through the session budget."""
+    counter = {"next": 0}
+    lock = threading.Lock()
+
+    def take():
+        with lock:
+            s = counter["next"]
+            if s >= sessions:
+                return None
+            counter["next"] = s + 1
+            return s
+
+    def worker():
+        while True:
+            seed = take()
+            if seed is None:
+                return
+            sid = None
+            try:
+                t0 = time.perf_counter()
+                out = client.open(seed)
+                sid = out["session"]
+                latencies.append(time.perf_counter() - t0)
+                for _ in range(labels_per_session):
+                    t0 = time.perf_counter()
+                    out = client.label(sid, int(out["idx"]) % n_classes)
+                    latencies.append(time.perf_counter() - t0)
+                client.close(sid)
+                sid = None
+            except Exception as e:  # keep the run alive; report at the end
+                errors.append(repr(e))
+                if sid is not None:
+                    # free the slot: capacity == workers, so one leaked
+                    # session would starve every later open into SlabFull
+                    try:
+                        client.close(sid)
+                    except Exception:
+                        pass
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _lockstep(app, client, n_classes, workers, labels_per_session,
+              latencies, errors):
+    """Deterministic occupancy: open W sessions, then label all W in
+    rounds, pausing the batcher while each round's requests queue up so
+    every round is exactly ONE dispatch per bucket. In-process only (needs
+    the batcher handle)."""
+    sids = []
+    for seed in range(workers):
+        sids.append(client.open(seed)["session"])
+    for _ in range(labels_per_session):
+        app.batcher.pause()
+        tickets = []
+        t0 = time.perf_counter()
+        for sid in sids:
+            sess = app.store.get(sid)
+            cur = sess.last
+            tickets.append(app.batcher.submit_label(
+                sess, idx=cur["next_idx"],
+                label=int(cur["next_idx"]) % n_classes,
+                prob=cur["next_prob"]))
+        app.batcher.resume()
+        for t in tickets:
+            try:
+                t.wait(60.0)
+                latencies.append(time.perf_counter() - t0)
+            except Exception as e:
+                errors.append(repr(e))
+    for sid in sids:
+        client.close(sid)
+
+
+def run_loadgen(args) -> dict:
+    """Run the configured load and return the report dict (the script's
+    JSON payload; the smoke test calls this directly)."""
+    from coda_tpu.serve.server import build_app, make_server
+
+    app = srv = None
+    if args.url:
+        client = HttpClient(args.url)
+        n_classes = args.classes
+    else:
+        app = build_app(args).start()
+        meta = app.store.task_meta(app.default_task)
+        n_classes = len(meta["class_names"])
+        if args.http:
+            srv = make_server(app, 0)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            client = HttpClient(
+                f"http://127.0.0.1:{srv.server_address[1]}")
+        else:
+            client = InprocClient(app)
+
+    latencies: list = []
+    errors: list = []
+    t_start = time.perf_counter()
+    if args.lockstep:
+        if app is None:
+            raise SystemExit("--lockstep needs an in-process app (no --url)")
+        n_sessions = args.workers
+        _lockstep(app, client, n_classes, args.workers, args.labels,
+                  latencies, errors)
+    else:
+        n_sessions = args.sessions
+        _free_run(client, n_classes, args.workers, args.sessions,
+                  args.labels, latencies, errors)
+    wall = time.perf_counter() - t_start
+
+    stats = client.stats()
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if app is not None:
+        app.drain()
+
+    lat_ms = np.asarray(latencies, np.float64) * 1e3
+    n_requests = len(latencies)
+    report = {
+        "bench": "serve_loadgen",
+        "mode": "lockstep" if args.lockstep else "free_run",
+        "transport": ("http" if (args.url or args.http) else "inproc"),
+        "workers": args.workers,
+        "sessions": n_sessions,
+        "labels_per_session": args.labels,
+        "wall_s": wall,
+        "sessions_per_s": n_sessions / wall,
+        "requests_per_s": n_requests / wall,
+        "latency_ms": {
+            "p50": float(np.percentile(lat_ms, 50)) if n_requests else None,
+            "p99": float(np.percentile(lat_ms, 99)) if n_requests else None,
+            "mean": float(lat_ms.mean()) if n_requests else None,
+        },
+        "errors": errors[:20],
+        "n_errors": len(errors),
+        "server": {
+            "dispatches": stats.get("dispatches"),
+            "requests": stats.get("requests"),
+            "max_occupancy": stats.get("max_occupancy"),
+            "mean_occupancy": stats.get("mean_occupancy"),
+            "mean_queue_depth": stats.get("mean_queue_depth"),
+            "dispatch_latency": stats.get("dispatch_latency"),
+            "request_latency": stats.get("request_latency"),
+        },
+        "config": {
+            "method": args.method,
+            "capacity": args.capacity,
+            "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+            "task": args.task or args.synthetic or "default",
+        },
+    }
+    return report
+
+
+def parse_args(argv=None):
+    from coda_tpu.serve.server import parse_args as server_args
+
+    # reuse the server's flags (task/method/capacity/batching) and add the
+    # load shape on top
+    base = server_args([])
+    p = argparse.ArgumentParser(description=__doc__)
+    for a, v in vars(base).items():
+        if a != "port":
+            p.add_argument("--" + a.replace("_", "-"),
+                           default=v, type=(type(v) if v is not None
+                                            else str))
+    p.add_argument("--workers", type=int, default=32)
+    p.add_argument("--sessions", type=int, default=64,
+                   help="total sessions to run (free-run mode)")
+    p.add_argument("--labels", type=int, default=8,
+                   help="labels per session")
+    p.add_argument("--lockstep", action="store_true",
+                   help="barrier arrivals: every round of W labels rides "
+                        "one dispatch (deterministic occupancy)")
+    p.add_argument("--http", action="store_true",
+                   help="drive the in-process app over real HTTP instead "
+                        "of direct calls")
+    p.add_argument("--url", default=None,
+                   help="target a RUNNING server instead of in-process")
+    p.add_argument("--classes", type=int, default=10,
+                   help="label range when targeting --url (the remote "
+                        "task's C)")
+    p.add_argument("--out", default=None,
+                   help="write the JSON report here "
+                        "(default BENCH_SERVE_<mode>.json)")
+    args = p.parse_args(argv)
+    if args.capacity < args.workers and not args.url:
+        # closed-loop workers each hold one live session; a smaller slab
+        # would make backpressure part of the measurement
+        args.capacity = args.workers
+    return args
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
+    report = run_loadgen(args)
+    out = args.out or f"BENCH_SERVE_{report['mode']}.json"
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

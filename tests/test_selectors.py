@@ -760,7 +760,9 @@ def test_streamed_pi_contraction_matches_einsum(monkeypatch):
     DEFAULT matmul precision (no HIGH/HIGHEST contraction of a ~10 GiB
     operand compiles on the TPU stack); the einsum FORM is unchanged, so
     on the CPU test backend (fp32 either way) results match the HIGHEST
-    path exactly — this pins that the demotion changes nothing else."""
+    path exactly — this pins that the demotion changes nothing else.
+    The demotion is gated on the backend that forces it, so exercising it
+    here widens the gate to the CPU test backend."""
     import coda_tpu.ops.confusion as confusion
     import coda_tpu.selectors.coda as coda_mod
     from coda_tpu.selectors.coda import (
@@ -781,7 +783,10 @@ def test_streamed_pi_contraction_matches_einsum(monkeypatch):
                                    ref_unnorm)
 
     monkeypatch.setattr(confusion, "PREDS_ONESHOT_MAX_BYTES", 1)
-    out_unnorm = pi_unnorm(dirichlets, preds)
+    monkeypatch.setattr(confusion, "_DEMOTE_BACKENDS", ("cpu", "tpu"))
+    monkeypatch.setattr(confusion, "_warned_demotion", False)
+    with pytest.warns(UserWarning, match="one-shot"):
+        out_unnorm = pi_unnorm(dirichlets, preds)
     out_conf = create_confusion_matrices(ens, preds, mode="soft")
     out_col = update_pi_hat_column(dirichlets, jnp.int32(1), preds,
                                    ref_unnorm)
@@ -792,3 +797,32 @@ def test_streamed_pi_contraction_matches_einsum(monkeypatch):
     for a, b in zip(ref_col, out_col):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5)
+
+
+def test_oneshot_demotion_gated_on_backend(monkeypatch):
+    """The automatic precision demotion is scoped to the TPU backend that
+    cannot compile the HIGHEST contraction (ADVICE round 5): on the CPU
+    test backend an over-budget operand keeps HIGHEST, and the one-time
+    warning fires only when the demotion actually engages."""
+    import warnings as _warnings
+
+    import coda_tpu.ops.confusion as confusion
+
+    monkeypatch.setattr(confusion, "PREDS_ONESHOT_MAX_BYTES", 1)
+    monkeypatch.setattr(confusion, "_warned_demotion", False)
+    # default gate: cpu backend never demotes, never warns
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        assert (confusion.oneshot_precision(10 << 30)
+                == jax.lax.Precision.HIGHEST)
+    # widened gate: demotes past the budget, warns exactly once
+    monkeypatch.setattr(confusion, "_DEMOTE_BACKENDS", ("cpu", "tpu"))
+    with pytest.warns(UserWarning, match="compile bound"):
+        assert (confusion.oneshot_precision(10 << 30)
+                == jax.lax.Precision.DEFAULT)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        assert (confusion.oneshot_precision(10 << 30)
+                == jax.lax.Precision.DEFAULT)   # warned already
+        assert (confusion.oneshot_precision(1) ==
+                jax.lax.Precision.HIGHEST)      # in-budget stays HIGHEST
