@@ -109,6 +109,16 @@ def parse_args(argv=None):
                         "fused = inside the pallas scoring kernel (fp32 "
                         "MXU dots overlap the cache read — opt-in "
                         "numerics, pallas backend only)")
+    p.add_argument("--eig-entropy", default="exact",
+                   choices=["exact", "approx"],
+                   help="log lowering of the expected-entropy scoring "
+                        "chain: exact = transcendental log2 (reference "
+                        "numerics, the parity-tested default); approx = "
+                        "bit-extracted exponent + degree-6 mantissa "
+                        "polynomial on the clamped [1e-12, 1] domain "
+                        "(max |Dscore| <= 1e-4 — cuts the N*C*H "
+                        "transcendental tail that caps the bf16 "
+                        "headline; opt-in numerics like --eig-precision)")
     p.add_argument("--pi-update", default="auto",
                    choices=["auto", "delta", "exact"],
                    help="incremental pi-hat refresh: auto (default) = exact "
@@ -195,6 +205,7 @@ def build_selector_factory(args, task_name: str):
             eig_precision=getattr(args, "eig_precision", "highest"),
             eig_cache_dtype=getattr(args, "eig_cache_dtype", "float32"),
             eig_refresh=getattr(args, "eig_refresh", "precomputed"),
+            eig_entropy=getattr(args, "eig_entropy", "exact"),
             pi_update=getattr(args, "pi_update", "auto"),
             # a --mesh run declares its sharding so the pallas fast path
             # can shard_map the kernels over the data axis (make_coda

@@ -27,6 +27,31 @@ import numpy as np
 from coda_tpu.engine.loop import make_batched_experiment_fn
 from coda_tpu.losses import LOSS_FNS
 
+def _warm_profile(pairs) -> tuple[dict, dict]:
+    """Per-method and per-family WARM seconds from the pair records.
+
+    "Warm" = pairs that did not pay a jit compile (``cold`` False), so on
+    a steady-state rerun — where every executable is cached — these ARE
+    the per-method / per-family steady-state breakdown the cold-inclusive
+    ``per_method_s`` cannot provide (a method whose 26 pairs are all cold
+    reports compile time, not compute). Family is the task-name prefix
+    before a trailing ``_<index>`` (``domainnet_3`` -> ``domainnet``); a
+    name without a numeric suffix is its own family.
+    """
+    per_method: dict = {}
+    per_family: dict = {}
+    for p in pairs:
+        if p.get("cold"):
+            continue
+        fam, _, idx = p["task"].rpartition("_")
+        fam = fam if fam and idx.isdigit() else p["task"]
+        per_method[p["method"]] = per_method.get(p["method"], 0.0) \
+            + p["seconds"]
+        per_family[fam] = per_family.get(fam, 0.0) + p["seconds"]
+    return ({k: round(v, 3) for k, v in per_method.items()},
+            {k: round(v, 3) for k, v in per_family.items()})
+
+
 # Hyperparams passed to the jitted program as TRACED runtime scalars instead
 # of being baked into the executable: the per-task tuned values then share
 # one compile (and one task-batch group) across tasks. ModelPicker's ε is
@@ -58,6 +83,12 @@ class SuiteRunner:
         # tasks at the cost of one extra (1-seed) compile per method.
         self.dedup_seeds = dedup_seeds
         self._jitted: dict = {}
+        # cold attribution persists across run()/run_batched() calls, like
+        # the jit cache it mirrors: a warm RERUN on the same runner pays no
+        # compiles, so none of its pairs may be marked cold — that would
+        # silently drop the first pair of every shape from the
+        # per-method/per-family warm (steady-state) profile
+        self._seen_shapes: set = set()
         self._keys = jax.numpy.stack(
             [jax.random.PRNGKey(s) for s in range(seeds)]
         )
@@ -209,7 +240,7 @@ class SuiteRunner:
         t_load = 0.0
         t_compute = 0.0
         pairs: list = []  # per task-method timing records (for BENCH_SUITE)
-        seen_shapes: set = set()
+        seen_shapes = self._seen_shapes
         for ds_or_loader in datasets:
             lazy = callable(ds_or_loader)
             t0 = time.perf_counter()
@@ -247,8 +278,11 @@ class SuiteRunner:
             if lazy:
                 del ds  # drop the device tensor before the next task loads
         total = time.perf_counter() - t_start
+        warm_m, warm_f = _warm_profile(pairs)
         self.last_stats = {"total_s": total, "load_s": t_load,
-                           "compute_s": t_compute, "pairs": pairs}
+                           "compute_s": t_compute, "pairs": pairs,
+                           "per_method_warm_s": warm_m,
+                           "per_family_warm_s": warm_f}
         progress(f"suite: {len(results)} task-method pairs in {total:.2f}s "
                  f"(compute {t_compute:.2f}s, data load {t_load:.2f}s)")
         return results
@@ -302,7 +336,7 @@ class SuiteRunner:
         t_load = 0.0
         t_compute = 0.0
         pairs: list = []
-        seen_shapes: set = set()
+        seen_shapes = self._seen_shapes
         for group in groups:
             t0 = time.perf_counter()
             datasets = [d() if callable(d) else d for d in group]
@@ -337,8 +371,11 @@ class SuiteRunner:
                         results, progress)
                     t_compute += pairs[-1]["seconds"] * pairs[-1]["batched"]
         total = time.perf_counter() - t_start
+        warm_m, warm_f = _warm_profile(pairs)
         self.last_stats = {"total_s": total, "load_s": t_load,
-                           "compute_s": t_compute, "pairs": pairs}
+                           "compute_s": t_compute, "pairs": pairs,
+                           "per_method_warm_s": warm_m,
+                           "per_family_warm_s": warm_f}
         progress(f"suite[batched]: {len(results)} task-method pairs in "
                  f"{total:.2f}s (compute {t_compute:.2f}s, data load "
                  f"{t_load:.2f}s)")

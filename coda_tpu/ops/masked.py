@@ -12,13 +12,70 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 _NEG_INF = -jnp.inf
 
+# Degree-6 Chebyshev-fitted polynomial for log2(m) on the reduced mantissa
+# m in [1, 2), evaluated in t = m - 1 (Horner, ascending coefficients).
+# Max fit error 5.1e-6 over the interval; the fp32 end-to-end error of
+# log2_approx over the whole clamped entropy domain [1e-12, 1] measures
+# 6.9e-6 (see tests/test_fast_entropy.py, which pins the 1e-5 bound).
+# Seven FMAs + integer bit ops on the VPU replace the transcendental log
+# lowering — the point of the eig_entropy='approx' scoring path, whose
+# N*C*H ~ 5e8 log evaluations per round are the bf16 headline's limiter
+# (NOTES_r05.md: the invariant ~1.2 ms VPU entropy tail).
+_LOG2_POLY = (
+    5.065333097742375e-06,
+    1.4423954826705712,
+    -0.7169868747328294,
+    0.45385624123395407,
+    -0.27235315795334314,
+    0.11790518317842658,
+    -0.0248256066155325,
+)
 
-def entropy2(p: jnp.ndarray, axis: int = -1, floor: float = 1e-12) -> jnp.ndarray:
-    """Shannon entropy in bits with the reference's 1e-12 floor clamp."""
+
+def log2_approx(x: jnp.ndarray) -> jnp.ndarray:
+    """Fast fp32 log2 for POSITIVE NORMAL floats (the clamped simplex
+    domain [1e-12, 1] of the entropy chain — callers clamp first).
+
+    The IEEE-754 exponent is extracted with integer bit manipulation
+    (``x = m * 2^e``, ``log2(x) = e + log2(m)``) and ``log2(m)`` comes
+    from the fixed-degree :data:`_LOG2_POLY` — no transcendental, only
+    VPU-friendly integer ops and FMAs, the same ops in the XLA lowering
+    and inside the Mosaic kernels (``lax.bitcast_convert_type`` and
+    int32 shifts lower on both). NaN/inf/zero/denormal inputs are NOT
+    handled (the 1e-12 entropy floor exceeds the 1.18e-38 fp32 normal
+    minimum by 26 binades, so the clamp makes them unreachable).
+    """
+    x = x.astype(jnp.float32)
+    xi = lax.bitcast_convert_type(x, jnp.int32)
+    e = jnp.right_shift(xi, 23) - 127
+    m = lax.bitcast_convert_type(
+        jnp.bitwise_or(jnp.bitwise_and(xi, 0x007FFFFF), 0x3F800000),
+        jnp.float32,
+    )
+    t = m - 1.0
+    p = jnp.float32(_LOG2_POLY[-1])
+    for c in _LOG2_POLY[-2::-1]:
+        p = p * t + jnp.float32(c)
+    return e.astype(jnp.float32) + p
+
+
+def entropy2(p: jnp.ndarray, axis: int = -1, floor: float = 1e-12,
+             approx: bool = False) -> jnp.ndarray:
+    """Shannon entropy in bits with the reference's 1e-12 floor clamp.
+
+    ``approx=True`` swaps the transcendental ``log2`` for
+    :func:`log2_approx` (the ``eig_entropy='approx'`` opt-in: max
+    |Δlog2| ≤ 1e-5 on the clamped domain, so |ΔH| of a simplex row is
+    bounded by the same — errors scale with Σp). The default stays
+    byte-identical to the reference lowering.
+    """
     pc = jnp.clip(p, floor, None)
+    if approx:
+        return -(pc * log2_approx(pc)).sum(axis=axis)
     return -(pc * jnp.log2(pc)).sum(axis=axis)
 
 

@@ -58,6 +58,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from coda_tpu.ops.masked import entropy2, log2_approx
+
 _ENTROPY_FLOOR = 1e-12  # reference clamp, see ops/masked.py entropy2
 _LOG2E = 1.4426950408889634
 
@@ -140,33 +142,41 @@ def choose_block(N: int, C: int, H: int, block: int = 0,
 
 
 def _weighted_entropy_scores(hyp, mixture0_ref, h_before_ref, pi_hat_ref,
-                             rows_ref, pi_xi_t_ref):
+                             rows_ref, pi_xi_t_ref, approx: bool = False):
     """(B, 1) scores from a fp32 (C, B, H) tile — the shared kernel tail.
 
     All math fp32, fully vectorized; reduction order matches the jnp
-    path's (entropy over H, then weighted class sum over axis 0)."""
+    path's (entropy over H, then weighted class sum over axis 0).
+    ``approx`` (the ``eig_entropy='approx'`` opt-in) swaps the
+    transcendental log for the bit-manipulation + polynomial
+    ``log2_approx`` — integer VPU ops + FMAs, same lowering as the jnp
+    path's approx flavor (ops/masked.py), cutting the N·C·H ~ 5e8 log
+    evaluations that are the bf16 headline's VPU tail."""
     delta = hyp - rows_ref[:]                            # (C, B, H)-(C,1,H)
     mix = mixture0_ref[:] + pi_hat_ref[:] * delta
     p = jnp.maximum(mix, _ENTROPY_FLOOR)
-    h_after = -(p * (jnp.log(p) * _LOG2E)).sum(axis=-1, keepdims=True)
+    log2p = log2_approx(p) if approx else jnp.log(p) * _LOG2E
+    h_after = -(p * log2p).sum(axis=-1, keepdims=True)
     return h_before_ref[0, 0] - (pi_xi_t_ref[:] * h_after).sum(axis=0)
 
 
-def _score_block_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
-                        hyp_ref, pi_xi_t_ref, out_ref):
+def _score_block_kernel(approx, mixture0_ref, h_before_ref, pi_hat_ref,
+                        rows_ref, hyp_ref, pi_xi_t_ref, out_ref):
     """One N-tile: (C, B, H) cache block -> (B, 1) scores.
 
     Refs: mixture0 (1, 1, H); h_before (1, 1); pi_hat (C, 1, 1); rows
     (C, 1, H); hyp (C, B, H); pi_xi_t (C, B, 1); out (B, 1) — 2-D so the
     N-tile only needs sublane (x8) alignment. Storage may be bf16
-    (eig_cache_dtype); all math runs fp32.
+    (eig_cache_dtype); all math runs fp32. ``approx`` is bound statically
+    via functools.partial at the pallas_call site.
     """
     hyp = hyp_ref[:].astype(jnp.float32)
     out_ref[:] = _weighted_entropy_scores(
-        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref)
+        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref,
+        approx=approx)
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block", "interpret", "approx"))
 def eig_scores_cache_pallas(
     pbest_rows: jnp.ndarray,   # (C, H)
     pbest_hyp: jnp.ndarray,    # (C, N, H)
@@ -174,12 +184,16 @@ def eig_scores_cache_pallas(
     pi_hat_xi: jnp.ndarray,    # (N, C)
     block: int = 0,
     interpret: bool | None = None,
+    approx: bool = False,
 ) -> jnp.ndarray:
     """(N,) EIG scores from the incremental cache, fused in one HBM pass.
 
     Matches ``eig_scores_from_cache`` numerics: same mixture-delta, the same
     1e-12 entropy floor, log2 via ln·log2(e) (the same lowering XLA emits
-    for ``jnp.log2``), same reduction order. ``block`` is a CAP on the
+    for ``jnp.log2``), same reduction order. ``approx`` selects the
+    eig_entropy='approx' lowering of the whole chain (the same
+    ``log2_approx`` the jnp path uses, so backend fallbacks never change
+    numerics class). ``block`` is a CAP on the
     N-tile; the actual tile is derived from the VMEM budget (see
     :func:`choose_block`; block=0 means "derive from VMEM alone").
 
@@ -207,7 +221,7 @@ def eig_scores_cache_pallas(
     @custom_batching.custom_vmap
     def _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi):
         return _scores_impl(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi,
-                            block, interpret)
+                            block, interpret, approx)
 
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, pi_b, pi_xi_b):
@@ -216,13 +230,13 @@ def eig_scores_cache_pallas(
                 hyp_b.shape[3], hyp_b.dtype.itemsize):
             return eig_scores_cache_pallas_batched(
                 rows_b, hyp_b, pi_b, pi_xi_b, block=block,
-                interpret=interpret), True
+                interpret=interpret, approx=approx), True
         from coda_tpu.selectors.coda import eig_scores_from_cache
 
         in_axes = [0 if b else None for b in in_batched]
         out = jax.vmap(
             lambda r, h, p, px: eig_scores_from_cache(
-                r, h, p, px, chunk=block or 2048),
+                r, h, p, px, chunk=block or 2048, approx=approx),
             in_axes=in_axes,
         )(rows_b, hyp_b, pi_b, pi_xi_b)
         return out, True
@@ -230,7 +244,7 @@ def eig_scores_cache_pallas(
     return _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block", "interpret", "approx"))
 def eig_scores_cache_pallas_batched(
     pbest_rows: jnp.ndarray,   # (S, C, H)
     pbest_hyp: jnp.ndarray,    # (S, C, N, H)
@@ -238,6 +252,7 @@ def eig_scores_cache_pallas_batched(
     pi_hat_xi: jnp.ndarray,    # (S, N, C)
     block: int = 0,
     interpret: bool | None = None,
+    approx: bool = False,
 ) -> jnp.ndarray:
     """(S, N) EIG scores for a BATCH of incremental caches in one kernel.
 
@@ -256,7 +271,8 @@ def eig_scores_cache_pallas_batched(
 
     @custom_batching.custom_vmap
     def _call(rows, hyp, pi, pi_xi):
-        return _scores_impl_batched(rows, hyp, pi, pi_xi, block, interpret)
+        return _scores_impl_batched(rows, hyp, pi, pi_xi, block, interpret,
+                                    approx)
 
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, pi_b, pi_xi_b):
@@ -267,7 +283,8 @@ def eig_scores_cache_pallas_batched(
             out = jax.vmap(
                 lambda r, h, p, px: jax.vmap(
                     lambda r2, h2, p2, px2: eig_scores_from_cache(
-                        r2, h2, p2, px2, chunk=block or 2048)
+                        r2, h2, p2, px2, chunk=block or 2048,
+                        approx=approx)
                 )(r, h, p, px),
                 in_axes=in_axes,
             )(rows_b, hyp_b, pi_b, pi_xi_b)
@@ -285,7 +302,7 @@ def eig_scores_cache_pallas_batched(
 
             out = jax.vmap(jax.vmap(
                 lambda r, h, p, px: eig_scores_from_cache(
-                    r, h, p, px, chunk=block or 2048)))(
+                    r, h, p, px, chunk=block or 2048, approx=approx)))(
                 rows_b, hyp_b, pi_b, pi_xi_b)
             return out, True
 
@@ -294,17 +311,17 @@ def eig_scores_cache_pallas_batched(
 
         out = eig_scores_cache_pallas_batched(
             flat(rows_b), flat(hyp_b), flat(pi_b), flat(pi_xi_b),
-            block=block, interpret=interpret)
+            block=block, interpret=interpret, approx=approx)
         return out.reshape(T, S, -1), True
 
     return _call(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi)
 
 
-def _refresh_compute_score_kernel(c_sp_ref, mixture0_ref, h_before_ref,
-                                  pi_hat_ref, rows_ref, s0_ref, dlog_ref,
-                                  fu_t_ref, df_t_ref, wtr_ref, hp_ref,
-                                  pi_xi_t_ref, hyp_ref, score_ref,
-                                  row_out_ref):
+def _refresh_compute_score_kernel(approx, c_sp_ref, mixture0_ref,
+                                  h_before_ref, pi_hat_ref, rows_ref,
+                                  s0_ref, dlog_ref, fu_t_ref, df_t_ref,
+                                  wtr_ref, hp_ref, pi_xi_t_ref, hyp_ref,
+                                  score_ref, row_out_ref):
     """One N-tile of the fully-fused refresh: computes the replacement
     class row IN-KERNEL from the Beta grid tables (three MXU dots per
     tile — the work the precomputed path does as XLA einsums), then
@@ -341,11 +358,13 @@ def _refresh_compute_score_kernel(c_sp_ref, mixture0_ref, h_before_ref,
     hyp = jnp.where(cls == c, row_f32[None],
                     hyp_ref[:].astype(jnp.float32))
     score_ref[:] = _weighted_entropy_scores(
-        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref)
+        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref,
+        approx=approx)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_points", "block", "interpret"))
+                   static_argnames=("num_points", "block", "interpret",
+                                    "approx"))
 def eig_scores_refresh_compute_pallas(
     pbest_rows: jnp.ndarray,   # (C, H) — ALREADY holding the refreshed row
     pbest_hyp: jnp.ndarray,    # (C, N, H) — still holding the OLD row
@@ -359,6 +378,7 @@ def eig_scores_refresh_compute_pallas(
     num_points: int = 256,
     block: int = 0,
     interpret: bool | None = None,
+    approx: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fully-fused refresh+score: the replacement row is COMPUTED inside
     the scoring kernel from O(H·G) Beta tables, so the refresh einsums
@@ -367,11 +387,19 @@ def eig_scores_refresh_compute_pallas(
     preceding it, and the (N, H) hyp_t intermediate never exists.
 
     OPT-IN numerics (``eig_refresh='fused'``): the in-kernel fp32 MXU
-    dots replace XLA-HIGHEST einsums, so refreshed cache VALUES can
-    differ by ulps from the precomputed path — same contract as
-    ``eig_precision``/``eig_cache_dtype``. No vmap/sharding variants:
-    the lever targets the single-chip headline; batched callers raise
-    (resolve via the precomputed path there).
+    dots replace XLA-HIGHEST einsums, so refreshed cache VALUES differ
+    from the precomputed path by up to the MEASURED 2.34e-4 at the
+    headline shape (``fusedcompute_row_max_abs_diff``,
+    PALLAS_TPU_VALIDATION_r05.json, v5e silicon): the single-pass fp32
+    accumulation's rounding difference is amplified by the
+    ``exp(S - max S)`` integrand on near-degenerate Beta rows. The drift
+    does not compound across rounds (each refresh recomputes its row
+    from the identically-updated Dirichlet posterior); long-horizon
+    behavior is pinned by the 100-round digits_h80 trace-agreement test.
+    Same contract as ``eig_precision``/``eig_cache_dtype``. ``approx``
+    additionally selects the eig_entropy='approx' scoring tail. No
+    vmap/sharding variants: the lever targets the single-chip headline;
+    batched callers raise (resolve via the precomputed path there).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -391,7 +419,7 @@ def eig_scores_refresh_compute_pallas(
     tables = 2 * 4 * (H * G + 2 * G * _lane_padded(H) + 2 * G)
     B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize,
                      fused=True, table_bytes=tables)
-    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat)
+    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat, approx=approx)
     n_blocks = -(-N // B)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -417,7 +445,7 @@ def eig_scores_refresh_compute_pallas(
         ),
     )
     scores, hyp_out = pl.pallas_call(
-        _refresh_compute_score_kernel,
+        functools.partial(_refresh_compute_score_kernel, approx),
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct((N, 1), jnp.float32),
@@ -453,6 +481,7 @@ def eig_scores_cache_pallas_sharded(
     mesh,
     block: int = 0,
     interpret: bool | None = None,
+    approx: bool = False,
 ) -> jnp.ndarray:
     """(N,) scores with the pallas kernel running PER DATA SHARD.
 
@@ -467,18 +496,18 @@ def eig_scores_cache_pallas_sharded(
     """
     from jax.sharding import PartitionSpec as P
 
-    from coda_tpu.parallel.mesh import DATA_AXIS
+    from coda_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     def local(rows, hyp, pi, pi_xi):
-        return _scores_impl(rows, hyp, pi, pi_xi, block, interpret)
+        return _scores_impl(rows, hyp, pi, pi_xi, block, interpret, approx)
 
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-
     # axes annotation, which the default vma check rejects; the specs above
     # state the sharding contract explicitly
-    return jax.shard_map(
+    return shard_map_compat(
         local, mesh=mesh,
         in_specs=(P(), P(None, DATA_AXIS, None), P(), P(DATA_AXIS, None)),
         out_specs=P(DATA_AXIS), check_vma=False,
@@ -495,6 +524,7 @@ def eig_scores_refresh_pallas_sharded(
     mesh,
     block: int = 0,
     interpret: bool | None = None,
+    approx: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused refresh+score per data shard: ``(scores (N,), cache)``.
 
@@ -504,16 +534,16 @@ def eig_scores_refresh_pallas_sharded(
     """
     from jax.sharding import PartitionSpec as P
 
-    from coda_tpu.parallel.mesh import DATA_AXIS
+    from coda_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     def local(rows, hyp, hyp_t, c, pi, pi_xi):
         return _refresh_impl(rows, hyp, hyp_t, c, pi, pi_xi, block,
-                             interpret)
+                             interpret, approx)
 
-    return jax.shard_map(
+    return shard_map_compat(
         local, mesh=mesh,
         in_specs=(P(), P(None, DATA_AXIS, None), P(DATA_AXIS, None), P(),
                   P(), P(DATA_AXIS, None)),
@@ -523,27 +553,29 @@ def eig_scores_refresh_pallas_sharded(
       pi_hat, pi_hat_xi)
 
 
-def _batched_score_kernel(mixture0_ref, h_before_ref, pi_hat_ref, rows_ref,
-                          hyp_ref, pi_xi_t_ref, out_ref):
+def _batched_score_kernel(approx, mixture0_ref, h_before_ref, pi_hat_ref,
+                          rows_ref, hyp_ref, pi_xi_t_ref, out_ref):
     """One (replica, N-tile) grid step: refs carry a leading size-1 batch
     block; the math is :func:`_score_block_kernel`'s exactly."""
     hyp = hyp_ref[0].astype(jnp.float32)
     out_ref[0] = _weighted_entropy_scores(
         hyp, mixture0_ref[0], h_before_ref[0], pi_hat_ref[0], rows_ref[0],
-        pi_xi_t_ref[0])
+        pi_xi_t_ref[0], approx=approx)
 
 
 def _scores_impl_batched(rows, hyp, pi, pi_xi, block: int,
-                         interpret: bool) -> jnp.ndarray:
+                         interpret: bool,
+                         approx: bool = False) -> jnp.ndarray:
     S, C, N, H = hyp.shape
     B = choose_block(N, C, H, block, itemsize=hyp.dtype.itemsize)
     # _mixture_stats already emits (1, 1, H)/(1, 1) per replica, so the
     # vmap lands exactly on the (S, 1, 1, H)/(S, 1, 1) operand shapes
-    mixture0, h_before = jax.vmap(_mixture_stats)(rows, pi)
+    mixture0, h_before = jax.vmap(
+        functools.partial(_mixture_stats, approx=approx))(rows, pi)
     n_blocks = -(-N // B)
 
     out = pl.pallas_call(
-        _batched_score_kernel,
+        functools.partial(_batched_score_kernel, approx),
         out_shape=jax.ShapeDtypeStruct((S, N, 1), jnp.float32),
         grid=(S, n_blocks),
         in_specs=[
@@ -567,23 +599,27 @@ def _scores_impl_batched(rows, hyp, pi, pi_xi, block: int,
     return out[:, :, 0]
 
 
-def _mixture_stats(pbest_rows, pi_hat):
-    """(mixture0 (1,1,H), h_before (1,1)) — the cheap pre-kernel scalars."""
+def _mixture_stats(pbest_rows, pi_hat, approx: bool = False):
+    """(mixture0 (1,1,H), h_before (1,1)) — the cheap pre-kernel scalars.
+
+    ``approx`` must match the kernel tail's flavor: h_before and h_after
+    enter the same subtraction, so a mixed lowering would forfeit the
+    error cancellation the Δscore bound relies on."""
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
-    pc = jnp.clip(mixture0, _ENTROPY_FLOOR, None)
-    h_before = -(pc * jnp.log2(pc)).sum()
+    h_before = entropy2(mixture0, approx=approx)
     return mixture0[None, None, :], h_before[None, None]
 
 
 def _scores_impl(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi,
-                 block: int, interpret: bool) -> jnp.ndarray:
+                 block: int, interpret: bool,
+                 approx: bool = False) -> jnp.ndarray:
     C, N, H = pbest_hyp.shape
     B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize)
-    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat)
+    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat, approx=approx)
     n_blocks = -(-N // B)
 
     out = pl.pallas_call(
-        _score_block_kernel,
+        functools.partial(_score_block_kernel, approx),
         out_shape=jax.ShapeDtypeStruct((N, 1), jnp.float32),
         grid=(n_blocks,),
         in_specs=[
@@ -607,9 +643,9 @@ def _scores_impl(pbest_rows, pbest_hyp, pi_hat, pi_hat_xi,
     return out[:, 0]
 
 
-def _refresh_score_kernel(c_sp_ref, mixture0_ref, h_before_ref, pi_hat_ref,
-                          rows_ref, hyp_t_ref, pi_xi_t_ref, hyp_ref,
-                          score_ref, row_out_ref):
+def _refresh_score_kernel(approx, c_sp_ref, mixture0_ref, h_before_ref,
+                          pi_hat_ref, rows_ref, hyp_t_ref, pi_xi_t_ref,
+                          hyp_ref, score_ref, row_out_ref):
     """One N-tile of the fused refresh+score pass.
 
     Scores the (C, B, H) cache tile with class row ``c`` read from the
@@ -633,10 +669,11 @@ def _refresh_score_kernel(c_sp_ref, mixture0_ref, h_before_ref, pi_hat_ref,
     cls = lax.broadcasted_iota(jnp.int32, (hyp_ref.shape[0], 1, 1), 0)
     hyp = jnp.where(cls == c, row_new[None], hyp_ref[:].astype(jnp.float32))
     score_ref[:] = _weighted_entropy_scores(
-        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref)
+        hyp, mixture0_ref, h_before_ref, pi_hat_ref, rows_ref, pi_xi_t_ref,
+        approx=approx)
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block", "interpret", "approx"))
 def eig_scores_refresh_pallas(
     pbest_rows: jnp.ndarray,   # (C, H) — ALREADY holding the refreshed row
     pbest_hyp: jnp.ndarray,    # (C, N, H) — still holding the OLD row
@@ -646,6 +683,7 @@ def eig_scores_refresh_pallas(
     pi_hat_xi: jnp.ndarray,    # (N, C)
     block: int = 0,
     interpret: bool | None = None,
+    approx: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused cache-row refresh + EIG scoring: one HBM read of the cache,
     one row write.
@@ -680,7 +718,7 @@ def eig_scores_refresh_pallas(
     @custom_batching.custom_vmap
     def _call(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat, pi_hat_xi):
         return _refresh_impl(pbest_rows, pbest_hyp, hyp_t, true_class,
-                             pi_hat, pi_hat_xi, block, interpret)
+                             pi_hat, pi_hat_xi, block, interpret, approx)
 
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, hyp_t_b, c_b,
@@ -690,7 +728,7 @@ def eig_scores_refresh_pallas(
                 hyp_b.shape[3], hyp_b.dtype.itemsize):
             return eig_scores_refresh_pallas_batched(
                 rows_b, hyp_b, hyp_t_b, c_b, pi_b, pi_xi_b, block=block,
-                interpret=interpret), (True, True)
+                interpret=interpret, approx=approx), (True, True)
         from coda_tpu.selectors.coda import eig_scores_from_cache
 
         in_axes = [0 if b else None for b in in_batched]
@@ -698,7 +736,8 @@ def eig_scores_refresh_pallas(
         def one(rows, hyp, hyp_t, c, pi, pi_xi):
             hyp2 = hyp.at[c].set(hyp_t.astype(hyp.dtype))
             scores = eig_scores_from_cache(rows, hyp2, pi, pi_xi,
-                                           chunk=block or 2048)
+                                           chunk=block or 2048,
+                                           approx=approx)
             return scores, hyp2
 
         out = jax.vmap(one, in_axes=in_axes)(
@@ -709,7 +748,7 @@ def eig_scores_refresh_pallas(
                  pi_hat_xi)
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block", "interpret", "approx"))
 def eig_scores_refresh_pallas_batched(
     pbest_rows: jnp.ndarray,   # (S, C, H) — ALREADY holding refreshed rows
     pbest_hyp: jnp.ndarray,    # (S, C, N, H) — still holding the OLD rows
@@ -719,6 +758,7 @@ def eig_scores_refresh_pallas_batched(
     pi_hat_xi: jnp.ndarray,    # (S, N, C)
     block: int = 0,
     interpret: bool | None = None,
+    approx: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused refresh+score for a BATCH of caches: ``(scores (S, N),
     updated cache (S, C, N, H))``.
@@ -737,7 +777,7 @@ def eig_scores_refresh_pallas_batched(
     @custom_batching.custom_vmap
     def _call(rows, hyp, hyp_t, cls, pi, pi_xi):
         return _refresh_impl_batched(rows, hyp, hyp_t, cls, pi, pi_xi,
-                                     block, interpret)
+                                     block, interpret, approx)
 
     @_call.def_vmap
     def _call_vmap(axis_size, in_batched, rows_b, hyp_b, hyp_t_b, c_b,
@@ -748,7 +788,7 @@ def eig_scores_refresh_pallas_batched(
 
             h2 = h.at[c].set(ht.astype(h.dtype))
             return eig_scores_from_cache(
-                r, h2, p, px, chunk=block or 2048), h2
+                r, h2, p, px, chunk=block or 2048, approx=approx), h2
 
         if not all(in_batched):
             in_axes = [0 if b else None for b in in_batched]
@@ -773,7 +813,8 @@ def eig_scores_refresh_pallas_batched(
 
         scores, hyp_out = eig_scores_refresh_pallas_batched(
             flat(rows_b), flat(hyp_b), flat(hyp_t_b), flat(c_b),
-            flat(pi_b), flat(pi_xi_b), block=block, interpret=interpret)
+            flat(pi_b), flat(pi_xi_b), block=block, interpret=interpret,
+            approx=approx)
         return (scores.reshape((T, S) + scores.shape[1:]),
                 hyp_out.reshape((T, S) + hyp_out.shape[1:])), (True, True)
 
@@ -781,7 +822,7 @@ def eig_scores_refresh_pallas_batched(
                  pi_hat_xi)
 
 
-def _batched_refresh_kernel(c_sp_ref, mixture0_ref, h_before_ref,
+def _batched_refresh_kernel(approx, c_sp_ref, mixture0_ref, h_before_ref,
                             pi_hat_ref, rows_ref, hyp_t_ref, pi_xi_t_ref,
                             hyp_ref, score_ref, row_out_ref):
     """One (replica, N-tile) grid step of the batched fused pass — the
@@ -795,15 +836,16 @@ def _batched_refresh_kernel(c_sp_ref, mixture0_ref, h_before_ref,
                     hyp_ref[0].astype(jnp.float32))
     score_ref[0] = _weighted_entropy_scores(
         hyp, mixture0_ref[0], h_before_ref[0], pi_hat_ref[0], rows_ref[0],
-        pi_xi_t_ref[0])
+        pi_xi_t_ref[0], approx=approx)
 
 
 def _refresh_impl_batched(rows, hyp, hyp_t, cls, pi, pi_xi, block: int,
-                          interpret: bool):
+                          interpret: bool, approx: bool = False):
     S, C, N, H = hyp.shape
     B = choose_block(N, C, H, block, itemsize=hyp.dtype.itemsize,
                      fused=True)
-    mixture0, h_before = jax.vmap(_mixture_stats)(rows, pi)
+    mixture0, h_before = jax.vmap(
+        functools.partial(_mixture_stats, approx=approx))(rows, pi)
     n_blocks = -(-N // B)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -826,7 +868,7 @@ def _refresh_impl_batched(rows, hyp, hyp_t, cls, pi, pi_xi, block: int,
         ),
     )
     scores, hyp_out = pl.pallas_call(
-        _batched_refresh_kernel,
+        functools.partial(_batched_refresh_kernel, approx),
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct((S, N, 1), jnp.float32),
@@ -848,11 +890,12 @@ def _refresh_impl_batched(rows, hyp, hyp_t, cls, pi, pi_xi, block: int,
 
 
 def _refresh_impl(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat,
-                  pi_hat_xi, block: int, interpret: bool):
+                  pi_hat_xi, block: int, interpret: bool,
+                  approx: bool = False):
     C, N, H = pbest_hyp.shape
     B = choose_block(N, C, H, block, itemsize=pbest_hyp.dtype.itemsize,
                      fused=True)
-    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat)
+    mixture0, h_before = _mixture_stats(pbest_rows, pi_hat, approx=approx)
     n_blocks = -(-N // B)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -875,7 +918,7 @@ def _refresh_impl(pbest_rows, pbest_hyp, hyp_t, true_class, pi_hat,
         ),
     )
     scores, hyp_out = pl.pallas_call(
-        _refresh_score_kernel,
+        functools.partial(_refresh_score_kernel, approx),
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct((N, 1), jnp.float32),

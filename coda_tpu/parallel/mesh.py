@@ -32,6 +32,30 @@ MODEL_AXIS = "model"  # shards H (the candidate-model pool)
 DATA_AXIS = "data"    # shards N (the unlabeled data points)
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across the jax versions this repo runs on.
+
+    Newer jax exposes it top-level with a ``check_vma`` kwarg; 0.4.x has
+    ``jax.experimental.shard_map.shard_map`` with the same check named
+    ``check_rep``; the releases in between promoted the function before
+    renaming the kwarg — so the two drifts are detected INDEPENDENTLY
+    (attribute lookup for the function, signature inspection for the
+    kwarg name). Same semantics either way; this shim exists so the
+    sharded pallas fast path (and the multichip dryrun that validates
+    it) runs on all three eras instead of AttributeError/TypeError'ing.
+    """
+    import inspect
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    check_kwarg = ("check_vma"
+                   if "check_vma" in inspect.signature(fn).parameters
+                   else "check_rep")
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{check_kwarg: check_vma})
+
+
 def make_mesh(
     data: int = 1,
     model: int = 1,

@@ -114,9 +114,48 @@ class CODAHyperparams(NamedTuple):
     #                               remaining XLA stage (3.2-3.7 ms at
     #                               headline) and the (N, H) round-trip.
     #                               In-kernel dots are not XLA-HIGHEST:
-    #                               refreshed cache values can differ by
-    #                               ulps — same opt-in contract as
+    #                               refreshed cache values differ from
+    #                               the precomputed path by up to the
+    #                               MEASURED 2.34e-4 at the headline
+    #                               shape (fusedcompute_row_max_abs_diff,
+    #                               PALLAS_TPU_VALIDATION_r05.json, v5e
+    #                               silicon) — not "ulps": the origin is
+    #                               the single-pass fp32 MXU dots
+    #                               replacing 6-pass XLA-HIGHEST einsums
+    #                               in the S/t_base/t_diff contractions,
+    #                               whose rounding difference the
+    #                               exp(S - max S) integrand then
+    #                               amplifies on near-degenerate Beta
+    #                               rows. The drift does NOT compound
+    #                               over rounds (each refresh recomputes
+    #                               its row from the Dirichlet posterior,
+    #                               which both paths update identically);
+    #                               the 100-round digits_h80 fused-vs-
+    #                               default selection-trace agreement
+    #                               test pins the long-horizon behavior.
+    #                               Same opt-in contract as
     #                               eig_precision / eig_cache_dtype.
+    eig_entropy: str = "exact"    # exact | approx — the log lowering of
+    #                               the expected-entropy chain (the
+    #                               scoring pass's N·C·H ~ 5e8 log evals
+    #                               per round at headline — the invariant
+    #                               ~1.2 ms VPU tail that caps the bf16
+    #                               path at 3.04 ms, NOTES_r05.md).
+    #                               "approx" replaces the transcendental
+    #                               with a bit-extracted exponent + fixed
+    #                               degree-6 mantissa polynomial on the
+    #                               clamped [1e-12, 1] domain
+    #                               (ops/masked.log2_approx): max |Δlog2|
+    #                               ≤ 1e-5, max |Δscore| ≤ 1e-4 (measured
+    #                               ~2e-5, pinned by
+    #                               tests/test_fast_entropy.py), applied
+    #                               consistently in the jnp AND pallas
+    #                               lowerings so auto backend routing
+    #                               never changes numerics class across a
+    #                               fallback. Opt-in speed, not reference
+    #                               semantics — same contract as
+    #                               eig_precision / eig_cache_dtype /
+    #                               eig_refresh.
     shard_spec: str = ""          # "" | "data=K" — declared mesh sharding
     #                               of the (H, N, C) tensor for the pallas
     #                               fast path. pallas_call is an opaque
@@ -717,6 +756,7 @@ def eig_scores_rowscan(
     num_points: int = 256,
     chunk: int = 256,
     precision=_PRECISION,
+    approx: bool = False,
 ) -> jnp.ndarray:
     """EIG of labeling each point, scanned over class rows. Returns (N,).
 
@@ -736,7 +776,7 @@ def eig_scores_rowscan(
     aT, bT = a_cc.T, b_cc.T                          # (C, H)
     pbest_before = compute_pbest_rows(aT, bT, num_points=num_points)
     mixture0 = (pi_hat[:, None] * pbest_before).sum(0)           # (H,)
-    h_before = entropy2(mixture0)
+    h_before = entropy2(mixture0, approx=approx)
 
     class_range = jnp.arange(C, dtype=jnp.int32)
     # pad the (cheap, int32) item axis once so every class row sees the same
@@ -754,7 +794,7 @@ def eig_scores_rowscan(
             hyp = _pbest_hyp_row(a_t, b_t, pred_b == c_idx,
                                  update_weight, num_points, precision)
             mix = mixture0[None] + pi_c * (hyp - before_t[None])
-            return entropy2(mix, axis=-1)
+            return entropy2(mix, axis=-1, approx=approx)
 
         h_after_c = lax.map(blk, hp_blocks).reshape(-1)[:N]
         return acc + pi_hat_xi[:, c_idx] * h_after_c, None
@@ -772,6 +812,7 @@ def eig_scores_from_cache(
     pi_hat: jnp.ndarray,       # (C,)
     pi_hat_xi: jnp.ndarray,    # (N, C)
     chunk: int = 256,
+    approx: bool = False,
 ) -> jnp.ndarray:
     """EIG of labeling each point from the incremental cache. Returns (N,).
 
@@ -791,9 +832,14 @@ def eig_scores_from_cache(
     score errors exactly when chunk did not divide N; every
     N-divisible shape was bit-clean, which is why round 4's validation
     missed it).
+
+    ``approx``: the ``eig_entropy='approx'`` lowering — every entropy in
+    the chain (h_before AND the per-block h_after) runs through
+    :func:`~coda_tpu.ops.masked.log2_approx`, matching the pallas
+    kernels' approx flavor so the two backends stay interchangeable.
     """
     mixture0 = (pi_hat[:, None] * pbest_rows).sum(0)             # (H,)
-    h_before = entropy2(mixture0)
+    h_before = entropy2(mixture0, approx=approx)
     N = pbest_hyp.shape[1]
     B = min(chunk, N)
 
@@ -806,7 +852,7 @@ def eig_scores_from_cache(
         hyp_b = hyp_b.astype(mixture0.dtype)         # (C, B, H)
         mix = mixture0[None, None, :] + pi_hat[:, None, None] * (
             hyp_b - pbest_rows[:, None, :])
-        h_after = entropy2(mix, axis=-1)             # (C, B)
+        h_after = entropy2(mix, axis=-1, approx=approx)  # (C, B)
         # reduce classes over axis 0 of (C, B) — the SAME reduction
         # structure as the pallas kernels' stacked class terms, so the two
         # backends agree to ~1 ulp instead of O(C·ulp) reduction-order
@@ -827,6 +873,7 @@ def eig_scores_factored(
     num_points: int = 256,
     chunk: int = 256,
     precision=_PRECISION,
+    approx: bool = False,
 ) -> jnp.ndarray:
     """EIG of labeling each point, factored for the MXU. Returns (N,).
 
@@ -853,7 +900,7 @@ def eig_scores_factored(
     aT, bT = a_cc.T, b_cc.T                          # (C, H)
     pbest_before = compute_pbest(aT, bT, num_points=num_points)  # (C, H)
     mixture0 = (pi_hat[:, None] * pbest_before).sum(0)           # (H,)
-    h_before = entropy2(mixture0)
+    h_before = entropy2(mixture0, approx=approx)
 
     x = pbest_grid(num_points)                       # (G,)
     dx = x[1] - x[0]
@@ -871,7 +918,7 @@ def eig_scores_factored(
         mix_new = mixture0[None, None] + pi_hat[None, :, None] * (
             pbest_hyp - pbest_before[None]
         )
-        h_after = entropy2(mix_new, axis=-1)         # (B, C)
+        h_after = entropy2(mix_new, axis=-1, approx=approx)  # (B, C)
         return h_before - (pi_xi_b * h_after).sum(-1)
 
     N = hard_preds.shape[0]
@@ -954,8 +1001,20 @@ def make_coda(
             "kernel and always runs at HIGHEST precision; "
             f"eig_precision={hp.eig_precision!r} would silently not apply"
         )
-    # the direct kernel takes no precision parameter (see guard above)
-    eig_kwargs = {} if eig_mode == "direct" else {"precision": eig_precision}
+    if hp.eig_entropy not in ("exact", "approx"):
+        raise ValueError(f"unknown eig_entropy {hp.eig_entropy!r} "
+                         "(use 'exact' or 'approx')")
+    approx_entropy = hp.eig_entropy == "approx"
+    if eig_mode == "direct" and approx_entropy:
+        raise ValueError(
+            "eig_mode='direct' is the reference-choreography cross-check "
+            "kernel and always uses the exact entropy lowering; "
+            "eig_entropy='approx' would silently not apply"
+        )
+    # the direct kernel takes no precision/entropy parameters (guards above)
+    eig_kwargs = ({} if eig_mode == "direct"
+                  else {"precision": eig_precision,
+                        "approx": approx_entropy})
     incremental = eig_mode == "incremental"
     # (C, H, N) layout for the delta pi-hat gather, built OUTSIDE the scan
     # step so it is a loop constant (materialized once per experiment), not
@@ -1030,13 +1089,15 @@ def make_coda(
 
                 return eig_scores_cache_pallas_sharded(
                     rows, hyp, pi, pi_xi, mesh=shard_mesh,
-                    block=hp.eig_chunk)
+                    block=hp.eig_chunk, approx=approx_entropy)
             from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
 
             return eig_scores_cache_pallas(rows, hyp, pi, pi_xi,
-                                           block=hp.eig_chunk)
+                                           block=hp.eig_chunk,
+                                           approx=approx_entropy)
         return eig_scores_from_cache(rows, hyp, pi, pi_xi,
-                                     chunk=hp.eig_chunk)
+                                     chunk=hp.eig_chunk,
+                                     approx=approx_entropy)
 
     def init(key):
         del key  # CODA's initialization is deterministic
@@ -1205,7 +1266,7 @@ def make_coda(
                 scores, hyp = eig_scores_refresh_compute_pallas(
                     rows, state.pbest_hyp, a_t, b_t, hard_preds,
                     true_class, pi, pi_xi, num_points=hp.num_points,
-                    block=hp.eig_chunk)
+                    block=hp.eig_chunk, approx=approx_entropy)
             elif eig_backend == "pallas":
                 # fused refresh+score: the cache is donated through the
                 # kernel, so the scan carry never pays the XLA defensive
@@ -1221,7 +1282,8 @@ def make_coda(
 
                     scores, hyp = eig_scores_refresh_pallas_sharded(
                         rows, state.pbest_hyp, hyp_t, true_class, pi,
-                        pi_xi, mesh=shard_mesh, block=hp.eig_chunk)
+                        pi_xi, mesh=shard_mesh, block=hp.eig_chunk,
+                        approx=approx_entropy)
                 else:
                     from coda_tpu.ops.pallas_eig import (
                         eig_scores_refresh_pallas,
@@ -1229,7 +1291,8 @@ def make_coda(
 
                     scores, hyp = eig_scores_refresh_pallas(
                         rows, state.pbest_hyp, hyp_t, true_class, pi,
-                        pi_xi, block=hp.eig_chunk)
+                        pi_xi, block=hp.eig_chunk,
+                        approx=approx_entropy)
             else:
                 rows, hyp = update_eig_cache(
                     dirichlets, true_class, hard_preds,

@@ -84,14 +84,34 @@ def run_shape(N: int, C: int, H: int, reps_hi: int = 8,
     rec["argmax_agree"] = bool(s_pl.argmax() == s_jnp.argmax())
     rec["scale"] = float(np.abs(s_jnp).mean())
 
-    # 3. marginal timing, loop-in-jit (both paths, same discipline)
+    # 2b. the fast-entropy lowering (eig_entropy='approx'): the pallas
+    #     approx kernel against BOTH the jnp approx composition (the two
+    #     lowerings of the same polynomial chain must agree tightly) and
+    #     the exact scores (the committed |Dscore| <= 1e-4 opt-in bound)
+    s_ap = np.asarray(eig_scores_cache_pallas(rows, hyp, pi, pi_xi,
+                                              approx=True))
+    s_ap_jnp = np.asarray(eig_scores_from_cache(rows, hyp, pi, pi_xi,
+                                                approx=True))
+    rec["approx_pallas_vs_jnp_max_abs_diff"] = float(
+        np.max(np.abs(s_ap - s_ap_jnp)))
+    rec["approx_vs_exact_max_abs_diff"] = float(
+        np.max(np.abs(s_ap - s_jnp)))
+    rec["approx_argmax_agree"] = bool(s_ap.argmax() == s_jnp.argmax())
+
+    # 3. marginal timing, loop-in-jit (same discipline for every path;
+    #    pallas_approx is the --eig-entropy approx silicon number — the
+    #    lever against the ~1.2 ms VPU transcendental tail)
     def jnp_fn(r, h, p, px):
         return eig_scores_from_cache(r, h, p, px)
 
     def pl_fn(r, h, p, px):
         return eig_scores_cache_pallas(r, h, p, px)
 
-    for name, fn in (("jnp", jnp_fn), ("pallas", pl_fn)):
+    def pl_approx_fn(r, h, p, px):
+        return eig_scores_cache_pallas(r, h, p, px, approx=True)
+
+    for name, fn in (("jnp", jnp_fn), ("pallas", pl_fn),
+                     ("pallas_approx", pl_approx_fn)):
         _timed_loop(fn, rows, hyp, pi, pi_xi, reps_lo)  # warm
         hi = _timed_loop(fn, rows, hyp, pi, pi_xi, reps_hi)
         lo = _timed_loop(fn, rows, hyp, pi, pi_xi, reps_lo)
@@ -244,6 +264,15 @@ def main(argv=None):
     ap.add_argument("--platform", default=None)
     ap.add_argument("--tol", type=float, default=2e-5,
                     help="max abs score diff vs the jnp path")
+    ap.add_argument("--approx-tol", type=float, default=1e-4,
+                    help="max abs score diff of the eig_entropy='approx' "
+                         "lowering vs the exact path (the committed "
+                         "opt-in bound; measured ~2e-5)")
+    ap.add_argument("--eig-entropy", default="exact",
+                    choices=["exact", "approx"],
+                    help="recorded in the artifact so a capture names "
+                         "which lowering its headline numbers target "
+                         "(both variants are always validated and timed)")
     ap.add_argument("--batched-only", action="store_true",
                     help="run only the batched-kernel section")
     args = ap.parse_args(argv)
@@ -256,7 +285,8 @@ def main(argv=None):
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     out = {"device": dev.device_kind, "platform": dev.platform,
-           "interpret": not on_tpu, "shapes": []}
+           "interpret": not on_tpu, "eig_entropy": args.eig_entropy,
+           "shapes": []}
     # On TPU: the headline incremental shape + a deliberately ragged one
     # (N % 8 != 0, C not x8, H not x128) to exercise Mosaic's edge
     # handling. Off-TPU the kernel runs in the per-element interpreter,
@@ -283,6 +313,13 @@ def main(argv=None):
              and s["fused_argmax_agree"] and s["fused_row_updated"]
              and s["fused_rows_carried"]
              for s in out["shapes"] + out["batched_shapes"])
+    # the fast-entropy lowering: pallas and jnp approx must agree like the
+    # exact pair, and approx-vs-exact must hold the committed opt-in bound
+    ok = ok and all(
+        s["approx_pallas_vs_jnp_max_abs_diff"] <= args.tol
+        and s["approx_vs_exact_max_abs_diff"] <= args.approx_tol
+        and s["approx_argmax_agree"]
+        for s in out["shapes"])
     # the fused-COMPUTE kernel carries the documented opt-in tolerance
     # (in-kernel fp32 dots vs XLA-HIGHEST einsums): scores ~1e-4, row
     # values ~1e-5 of O(1/H)-scale probabilities

@@ -77,6 +77,7 @@ _SLOW_TESTS = {
     "test_paper_scripts_end_to_end",
     "test_gather_matches_xla_path",
     "test_fused_compute_refresh_real_data_trace",
+    "test_fused_compute_long_horizon_widepool_trace",
 }
 
 

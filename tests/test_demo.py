@@ -269,6 +269,53 @@ def test_build_pool_resume_skips_existing(image_dir, tmp_path):
     assert calls["n"] == first
 
 
+def test_bioclip_scorer_wiring_with_stub(image_dir, tmp_path, monkeypatch):
+    """The pybioclip branch (previously zero-coverage — the library is
+    absent in this image, so the import gate always skipped it): inject a
+    fake ``bioclip`` module and drive the REAL ``_bioclip_scorer`` wiring
+    through ``build_pool`` — name-based backend inference, the
+    one-classifier-per-class-list cache, the predict -> by-label score
+    mapping, and assembly into the (H, N, C) tensor."""
+    import sys
+    import types
+
+    calls = {"init": 0, "predict": 0}
+
+    class FakeClassifier:
+        def __init__(self, classes):
+            calls["init"] += 1
+            self.classes = list(classes)
+
+        def predict(self, image_path):
+            calls["predict"] += 1
+            assert os.path.exists(image_path)
+            # pybioclip's record schema: one dict per class
+            return [{"classification": c, "score": float(i + 1)}
+                    for i, c in enumerate(self.classes)]
+
+    mod = types.ModuleType("bioclip")
+    mod.CustomLabelsClassifier = FakeClassifier
+    monkeypatch.setitem(sys.modules, "bioclip", mod)
+
+    from demo.hf_zeroshot import build_pool, make_scorer
+
+    # backend inference: a name containing 'bioclip' routes to the branch
+    scorer = make_scorer("imageomics/bioclip")
+    assert scorer(os.path.join(image_dir, "img_00.png"),
+                  ["x", "y"]) == [1.0, 2.0]
+
+    classes = ["a", "b", "c"]
+    preds = build_pool(image_dir, classes, str(tmp_path / "pool"),
+                       models=["imageomics/bioclip"])
+    assert preds.shape == (1, 6, 3)
+    # by-label mapping preserved the per-class scores for every image
+    np.testing.assert_allclose(preds[0], np.tile([1.0, 2.0, 3.0], (6, 1)))
+    # ONE classifier instance per class list, not per image (the cache);
+    # the make_scorer smoke call above built its own for ["x", "y"]
+    assert calls["init"] == 2
+    assert calls["predict"] == 1 + 6
+
+
 def test_build_pool_unavailable_backend_is_gated(image_dir, tmp_path):
     """A model whose library is missing is skipped, not fatal."""
     from demo import hf_zeroshot

@@ -1,0 +1,383 @@
+"""The eig_entropy='approx' fast-entropy scoring path.
+
+Contract under test (the ISSUE-2 opt-in numerics bar):
+
+  * ``log2_approx`` holds max |Δlog2| <= 1e-5 over the whole clamped
+    entropy domain [1e-12, 1] (measured ~6.9e-6: degree-6 mantissa
+    polynomial, fit error 5.1e-6, plus fp32 evaluation noise);
+  * EIG scores from the approx lowering hold the COMMITTED bound
+    max |Δscore| <= 1e-4 vs the exact path (measured ~2e-5 at worst
+    over adversarial caches; |Δscore| <= 2·max|Δlog2| analytically,
+    since each mixture row sums to ~1 over models and the pi_xi class
+    weights sum to 1);
+  * the jnp and pallas approx lowerings agree with each other as
+    tightly as the exact pair (same polynomial, same reduction order),
+    so auto backend routing never changes numerics class;
+  * a >=30-round selection trace on the committed REAL digits task is
+    IDENTICAL to the default path's (argmax ordering survives the
+    perturbation);
+  * the default stays byte-identical (existing parity tests cover it;
+    the guards here pin the knob's error surface).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+_DIGITS = os.path.join(os.path.dirname(__file__), "..", "data",
+                       "digits.npz")
+
+
+def _random_cache(key, N, C, H, floor_frac=0.0):
+    """Random normalized cache tensors; ``floor_frac`` of the hyp entries
+    are zeroed so the scoring clamp engages exactly at the 1e-12 floor —
+    the edge of the approx domain."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    rows = jax.random.uniform(k1, (C, H)) + 0.1
+    rows /= rows.sum(-1, keepdims=True)
+    hyp = jax.random.uniform(k2, (C, N, H)) + 0.01
+    if floor_frac:
+        mask = jax.random.uniform(k4, hyp.shape) < floor_frac
+        hyp = jnp.where(mask, 0.0, hyp)
+    hyp /= jnp.clip(hyp.sum(-1, keepdims=True), 1e-30, None)
+    pi_xi = jax.random.uniform(k3, (N, C))
+    pi_xi /= pi_xi.sum(-1, keepdims=True)
+    pi = pi_xi.mean(0)
+    return rows, hyp, pi / pi.sum(), pi_xi
+
+
+def test_log2_approx_bound_on_clamped_domain():
+    """max |Δlog2| <= 1e-5 over [1e-12, 1] — log-uniform + linear sweeps
+    + the exact floor/ceiling endpoints."""
+    from coda_tpu.ops.masked import log2_approx
+
+    rng = np.random.default_rng(0)
+    xs = np.concatenate([
+        10.0 ** rng.uniform(-12, 0, 200_000),
+        np.linspace(1e-12, 1.0, 200_000),
+        [1e-12, 1.0, 0.5, 2 ** -40],
+    ]).astype(np.float32)
+    xs = np.clip(xs, 1e-12, 1.0)
+    got = np.asarray(jax.jit(log2_approx)(jnp.asarray(xs)), np.float64)
+    want = np.log2(xs.astype(np.float64))
+    assert np.max(np.abs(got - want)) <= 1e-5
+
+
+def test_entropy2_approx_bound():
+    """|ΔH| of simplex rows is bounded by max |Δlog2| (errors scale with
+    Σp = 1); exact mode stays the default and untouched."""
+    from coda_tpu.ops.masked import entropy2
+
+    rng = np.random.default_rng(1)
+    p = rng.dirichlet(np.full(1000, 0.1), size=500).astype(np.float32)
+    p = jnp.asarray(p)
+    h_ex = np.asarray(entropy2(p), np.float64)
+    h_ap = np.asarray(entropy2(p, approx=True), np.float64)
+    assert np.max(np.abs(h_ex - h_ap)) <= 1e-5
+    # the default signature is unchanged exact math
+    np.testing.assert_array_equal(np.asarray(entropy2(p)),
+                                  np.asarray(entropy2(p, approx=False)))
+
+
+def test_eig_scores_approx_committed_bound():
+    """THE committed accuracy bound: max |Δscore| <= 1e-4 between the
+    exact and approx lowerings of the incremental scoring pass, over
+    caches that include floor-clamped (zero-probability) entries."""
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    worst = 0.0
+    for seed, (N, C, H, frac) in enumerate(
+            [(300, 5, 12, 0.0), (257, 4, 40, 0.3), (96, 10, 100, 0.1)]):
+        rows, hyp, pi, pi_xi = _random_cache(
+            jax.random.PRNGKey(seed), N, C, H, floor_frac=frac)
+        ex = np.asarray(eig_scores_from_cache(rows, hyp, pi, pi_xi,
+                                              chunk=64))
+        ap = np.asarray(eig_scores_from_cache(rows, hyp, pi, pi_xi,
+                                              chunk=64, approx=True))
+        worst = max(worst, float(np.max(np.abs(ex - ap))))
+        assert int(ex.argmax()) == int(ap.argmax())
+    assert worst <= 1e-4, worst
+
+
+def test_factored_and_rowscan_approx_bound():
+    """The non-incremental jnp tiers carry the same knob and the same
+    bound (auto tier fallback must not change numerics class)."""
+    from coda_tpu.ops.confusion import (
+        create_confusion_matrices,
+        ensemble_preds,
+        initialize_dirichlets,
+    )
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.selectors.coda import (
+        eig_scores_factored,
+        eig_scores_rowscan,
+        update_pi_hat,
+    )
+
+    t = make_synthetic_task(seed=2, H=8, N=96, C=5)
+    preds = t.preds
+    hard = preds.argmax(-1).T.astype(jnp.int32)
+    ens = ensemble_preds(preds).argmax(-1)
+    dirichlets = 2.0 * initialize_dirichlets(
+        create_confusion_matrices(ens, preds, mode="soft"), 0.1, False)
+    pi_xi, pi = update_pi_hat(dirichlets, preds)
+    for fn in (eig_scores_factored, eig_scores_rowscan):
+        ex = np.asarray(fn(dirichlets, pi, pi_xi, hard, num_points=64,
+                           chunk=32))
+        ap = np.asarray(fn(dirichlets, pi, pi_xi, hard, num_points=64,
+                           chunk=32, approx=True))
+        assert np.max(np.abs(ex - ap)) <= 1e-4
+        assert int(ex.argmax()) == int(ap.argmax())
+
+
+def test_pallas_approx_matches_jnp_approx():
+    """The two lowerings of the SAME polynomial chain agree like the
+    exact pair does — including a ragged final block."""
+    from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    for seed, (N, C, H, blk) in enumerate([(300, 5, 12, 64), (77, 4, 9, 32)]):
+        rows, hyp, pi, pi_xi = _random_cache(
+            jax.random.PRNGKey(10 + seed), N, C, H, floor_frac=0.2)
+        ref = np.asarray(eig_scores_from_cache(rows, hyp, pi, pi_xi,
+                                               chunk=blk, approx=True))
+        pal = np.asarray(eig_scores_cache_pallas(
+            rows, hyp, pi, pi_xi, block=blk, interpret=True, approx=True))
+        np.testing.assert_allclose(ref, pal, rtol=1e-4, atol=1e-6)
+        assert int(ref.argmax()) == int(pal.argmax())
+
+
+def test_refresh_kernel_approx_matches_dus_then_score():
+    """The fused refresh+score kernel under approx == DUS the row, then
+    jnp-approx score; the returned cache is unaffected by the entropy
+    flavor (entropy only shapes scores)."""
+    from coda_tpu.ops.pallas_eig import eig_scores_refresh_pallas
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    N, C, H = 200, 7, 11
+    rows, hyp, pi, pi_xi = _random_cache(jax.random.PRNGKey(3), N, C, H)
+    hyp_t = jax.random.uniform(jax.random.PRNGKey(4), (N, H)) + 0.1
+    hyp_t /= hyp_t.sum(-1, keepdims=True)
+    c = jnp.int32(2)
+    hyp_ref = hyp.at[c].set(hyp_t)
+    ref = np.asarray(eig_scores_from_cache(rows, hyp_ref, pi, pi_xi,
+                                           chunk=48, approx=True))
+    scores, hyp_out = eig_scores_refresh_pallas(
+        rows, hyp, hyp_t, c, pi, pi_xi, block=48, interpret=True,
+        approx=True)
+    np.testing.assert_allclose(ref, np.asarray(scores), rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(hyp_ref), np.asarray(hyp_out))
+
+
+def test_fused_compute_kernel_approx():
+    """eig_refresh='fused' composes with eig_entropy='approx': the
+    in-kernel row computation is entropy-flavor-independent, the scoring
+    tail follows the knob."""
+    from coda_tpu.ops.beta import dirichlet_to_beta
+    from coda_tpu.ops.pallas_eig import eig_scores_refresh_compute_pallas
+    from coda_tpu.ops.pbest import compute_pbest
+    from coda_tpu.selectors.coda import (
+        eig_scores_from_cache,
+        update_eig_cache_parts,
+    )
+
+    N, C, H = 77, 4, 10
+    dir_ = jax.random.uniform(jax.random.PRNGKey(5), (H, C, C)) * 3.0 + 0.5
+    hard = jax.random.randint(jax.random.PRNGKey(6), (N, H), 0,
+                              C).astype(jnp.int32)
+    a_cc, b_cc = dirichlet_to_beta(dir_)
+    c = jnp.int32(1)
+    a_t, b_t = a_cc[:, c], b_cc[:, c]
+    rows = compute_pbest(a_cc.T, b_cc.T).at[c].set(compute_pbest(a_t, b_t))
+    rows2, hyp, pi, pi_xi = _random_cache(jax.random.PRNGKey(7), N, C, H)
+    del rows2
+    _, hyp_t_ref = update_eig_cache_parts(dir_, c, hard)
+    s_ref = np.asarray(eig_scores_from_cache(
+        rows, hyp.at[c].set(hyp_t_ref), pi, pi_xi, chunk=32, approx=True))
+    s_fc, hyp_fc = eig_scores_refresh_compute_pallas(
+        rows, hyp, a_t, b_t, hard, c, pi, pi_xi, block=32, interpret=True,
+        approx=True)
+    # the in-kernel dots carry the fused-compute tolerance (measured
+    # 2.34e-4 on silicon); the approx entropy adds its own <=1e-4
+    np.testing.assert_allclose(s_ref, np.asarray(s_fc), rtol=1e-3,
+                               atol=2e-5)
+
+
+def test_batched_and_vmapped_approx_dispatch():
+    """vmapped approx callers ride the batched kernels (and the jnp
+    fallback) with the approx flag intact — per-element parity with the
+    jnp approx composition."""
+    from coda_tpu.ops.pallas_eig import eig_scores_cache_pallas
+    from coda_tpu.selectors.coda import eig_scores_from_cache
+
+    B = 3
+    keys = jax.random.split(jax.random.PRNGKey(8), B)
+    packs = [_random_cache(k, 64, 4, 10) for k in keys]
+    rows = jnp.stack([p[0] for p in packs])
+    hyp = jnp.stack([p[1] for p in packs])
+    pi = jnp.stack([p[2] for p in packs])
+    pi_xi = jnp.stack([p[3] for p in packs])
+    out = jax.vmap(
+        lambda r, h, p, px: eig_scores_cache_pallas(
+            r, h, p, px, block=32, approx=True)
+    )(rows, hyp, pi, pi_xi)
+    ref = jax.vmap(
+        lambda r, h, p, px: eig_scores_from_cache(
+            r, h, p, px, chunk=32, approx=True)
+    )(rows, hyp, pi, pi_xi)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.skipif(not os.path.exists(_DIGITS),
+                    reason="committed digits task not present")
+def test_approx_real_digits_trace_parity():
+    """THE committed trace-parity bar: >=30 rounds on the REAL digits
+    task, eig_entropy='approx' (jnp lowering) vs the byte-identical
+    default — identical selection trace and best-model readout."""
+    from coda_tpu.data import Dataset
+    from coda_tpu.engine import run_experiment
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    ds = Dataset.from_file(_DIGITS)
+    r_def = run_experiment(
+        make_coda(ds.preds, CODAHyperparams(eig_mode="incremental")),
+        ds, iters=30, seed=0)
+    r_apx = run_experiment(
+        make_coda(ds.preds, CODAHyperparams(eig_mode="incremental",
+                                            eig_entropy="approx")),
+        ds, iters=30, seed=0)
+    np.testing.assert_array_equal(np.asarray(r_def.chosen_idx),
+                                  np.asarray(r_apx.chosen_idx))
+    np.testing.assert_array_equal(np.asarray(r_def.best_model),
+                                  np.asarray(r_apx.best_model))
+
+
+@pytest.mark.skipif(not os.path.exists(_DIGITS),
+                    reason="committed digits task not present")
+def test_approx_pallas_real_digits_trace_parity():
+    """Same bar through the PALLAS lowering (interpret mode here; the
+    identical kernels Mosaic-compile on silicon): approx + pallas
+    reproduces the default trace on the real digits task."""
+    from coda_tpu.data import Dataset
+    from coda_tpu.engine import run_experiment
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    ds = Dataset.from_file(_DIGITS)
+    r_def = run_experiment(
+        make_coda(ds.preds, CODAHyperparams(eig_mode="incremental")),
+        ds, iters=30, seed=0)
+    r_apx = run_experiment(
+        make_coda(ds.preds, CODAHyperparams(
+            eig_mode="incremental", eig_backend="pallas",
+            eig_entropy="approx")),
+        ds, iters=30, seed=0)
+    np.testing.assert_array_equal(np.asarray(r_def.chosen_idx),
+                                  np.asarray(r_apx.chosen_idx))
+    np.testing.assert_array_equal(np.asarray(r_def.best_model),
+                                  np.asarray(r_apx.best_model))
+
+
+def test_eig_entropy_guards():
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    t = make_synthetic_task(seed=1, H=4, N=32, C=4)
+    with pytest.raises(ValueError, match="unknown eig_entropy"):
+        make_coda(t.preds, CODAHyperparams(eig_entropy="Approx"))
+    # the direct tier is the reference-choreography cross-check: exact only
+    with pytest.raises(ValueError, match="exact entropy"):
+        make_coda(t.preds, CODAHyperparams(eig_mode="direct",
+                                           eig_entropy="approx"))
+
+
+def test_cli_eig_entropy_plumbs_to_selector():
+    """--eig-entropy reaches CODAHyperparams through the CLI factory (and
+    therefore through the suite's method_args, which set the same attr)."""
+    from coda_tpu.cli import build_selector_factory, parse_args
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine import run_experiment
+
+    t = make_synthetic_task(seed=3, H=5, N=48, C=4)
+    args = parse_args(["--synthetic", "5,48,4", "--method", "coda",
+                       "--eig-entropy", "approx", "--eig-chunk", "48"])
+    sel = build_selector_factory(args, "synthetic")(t.preds)
+    assert sel.hyperparams["eig_entropy"] == "approx"
+    # and the selector runs end to end with the approx scoring pass
+    res = run_experiment(sel, t, iters=5, seed=0)
+    assert np.isfinite(np.asarray(res.regret)).all()
+
+
+def test_suite_warm_profile_schema():
+    """SuiteRunner emits per-method AND per-family warm steady-state
+    seconds; a second pass over the same runner is all-warm (cold
+    attribution persists with the jit cache) and its warm profile
+    accounts every pair."""
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine.suite import SuiteRunner
+
+    loaders = [
+        lambda i=i: make_synthetic_task(seed=i, H=4, N=64, C=4,
+                                        name=f"fam_{i}")
+        for i in range(2)
+    ] + [lambda: make_synthetic_task(seed=9, H=4, N=32, C=4,
+                                     name="other_0")]
+    runner = SuiteRunner(iters=3, seeds=2)
+    runner.run(loaders, ["iid"], progress=lambda *_: None)
+    cold_stats = runner.last_stats
+    assert set(cold_stats["per_method_warm_s"]) <= {"iid"}
+    # warm rerun: every pair is compile-free, so the profile covers all
+    # 3 tasks across both families
+    runner.run(loaders, ["iid"], progress=lambda *_: None)
+    warm_stats = runner.last_stats
+    assert all(not p["cold"] for p in warm_stats["pairs"])
+    assert set(warm_stats["per_family_warm_s"]) == {"fam", "other"}
+    # the profile rounds to milliseconds; compare at that granularity
+    assert warm_stats["per_method_warm_s"]["iid"] == pytest.approx(
+        sum(p["seconds"] for p in warm_stats["pairs"]), abs=5e-3)
+
+
+def test_bench_suite_baseline_ratio():
+    """vs_baseline populates from the committed CPU capture exactly when
+    the run measured the baseline's sweep (full families, all methods,
+    5 seeds x 100 iters), preferring steady-state compute."""
+    import argparse
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    bs = importlib.import_module("scripts.bench_suite")
+
+    def mkargs(**kw):
+        base = dict(small=False, methods=bs._DEFAULT_METHODS, seeds=5,
+                    iters=100)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    line = {"value": 200.0, "steady_state_compute_s": 100.0,
+            "vs_baseline": 0.0}
+    bs._baseline_ratio(line, mkargs())
+    assert line["vs_baseline"] == pytest.approx(9501.6 / 100.0, rel=1e-3)
+    assert "steady_state" in line["vs_baseline_source"]
+
+    # no steady-state capture -> the cold value, labeled as such
+    line2 = {"value": 200.0, "vs_baseline": 0.0}
+    bs._baseline_ratio(line2, mkargs())
+    assert line2["vs_baseline"] == pytest.approx(9501.6 / 200.0, rel=1e-3)
+    assert "cold" in line2["vs_baseline_source"]
+
+    # non-comparable configs keep the 0.0 sentinel
+    for bad in (mkargs(small=True), mkargs(methods="iid"),
+                mkargs(seeds=3), mkargs(iters=10)):
+        line3 = {"value": 200.0, "vs_baseline": 0.0}
+        bs._baseline_ratio(line3, bad)
+        assert line3["vs_baseline"] == 0.0
+    # the median-of-reps profile helper: missing keys count as 0.0
+    med = bs._median_profile([{"a": 1.0, "b": 2.0}, {"a": 3.0}])
+    assert med == {"a": 2.0, "b": 1.0}
