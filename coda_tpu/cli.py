@@ -127,6 +127,17 @@ def parse_args(argv=None):
                         "choreography")
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
                    help="shard the (H,N,C) tensor, e.g. 'data=4' or 'data=4,model=2'")
+    p.add_argument("--suite-devices", default=None, metavar="auto|N",
+                   help="suite runs only (`cli suite`, run_suite, "
+                        "bench_suite): place independent task-method "
+                        "dispatches on this many local devices via the "
+                        "task-parallel scheduler ('auto' = all); default "
+                        "= serial dispatch on one device")
+    p.add_argument("--schedule", default="lpt", choices=["lpt", "fifo"],
+                   help="with --suite-devices: dispatch order — lpt = "
+                        "longest-processing-time-first from the "
+                        "per-family warm cost profile (default), fifo = "
+                        "caller order")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (cpu/tpu), e.g. for local runs")
     p.add_argument("--profile-dir", default=None,
@@ -290,7 +301,29 @@ def main(argv=None):
         from coda_tpu.serve.server import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "suite":
+        # `python -m coda_tpu.cli suite ...`: the in-process sweep driver
+        # (scripts/run_suite.py) — grows --task-batch/--suite-devices/
+        # --schedule for multi-device task-parallel execution
+        import importlib.util
+
+        fp = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                          "scripts", "run_suite.py")
+        if not os.path.exists(fp):
+            raise SystemExit(
+                "cli suite needs scripts/run_suite.py (repo checkout); "
+                "run it directly from an installed package instead")
+        spec = importlib.util.spec_from_file_location("run_suite", fp)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main(argv[1:])
     args = parse_args(argv)
+    if args.suite_devices is not None:
+        raise SystemExit(
+            "--suite-devices/--schedule configure suite sweeps, which the "
+            "single-task runner never dispatches; use "
+            "`python -m coda_tpu.cli suite ...` (or scripts/run_suite.py / "
+            "scripts/bench_suite.py)")
     from coda_tpu.utils.platform import pin_platform
 
     pin_platform(args.platform)

@@ -1,0 +1,329 @@
+"""Multi-device task-parallel scheduler for the in-process suite.
+
+``SuiteRunner.run_batched`` dispatches every (family-chunk, method) pair
+serially and blocks on the host copy before the next dispatch even starts —
+on a v5e-8 that leaves 7 chips idle for the whole sweep. The 156 task-method
+pairs are embarrassingly parallel across devices (no pair reads another's
+results), so this module places independent dispatches on distinct local
+devices and lets jax's async dispatch run them concurrently:
+
+  * **Placement**: each chunk's stacked operands are committed to a target
+    device with ``jax.device_put``; jit then executes the per-device
+    executable there. Placement is a pure copy — scheduled results are
+    bitwise identical to the serial path (same programs, same seed keys).
+  * **LPT ordering**: chunks are dispatched longest-processing-time-first
+    onto the least-loaded device (the classic greedy makespan heuristic),
+    with per-chunk costs estimated from the ``per_family_warm_s`` /
+    ``per_method_warm_s`` profiles the runner emits (persisted from prior
+    runs or a committed bench artifact) and a uniform fallback for unseen
+    families.
+  * **Deferred harvesting**: results go into a pending-futures queue and
+    are copied device-to-host asynchronously (``copy_to_host_async``), so
+    the host-side ``np.stack`` of the next chunk's operands and the store
+    logging of finished chunks overlap device compute instead of
+    serializing with it.
+  * **Memory budget**: ``max_inflight`` bounds queued chunks per device,
+    and any method with a ``batch_caps`` entry is treated as memory-heavy
+    (the caps exist precisely because those methods' per-replica state
+    rivals the prediction tensor) — two heavy chunks are never co-resident
+    on one device.
+
+The sweep's semantics are unchanged: same chunking, same resume-skip
+checks, same result unpacking — ``tests/test_scheduler.py`` pins bitwise
+parity against the serial path on the 8-virtual-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from coda_tpu.engine.suite import _warm_profile, family_of
+
+
+def resolve_devices(spec, jax=None) -> list:
+    """Local jax devices for a ``devices=`` spec.
+
+    ``'auto'`` -> all local devices; an int (or int-like string) -> the
+    first N local devices; a sequence of device ids or Device objects ->
+    exactly those. Raises on counts the host can't satisfy, so a
+    mis-sized ``--suite-devices`` fails loudly instead of silently
+    under-parallelizing.
+    """
+    if jax is None:
+        import jax
+    local = list(jax.local_devices())
+    if spec is None or spec == "auto":
+        return local
+    if isinstance(spec, str):
+        spec = int(spec)  # ValueError on junk is the right error
+    if isinstance(spec, int):
+        if not 1 <= spec <= len(local):
+            raise ValueError(
+                f"devices={spec} but this process has {len(local)} local "
+                f"devices")
+        return local[:spec]
+    out = []
+    by_id = {d.id: d for d in local}
+    for d in spec:
+        if isinstance(d, int):
+            if d not in by_id:
+                raise ValueError(f"no local device with id {d}")
+            out.append(by_id[d])
+        else:
+            out.append(d)
+    if not out:
+        raise ValueError("empty device list")
+    return out
+
+
+def estimate_cost(family: str, method: str, n_tasks: int,
+                  cost_profile: Optional[dict],
+                  family_task_counts: Optional[dict] = None) -> float:
+    """Relative LPT weight of one chunk (``n_tasks`` tasks of one family
+    under one method).
+
+    ``cost_profile`` is either a runner ``last_stats``-shaped dict with
+    ``per_family_warm_s`` / ``per_method_warm_s`` keys, or a flat
+    ``{family: seconds}`` mapping. A family's profiled seconds are a SUM
+    over its tasks, so they are normalized by this run's task count for
+    that family (``family_task_counts``) to get a per-task rate; method
+    weights are normalized to mean 1 so they only redistribute, never
+    rescale. Unseen families/methods fall back to the mean known rate
+    (uniform when nothing is known) — LPT only needs relative order, so
+    absolute scale is irrelevant.
+    """
+    prof = cost_profile or {}
+    fam_p = prof.get("per_family_warm_s", prof)
+    meth_p = prof.get("per_method_warm_s", {})
+    fam_p = {k: float(v) for k, v in fam_p.items()
+             if isinstance(v, (int, float))}
+    rates = {}
+    for fam, total in fam_p.items():
+        cnt = (family_task_counts or {}).get(fam, 0)
+        if cnt > 0:
+            rates[fam] = total / cnt
+    fallback = (sum(rates.values()) / len(rates)) if rates else 1.0
+    rate = rates.get(family, fallback)
+    w_m = 1.0
+    if meth_p:
+        vals = [float(v) for v in meth_p.values()]
+        mean = sum(vals) / len(vals)
+        if mean > 0 and method in meth_p:
+            w_m = float(meth_p[method]) / mean
+    return max(rate * w_m * n_tasks, 1e-9)
+
+
+def plan_schedule(costs: Sequence[float], n_devices: int,
+                  schedule: str = "lpt"):
+    """Dispatch order + device assignment for chunk ``costs``.
+
+    ``'lpt'`` sorts chunks by descending cost (ties keep input order) and
+    greedily assigns each to the currently least-loaded device — the
+    longest-processing-time-first makespan heuristic (≤ 4/3·OPT).
+    ``'fifo'`` keeps the input order with the same least-loaded placement.
+    Returns ``(order, assignment, loads)``: the dispatch order as indices
+    into ``costs``, the device index per chunk (input order), and the
+    estimated per-device load.
+    """
+    if schedule not in ("lpt", "fifo"):
+        raise ValueError(f"unknown schedule {schedule!r}; use 'lpt'|'fifo'")
+    idx = list(range(len(costs)))
+    if schedule == "lpt":
+        idx.sort(key=lambda i: (-costs[i], i))
+    loads = [0.0] * n_devices
+    assignment = [0] * len(costs)
+    for i in idx:
+        d = min(range(n_devices), key=lambda j: (loads[j], j))
+        assignment[i] = d
+        loads[d] += costs[i]
+    return idx, assignment, loads
+
+
+@dataclass
+class _Chunk:
+    """One schedulable dispatch: a todo-subset of one group, one method."""
+
+    group: int
+    todo: list
+    method: str
+    names: list        # the full group's names (todo indexes into it)
+    shape: tuple
+    family: str
+    heavy: bool
+    cost: float = 0.0
+
+
+@dataclass
+class _HostTask:
+    """Host-side staging of one loaded task for the scheduler.
+
+    The plan phase holds EVERY group at once (global LPT needs the full
+    work list), so tensors must not sit in device memory meanwhile —
+    loaders materialize onto the default device, and the full reference
+    suite would blow one chip's HBM before the first dispatch. Copying to
+    numpy here frees the loader's device buffers immediately; device
+    memory then only ever holds in-flight chunks, and ``_launch_batch``'s
+    per-chunk ``np.asarray`` becomes a no-op instead of a repeated
+    device-to-host copy per (method, chunk)."""
+
+    name: str
+    preds: np.ndarray
+    labels: np.ndarray
+
+    @property
+    def shape(self):
+        return self.preds.shape
+
+
+def _all_ready(pend, jax) -> bool:
+    return all(leaf.is_ready()
+               for leaf in jax.tree_util.tree_leaves((pend.r0, pend.rest)))
+
+
+def run_scheduled(runner, groups, methods, *, store=None, force_rerun=False,
+                  method_args=None, batch_caps=None, progress=print,
+                  devices="auto", schedule="lpt", cost_profile=None,
+                  max_inflight=2) -> dict:
+    """``SuiteRunner.run_batched`` with task-parallel device placement.
+
+    Same contract as the serial path (chunking, resume, result layout,
+    bitwise-identical numbers); see the module docstring for what runs
+    concurrently. Groups are fully loaded before the compute phase so the
+    whole work list can be LPT-ordered globally — host memory briefly
+    holds every group (device memory still only holds in-flight chunks);
+    callers for whom that is too much should fall back to the serial
+    path's one-group-at-a-time streaming.
+    """
+    jax = runner._jax
+    devs = resolve_devices(devices, jax)
+    max_inflight = max(1, int(max_inflight))
+    results: dict = {}
+    pairs: list = []
+    t_suite0 = time.perf_counter()
+    t_load = 0.0
+
+    # ---- plan phase: load groups, enumerate chunks (chunking identical
+    # to the serial path so executables and T-keys match bitwise)
+    group_data: list = []
+    chunks: list = []
+    fam_counts: dict = {}
+    for gi, group in enumerate(groups):
+        t0 = time.perf_counter()
+        datasets = [d() if callable(d) else d for d in group]
+        names, planned = runner._plan_group(
+            datasets, methods, store, force_rerun, batch_caps, progress)
+        # stage on host, dropping the loader's device-resident tensors
+        datasets = [_HostTask(name=d.name, preds=np.asarray(d.preds),
+                              labels=np.asarray(d.labels))
+                    for d in datasets]
+        t_load += time.perf_counter() - t0
+        group_data.append(datasets)
+        for n in names:
+            fam = family_of(n)
+            fam_counts[fam] = fam_counts.get(fam, 0) + 1
+        for method, todo in planned:
+            chunks.append(_Chunk(
+                group=gi, todo=list(todo), method=method, names=names,
+                shape=tuple(datasets[0].shape),
+                family=family_of(names[todo[0]]),
+                heavy=method in (batch_caps or {})))
+    for ch in chunks:
+        ch.cost = estimate_cost(ch.family, ch.method, len(ch.todo),
+                                cost_profile, fam_counts)
+    order, assignment, est_loads = plan_schedule(
+        [c.cost for c in chunks], len(devs), schedule)
+
+    # ---- compute phase: throttled async dispatch + deferred harvest
+    pending: dict = {i: [] for i in range(len(devs))}
+    harvested: list = []
+    timeline: dict = {d.id: [] for d in devs}
+    remaining = [sum(1 for c in chunks if c.group == gi)
+                 for gi in range(len(group_data))]
+    for gi, n in enumerate(remaining):
+        if n == 0:   # fully-finished group (resume): nothing will free it
+            group_data[gi] = None
+    t_compute0 = None
+
+    def _harvest(di: int, pend) -> None:
+        runner._harvest_batch(pend, store, pairs, results, progress)
+        harvested.append(pend)
+        timeline[devs[di].id].append({
+            "method": pend.method, "tasks": list(pend.names),
+            "start": round(pend.t_start - t_compute0, 4),
+            "end": round(pend.t_end - t_compute0, 4),
+            "est_cost": round(pend.cost, 4), "cold": pend.cold,
+        })
+
+    for ci in order:
+        ch = chunks[ci]
+        di = assignment[ci]
+        q = pending[di]
+        # throttle before staging the next chunk's HBM: at most
+        # max_inflight chunks queued per device, and never two
+        # memory-heavy chunks co-resident on one device
+        while len(q) >= max_inflight or (
+                ch.heavy and any(p.heavy for p in q)):
+            _harvest(di, q.pop(0))
+        # opportunistic drain: anything already finished anywhere frees
+        # its device buffers and does its store logging now, overlapping
+        # the dispatches below
+        for dj, qj in pending.items():
+            while qj and _all_ready(qj[0], jax):
+                _harvest(dj, qj.pop(0))
+        if t_compute0 is None:
+            t_compute0 = time.perf_counter()
+        pend = runner._launch_batch(
+            ch.todo, ch.names, group_data[ch.group], ch.method,
+            method_args, ch.shape, runner._seen_shapes,
+            device=devs[di], cost=ch.cost)
+        pend.heavy = ch.heavy
+        q.append(pend)
+        remaining[ch.group] -= 1
+        if remaining[ch.group] == 0:
+            group_data[ch.group] = None  # free the group's tensors
+    # final drain, oldest dispatch first (approximates completion order)
+    tail = sorted(((di, p) for di, q in pending.items() for p in q),
+                  key=lambda t: t[1].t_start)
+    for di, p in tail:
+        _harvest(di, p)
+
+    t_end = time.perf_counter()
+    compute_wall = (t_end - t_compute0) if t_compute0 is not None else 0.0
+    compute_device_s = sum(p.t_end - p.t_start for p in harvested)
+    occupancy = {}
+    for d in devs:
+        busy, last = 0.0, None
+        for rec in sorted(timeline[d.id], key=lambda r: r["start"]):
+            s, e = rec["start"], rec["end"]
+            if last is None or s > last:
+                busy += e - s
+                last = e
+            elif e > last:   # overlapping in-flight intervals: count once
+                busy += e - last
+                last = e
+        occupancy[d.id] = round(busy / compute_wall, 4) if compute_wall \
+            else 0.0
+
+    total = t_end - t_suite0
+    warm_m, warm_f = _warm_profile(pairs)
+    runner.last_stats = {
+        "total_s": total, "load_s": t_load,
+        "compute_s": compute_wall,
+        "compute_device_s": compute_device_s,
+        "pairs": pairs,
+        "per_method_warm_s": warm_m, "per_family_warm_s": warm_f,
+        "n_devices": len(devs), "schedule": schedule,
+        "device_timeline": timeline, "occupancy": occupancy,
+        "est_device_load": {devs[i].id: round(est_loads[i], 4)
+                            for i in range(len(devs))},
+    }
+    progress(f"suite[scheduled x{len(devs)}]: {len(results)} task-method "
+             f"pairs in {total:.2f}s (compute wall {compute_wall:.2f}s, "
+             f"device-seconds {compute_device_s:.2f}s, data load "
+             f"{t_load:.2f}s, occupancy "
+             f"{ {k: v for k, v in sorted(occupancy.items())} })")
+    return results

@@ -14,18 +14,56 @@ whole sweep fits one process:
     streamed to the tracking store afterward.
 
 ``scripts/run_suite.py`` is the CLI; the SLURM launcher remains for
-multi-node fan-out where one host's HBM can't hold a task.
+multi-node fan-out where one host's HBM can't hold a task. On multi-chip
+hosts ``run_batched(devices=...)`` hands the dispatch loop to the
+task-parallel scheduler (``engine/scheduler.py``): independent
+(family-chunk, method) dispatches placed on distinct devices, LPT-ordered
+from the per-family warm cost profile, results harvested through a
+deferred pending-futures queue — bitwise-identical to serial dispatch.
 """
 
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
 import numpy as np
 
 from coda_tpu.engine.loop import make_batched_experiment_fn
 from coda_tpu.losses import LOSS_FNS
+
+
+@dataclass
+class PendingBatch:
+    """One in-flight ``run_batched`` dispatch awaiting host harvest.
+
+    ``r0``/``rest`` are device results whose computation (and, once
+    ``copy_to_host_async`` has been issued, device-to-host copy) may still
+    be running — jax's async dispatch returns them as futures. Harvesting
+    (:meth:`SuiteRunner._harvest_batch`) blocks on them; everything the
+    harvest needs to unpack, log, and attribute the chunk rides here."""
+
+    names: list
+    method: str
+    shape: tuple
+    cold: bool
+    r0: object
+    rest: object
+    t_start: float          # perf_counter at dispatch
+    device: object = None   # jax Device under scheduled placement, else None
+    cost: float = 0.0       # scheduler's relative LPT weight (telemetry)
+    heavy: bool = False     # memory-heavy (method has a batch_caps entry)
+    t_end: float = field(default=0.0)  # set by harvest
+
+def family_of(name: str) -> str:
+    """Task-name family: the prefix before a trailing ``_<index>``
+    (``domainnet_3`` -> ``domainnet``); a name without a numeric suffix is
+    its own family. The ONE definition shared by the warm profiles and the
+    scheduler's LPT cost model, so profile keys always match cost keys."""
+    fam, _, idx = name.rpartition("_")
+    return fam if fam and idx.isdigit() else name
+
 
 def _warm_profile(pairs) -> tuple[dict, dict]:
     """Per-method and per-family WARM seconds from the pair records.
@@ -34,17 +72,14 @@ def _warm_profile(pairs) -> tuple[dict, dict]:
     a steady-state rerun — where every executable is cached — these ARE
     the per-method / per-family steady-state breakdown the cold-inclusive
     ``per_method_s`` cannot provide (a method whose 26 pairs are all cold
-    reports compile time, not compute). Family is the task-name prefix
-    before a trailing ``_<index>`` (``domainnet_3`` -> ``domainnet``); a
-    name without a numeric suffix is its own family.
+    reports compile time, not compute). Family per :func:`family_of`.
     """
     per_method: dict = {}
     per_family: dict = {}
     for p in pairs:
         if p.get("cold"):
             continue
-        fam, _, idx = p["task"].rpartition("_")
-        fam = fam if fam and idx.isdigit() else p["task"]
+        fam = family_of(p["task"])
         per_method[p["method"]] = per_method.get(p["method"], 0.0) \
             + p["seconds"]
         per_family[fam] = per_family.get(fam, 0.0) + p["seconds"]
@@ -280,7 +315,8 @@ class SuiteRunner:
         total = time.perf_counter() - t_start
         warm_m, warm_f = _warm_profile(pairs)
         self.last_stats = {"total_s": total, "load_s": t_load,
-                           "compute_s": t_compute, "pairs": pairs,
+                           "compute_s": t_compute,
+                           "compute_device_s": t_compute, "pairs": pairs,
                            "per_method_warm_s": warm_m,
                            "per_family_warm_s": warm_f}
         progress(f"suite: {len(results)} task-method pairs in {total:.2f}s "
@@ -296,6 +332,10 @@ class SuiteRunner:
         method_args: Optional[dict] = None,
         batch_caps: Optional[dict] = None,
         progress: Callable[[str], None] = print,
+        devices=None,
+        schedule: str = "lpt",
+        cost_profile: Optional[dict] = None,
+        max_inflight: int = 2,
     ) -> dict:
         """The sweep with same-shape tasks BATCHED into one program.
 
@@ -330,7 +370,32 @@ class SuiteRunner:
         score-parity-tested, same caveat as ``run_one``'s dedup note.
         Sharded prediction tensors are not supported here (the task axis
         would need its own mesh dimension); use ``run``.
+
+        ``devices`` opts into the task-parallel scheduler
+        (``engine/scheduler.py``): independent (chunk, method) dispatches
+        are placed on distinct local devices — 'auto' (all local devices),
+        an int count, or an explicit device list — ordered
+        longest-processing-time-first by ``schedule='lpt'`` using
+        ``cost_profile`` (a ``per_family_warm_s``/``per_method_warm_s``
+        dict from a prior run's ``last_stats`` or a committed bench
+        artifact; uniform weights when absent), with results harvested
+        through a deferred pending-futures queue instead of an inline
+        blocking copy. ``max_inflight`` bounds queued chunks per device;
+        methods with a ``batch_caps`` entry are treated as memory-heavy
+        and are never co-resident with another heavy chunk on one device.
+        Placement never changes numerics: the scheduled results are
+        bitwise identical to ``devices=None`` (same executables, same
+        seed keys — pinned by ``tests/test_scheduler.py``).
+        ``devices=None`` (default) is the serial path.
         """
+        if devices is not None:
+            from coda_tpu.engine.scheduler import run_scheduled
+
+            return run_scheduled(
+                self, groups, methods, store=store, force_rerun=force_rerun,
+                method_args=method_args, batch_caps=batch_caps,
+                progress=progress, devices=devices, schedule=schedule,
+                cost_profile=cost_profile, max_inflight=max_inflight)
         results: dict = {}
         t_start = time.perf_counter()
         t_load = 0.0
@@ -341,52 +406,75 @@ class SuiteRunner:
             t0 = time.perf_counter()
             datasets = [d() if callable(d) else d for d in group]
             t_load += time.perf_counter() - t0
-            shapes = {tuple(d.shape) for d in datasets}
-            if len(shapes) != 1:
-                raise ValueError(
-                    f"run_batched group mixes shapes {sorted(shapes)}; "
-                    "group tasks by shape"
-                )
-            names = [d.name for d in datasets]
-            for method in methods:
-                todo = [
-                    i for i, n in enumerate(names)
-                    if force_rerun or not (store is not None and _finished(
-                        store, n, method, self.seeds))
-                ]
-                for i, n in enumerate(names):
-                    if i not in todo:
-                        progress(f"skip {n}/{method} (finished)")
-                if not todo:
-                    continue
-                cap = (batch_caps or {}).get(method)
-                if callable(cap):
-                    cap = cap(*datasets[0].shape)
-                cap = cap or len(todo)
-                for chunk in (todo[j:j + cap]
-                              for j in range(0, len(todo), cap)):
-                    self._dispatch_batch(
-                        chunk, names, datasets, method, method_args,
-                        datasets[0].shape, store, seen_shapes, pairs,
-                        results, progress)
-                    t_compute += pairs[-1]["seconds"] * pairs[-1]["batched"]
+            names, planned = self._plan_group(
+                datasets, methods, store, force_rerun, batch_caps, progress)
+            for method, chunk in planned:
+                pend = self._launch_batch(
+                    chunk, names, datasets, method, method_args,
+                    datasets[0].shape, seen_shapes)
+                self._harvest_batch(pend, store, pairs, results,
+                                    progress)
+                # serial: each chunk's wall IS its device time (the
+                # harvest blocks inline), so the two compute totals
+                # coincide here — they diverge under the scheduler
+                t_compute += pend.t_end - pend.t_start
         total = time.perf_counter() - t_start
         warm_m, warm_f = _warm_profile(pairs)
         self.last_stats = {"total_s": total, "load_s": t_load,
-                           "compute_s": t_compute, "pairs": pairs,
+                           "compute_s": t_compute,
+                           "compute_device_s": t_compute, "pairs": pairs,
                            "per_method_warm_s": warm_m,
-                           "per_family_warm_s": warm_f}
+                           "per_family_warm_s": warm_f,
+                           "n_devices": 1, "schedule": "serial",
+                           "device_timeline": {}, "occupancy": {}}
         progress(f"suite[batched]: {len(results)} task-method pairs in "
                  f"{total:.2f}s (compute {t_compute:.2f}s, data load "
                  f"{t_load:.2f}s)")
         return results
 
-    def _dispatch_batch(self, todo, names, datasets, method,
-                        method_args, shape, store, seen_shapes, pairs,
-                        results, progress) -> None:
-        """One stacked dispatch of ``todo``'s tasks for one method (the
-        run_batched inner body: probe + rest, broadcast/concat per task,
-        logging, timing records)."""
+    def _plan_group(self, datasets, methods, store, force_rerun,
+                    batch_caps, progress):
+        """Validate one loaded group and enumerate its dispatch chunks as
+        ``(method, todo_indices)`` pairs — the resume-skip and batch_caps
+        chunking shared VERBATIM by the serial loop and the scheduler's
+        plan phase (the scheduler's bitwise-parity contract requires the
+        chunking, and therefore the executables' T keys, to be identical
+        in both paths)."""
+        shapes = {tuple(d.shape) for d in datasets}
+        if len(shapes) != 1:
+            raise ValueError(
+                f"run_batched group mixes shapes {sorted(shapes)}; "
+                "group tasks by shape"
+            )
+        names = [d.name for d in datasets]
+        planned = []
+        for method in methods:
+            todo = [
+                i for i, n in enumerate(names)
+                if force_rerun or not (store is not None and _finished(
+                    store, n, method, self.seeds))
+            ]
+            for i, n in enumerate(names):
+                if i not in todo:
+                    progress(f"skip {n}/{method} (finished)")
+            if not todo:
+                continue
+            cap = (batch_caps or {}).get(method)
+            if callable(cap):
+                cap = cap(*datasets[0].shape)
+            cap = cap or len(todo)
+            planned += [(method, todo[j:j + cap])
+                        for j in range(0, len(todo), cap)]
+        return names, planned
+
+    def _launch_batch(self, todo, names, datasets, method, method_args,
+                      shape, seen_shapes, device=None,
+                      cost: float = 0.0) -> PendingBatch:
+        """Stack and DISPATCH one chunk of ``todo``'s tasks for one method;
+        returns a :class:`PendingBatch` whose device results are still
+        in flight (jax async dispatch). The serial path harvests it
+        immediately; the scheduler queues it and harvests later so the
+        next chunk's host-side stacking overlaps this one's compute."""
         resolved = [self._resolved_args(method, method_args,
                                         names[i]) for i in todo]
         statics = [self._static_resolved(r, method) for r in resolved]
@@ -408,27 +496,70 @@ class SuiteRunner:
         # re-stacking per (method, chunk) when the group is dispatched
         # whole.
         jnp = self._jax.numpy
-        preds_m = jnp.stack([datasets[i].preds for i in todo])
-        labels_m = jnp.stack([datasets[i].labels for i in todo])
         names_m = [names[i] for i in todo]
+        keys0, keys_rest = self._keys[:1], self._keys[1:]
+        if device is None:
+            preds_m = jnp.stack([datasets[i].preds for i in todo])
+            labels_m = jnp.stack([datasets[i].labels for i in todo])
+        else:
+            # scheduled placement: stack on HOST, commit the operands to
+            # the target device — jit then runs the per-device executable
+            # there. The seed keys ride along committed too (mixing a
+            # committed operand with uncommitted keys would work, but
+            # pinning everything keeps placement explicit). Pure copies:
+            # bitwise identical to the jnp.stack path above.
+            put = lambda x: self._jax.device_put(x, device)
+            preds_m = put(np.stack(
+                [np.asarray(datasets[i].preds) for i in todo]))
+            labels_m = put(np.stack(
+                [np.asarray(datasets[i].labels) for i in todo]))
+            keys0, keys_rest = put(keys0), put(keys_rest)
         extra = self._extra_args(method, resolved, batched=True)
+        if device is not None:
+            extra = tuple(self._jax.device_put(e, device) for e in extra)
         shape_key = (method, tuple(sorted(statics[0].items())),
                      tuple(shape), T)
+        if device is not None:
+            # per-device executables each pay their own compile; attribute
+            # cold per placement so the warm profile stays compile-free
+            shape_key += (device.id,)
         cold = shape_key not in seen_shapes
         seen_shapes.add(shape_key)
         t0 = time.perf_counter()
         probe_fn = self._fn_for(method, method_args, names_m[0],
                                 width=1, n_tasks=T)
-        r0 = probe_fn(preds_m, labels_m, self._keys[:1], *extra)
+        r0 = probe_fn(preds_m, labels_m, keys0, *extra)
         rest = None
         if self.seeds > 1:
             rest_fn = self._fn_for(method, method_args, names_m[0],
                                    width=self.seeds - 1, n_tasks=T)
-            rest = rest_fn(preds_m, labels_m, self._keys[1:], *extra)
-        r0 = _to_host(r0)
-        rest = _to_host(rest) if rest is not None else None
-        dt = time.perf_counter() - t0
-        for t, name in enumerate(names_m):
+            rest = rest_fn(preds_m, labels_m, keys_rest, *extra)
+        if device is not None:
+            # start the device-to-host copies NOW so they overlap later
+            # dispatches; the harvest's np.asarray then finds them done
+            for leaf in self._jax.tree_util.tree_leaves((r0, rest)):
+                leaf.copy_to_host_async()
+        return PendingBatch(names=names_m, method=method,
+                            shape=tuple(shape), cold=cold, r0=r0,
+                            rest=rest, t_start=t0, device=device,
+                            cost=cost)
+
+    def _harvest_batch(self, pend: PendingBatch, store, pairs, results,
+                       progress) -> None:
+        """Block on one pending dispatch, unpack per task (probe
+        broadcast / rest concat), log, and append timing records. Under
+        the scheduler a chunk's recorded ``seconds`` spans dispatch to
+        harvest-complete on ITS device — wall time that includes queue
+        wait there, which is why ``compute_device_s`` (the sum of these)
+        exceeds ``compute_s`` (the compute region's wall clock) exactly
+        when placement achieves concurrency."""
+        r0 = _to_host(pend.r0)
+        rest = _to_host(pend.rest) if pend.rest is not None else None
+        pend.t_end = time.perf_counter()
+        dt = pend.t_end - pend.t_start
+        T = len(pend.names)
+        method, cold = pend.method, pend.cold
+        for t, name in enumerate(pend.names):
             r0_t = type(r0)(*[x[t] for x in r0])
             if rest is None or not bool(np.asarray(
                     r0_t.stochastic)[0]):
@@ -443,14 +574,18 @@ class SuiteRunner:
                     for a, b in zip(r0_t, rest)
                 ])
             results[(name, method)] = res
-            pairs.append({"task": name, "method": method,
-                          "shape": list(shape),
-                          "seconds": dt / T, "cold": cold,
-                          "batched": T})
+            rec = {"task": name, "method": method,
+                   "shape": list(pend.shape),
+                   "seconds": dt / T, "cold": cold,
+                   "batched": T}
+            if pend.device is not None:
+                rec["device"] = pend.device.id
+            pairs.append(rec)
             if store is not None:
                 _log(store, name, method, res, self.seeds,
                      self.iters)
-        progress(f"[batch x{T}] {'/'.join(names_m[:3])}"
+        dev = f" @dev{pend.device.id}" if pend.device is not None else ""
+        progress(f"[batch x{T}]{dev} {'/'.join(pend.names[:3])}"
                  f"{'...' if T > 3 else ''}/{method}: "
                  f"{self.seeds} seeds x {self.iters} iters in "
                  f"{dt:.2f}s{' (incl. compile)' if cold else ''}")

@@ -47,6 +47,18 @@ def initialize(
 
     if num_processes <= 1 and coordinator_address is None:
         return False
+    # XLA's default CPU client refuses cross-process computations
+    # ("Multiprocess computations aren't implemented on the CPU backend");
+    # jaxlib ships a gloo-based host-side collectives implementation that
+    # must be selected before the backend initializes. TPU/GPU backends
+    # ignore the setting. The guard only covers jax versions that predate
+    # the config option; a jaxlib built WITHOUT gloo accepts the setting
+    # here and fails later, when jax.distributed.initialize (or the first
+    # computation) creates the CPU client.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

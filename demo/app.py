@@ -28,7 +28,7 @@ This app serves ONE selector session per user, one device round trip per
 click. For many concurrent sessions multiplexed onto one accelerator —
 micro-batched so each tick is a single compiled step over every active
 session — use the serving layer: ``python -m coda_tpu.cli serve``
-(``coda_tpu/serve/``, ARCHITECTURE.md §5).
+(``coda_tpu/serve/``, ARCHITECTURE.md §6).
 """
 
 from __future__ import annotations

@@ -50,6 +50,63 @@ def _median_profile(reps: list) -> dict:
             for k in keys}
 
 
+def _profile_from_artifact(name: str):
+    """Per-family/per-method WARM cost profile out of a committed bench
+    artifact's pair records (the scheduler's LPT weights). Pre-profile
+    artifacts (no pairs) or a missing file yield None — the scheduler
+    then falls back to uniform costs."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        name)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        art = json.load(f)
+    pairs = art.get("pairs")
+    if not pairs:
+        return None
+    from coda_tpu.engine.suite import _warm_profile
+
+    warm_m, warm_f = _warm_profile(pairs)
+    if not warm_f:
+        return None
+    return {"per_family_warm_s": warm_f, "per_method_warm_s": warm_m}
+
+
+def _vs_single_device(line: dict, runner, groups, methods, margs, caps,
+                      sched_kw, reps: int = 3) -> None:
+    """Measure the scheduled-vs-serial speedup off the hot jit cache.
+
+    Median-of-``reps`` warm passes PER SIDE (single passes on a noisy
+    shared host swing ±10%, enough to flip the ratio's direction): a
+    serial warm-up first (pays any device-0 executables the scheduled
+    run never compiled there), then serial and scheduled timed passes
+    interleaved so slow drift hits both sides alike. Ratio > 1 means
+    placement beat serial dispatch; the field stays honest on hosts
+    where virtual devices share one core (ratio ~1)."""
+    import statistics
+
+    runner.run_batched(groups, methods, method_args=margs, batch_caps=caps,
+                       progress=lambda s: None)  # serial warm-up
+    serial, sched = [], []
+    for _ in range(reps):
+        runner.run_batched(groups, methods, method_args=margs,
+                           batch_caps=caps, progress=lambda s: None)
+        serial.append(runner.last_stats["compute_s"])
+        runner.run_batched(groups, methods, method_args=margs,
+                           batch_caps=caps, progress=lambda s: None,
+                           **sched_kw)
+        sched.append(runner.last_stats["compute_s"])
+    serial_s, sched_s = statistics.median(serial), statistics.median(sched)
+    if sched_s:
+        line["vs_single_device"] = round(serial_s / sched_s, 3)
+        line["vs_single_device_basis"] = (
+            f"median-of-{reps} serial warm compute {round(serial_s, 2)}s / "
+            f"scheduled warm compute {round(sched_s, 2)}s (same process, "
+            f"hot jit cache)")
+
+
 def _baseline_ratio(line: dict, args) -> None:
     """Populate ``vs_baseline`` from the committed CPU full-suite capture.
 
@@ -132,9 +189,24 @@ def main(argv=None):
                    help="with --task-batch: max tasks per batched group "
                         "(0 = whole family) — the HBM valve for big "
                         "families")
+    p.add_argument("--suite-devices", default=None, metavar="auto|N",
+                   help="schedule independent family-method dispatches "
+                        "across this many local devices ('auto' = all) — "
+                        "the task-parallel scheduler; implies "
+                        "--task-batch. Default: serial dispatch.")
+    p.add_argument("--schedule", default="lpt", choices=["lpt", "fifo"],
+                   help="with --suite-devices: dispatch order (lpt = "
+                        "longest-processing-time-first off the committed "
+                        "per-family warm profile, fifo = family order)")
+    p.add_argument("--no-vs-single-device", action="store_true",
+                   help="with --suite-devices: skip the extra serial "
+                        "passes that measure the vs_single_device "
+                        "speedup (3 warm passes on big sweeps)")
     args = p.parse_args(argv)
+    if args.suite_devices is not None:
+        args.task_batch = True  # the scheduler runs through run_batched
     if args.task_batch and args.mesh:
-        p.error("--task-batch is single-device (the task axis would need "
+        p.error("--task-batch is per-device (the task axis would need "
                 "its own mesh dimension); drop one of the flags")
     if args.warm_reps is not None and args.warm_reps < 1:
         p.error("--warm-reps must be >= 1")
@@ -196,11 +268,22 @@ def main(argv=None):
         margs["eig_backend"] = args.eig_backend
     if args.eig_entropy:
         margs["eig_entropy"] = args.eig_entropy
+
+    # LPT costs for the scheduler: the committed full-suite capture's pair
+    # records, reduced to per-family/per-method warm profiles (uniform
+    # fallback inside the scheduler when the artifact is absent)
+    cost_profile = _profile_from_artifact(BASELINE_ARTIFACT) \
+        if args.suite_devices is not None else None
+    sched_kw = {}
+    if args.suite_devices is not None:
+        sched_kw = dict(devices=args.suite_devices, schedule=args.schedule,
+                        cost_profile=cost_profile)
+
     t0 = time.perf_counter()
     if args.task_batch:
         results = runner.run_batched(
             groups, methods, method_args=margs,
-            batch_caps={"coda": coda_cap})
+            batch_caps={"coda": coda_cap}, **sched_kw)
     else:
         results = runner.run(loaders, methods, method_args=margs)
     wall = time.perf_counter() - t0
@@ -242,6 +325,16 @@ def main(argv=None):
         "eig_entropy": args.eig_entropy or "exact",
         "vs_baseline": 0.0,
     }
+    if args.suite_devices is not None:
+        # wall vs summed device-seconds diverge exactly when placement
+        # achieves concurrency; both are recorded so speedup math stays
+        # honest (the satellite of the t_compute double-count fix)
+        line["compute_device_s"] = round(
+            stats.get("compute_device_s", 0.0), 2)
+        line["n_devices"] = stats.get("n_devices", 1)
+        line["schedule"] = stats.get("schedule")
+        line["occupancy"] = stats.get("occupancy", {})
+        line["vs_single_device"] = 0.0  # 0.0 = not measured
 
     if args.warm_rerun or args.warm_reps is not None:
         # warm passes off the hot in-process jit cache: pairs are pure
@@ -259,7 +352,7 @@ def main(argv=None):
             if args.task_batch:
                 runner.run_batched(
                     groups, methods, method_args=margs,
-                    batch_caps={"coda": coda_cap})
+                    batch_caps={"coda": coda_cap}, **sched_kw)
             else:
                 runner.run(loaders, methods, method_args=margs)
             walls.append(round(time.perf_counter() - t0, 2))
@@ -278,6 +371,9 @@ def main(argv=None):
         # flaky-tunnel discipline as the headline number)
         line["per_method_warm_s"] = _median_profile(warm_method_reps)
         line["per_family_warm_s"] = _median_profile(warm_family_reps)
+    if args.suite_devices is not None and not args.no_vs_single_device:
+        _vs_single_device(line, runner, groups, methods, margs,
+                          {"coda": coda_cap}, sched_kw)
     _baseline_ratio(line, args)
     print(json.dumps(line))
     if args.out:
@@ -290,6 +386,9 @@ def main(argv=None):
         detail["hostname"] = _pl.node()
         detail["per_method"] = per_method
         detail["pairs"] = stats.get("pairs", [])
+        if args.suite_devices is not None:
+            detail["device_timeline"] = stats.get("device_timeline", {})
+            detail["est_device_load"] = stats.get("est_device_load", {})
         with open(args.out, "w") as f:
             json.dump(detail, f, indent=2)
 

@@ -40,7 +40,31 @@ def main(argv=None):
     p.add_argument("--mesh", default=None, metavar="AXIS=K,...",
                    help="shard each task tensor over a device mesh, "
                         "e.g. data=8 or data=4,model=2")
+    p.add_argument("--task-batch", action="store_true",
+                   help="batch same-size tasks into one vmapped program "
+                        "per method (SuiteRunner.run_batched); groups by "
+                        "file size — a size collision across shapes fails "
+                        "loudly at dispatch. Incompatible with --mesh.")
+    p.add_argument("--suite-devices", default=None, metavar="auto|N",
+                   help="with --task-batch (implied): schedule independent "
+                        "task-method dispatches across this many local "
+                        "devices ('auto' = all) — the task-parallel "
+                        "scheduler (engine/scheduler.py)")
+    p.add_argument("--schedule", default="lpt", choices=["lpt", "fifo"],
+                   help="with --suite-devices: dispatch order (lpt = "
+                        "longest-processing-time-first off the per-family "
+                        "warm cost profile)")
+    p.add_argument("--cost-profile", default=None, metavar="BENCH.json",
+                   help="with --suite-devices: JSON artifact carrying "
+                        "per_family_warm_s/per_method_warm_s (a prior "
+                        "bench_suite --out capture) to seed LPT costs; "
+                        "default uniform")
     args = p.parse_args(argv)
+    if args.suite_devices is not None:
+        args.task_batch = True  # scheduling runs through run_batched
+    if args.task_batch and args.mesh:
+        p.error("--task-batch is per-device (the task axis would need its "
+                "own mesh dimension); drop one of the flags")
 
     from coda_tpu.utils.platform import pin_platform
 
@@ -79,10 +103,28 @@ def main(argv=None):
     store = None if args.no_db else TrackingStore(args.db)
     runner = SuiteRunner(iters=args.iters, seeds=args.seeds, loss=args.loss)
     t0 = time.perf_counter()
-    results = runner.run(datasets, args.methods.split(","), store=store,
-                         force_rerun=args.force_rerun)
+    if args.task_batch:
+        # group loaders by file size (the same shape proxy the sort uses);
+        # run_batched validates real shape agreement per group
+        groups: dict = {}
+        for size, fp, t in sorted(paths):
+            groups.setdefault(size, []).append(
+                lambda fp=fp, t=t: Dataset.from_file(
+                    fp, name=t, sharding=None, unsharded_fallback=True))
+        cost_profile = None
+        if args.cost_profile:
+            with open(args.cost_profile) as f:
+                cost_profile = json.load(f)
+        results = runner.run_batched(
+            list(groups.values()), args.methods.split(","), store=store,
+            force_rerun=args.force_rerun, devices=args.suite_devices,
+            schedule=args.schedule, cost_profile=cost_profile)
+    else:
+        results = runner.run(datasets, args.methods.split(","), store=store,
+                             force_rerun=args.force_rerun)
     wall = time.perf_counter() - t0
-    print(json.dumps({
+    stats = getattr(runner, "last_stats", {})
+    line = {
         "metric": "suite-wall-clock",
         "tasks": len(datasets),
         "methods": len(args.methods.split(",")),
@@ -91,7 +133,15 @@ def main(argv=None):
         "pairs_run": len(results),
         "value": round(wall, 2),
         "unit": "seconds",
-    }))
+    }
+    if args.suite_devices is not None:
+        line["n_devices"] = stats.get("n_devices")
+        line["schedule"] = stats.get("schedule")
+        line["occupancy"] = stats.get("occupancy")
+        line["compute_s"] = round(stats.get("compute_s", 0.0), 2)
+        line["compute_device_s"] = round(
+            stats.get("compute_device_s", 0.0), 2)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -124,8 +124,13 @@ def test_sharded_pbest_matches(tiny_task):
     sh = NamedSharding(mesh, P(None, MODEL_AXIS))
     out1 = jax.jit(compute_pbest)(a, b)
     out8 = jax.jit(compute_pbest)(jax.device_put(a, sh), jax.device_put(b, sh))
+    # tolerance, not bitwise: the sharded psum of per-model log-CDFs
+    # reassociates the fp32 reduction, so partial-sum order legitimately
+    # drifts from the single-device sum by ~1 ulp (measured max abs diff
+    # 5.96e-8 on the 8-way virtual mesh); the kernel semantics are
+    # otherwise identical
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out8),
-                               rtol=0, atol=0)
+                               rtol=1e-6, atol=1e-7)
 
 
 def test_sharded_eig_scores_match():
