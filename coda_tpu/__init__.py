@@ -16,6 +16,19 @@ Design stance (TPU-first, not a port):
     ``jax.sharding.Mesh`` (N and/or H axes) with XLA collectives over ICI.
 """
 
+import jax as _jax
+
+# Sharding-invariant RNG, set before any program traces. The default
+# (non-partitionable) threefry's bit-generation gets partitioned by GSPMD
+# with shard-local counter offsets when its output is sharded, so the SAME
+# key could yield DIFFERENT bits in a sharded vs unsharded program — which
+# silently diverged sharded experiment traces wherever randomness feeds an
+# adaptive decision (the tie-break draws in `masked_argmax_tiebreak`; the
+# former `test_suite_sharded_task_matches_unsharded` failure, NOTES_r07).
+# Partitionable threefry computes bits as a sharding-oblivious function of
+# (key, position), restoring trace parity across mesh layouts.
+_jax.config.update("jax_threefry_partitionable", True)
+
 from coda_tpu.data import Dataset, make_synthetic_task
 from coda_tpu.oracle import Oracle, true_losses
 from coda_tpu.losses import LOSS_FNS, accuracy_loss

@@ -15,6 +15,7 @@ stream to the tracking store *after* the compiled run, in one batch per seed.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import sys
 import time
@@ -143,6 +144,12 @@ def parse_args(argv=None):
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler device trace of the compiled "
                         "run into this directory (TensorBoard/Perfetto)")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="write structured telemetry artifacts there: "
+                        "trace.json (Perfetto host/device spans), "
+                        "telemetry.json (jit recompiles, HBM watermarks), "
+                        "metrics.prom (Prometheus text); scalars also land "
+                        "in the tracking store unless --no-mlflow")
     p.add_argument("--debug-viz", action="store_true",
                    help="log P(best) / regret-curve charts as artifacts to "
                         "the tracking store (reference _DEBUG_VIZ analog)")
@@ -333,8 +340,22 @@ def main(argv=None):
     from coda_tpu.losses import LOSS_FNS
     from coda_tpu.oracle import true_losses
 
+    # telemetry before any compile, so the jax.monitoring recompile hook
+    # sees every backend compile this run pays
+    telemetry = None
+    if args.telemetry_dir:
+        from coda_tpu.telemetry import Telemetry
+
+        telemetry = Telemetry(out_dir=args.telemetry_dir)
+
+    def tele_span(name, **attrs):
+        return (telemetry.span(name, lane="host:main", annotate=True,
+                               **attrs)
+                if telemetry is not None else contextlib.nullcontext())
+
     print("devices:", jax.devices())
-    dataset = load_dataset(args)
+    with tele_span("load_dataset"):
+        dataset = load_dataset(args)
     H, N, C = dataset.shape
     print(f"Loaded preds of shape ({H}, {N}, {C})")
     if dataset.labels is None:
@@ -352,12 +373,16 @@ def main(argv=None):
 
     t0 = time.perf_counter()
     with profiler_trace(args.profile_dir):
-        result = _run_all_seeds(args, factory, selector, dataset,
-                                model_losses, loss_fn)
-        result.regret.block_until_ready()
+        with tele_span("experiment", method=args.method, iters=args.iters,
+                       seeds=args.seeds):
+            result = _run_all_seeds(args, factory, selector, dataset,
+                                    model_losses, loss_fn)
+            result.regret.block_until_ready()
     if args.profile_dir:
         print(f"Profiler trace written to {args.profile_dir}")
     wall = time.perf_counter() - t0
+    if telemetry is not None:
+        telemetry.sample_devices()
     steps = args.iters * args.seeds
     print(f"{steps} selection steps in {wall:.2f}s "
           f"({steps / wall:.2f} steps/s, all seeds batched)")
@@ -394,7 +419,20 @@ def main(argv=None):
             # SQL (mean over children) free of special cases
             if not stoch.any():
                 print("Method is not stochastic for this task.")
+        if telemetry is not None:
+            telemetry.flush_to_store(
+                store, experiment=experiment,
+                run_name=f"{run_name}-telemetry",
+                params={"method": args.method})
         print(f"Logged to {args.tracking_db}")
+
+    if telemetry is not None:
+        paths = telemetry.write(extra={
+            "run": {"task": dataset.name, "method": args.method,
+                    "iters": args.iters, "seeds": args.seeds,
+                    "wall_s": round(wall, 4)}})
+        print(f"Telemetry written to {args.telemetry_dir} "
+              f"({', '.join(sorted(paths))})")
 
     return result
 

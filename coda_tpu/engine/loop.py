@@ -52,13 +52,20 @@ def make_step_fn(
     """
     best_loss = model_losses.min()
 
+    # named_scope stamps the phase names into HLO metadata, so a
+    # --profile-dir device trace carries the same select/update/best
+    # vocabulary as the host-side telemetry spans (ARCHITECTURE.md
+    # §"Observability")
     def step(carry, k):
         state, cum = carry
         k_sel, k_best = jax.random.split(k)
-        res = selector.select(state, k_sel)
+        with jax.named_scope("select"):
+            res = selector.select(state, k_sel)
         tc = labels[res.idx]
-        state = selector.update(state, res.idx, tc, res.prob)
-        best, b_stoch = selector.best(state, k_best)
+        with jax.named_scope("update"):
+            state = selector.update(state, res.idx, tc, res.prob)
+        with jax.named_scope("best"):
+            best, b_stoch = selector.best(state, k_best)
         regret = model_losses[best] - best_loss
         cum = cum + regret
         return (state, cum), (res.idx, tc, best, regret, cum, res.prob,

@@ -201,6 +201,7 @@ def run_scheduled(runner, groups, methods, *, store=None, force_rerun=False,
     jax = runner._jax
     devs = resolve_devices(devices, jax)
     max_inflight = max(1, int(max_inflight))
+    tele = getattr(runner, "telemetry", None)
     results: dict = {}
     pairs: list = []
     t_suite0 = time.perf_counter()
@@ -220,7 +221,12 @@ def run_scheduled(runner, groups, methods, *, store=None, force_rerun=False,
         datasets = [_HostTask(name=d.name, preds=np.asarray(d.preds),
                               labels=np.asarray(d.labels))
                     for d in datasets]
-        t_load += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        t_load += t1 - t0
+        if tele is not None:  # host lane: loads overlap device lanes' spans
+            tele.spans.record(f"load/group{gi}", lane="host:suite",
+                              t_start=t0, t_end=t1,
+                              attrs={"tasks": [d.name for d in datasets]})
         group_data.append(datasets)
         for n in names:
             fam = family_of(n)

@@ -105,12 +105,16 @@ class SuiteRunner:
     """
 
     def __init__(self, iters: int = 100, seeds: int = 5, loss: str = "acc",
-                 dedup_seeds: bool = True):
+                 dedup_seeds: bool = True, telemetry=None):
         import jax
 
         self.iters = iters
         self.seeds = seeds
         self.loss_fn = LOSS_FNS[loss]
+        # optional telemetry.Telemetry: every dispatch becomes a span on its
+        # device lane, cold dispatches feed the recompile-fallback counter,
+        # and HBM watermarks are sampled after each harvest
+        self.telemetry = telemetry
         # the reference's deterministic-method optimization (reference
         # main.py:128-130,166-168): run seed 0 alone; only when the method
         # reports randomness actually mattered (ties, sampling) run the
@@ -128,6 +132,28 @@ class SuiteRunner:
             [jax.random.PRNGKey(s) for s in range(seeds)]
         )
         self._jax = jax
+
+    def _tele_cold(self, cold: bool) -> None:
+        """Feed the telemetry recompile evidence from the runner's own
+        shape-keyed cold attribution — the timing-based fallback that stays
+        live even where ``jax.monitoring`` hooks are unavailable."""
+        if cold and self.telemetry is not None:
+            self.telemetry.counter(
+                "suite_cold_dispatches_total",
+                "Suite dispatches that paid a jit compile "
+                "(shape-keyed cold attribution)").inc()
+
+    def _tele_span(self, name: str, device, t_start: float, t_end: float,
+                   attrs: Optional[dict] = None) -> None:
+        """Record one finished dispatch as a span on its device lane and
+        sample that device's HBM watermark (no-op without telemetry)."""
+        tele = self.telemetry
+        if tele is None:
+            return
+        dev_id = device.id if device is not None else 0
+        tele.spans.record(name, lane=f"device:{dev_id}",
+                          t_start=t_start, t_end=t_end, attrs=attrs)
+        tele.sample_devices([device] if device is not None else None)
 
     def _resolved_args(self, method: str, method_args: Optional[dict],
                        task_name: str) -> dict:
@@ -296,11 +322,16 @@ class SuiteRunner:
                     method).items())), tuple(ds.shape))
                 cold = shape_key not in seen_shapes  # first run pays compile
                 seen_shapes.add(shape_key)
+                self._tele_cold(cold)
                 t0 = time.perf_counter()
                 res = self.run_one(method, ds, method_args)
                 res = _to_host(res)  # sync + free device result buffers
-                dt = time.perf_counter() - t0
+                t1 = time.perf_counter()
+                dt = t1 - t0
                 t_compute += dt
+                self._tele_span(f"{ds.name}/{method}", None, t0, t1,
+                                {"task": ds.name, "method": method,
+                                 "cold": cold})
                 pairs.append({"task": ds.name, "method": method,
                               "shape": list(ds.shape), "seconds": dt,
                               "cold": cold})
@@ -525,6 +556,7 @@ class SuiteRunner:
             shape_key += (device.id,)
         cold = shape_key not in seen_shapes
         seen_shapes.add(shape_key)
+        self._tele_cold(cold)
         t0 = time.perf_counter()
         probe_fn = self._fn_for(method, method_args, names_m[0],
                                 width=1, n_tasks=T)
@@ -557,6 +589,11 @@ class SuiteRunner:
         rest = _to_host(pend.rest) if pend.rest is not None else None
         pend.t_end = time.perf_counter()
         dt = pend.t_end - pend.t_start
+        self._tele_span(
+            f"{pend.method}[x{len(pend.names)}]", pend.device,
+            pend.t_start, pend.t_end,
+            {"method": pend.method, "tasks": list(pend.names),
+             "cold": pend.cold, "est_cost": round(pend.cost, 4)})
         T = len(pend.names)
         method, cold = pend.method, pend.cold
         for t, name in enumerate(pend.names):

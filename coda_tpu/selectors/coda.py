@@ -1080,7 +1080,15 @@ def make_coda(
         )
 
     def _score_cache(rows, hyp, pi, pi_xi):
-        """The incremental scoring pass, backend-dispatched."""
+        """The incremental scoring pass, backend-dispatched.
+
+        The whole body sits in one ``named_scope`` so the N·C·H scoring
+        chain is attributable as a block in a ``--profile-dir`` device
+        trace — the region the telemetry layer's host spans bracket."""
+        with jax.named_scope("eig/score_cache"):
+            return _score_cache_impl(rows, hyp, pi, pi_xi)
+
+    def _score_cache_impl(rows, hyp, pi, pi_xi):
         if eig_backend == "pallas":
             if shard_mesh is not None:
                 from coda_tpu.ops.pallas_eig import (
@@ -1151,10 +1159,12 @@ def make_coda(
             # the carried posterior (see CODAState.eig_scores_cached)
             scores = state.eig_scores_cached
         else:
-            scores = eig_fn(
-                state.dirichlets, state.pi_hat, state.pi_hat_xi, hard_preds,
-                num_points=hp.num_points, chunk=hp.eig_chunk, **eig_kwargs,
-            )
+            with jax.named_scope("eig/scores"):
+                scores = eig_fn(
+                    state.dirichlets, state.pi_hat, state.pi_hat_xi,
+                    hard_preds, num_points=hp.num_points,
+                    chunk=hp.eig_chunk, **eig_kwargs,
+                )
         idx, n_ties = masked_argmax_tiebreak(k_tie, scores, cand,
                                              rtol=_TIE_RTOL, atol=_TIE_ATOL)
         return SelectResult(

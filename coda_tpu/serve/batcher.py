@@ -18,6 +18,7 @@ so this path only guards misbehaving open-loop callers.
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -67,9 +68,13 @@ class Batcher:
     """
 
     def __init__(self, store, metrics=None, max_batch: int = 256,
-                 max_wait: float = 0.002):
+                 max_wait: float = 0.002, telemetry=None):
         self.store = store
         self.metrics = metrics
+        # optional Telemetry: each per-bucket dispatch becomes a span on the
+        # "host:batcher" lane (annotated so a live jax.profiler capture
+        # shows the same tick names next to the device rows)
+        self.telemetry = telemetry
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait)
         self.queue: queue.Queue = queue.Queue()
@@ -190,12 +195,17 @@ class Batcher:
                        "label": t.label, "prob": t.prob}
                 for slot, t in slots.items()
             }
+            span = (self.telemetry.span(
+                        f"tick/{bucket.task}", lane="host:batcher",
+                        annotate=True, requests=len(slots), depth=depth)
+                    if self.telemetry is not None
+                    else contextlib.nullcontext())
             t0 = time.perf_counter()
             try:
                 # the bucket lock serializes the slab swap against THIS
                 # bucket's admission writes only — other buckets' dispatches
                 # and admissions proceed (see SessionStore docstring)
-                with bucket.lock:
+                with span, bucket.lock:
                     results = bucket.dispatch(reqs)
             except BaseException as e:  # surface to every waiter, keep going
                 for t in slots.values():

@@ -42,7 +42,9 @@ class ServeMetrics:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.started = time.time()
+        # monotonic baseline: uptime is a DURATION, and wall clock jumps
+        # (NTP slew, suspend) must not produce negative or inflated uptimes
+        self.started = time.monotonic()
         # monotonically increasing counters
         self.dispatches = 0
         self.requests = 0
@@ -93,7 +95,7 @@ class ServeMetrics:
             occ = list(self._occupancy)
             depth = list(self._queue_depth)
             snap = {
-                "uptime_s": time.time() - self.started,
+                "uptime_s": time.monotonic() - self.started,
                 "dispatches": self.dispatches,
                 "requests": self.requests,
                 "sessions_opened": self.sessions_opened,
@@ -106,6 +108,16 @@ class ServeMetrics:
                                      else None),
                 "dispatch_latency": _percentiles(self._dispatch_s),
                 "request_latency": _percentiles(self._request_s),
+                # ring fill: how much recent-window evidence backs the
+                # percentiles above (fill == capacity -> the ring has
+                # wrapped and older events have been evicted)
+                "ring_capacity": _RING,
+                "ring_fill": {
+                    "occupancy": len(self._occupancy),
+                    "queue_depth": len(self._queue_depth),
+                    "dispatch_latency": len(self._dispatch_s),
+                    "request_latency": len(self._request_s),
+                },
             }
         return snap
 

@@ -12,6 +12,7 @@ not N device round trips.
     GET    /session/{id}/best                          -> best (+ pbest)
     DELETE /session/{id}                               -> close, free slot
     GET    /stats                                      -> metrics snapshot
+    GET    /metrics                                    -> Prometheus text
     GET    /healthz                                    -> liveness/draining
 
 Admission control: a full slab answers 503 (the client's retry signal), as
@@ -53,11 +54,18 @@ class ServeApp:
     def __init__(self, capacity: int = 64, bucket_n: int = 1,
                  max_batch: int = 256, max_wait: float = 0.002,
                  default_task: Optional[str] = None,
-                 spec: Optional[SelectorSpec] = None):
+                 spec: Optional[SelectorSpec] = None,
+                 telemetry=None):
+        from coda_tpu.telemetry import Telemetry
+
         self.store = SessionStore(capacity=capacity, bucket_n=bucket_n)
         self.metrics = ServeMetrics()
+        # always live (registry-backed /metrics needs one); --telemetry-dir
+        # upgrades it to an artifact-writing instance
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
         self.batcher = Batcher(self.store, self.metrics,
-                               max_batch=max_batch, max_wait=max_wait)
+                               max_batch=max_batch, max_wait=max_wait,
+                               telemetry=self.telemetry)
         self.spec = spec or SelectorSpec.create("coda", n_parallel=capacity)
         self.default_task = default_task
         self.draining = False
@@ -199,6 +207,14 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _text(self, body: str, content_type: str, code: int = 200):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def _body(self) -> dict:
         n = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(n) or b"{}")
@@ -227,6 +243,23 @@ class Handler(BaseHTTPRequestHandler):
         return None
 
     def _handle(self, method: str):
+        if method == "GET" and self.path.split("?")[0] == "/metrics":
+            # Prometheus text exposition, not JSON: registry counters
+            # (recompiles, HBM watermarks) + the serve snapshot (dispatches,
+            # occupancy, queue depth, latency quantiles). Same error
+            # envelope as every other route: a render failure must answer
+            # a JSON 500, never drop the connection.
+            try:
+                from coda_tpu.telemetry import render_prometheus
+
+                body = render_prometheus(self.app.telemetry.registry,
+                                         serve_metrics=self.app.metrics)
+            except Exception as e:
+                self._json({"error": f"internal: {e}"}, 500)
+            else:
+                self._text(body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            return
         try:
             out = self._route(method)
         except Draining:
@@ -298,6 +331,11 @@ def parse_args(argv=None):
     p.add_argument("--tracking-db", default=None,
                    help="flush serving metrics into this MLflow-schema "
                         "sqlite DB on shutdown")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="write trace.json (Perfetto spans: batcher ticks) "
+                        "+ telemetry.json (recompiles, HBM watermarks) + "
+                        "metrics.prom there on shutdown; /metrics serves "
+                        "the same registry live either way")
     return p.parse_args(argv)
 
 
@@ -311,10 +349,16 @@ def build_app(args) -> ServeApp:
         # budget must see the whole slab (cli.py sets the same hint from
         # the seed-vmap width)
         spec_kwargs["n_parallel"] = args.capacity
+    telemetry = None
+    if getattr(args, "telemetry_dir", None):
+        from coda_tpu.telemetry import Telemetry
+
+        telemetry = Telemetry(out_dir=args.telemetry_dir)
     app = ServeApp(
         capacity=args.capacity, bucket_n=args.bucket_n,
         max_batch=args.max_batch, max_wait=args.max_wait_ms / 1e3,
         spec=SelectorSpec.create(args.method, **spec_kwargs),
+        telemetry=telemetry,
     )
     if args.task or args.synthetic:
         ds = load_dataset(args)
@@ -345,6 +389,10 @@ def main(argv=None):
     finally:
         app.drain()
         srv.server_close()
+        if args.telemetry_dir:
+            paths = app.telemetry.write(
+                extra={"serve": app.metrics.snapshot()})
+            print(f"telemetry written to {paths.get('telemetry')}")
         if args.tracking_db:
             from coda_tpu.tracking import TrackingStore
 

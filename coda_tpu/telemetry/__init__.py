@@ -1,0 +1,161 @@
+"""Unified telemetry: spans, counters/gauges, Perfetto export, Prometheus.
+
+One evidence layer for every hot loop in the stack:
+
+  * :mod:`~coda_tpu.telemetry.spans` — thread-safe structured span recorder
+    (named begin/end events on per-device + host lanes) exported as Chrome
+    ``trace_event`` JSON, loadable in Perfetto / ``chrome://tracing``;
+  * :mod:`~coda_tpu.telemetry.registry` — process-wide counters/gauges with
+    a ``jax.monitoring``-backed jit-recompile counter and per-device HBM
+    watermarks from ``device.memory_stats()``;
+  * :mod:`~coda_tpu.telemetry.prometheus` — text exposition of both, served
+    at ``GET /metrics`` by the serving layer and dumpable from batch runs.
+
+:class:`Telemetry` bundles the three for the plumbing layers: every driver
+(``cli.py``, ``scripts/run_suite.py``, ``scripts/bench_suite.py``, ``serve``)
+grows a ``--telemetry-dir`` flag that writes ``trace.json`` +
+``telemetry.json`` (+ ``metrics.prom``) artifacts there and can flush the
+scalar counters into the MLflow-schema tracking store next to experiment
+metrics. See ARCHITECTURE.md §"Observability".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from coda_tpu.telemetry.prometheus import render as render_prometheus
+from coda_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Registry,
+    get_registry,
+    install_jax_hooks,
+    jax_hooks_installed,
+    registry_hooked,
+    sample_device_memory,
+)
+from coda_tpu.telemetry.spans import SpanRecorder, annotation
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Registry",
+    "SpanRecorder",
+    "Telemetry",
+    "annotation",
+    "get_registry",
+    "install_jax_hooks",
+    "jax_hooks_installed",
+    "registry_hooked",
+    "render_prometheus",
+    "sample_device_memory",
+]
+
+
+class Telemetry:
+    """Span recorder + registry + artifact writer, bundled for plumbing.
+
+    ``out_dir=None`` keeps everything in memory (the serving layer serves
+    ``/metrics`` from the registry without ever writing a file); with an
+    ``out_dir``, :meth:`write` drops the run's artifacts there. The
+    registry defaults to the process-wide one so recompile/HBM evidence
+    aggregates across runners in one process.
+    """
+
+    def __init__(self, out_dir: Optional[str] = None,
+                 registry: Optional[Registry] = None,
+                 spans: Optional[SpanRecorder] = None,
+                 install_hooks: bool = True):
+        self.out_dir = out_dir
+        self.registry = registry if registry is not None else get_registry()
+        self.spans = spans if spans is not None else SpanRecorder()
+        # hooks_live is per-REGISTRY truth: with install_hooks=False the
+        # claim must not ride on some other registry's subscription
+        self.hooks_live = install_jax_hooks(self.registry) \
+            if install_hooks else registry_hooked(self.registry)
+
+    # -- recording passthroughs -------------------------------------------
+    def span(self, name: str, lane: str = "host", annotate: bool = False,
+             **attrs):
+        return self.spans.span(name, lane=lane, annotate=annotate, **attrs)
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self.registry.counter(name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self.registry.gauge(name, help)
+
+    def sample_devices(self, devices=None) -> dict:
+        return sample_device_memory(self.registry, devices)
+
+    # -- reading / artifacts ----------------------------------------------
+    def snapshot(self, extra: Optional[dict] = None) -> dict:
+        """The ``telemetry.json`` payload: counters/gauges (recompiles, HBM
+        watermarks), span summary, and the evidence source for each."""
+        reg = self.registry.snapshot()
+
+        def _values(name):
+            return (reg.get(name) or {}).get("values", {})
+
+        snap = {
+            "metrics": reg,
+            "jit": {
+                "recompiles": _values("jit_compiles_total").get("", 0.0),
+                "compile_seconds": _values(
+                    "jit_compile_seconds_total").get("", 0.0),
+                "source": ("jax.monitoring" if self.hooks_live
+                           else "cold-attribution-fallback"),
+                "cold_dispatches": _values(
+                    "suite_cold_dispatches_total").get("", 0.0),
+            },
+            "devices": {
+                dev.split("=", 1)[1]: {"peak_bytes_in_use": v}
+                for dev, v in _values("device_peak_bytes").items()
+            },
+            "spans": self.spans.summary(),
+        }
+        if extra:
+            snap.update(extra)
+        return snap
+
+    def write(self, extra: Optional[dict] = None) -> dict:
+        """Write ``trace.json`` / ``telemetry.json`` / ``metrics.prom``
+        under ``out_dir``; returns {artifact: path} (empty without a dir)."""
+        if not self.out_dir:
+            return {}
+        os.makedirs(self.out_dir, exist_ok=True)
+        paths = {
+            "trace": os.path.join(self.out_dir, "trace.json"),
+            "telemetry": os.path.join(self.out_dir, "telemetry.json"),
+            "prometheus": os.path.join(self.out_dir, "metrics.prom"),
+        }
+        self.spans.save(paths["trace"])
+        with open(paths["telemetry"], "w") as f:
+            json.dump(self.snapshot(extra), f, indent=2)
+        with open(paths["prometheus"], "w") as f:
+            f.write(render_prometheus(self.registry))
+        return paths
+
+    def flush_to_store(self, store, experiment: str = "telemetry",
+                       run_name: Optional[str] = None,
+                       params: Optional[dict] = None) -> str:
+        """Flush the scalar registry into the MLflow-schema tracking store
+        (same experiment -> run layout as benchmark metrics, so telemetry
+        rows sit next to regret curves in one sqlite DB)."""
+        name = run_name or f"{experiment}-telemetry"
+        with store.run(experiment, name, params=params or {}) as run:
+            for m in self.registry.collect():
+                for labels, value in m.samples():
+                    key = m.name
+                    if labels:
+                        key += "." + ".".join(
+                            f"{k}_{v}" for k, v in sorted(labels.items()))
+                    run.log_metric(key, float(value))
+            spans = self.spans.summary()
+            # total recorded, not ring-resident: long runs wrap the ring
+            # and the DB row must not understate the span evidence
+            run.log_metric("span_events", float(spans["recorded"]))
+            run.log_metric("span_events_dropped", float(spans["dropped"]))
+        return run.run_uuid

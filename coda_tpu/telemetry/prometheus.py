@@ -1,0 +1,133 @@
+"""Prometheus text-exposition rendering of the telemetry registry.
+
+One function, :func:`render`, turns the process-wide counter/gauge registry
+(plus an optional :class:`~coda_tpu.serve.metrics.ServeMetrics`) into the
+Prometheus text exposition format (version 0.0.4) — the payload the serving
+layer's ``GET /metrics`` answers and batch runs can dump next to
+``telemetry.json``. No client library: the format is lines of
+``name{labels} value`` under ``# HELP`` / ``# TYPE`` headers, and writing it
+directly keeps TPU images dependency-free (the same stance as the stdlib
+HTTP server and the MLflow-schema sqlite store).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from coda_tpu.telemetry.registry import Registry, get_registry
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _name(prefix: str, name: str) -> str:
+    n = f"{prefix}_{name}" if prefix else name
+    n = _NAME_OK.sub("_", n)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(value: float) -> str:
+    v = float(value)
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _line(name: str, labels: dict, value: float) -> str:
+    if labels:
+        lab = ",".join(f'{_NAME_OK.sub("_", str(k))}="{_escape(v)}"'
+                       for k, v in sorted(labels.items()))
+        return f"{name}{{{lab}}} {_fmt(value)}"
+    return f"{name} {_fmt(value)}"
+
+
+def _family(out: list, name: str, kind: str, help: str,
+            samples: list) -> None:
+    if help:
+        out.append(f"# HELP {name} {_escape(help)}")
+    out.append(f"# TYPE {name} {kind}")
+    for labels, value in samples:
+        out.append(_line(name, labels, value))
+
+
+def render(registry: Optional[Registry] = None, serve_metrics=None,
+           prefix: str = "coda") -> str:
+    """The registry (+ optional ServeMetrics snapshot) as exposition text."""
+    out: list[str] = []
+    reg = registry if registry is not None else get_registry()
+    for m in reg.collect():
+        _family(out, _name(prefix, m.name), m.kind, m.help, m.samples())
+    if serve_metrics is not None:
+        _render_serve(out, serve_metrics.snapshot(), prefix)
+    return "\n".join(out) + "\n"
+
+
+# (snapshot key, metric suffix, kind, help) — counters keep their
+# monotonic-total names, distribution means/maxes surface as gauges
+_SERVE_SCALARS = [
+    ("uptime_s", "serve_uptime_seconds", "gauge",
+     "Seconds since the serve metrics baseline (monotonic clock)"),
+    ("dispatches", "serve_dispatches_total", "counter",
+     "Compiled slab-step dispatches"),
+    ("requests", "serve_requests_total", "counter",
+     "Requests served across all dispatches"),
+    ("sessions_opened", "serve_sessions_opened_total", "counter",
+     "Sessions admitted"),
+    ("sessions_closed", "serve_sessions_closed_total", "counter",
+     "Sessions closed"),
+    ("sessions_rejected", "serve_sessions_rejected_total", "counter",
+     "Sessions refused by admission control (slab full / draining)"),
+    ("requests_rejected", "serve_requests_rejected_total", "counter",
+     "Requests refused (draining / unknown session / stale item)"),
+    ("max_occupancy", "serve_max_occupancy", "gauge",
+     "Most requests ever served by one dispatch"),
+    ("mean_occupancy", "serve_mean_occupancy", "gauge",
+     "Mean requests per dispatch over the recent ring"),
+    ("mean_queue_depth", "serve_mean_queue_depth", "gauge",
+     "Mean queue depth at tick start over the recent ring"),
+    ("ring_capacity", "serve_ring_capacity", "gauge",
+     "Capacity of each metrics ring (fill == capacity means wrapped)"),
+]
+
+_SERVE_SUMMARIES = [
+    ("dispatch_latency", "serve_dispatch_latency_seconds", "dispatches",
+     "Slab-step dispatch seconds over the recent ring"),
+    ("request_latency", "serve_request_latency_seconds", "requests",
+     "Submit-to-result request seconds over the recent ring"),
+]
+
+
+def _render_serve(out: list, snap: dict, prefix: str) -> None:
+    for key, suffix, kind, help in _SERVE_SCALARS:
+        v = snap.get(key)
+        if v is not None:
+            _family(out, _name(prefix, suffix), kind, help, [({}, v)])
+    fills = snap.get("ring_fill") or {}
+    if fills:
+        _family(out, _name(prefix, "serve_ring_fill"), "gauge",
+                "Events currently held in a metrics ring",
+                [({"ring": k}, n) for k, n in sorted(fills.items())])
+    for key, suffix, count_key, help in _SERVE_SUMMARIES:
+        q = snap.get(key) or {}
+        name = _name(prefix, suffix)
+        samples = []
+        for qk, quantile in (("p50_ms", "0.5"), ("p99_ms", "0.99")):
+            if q.get(qk) is not None:
+                samples.append(({"quantile": quantile}, q[qk] / 1e3))
+        if not samples:
+            continue
+        _family(out, name, "summary", help, samples)
+        out.append(_line(name + "_count", {}, snap.get(count_key, 0)))

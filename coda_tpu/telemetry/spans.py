@@ -1,0 +1,171 @@
+"""Structured span recorder: one timeline for host phases and device lanes.
+
+The stack has three independent hot loops — the compiled experiment scan
+(`engine/loop.py`), the multi-device suite scheduler (`engine/scheduler.py`)
+and the serving batcher tick (`serve/batcher.py`) — and before this module
+each reported time its own way (``StepTimer`` totals, ``last_stats`` dicts,
+latency rings). A :class:`SpanRecorder` gives them ONE vocabulary: named
+begin/end events on named *lanes* (one lane per device, plus host lanes),
+recorded O(1) into a fixed-capacity ring like ``ServeMetrics``' latency
+rings — no allocation growth, no reduction in the record path — and exported
+as Chrome ``trace_event`` JSON, loadable in Perfetto / ``chrome://tracing``.
+
+Host spans and ``--profile-dir`` device traces line up because hot regions
+also enter :func:`annotation` (``jax.profiler.TraceAnnotation``), which
+stamps the same names into the profiler's host rows; ``jax.named_scope``
+inside traced code does the counterpart for device-side HLO metadata.
+
+All timestamps come from ``time.perf_counter()`` (monotonic) relative to the
+recorder's creation — never wall clock (``scripts/check_clocks.py`` enforces
+this repo-wide).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import threading
+import time
+from typing import Optional
+
+# events kept per recorder: enough for a full 26-task suite sweep
+# (~hundreds of dispatch spans) plus long serve sessions' tick spans,
+# small enough that a trace.json export stays a few MB
+_CAPACITY = 65536
+
+
+@contextlib.contextmanager
+def annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` when jax is importable, else no-op.
+
+    Used around HOST-side hot regions (scheduler dispatch, batcher tick) so
+    a concurrently-running ``--profile-dir`` capture shows the same span
+    names as our ``trace.json`` — the correlation hook between the two.
+    """
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:  # jax absent or too old: spans still record
+        yield
+        return
+    with TraceAnnotation(name):
+        yield
+
+
+class SpanRecorder:
+    """Thread-safe structured span recorder with Chrome-trace export.
+
+    Lanes are created on first use and map to Chrome ``tid``s in first-seen
+    order; use ``device:<id>`` for device lanes and ``host:<role>`` for host
+    threads. Events are ``(name, lane, t_start, t_end, attrs)`` tuples in a
+    bounded ring — recording is O(1) and never blocks on a reduction.
+    """
+
+    def __init__(self, capacity: int = _CAPACITY):
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self._lanes: dict[str, int] = {}
+        self._t0 = time.perf_counter()
+        self.capacity = capacity
+        self.recorded = 0  # total ever recorded (ring evicts past capacity)
+
+    # -- recording (hot path: O(1)) ----------------------------------------
+    def record(self, name: str, lane: str = "host", t_start: float = 0.0,
+               t_end: float = 0.0, attrs: Optional[dict] = None) -> None:
+        """Record one completed span (perf_counter begin/end seconds)."""
+        with self._lock:
+            if lane not in self._lanes:
+                self._lanes[lane] = len(self._lanes)
+            self._events.append((name, lane, t_start, t_end, attrs))
+            self.recorded += 1
+
+    def instant(self, name: str, lane: str = "host",
+                attrs: Optional[dict] = None) -> None:
+        """Record a zero-duration marker event."""
+        t = time.perf_counter()
+        self.record(name, lane, t, t, attrs)
+
+    @contextlib.contextmanager
+    def span(self, name: str, lane: str = "host", annotate: bool = False,
+             **attrs):
+        """Time the enclosed block as one span on ``lane``.
+
+        ``annotate=True`` additionally enters :func:`annotation` so the
+        region shows up (same name) in a live ``jax.profiler`` capture.
+        """
+        cm = annotation(name) if annotate else contextlib.nullcontext()
+        t0 = time.perf_counter()
+        try:
+            with cm:
+                yield
+        finally:
+            self.record(name, lane, t0, time.perf_counter(), attrs or None)
+
+    # -- reading -----------------------------------------------------------
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    def lanes(self) -> list[str]:
+        """Lane names in tid order."""
+        with self._lock:
+            return sorted(self._lanes, key=self._lanes.get)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "events": len(self._events),
+                "recorded": self.recorded,
+                "dropped": max(0, self.recorded - len(self._events)),
+                "capacity": self.capacity,
+                "lanes": sorted(self._lanes, key=self._lanes.get),
+            }
+
+    def lane_busy_s(self, lane: str) -> float:
+        """Union-of-intervals busy seconds of one lane (overlapping spans
+        counted once — the same folding the scheduler's occupancy uses)."""
+        ivals = sorted((t0, t1) for name, ln, t0, t1, _ in self.events()
+                       if ln == lane)
+        busy, last = 0.0, None
+        for s, e in ivals:
+            if last is None or s > last:
+                busy += e - s
+                last = e
+            elif e > last:
+                busy += e - last
+                last = e
+        return busy
+
+    # -- export ------------------------------------------------------------
+    def to_chrome(self) -> dict:
+        """Chrome ``trace_event`` JSON object (Perfetto-loadable).
+
+        Spans become ``"X"`` (complete) events with microsecond timestamps
+        relative to recorder creation; each lane is a named thread of one
+        process, ordered by first use. Nested spans on a lane nest visually
+        because their intervals nest.
+        """
+        with self._lock:
+            events = list(self._events)
+            lanes = dict(self._lanes)
+        out = []
+        for lane, tid in sorted(lanes.items(), key=lambda kv: kv[1]):
+            out.append({"name": "thread_name", "ph": "M", "pid": 0,
+                        "tid": tid, "args": {"name": lane}})
+            out.append({"name": "thread_sort_index", "ph": "M", "pid": 0,
+                        "tid": tid, "args": {"sort_index": tid}})
+        for name, lane, t0, t1, attrs in events:
+            ev = {
+                "name": name, "ph": "X", "pid": 0, "tid": lanes[lane],
+                "ts": round((t0 - self._t0) * 1e6, 3),
+                "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+            }
+            if attrs:
+                ev["args"] = attrs
+            out.append(ev)
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f)
+        return path

@@ -97,6 +97,7 @@ CREATE INDEX IF NOT EXISTS idx_runs_experiment ON runs(experiment_id);
 
 
 def _now_ms() -> int:
+    # wall-clock: MLflow-schema timestamp columns are epoch ms (a timestamp)
     return int(time.time() * 1000)
 
 

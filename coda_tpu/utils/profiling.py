@@ -13,6 +13,7 @@ profiling is first-class and nearly free:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 
@@ -33,11 +34,20 @@ def trace(log_dir: str | None):
 
 
 class StepTimer:
-    """Accumulates named wall-clock spans; reports totals and rates."""
+    """Accumulates named wall-clock spans; reports totals, rates, min/max.
+
+    Thread-safe: the scheduler's async-harvest path and the serving
+    batcher's tick thread can both hold one timer, so accumulation happens
+    under a lock (the read-modify-write on the dicts would otherwise lose
+    updates) and per-span extrema are tracked alongside the totals.
+    """
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.spans: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+        self.mins: dict[str, float] = {}
+        self.maxs: dict[str, float] = {}
 
     @contextlib.contextmanager
     def span(self, name: str, steps: int = 1):
@@ -46,17 +56,24 @@ class StepTimer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.spans[name] = self.spans.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + steps
+            with self._lock:
+                self.spans[name] = self.spans.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + steps
+                self.mins[name] = min(self.mins.get(name, dt), dt)
+                self.maxs[name] = max(self.maxs.get(name, dt), dt)
 
     def rate(self, name: str) -> float:
         """Steps/sec for a span (0.0 when never entered)."""
-        dt = self.spans.get(name, 0.0)
-        return self.counts.get(name, 0) / dt if dt > 0 else 0.0
+        with self._lock:
+            dt = self.spans.get(name, 0.0)
+            return self.counts.get(name, 0) / dt if dt > 0 else 0.0
 
     def summary(self) -> dict[str, dict]:
-        return {
-            k: {"seconds": self.spans[k], "steps": self.counts[k],
-                "steps_per_sec": self.rate(k)}
-            for k in self.spans
-        }
+        with self._lock:
+            return {
+                k: {"seconds": self.spans[k], "steps": self.counts[k],
+                    "steps_per_sec": (self.counts[k] / self.spans[k]
+                                      if self.spans[k] > 0 else 0.0),
+                    "min_s": self.mins[k], "max_s": self.maxs[k]}
+                for k in self.spans
+            }

@@ -1,0 +1,76 @@
+"""Static clock-discipline check: no wall clock in duration math.
+
+``time.time()`` is the wrong clock for measuring durations — it jumps under
+NTP slew and suspend, which is exactly how a latency percentile or an
+occupancy ratio silently goes negative in a long-lived server. Everything
+under ``coda_tpu/`` must time with ``time.perf_counter()`` /
+``time.monotonic()``; wall-clock reads are allowed only for *timestamps*
+(epoch columns in the MLflow schema) and must carry an explicit
+``# wall-clock:`` pragma naming why on the same or the preceding line.
+
+Wired into tier-1 (``tests/test_telemetry.py``) so a regressed clock fails
+CI, and runnable standalone::
+
+    python scripts/check_clocks.py [root]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# wall-clock constructs that must not appear un-pragma'd: time.time() and
+# naive datetime "now" reads (same jump/slew problem, different spelling)
+_FORBIDDEN = re.compile(r"\btime\.time\(\)|\bdatetime\.(?:now|utcnow)\(")
+_PRAGMA = "# wall-clock:"
+
+
+def check_file(path: str) -> list[tuple[int, str]]:
+    """(lineno, line) violations in one file."""
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    out = []
+    for i, line in enumerate(lines):
+        if not _FORBIDDEN.search(line):
+            continue
+        prev = lines[i - 1] if i > 0 else ""
+        if _PRAGMA in line or _PRAGMA in prev:
+            continue
+        out.append((i + 1, line.rstrip()))
+    return out
+
+
+def check_tree(root: str) -> dict[str, list[tuple[int, str]]]:
+    """{relpath: violations} over every .py file under ``root``."""
+    bad = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            fp = os.path.join(dirpath, fn)
+            v = check_file(fp)
+            if v:
+                bad[os.path.relpath(fp, root)] = v
+    return bad
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "coda_tpu")
+    bad = check_tree(root)
+    for rel, violations in sorted(bad.items()):
+        for lineno, line in violations:
+            print(f"{rel}:{lineno}: wall clock in duration-capable code "
+                  f"(use perf_counter/monotonic, or annotate with "
+                  f"'{_PRAGMA} <reason>'): {line.strip()}")
+    if bad:
+        return 1
+    print(f"clock check clean: no unannotated wall-clock reads under {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,6 +59,11 @@ def main(argv=None):
                         "per_family_warm_s/per_method_warm_s (a prior "
                         "bench_suite --out capture) to seed LPT costs; "
                         "default uniform")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="write trace.json (Perfetto per-device dispatch "
+                        "lanes) + telemetry.json (recompiles, HBM "
+                        "watermarks) + metrics.prom there; scalars also "
+                        "flush into --db")
     args = p.parse_args(argv)
     if args.suite_devices is not None:
         args.task_batch = True  # scheduling runs through run_batched
@@ -100,8 +105,15 @@ def main(argv=None):
         for _, fp, t in sorted(paths)
     ]
 
+    telemetry = None
+    if args.telemetry_dir:
+        from coda_tpu.telemetry import Telemetry
+
+        telemetry = Telemetry(out_dir=args.telemetry_dir)
+
     store = None if args.no_db else TrackingStore(args.db)
-    runner = SuiteRunner(iters=args.iters, seeds=args.seeds, loss=args.loss)
+    runner = SuiteRunner(iters=args.iters, seeds=args.seeds, loss=args.loss,
+                         telemetry=telemetry)
     t0 = time.perf_counter()
     if args.task_batch:
         # group loaders by file size (the same shape proxy the sort uses);
@@ -141,6 +153,16 @@ def main(argv=None):
         line["compute_s"] = round(stats.get("compute_s", 0.0), 2)
         line["compute_device_s"] = round(
             stats.get("compute_device_s", 0.0), 2)
+    if telemetry is not None:
+        paths = telemetry.write(extra={"suite": {
+            k: stats.get(k) for k in ("total_s", "compute_s",
+                                      "compute_device_s", "n_devices",
+                                      "schedule", "occupancy")
+            if k in stats}})
+        if store is not None:
+            telemetry.flush_to_store(store, experiment="suite",
+                                     run_name="suite-telemetry")
+        line["telemetry"] = paths.get("telemetry")
     print(json.dumps(line))
 
 
