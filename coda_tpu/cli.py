@@ -150,6 +150,16 @@ def parse_args(argv=None):
                         "telemetry.json (jit recompiles, HBM watermarks), "
                         "metrics.prom (Prometheus text); scalars also land "
                         "in the tracking store unless --no-mlflow")
+    p.add_argument("--record-dir", default=None,
+                   help="decision flight recorder: write a per-round "
+                        "provenance record (chosen idx, oracle label, "
+                        "top-k EIG scores, runner-up gap, P(best) digest, "
+                        "PRNG key counters) + environment fingerprint "
+                        "there; verify later with "
+                        "`python -m coda_tpu.cli replay <dir>`")
+    p.add_argument("--record-topk", type=int, default=8,
+                   help="how many top-scored candidates the flight "
+                        "recorder captures per round (with --record-dir)")
     p.add_argument("--debug-viz", action="store_true",
                    help="log P(best) / regret-curve charts as artifacts to "
                         "the tracking store (reference _DEBUG_VIZ analog)")
@@ -308,6 +318,13 @@ def main(argv=None):
         from coda_tpu.serve.server import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "replay":
+        # `python -m coda_tpu.cli replay <record-dir> ...`: re-execute a
+        # flight-recorder record and triage any divergence (first diverging
+        # round + quantity); `--against` diffs two records instead
+        from coda_tpu.engine.replay import replay_main
+
+        return replay_main(argv[1:])
     if argv and argv[0] == "suite":
         # `python -m coda_tpu.cli suite ...`: the in-process sweep driver
         # (scripts/run_suite.py) — grows --task-batch/--suite-devices/
@@ -375,14 +392,38 @@ def main(argv=None):
     with profiler_trace(args.profile_dir):
         with tele_span("experiment", method=args.method, iters=args.iters,
                        seeds=args.seeds):
-            result = _run_all_seeds(args, factory, selector, dataset,
-                                    model_losses, loss_fn)
+            result, record_aux = _run_all_seeds(args, factory, selector,
+                                                dataset, model_losses,
+                                                loss_fn)
             result.regret.block_until_ready()
     if args.profile_dir:
         print(f"Profiler trace written to {args.profile_dir}")
     wall = time.perf_counter() - t0
     if telemetry is not None:
         telemetry.sample_devices()
+    if record_aux is not None:
+        from coda_tpu.telemetry.recorder import (
+            RunRecord,
+            environment_fingerprint,
+            knobs_from_args,
+        )
+
+        knobs = knobs_from_args(args)
+        # the replica-width hint the auto eig_mode budget saw — replay must
+        # rebuild the selector with the same value or the tier (and kernel)
+        # choice could silently differ from the recording
+        knobs["n_parallel"] = max(1, args.seeds)
+        record = RunRecord.from_result(
+            result, record_aux,
+            environment_fingerprint(dataset=dataset, knobs=knobs),
+            run={"task": dataset.name, "synthetic": args.synthetic,
+                 "data_dir": args.data_dir, "method": args.method,
+                 "loss": args.loss, "iters": args.iters,
+                 "seeds": args.seeds})
+        record.save(args.record_dir,
+                    registry=telemetry.registry if telemetry else None)
+        print(f"decision record written to {args.record_dir} "
+              f"(verify: python -m coda_tpu.cli replay {args.record_dir})")
     steps = args.iters * args.seeds
     print(f"{steps} selection steps in {wall:.2f}s "
           f"({steps / wall:.2f} steps/s, all seeds batched)")
@@ -438,11 +479,19 @@ def main(argv=None):
 
 
 def _run_all_seeds(args, factory, selector, dataset, model_losses, loss_fn):
+    """Returns ``(ExperimentResult, RunTraceAux | None)`` — the aux is the
+    flight-recorder sidecar, present only under ``--record-dir``."""
     import jax
 
-    from coda_tpu.engine import run_seeds_compiled
+    from coda_tpu.engine import run_seeds_compiled, run_seeds_recorded
 
     if args.checkpoint_dir:
+        if getattr(args, "record_dir", None):
+            raise SystemExit(
+                "--record-dir does not compose with --checkpoint-dir: the "
+                "chunked resumable scan is a different program from the "
+                "recorded one, so the record could not honor the bitwise "
+                "replay contract; drop one of the flags")
         # resumable path: seeds run serially, each checkpointing its chunked
         # scan under <dir>/seed_<s> (new capability; the reference's resume
         # granularity is the whole seed-run, main.py:155-157)
@@ -459,12 +508,22 @@ def _run_all_seeds(args, factory, selector, dataset, model_losses, loss_fn):
         import jax.numpy as jnp
 
         result = jax.tree.map(lambda *xs: jnp.stack(xs), *per_seed)
-    else:
-        result = run_seeds_compiled(factory, dataset.preds, dataset.labels,
-                                    iters=args.iters, seeds=args.seeds,
-                                    loss_fn=loss_fn)
-    return result
+        return result, None
+    if getattr(args, "record_dir", None):
+        return run_seeds_recorded(factory, dataset.preds, dataset.labels,
+                                  iters=args.iters, seeds=args.seeds,
+                                  loss_fn=loss_fn,
+                                  trace_k=getattr(args, "record_topk", 8))
+    result = run_seeds_compiled(factory, dataset.preds, dataset.labels,
+                                iters=args.iters, seeds=args.seeds,
+                                loss_fn=loss_fn)
+    return result, None
 
 
 if __name__ == "__main__":
-    main()
+    _out = main()
+    # subcommands (replay) return an int verdict code; experiment runs
+    # return the ExperimentResult for in-process callers — only the former
+    # is a process exit status
+    if isinstance(_out, int):
+        raise SystemExit(_out)

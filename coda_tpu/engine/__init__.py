@@ -1,9 +1,12 @@
 from coda_tpu.engine.loop import (
     ExperimentResult,
+    RoundTrace,
+    RunTraceAux,
     make_step_fn,
     run_experiment,
     run_seeds,
     run_seeds_compiled,
+    run_seeds_recorded,
 )
 
 _CHECKPOINT_EXPORTS = (
@@ -15,10 +18,13 @@ _CHECKPOINT_EXPORTS = (
 
 __all__ = [
     "ExperimentResult",
+    "RoundTrace",
+    "RunTraceAux",
     "make_step_fn",
     "run_experiment",
     "run_seeds",
     "run_seeds_compiled",
+    "run_seeds_recorded",
     *_CHECKPOINT_EXPORTS,
 ]
 

@@ -38,10 +38,89 @@ class ExperimentResult(NamedTuple):
     stochastic: jnp.ndarray    # scalar bool — did RNG affect the run?
 
 
+class RoundTrace(NamedTuple):
+    """Flight-recorder provenance of one labeling round (leading axis = round
+    under scan). Device-side: emitted as extra ``lax.scan`` outputs and
+    harvested ONCE per run — O(rounds·k) host traffic, no per-round sync.
+    See ``coda_tpu/telemetry/recorder.py`` for the on-disk schema and
+    ``coda_tpu/engine/replay.py`` for the parity/triage consumer."""
+
+    round_key: jnp.ndarray     # (T, 2) uint32 — the round's PRNG key counter
+    topk_idx: jnp.ndarray      # (T, k) int32 — top-k candidate indices
+    topk_score: jnp.ndarray    # (T, k) float32 — their acquisition scores
+    chosen_score: jnp.ndarray  # (T,) float32 — score of the picked point
+    runner_up_gap: jnp.ndarray  # (T,) float32 — top1 - top2 score margin
+    pbest_max: jnp.ndarray     # (T,) float32 — max of posterior P(best); NaN
+    #                             when the method exposes no posterior
+    pbest_entropy: jnp.ndarray  # (T,) float32 — entropy (bits) of P(best)
+
+
+class RunTraceAux(NamedTuple):
+    """Per-run recorder sidecar: the round traces plus the init/prior key
+    material replay needs to reconstruct the exact RNG stream."""
+
+    trace: RoundTrace
+    root_key: jnp.ndarray   # (2,) uint32 — PRNGKey(seed)
+    init_key: jnp.ndarray   # (2,) uint32 — consumed by selector.init
+    prior_key: jnp.ndarray  # (2,) uint32 — consumed by the round-0 best()
+
+
+def key_bits(k) -> jnp.ndarray:
+    """A key's raw uint32 counter words (identity for raw old-style keys,
+    ``jax.random.key_data`` for typed keys)."""
+    k = jnp.asarray(k)
+    if jnp.issubdtype(k.dtype, jnp.integer):
+        return k.astype(jnp.uint32)
+    return jax.random.key_data(k)
+
+
+def make_round_trace(selector: Selector, res, state_after, k,
+                     trace_k: int) -> RoundTrace:
+    """One round's provenance record (pure; shared by the recording scan
+    step and the replay engine so both emit bit-identical trace math).
+
+    ``state_after`` is the post-update state: the posterior digest describes
+    the round's *outcome*, aligned with the ``best_model`` trace entry.
+    Selectors that return no score vector still get a minimal record (their
+    chosen idx/prob in slot 0)."""
+    from coda_tpu.ops.masked import entropy2
+
+    scores = res.scores
+    if scores is None:
+        topk_score = jnp.full((trace_k,), -jnp.inf,
+                              jnp.float32).at[0].set(res.prob)
+        topk_idx = jnp.full((trace_k,), -1, jnp.int32).at[0].set(res.idx)
+        chosen = res.prob.astype(jnp.float32)
+    else:
+        topk_score, topk_idx = lax.top_k(scores.astype(jnp.float32), trace_k)
+        topk_idx = topk_idx.astype(jnp.int32)
+        chosen = scores[res.idx].astype(jnp.float32)
+    gap = (topk_score[0] - topk_score[1] if trace_k >= 2
+           else jnp.asarray(0.0, jnp.float32))
+    get_pbest = selector.extras.get("get_pbest")
+    if get_pbest is not None:
+        pb = get_pbest(state_after).astype(jnp.float32)
+        pbest_max = pb.max()
+        pbest_entropy = entropy2(pb)
+    else:
+        pbest_max = jnp.asarray(jnp.nan, jnp.float32)
+        pbest_entropy = jnp.asarray(jnp.nan, jnp.float32)
+    return RoundTrace(
+        round_key=key_bits(k),
+        topk_idx=topk_idx,
+        topk_score=topk_score,
+        chosen_score=chosen,
+        runner_up_gap=gap,
+        pbest_max=pbest_max,
+        pbest_entropy=pbest_entropy,
+    )
+
+
 def make_step_fn(
     selector: Selector,
     labels: jnp.ndarray,
     model_losses: jnp.ndarray,
+    trace_k: int = 0,
 ):
     """One labeling round as a pure scan step.
 
@@ -49,6 +128,12 @@ def make_step_fn(
     ``(idx, true_class, best, regret, cum, prob, stochastic)``. Shared by the
     single-shot scan (`build_experiment_fn`) and the chunked resumable runner
     (`coda_tpu.engine.checkpoint`), so both execute the identical program.
+
+    ``trace_k > 0`` appends a :class:`RoundTrace` to the per-round outputs
+    (the flight-recorder tap). The seven base outputs' dataflow is untouched
+    — the trace only *reads* values the step already computes — so a
+    recorded run's decision trajectory is the unrecorded program's, pinned
+    by ``tests/test_recorder.py``.
     """
     best_loss = model_losses.min()
 
@@ -68,8 +153,13 @@ def make_step_fn(
             best, b_stoch = selector.best(state, k_best)
         regret = model_losses[best] - best_loss
         cum = cum + regret
-        return (state, cum), (res.idx, tc, best, regret, cum, res.prob,
-                              res.stochastic | b_stoch)
+        outs = (res.idx, tc, best, regret, cum, res.prob,
+                res.stochastic | b_stoch)
+        if trace_k:
+            with jax.named_scope("record"):
+                outs = outs + (make_round_trace(selector, res, state, k,
+                                                trace_k),)
+        return (state, cum), outs
 
     return step
 
@@ -122,6 +212,77 @@ def build_experiment_fn(
     return experiment
 
 
+def build_recording_experiment_fn(
+    selector: Selector,
+    labels: jnp.ndarray,
+    model_losses: jnp.ndarray,
+    iters: int = 100,
+    trace_k: int = 8,
+) -> Callable[[jax.Array], tuple]:
+    """``key -> (ExperimentResult, RunTraceAux)`` — the flight-recorder
+    variant of :func:`build_experiment_fn`.
+
+    Identical experiment program with the per-round provenance tap enabled
+    (``make_step_fn(trace_k=...)``): same keys, same selections, same
+    metrics; the scan additionally stacks a :class:`RoundTrace` per round
+    which the caller harvests once alongside the result."""
+    best_loss = model_losses.min()
+    N = labels.shape[0]
+    if iters > N:
+        raise ValueError(
+            f"iters={iters} exceeds the {N} labelable points; the unlabeled "
+            "set would be exhausted mid-run"
+        )
+    trace_k = max(1, min(int(trace_k), N))
+    step = make_step_fn(selector, labels, model_losses, trace_k=trace_k)
+
+    def experiment(key: jax.Array):
+        k_init, k_prior, k_scan = jax.random.split(key, 3)
+        state0 = selector.init(k_init)
+        best0, stoch0 = selector.best(state0, k_prior)
+        regret0 = model_losses[best0] - best_loss
+
+        keys = jax.random.split(k_scan, iters)
+        (_, _), (idxs, tcs, bests, regrets, cums, probs, stoch,
+                 trace) = lax.scan(
+            step, (state0, jnp.asarray(0.0, jnp.float32)), keys
+        )
+        result = ExperimentResult(
+            chosen_idx=idxs,
+            true_class=tcs,
+            best_model=bests,
+            regret=regrets,
+            cumulative_regret=cums,
+            select_prob=probs,
+            regret_at_0=regret0,
+            stochastic=stoch.any() | stoch0
+            | jnp.asarray(selector.always_stochastic),
+        )
+        aux = RunTraceAux(trace=trace, root_key=key_bits(key),
+                          init_key=key_bits(k_init),
+                          prior_key=key_bits(k_prior))
+        return result, aux
+
+    return experiment
+
+
+def run_seeds_recorded(
+    selector_factory: Callable[[jnp.ndarray], Selector],
+    preds: jnp.ndarray,
+    labels: jnp.ndarray,
+    iters: int = 100,
+    seeds: int = 5,
+    loss_fn: Callable = accuracy_loss,
+    trace_k: int = 8,
+):
+    """:func:`run_seeds_compiled` with the flight recorder on: returns
+    ``(ExperimentResult, RunTraceAux)``, both with a leading seed axis."""
+    fn = make_batched_experiment_fn(selector_factory, iters, loss_fn,
+                                    trace_k=trace_k)
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in range(seeds)])
+    return jax.jit(fn)(preds, labels, keys)
+
+
 def run_experiment(
     selector: Selector,
     dataset,
@@ -171,6 +332,7 @@ def make_batched_experiment_fn(
     selector_factory: Callable[[jnp.ndarray], Selector],
     iters: int,
     loss_fn: Callable = accuracy_loss,
+    trace_k: int = 0,
 ):
     """``(preds, labels, keys, *extra) -> ExperimentResult`` (seed axis
     leading).
@@ -181,11 +343,18 @@ def make_batched_experiment_fn(
     runtime hyperparameters to the factory (``selector_factory(preds,
     *extra)`` — e.g. ModelPicker's per-task ε as a traced scalar, so one
     executable serves every task instead of compiling per tuned value).
+
+    ``trace_k > 0`` switches to the flight-recorder program: the returned
+    function yields ``(ExperimentResult, RunTraceAux)`` instead (same
+    decision trajectory; see :func:`build_recording_experiment_fn`).
     """
     def fn(preds, labels, keys, *extra):
         sel = selector_factory(preds, *extra)
         losses = compute_true_losses(preds, labels, loss_fn)
-        exp = build_experiment_fn(sel, labels, losses, iters)
+        exp = (build_recording_experiment_fn(sel, labels, losses, iters,
+                                             trace_k=trace_k)
+               if trace_k else build_experiment_fn(sel, labels, losses,
+                                                   iters))
         if keys.shape[0] == 1:
             # width-1 batches (the suite's seed-0 probe) skip the seed vmap:
             # under vmap both pallas kernels' custom_vmap rules fall back to

@@ -1,0 +1,502 @@
+"""Deterministic replay + divergence triage over flight-recorder records.
+
+The verify half of the decision flight recorder
+(``coda_tpu/telemetry/recorder.py``). A recorded run is re-executed through
+the **identical recording program** (same ``make_batched_experiment_fn``
+trace, same seed-batch width, the recorded root keys as input), so on the
+same backend with unchanged knobs the replay is bitwise the recorded run —
+any other contract would make XLA fusion choices look like bugs (a
+teacher-forced variant was tried first and drifts ~1 ulp on CPU purely from
+graph-shape-dependent fusion). The recorded per-round keys and oracle
+answers are then *verified* against the replay: a ``round_key`` mismatch
+means the key derivation itself changed (its own triage class), and a
+``true_class`` mismatch downstream of an idx flip shows the oracle was
+consulted differently. Divergence is reported at the FIRST diverging round
+— rounds before it agree by definition, rounds after it may cascade and are
+reported per quantity but not re-classified. Three comparison modes, one
+code path:
+
+  * **replay vs its record** (``python -m coda_tpu.cli replay <dir>``):
+    bitwise parity expected on the same backend with the same knobs;
+  * **record vs record** (``--against``): e.g. a pallas capture vs an XLA
+    capture, or bf16 vs exact — compared under the documented cross-backend
+    score contract (``CROSS_BACKEND_SCORE_TOL`` = 2.34e-4);
+  * the **dryrun/suite verifiers** (``scripts/dryrun_multichip.py``) reuse
+    :func:`compare_records` instead of hand-rolled asserts.
+
+On mismatch the triage report names the first diverging round and the first
+diverging quantity, classified as:
+
+  * ``score-delta`` — the acquisition scores themselves moved beyond
+    tolerance (numerics change in the scoring chain);
+  * ``tie-break-flip`` — scores agree within tolerance but the pick
+    changed (near-tie argmax order flipped, e.g. across lowerings);
+  * ``posterior-drift`` — decisions agree but the posterior digest
+    (P(best) max/entropy or the best-model readout) moved (numerics change
+    in the update/readout chain);
+  * ``metric-drift`` — only derived metrics (regret) moved.
+
+This turns NOTES_r07-class parity bugs (threefry/GSPMD tie-break
+divergence, found by hand in PR 4) into a one-command diagnosis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from coda_tpu.telemetry.recorder import (
+    CROSS_BACKEND_SCORE_TOL,
+    RunRecord,
+    dataset_digest,
+    environment_fingerprint,
+)
+
+# quantity -> triage class, in causal order: a key mismatch explains a
+# score delta explains a flip explains posterior drift explains metric
+# drift, so the FIRST diverging group at the first diverging round names
+# the root cause
+_QUANTITY_GROUPS = (
+    ("key-drift", ("round_key",)),
+    ("score-delta", ("topk_score", "chosen_score", "select_prob")),
+    ("tie-break-flip", ("chosen_idx", "true_class")),
+    ("posterior-drift", ("pbest_max", "pbest_entropy", "best_model")),
+    ("metric-drift", ("regret", "cumulative_regret", "runner_up_gap")),
+)
+_INT_QUANTITIES = {"chosen_idx", "true_class", "best_model", "round_key"}
+
+
+def replay_record(record: RunRecord, selector_factory, preds, labels,
+                  loss: str = "acc") -> dict:
+    """Re-execute a record's program and return the replayed arrays.
+
+    Runs the IDENTICAL recording program — same
+    ``make_batched_experiment_fn(trace_k=...)`` trace, same seed-batch
+    width, preds as a traced jit argument — seeded with the record's root
+    keys. Same backend + same knobs ⇒ bitwise the recorded arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.engine.loop import make_batched_experiment_fn
+    from coda_tpu.losses import LOSS_FNS
+
+    run = record.meta.get("run", {})
+    iters = int(run.get("iters", record.rounds))
+    fn = make_batched_experiment_fn(
+        selector_factory, iters, LOSS_FNS[loss],
+        trace_k=int(record.meta.get("trace_k", 8)))
+    keys = jnp.asarray(record.arrays["root_key"], jnp.uint32)
+    result, aux = jax.jit(fn)(preds, labels, keys)
+    return {
+        "chosen_idx": np.asarray(result.chosen_idx),
+        "true_class": np.asarray(result.true_class),
+        "best_model": np.asarray(result.best_model),
+        "regret": np.asarray(result.regret),
+        "cumulative_regret": np.asarray(result.cumulative_regret),
+        "select_prob": np.asarray(result.select_prob),
+        "round_key": np.asarray(aux.trace.round_key),
+        "topk_idx": np.asarray(aux.trace.topk_idx),
+        "topk_score": np.asarray(aux.trace.topk_score),
+        "chosen_score": np.asarray(aux.trace.chosen_score),
+        "runner_up_gap": np.asarray(aux.trace.runner_up_gap),
+        "pbest_max": np.asarray(aux.trace.pbest_max),
+        "pbest_entropy": np.asarray(aux.trace.pbest_entropy),
+    }
+
+
+# ---------------------------------------------------------------------------
+# comparison + triage (pure numpy — also drives record-vs-record mode)
+# ---------------------------------------------------------------------------
+
+def _rows_equal(a: np.ndarray, b: np.ndarray, tol: float) -> np.ndarray:
+    """(T,) bool: per-round equality, reducing trailing axes. ``tol=0`` is
+    bitwise-for-floats (NaN==NaN so an absent posterior digest never
+    diverges); integers always compare exact."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.dtype.kind in "iub" or tol == 0.0:
+        eq = (a == b)
+        if a.dtype.kind == "f":
+            eq |= np.isnan(a) & np.isnan(b)
+    else:
+        eq = np.isclose(a.astype(np.float64), b.astype(np.float64),
+                        rtol=0.0, atol=tol, equal_nan=True)
+        # two -inf (masked non-candidates) are equal; isclose(inf,inf) is
+        # already True, but inf-vs-finite must stay a divergence
+    while eq.ndim > 1:
+        eq = eq.all(axis=-1)
+    return eq
+
+
+def _max_delta(a: np.ndarray, b: np.ndarray) -> float:
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    d = np.abs(a - b)
+    d = np.where(np.isnan(a) & np.isnan(b), 0.0, d)
+    # NaN on exactly ONE side is a structural difference (a posterior digest
+    # present in one record, absent in the other) — report it as inf, never
+    # drop it (nanmax would) or let it poison the max (plain max of NaN)
+    d = np.where(np.isnan(a) ^ np.isnan(b), np.inf, d)
+    d = np.where(np.isinf(a) & np.isinf(b) & (np.sign(a) == np.sign(b)),
+                 0.0, d)
+    return float(np.max(d)) if d.size else 0.0
+
+
+@dataclass
+class SeedTriage:
+    """Divergence verdict for one seed of a record comparison."""
+
+    seed: int
+    parity: bool
+    first_divergent_round: Optional[int] = None
+    quantity: Optional[str] = None
+    classification: Optional[str] = None
+    # per-quantity evidence: first diverging round + max |delta| over rounds
+    quantities: dict = field(default_factory=dict)
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed, "parity": self.parity,
+            "first_divergent_round": self.first_divergent_round,
+            "quantity": self.quantity,
+            "classification": self.classification,
+            "quantities": self.quantities, "note": self.note,
+        }
+
+
+@dataclass
+class ReplayReport:
+    """Aggregate verdict of a replay/record comparison."""
+
+    mode: str                    # "replay" | "records"
+    score_tol: float
+    seeds: list = field(default_factory=list)   # [SeedTriage]
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def parity(self) -> bool:
+        return all(s.parity for s in self.seeds)
+
+    def to_dict(self) -> dict:
+        return {
+            "mode": self.mode, "parity": self.parity,
+            "score_tol": self.score_tol,
+            "seeds": [s.to_dict() for s in self.seeds],
+            "meta": self.meta,
+        }
+
+
+def compare_seed(rec: dict, rep: dict, score_tol: float = 0.0,
+                 seed: int = 0,
+                 int_tol_quantities: tuple = ()) -> SeedTriage:
+    """Triage one seed's recorded-vs-replayed (or A-vs-B) round arrays.
+
+    ``score_tol`` bounds every float quantity; integer decision quantities
+    always compare exact. The first diverging round is located across ALL
+    quantities, then classified by the causally-first diverging group at
+    that round (see module docstring)."""
+    first_by_q: dict = {}
+    deltas: dict = {}
+    T = int(np.asarray(rec["chosen_idx"]).shape[0])
+    for cls_name, quantities in _QUANTITY_GROUPS:
+        for q in quantities:
+            if q not in rec or q not in rep:
+                continue
+            # the runner-up gap is a DIFFERENCE of two tol-bounded scores,
+            # so its honest bound is 2·tol — comparing it at 1·tol would
+            # double-count drift the score comparison already admitted
+            tol_q = 2.0 * score_tol if q == "runner_up_gap" else score_tol
+            eq = _rows_equal(rec[q], rep[q], tol_q)
+            div = np.nonzero(~eq)[0]
+            if div.size:
+                first_by_q[q] = int(div[0])
+                if q not in _INT_QUANTITIES:
+                    deltas[q] = _max_delta(rec[q], rep[q])
+    if not first_by_q:
+        return SeedTriage(seed=seed, parity=True,
+                          quantities={"rounds_compared": T})
+    t0 = min(first_by_q.values())
+    quantity = None
+    classification = None
+    for cls_name, quantities in _QUANTITY_GROUPS:
+        hit = [q for q in quantities if first_by_q.get(q) == t0]
+        if hit:
+            quantity = hit[0]
+            classification = cls_name
+            break
+    note = ""
+    if classification == "tie-break-flip":
+        gap = float(np.asarray(rec["runner_up_gap"])[t0])
+        note = (f"recorded runner-up gap at round {t0} is {gap:.3e} — "
+                f"{'a near-tie; ' if abs(gap) <= max(score_tol, 1e-6) else ''}"
+                "scores agree within tolerance but the argmax pick changed")
+    info = {q: {"first_divergent_round": r,
+                "max_abs_delta": deltas.get(q)}
+            for q, r in sorted(first_by_q.items())}
+    return SeedTriage(seed=seed, parity=False, first_divergent_round=t0,
+                      quantity=quantity, classification=classification,
+                      quantities=info, note=note)
+
+
+def compare_records(a: RunRecord, b: RunRecord,
+                    score_tol: float = 0.0) -> ReplayReport:
+    """Direct record-vs-record comparison (no re-execution): the shared
+    verifier behind ``replay --against`` and the multichip dryrun's
+    pallas-vs-XLA / sharded-vs-serial checks.
+
+    Records captured with different ``--record-topk`` compare on the
+    common top-k prefix; a seed-count mismatch compares the common seeds
+    and is surfaced in the report meta + triage text (never silently
+    called full parity)."""
+    if a.rounds != b.rounds:
+        raise ValueError(
+            f"records disagree on round count ({a.rounds} vs {b.rounds}); "
+            "nothing round-aligned to compare")
+    report = ReplayReport(mode="records", score_tol=score_tol, meta={
+        "a": a.meta.get("run", {}), "b": b.meta.get("run", {}),
+        "backend_a": a.meta.get("fingerprint", {}).get("backend"),
+        "backend_b": b.meta.get("fingerprint", {}).get("backend"),
+    })
+    k = min(int(a.meta.get("trace_k", 8)), int(b.meta.get("trace_k", 8)))
+    if a.meta.get("trace_k") != b.meta.get("trace_k"):
+        report.meta["trace_k_compared"] = k
+    n_seeds = min(a.seeds, b.seeds)
+    if a.seeds != b.seeds:
+        report.meta["seed_count_mismatch"] = {"a": a.seeds, "b": b.seeds,
+                                              "compared": n_seeds}
+    def _trim(arr_dict):
+        return {key: (v[:, :k] if key in ("topk_idx", "topk_score")
+                      else v) for key, v in arr_dict.items()}
+    for s in range(n_seeds):
+        report.seeds.append(compare_seed(_trim(a.seed_arrays(s)),
+                                         _trim(b.seed_arrays(s)),
+                                         score_tol=score_tol, seed=s))
+    return report
+
+
+def verify_replay(record: RunRecord, selector_factory, preds, labels,
+                  loss: str = "acc", score_tol: float = 0.0, seeds=None,
+                  registry=None) -> ReplayReport:
+    """Re-execute ``record`` through its own program and triage each seed;
+    feeds the ``replay_verified_total`` / ``replay_divergent_total``
+    counters."""
+    from coda_tpu.telemetry.registry import get_registry
+
+    report = ReplayReport(mode="replay", score_tol=score_tol,
+                          meta={"run": record.meta.get("run", {})})
+    replayed = replay_record(record, selector_factory, preds, labels,
+                             loss=loss)
+    for s in (range(record.seeds) if seeds is None else seeds):
+        rec = record.seed_arrays(s)
+        rep = {k: v[s] for k, v in replayed.items()}
+        report.seeds.append(compare_seed(rec, rep, score_tol=score_tol,
+                                         seed=s))
+    reg = registry if registry is not None else get_registry()
+    if report.parity:
+        reg.counter("replay_verified_total",
+                    "Replay verifications that matched their record").inc()
+    else:
+        reg.counter("replay_divergent_total",
+                    "Replay verifications that diverged from their "
+                    "record").inc()
+    return report
+
+
+def format_triage(report: ReplayReport) -> str:
+    """Human-readable verdict block (the CLI's stdout)."""
+    lines = []
+    tol = ("bitwise" if report.score_tol == 0.0
+           else f"|Δscore| ≤ {report.score_tol:g}")
+    lines.append(f"replay[{report.mode}] contract: {tol}")
+    mism = report.meta.get("seed_count_mismatch")
+    if mism:
+        lines.append(
+            f"  WARNING: seed counts differ (a={mism['a']}, b={mism['b']})"
+            f" — only the {mism['compared']} common seed(s) were compared;"
+            " this verdict covers nothing beyond them")
+    if "trace_k_compared" in report.meta:
+        lines.append(f"  note: records carry different top-k widths; "
+                     f"compared the common top-"
+                     f"{report.meta['trace_k_compared']} prefix")
+    for s in report.seeds:
+        if s.parity:
+            lines.append(f"  seed {s.seed}: PARITY "
+                         f"({s.quantities.get('rounds_compared', '?')} "
+                         "rounds)")
+            continue
+        lines.append(
+            f"  seed {s.seed}: DIVERGED at round {s.first_divergent_round} "
+            f"— first diverging quantity: {s.quantity} "
+            f"[{s.classification}]")
+        if s.note:
+            lines.append(f"    {s.note}")
+        for q, info in s.quantities.items():
+            d = info.get("max_abs_delta")
+            lines.append(
+                f"    {q}: first at round {info['first_divergent_round']}"
+                + (f", max |Δ| = {d:.3e}" if d is not None else ""))
+    lines.append("verdict: " + ("PARITY" if report.parity else "DIVERGED"))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# record -> runnable experiment reconstruction + the CLI subcommand
+# ---------------------------------------------------------------------------
+
+def _args_from_record(record: RunRecord, data_dir: Optional[str] = None,
+                      overrides: Optional[dict] = None):
+    """Rebuild the argparse namespace a record was captured under: CLI
+    defaults, then the fingerprinted knobs, then explicit overrides."""
+    from coda_tpu.cli import parse_args
+
+    args = parse_args([])
+    run = record.meta.get("run", {})
+    knobs = dict(record.meta.get("fingerprint", {}).get("knobs", {}))
+    knobs.update(overrides or {})
+    for k, v in knobs.items():
+        setattr(args, k, v)
+    args.task = run.get("task")
+    args.synthetic = run.get("synthetic")
+    if data_dir:
+        args.data_dir = data_dir
+    elif run.get("data_dir"):
+        args.data_dir = run["data_dir"]
+    # note: the knobs loop above also restores n_parallel — the recorded
+    # replica-width hint that steers the auto eig_mode budget, so replay
+    # rebuilds the selector on the recorded kernel tier
+    return args
+
+
+def load_record_environment(record: RunRecord,
+                            data_dir: Optional[str] = None,
+                            overrides: Optional[dict] = None,
+                            check_digest: bool = True):
+    """``(dataset, selector_factory, args)`` for a record — everything
+    :func:`verify_replay` needs to re-execute the recorded program."""
+    from coda_tpu.cli import build_selector_factory, load_dataset
+
+    args = _args_from_record(record, data_dir, overrides)
+    dataset = load_dataset(args)
+    want = record.meta.get("fingerprint", {}).get("dataset", {}).get(
+        "digest")
+    if check_digest and want:
+        got = dataset_digest(dataset.preds, dataset.labels)
+        if got != want:
+            raise ValueError(
+                f"dataset digest mismatch: record was captured on "
+                f"{want}, loaded data hashes to {got} — replaying against "
+                "different data answers a different question "
+                "(pass --allow-digest-mismatch to proceed anyway)")
+    factory = build_selector_factory(args, dataset.name)
+    return dataset, factory, args
+
+
+def _auto_tol(record: RunRecord, overrides: dict,
+              against: Optional[RunRecord] = None) -> float:
+    """Bitwise when the two sides share a backend with unchanged knobs;
+    the documented cross-backend score contract otherwise.
+
+    In replay mode the "other side" is the current process; in --against
+    mode it is the second RECORD — the current host's backend is
+    irrelevant to a record-vs-record diff."""
+    fp = record.meta.get("fingerprint", {})
+    if against is not None:
+        fp_b = against.meta.get("fingerprint", {})
+        same = (fp.get("backend") == fp_b.get("backend")
+                and fp.get("knobs") == fp_b.get("knobs"))
+        return 0.0 if same else CROSS_BACKEND_SCORE_TOL
+    import jax
+
+    same_backend = fp.get("backend") == jax.default_backend()
+    return 0.0 if (same_backend and not overrides) \
+        else CROSS_BACKEND_SCORE_TOL
+
+
+def _parse_overrides(pairs) -> dict:
+    out = {}
+    for p in pairs or ():
+        if "=" not in p:
+            raise SystemExit(f"--set expects KEY=VALUE, got {p!r}")
+        k, v = p.split("=", 1)
+        for cast in (int, float):
+            try:
+                v = cast(v)
+                break
+            except ValueError:
+                continue
+        if v in ("true", "True"):
+            v = True
+        elif v in ("false", "False"):
+            v = False
+        out[k] = v
+    return out
+
+
+def replay_main(argv=None) -> int:
+    """``python -m coda_tpu.cli replay <record-dir> [...]``."""
+    p = argparse.ArgumentParser(
+        prog="coda_tpu.cli replay",
+        description="re-execute a flight-recorder record and triage any "
+                    "divergence (or diff two records with --against)")
+    p.add_argument("record_dir", help="directory with record.json + "
+                                      "rounds.npz (a --record-dir output)")
+    p.add_argument("--against", default=None, metavar="DIR",
+                   help="compare against this second record instead of "
+                        "re-executing (e.g. a pallas capture vs an XLA "
+                        "capture)")
+    p.add_argument("--data-dir", default=None,
+                   help="override the recorded data directory")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (cpu/tpu)")
+    p.add_argument("--score-tol", default="auto",
+                   help="float tolerance on score/posterior quantities; "
+                        "'auto' = bitwise (0.0) on the recorded backend "
+                        "with unchanged knobs, else the documented "
+                        f"{CROSS_BACKEND_SCORE_TOL} cross-backend contract")
+    p.add_argument("--seed", type=int, default=None,
+                   help="replay only this recorded seed (default: all)")
+    p.add_argument("--set", action="append", metavar="KEY=VALUE",
+                   dest="overrides",
+                   help="override a recorded knob for the replay (e.g. "
+                        "eig_entropy=approx) — divergence triage then "
+                        "isolates that knob's decision-trace impact")
+    p.add_argument("--allow-digest-mismatch", action="store_true")
+    p.add_argument("--out", default=None, metavar="REPORT.json",
+                   help="write the triage report there as JSON")
+    args = p.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
+
+    record = RunRecord.load(args.record_dir)
+    overrides = _parse_overrides(args.overrides)
+    other = RunRecord.load(args.against) if args.against else None
+    tol = (_auto_tol(record, overrides, against=other)
+           if args.score_tol == "auto" else float(args.score_tol))
+
+    if other is not None:
+        report = compare_records(record, other, score_tol=tol)
+    else:
+        dataset, factory, rec_args = load_record_environment(
+            record, data_dir=args.data_dir, overrides=overrides,
+            check_digest=not args.allow_digest_mismatch)
+        seeds = None if args.seed is None else [args.seed]
+        report = verify_replay(record, factory, dataset.preds,
+                               dataset.labels,
+                               loss=getattr(rec_args, "loss", "acc"),
+                               score_tol=tol, seeds=seeds)
+    print(format_triage(report))
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report.to_dict(), f, indent=2)
+        print(f"triage report written to {args.out}")
+    return 0 if report.parity else 2

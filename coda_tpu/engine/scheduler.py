@@ -30,7 +30,14 @@ devices and lets jax's async dispatch run them concurrently:
 
 The sweep's semantics are unchanged: same chunking, same resume-skip
 checks, same result unpacking — ``tests/test_scheduler.py`` pins bitwise
-parity against the serial path on the 8-virtual-device CPU mesh.
+parity against the serial path on the 8-virtual-device CPU mesh. The
+decision flight recorder rides the shared ``_launch_batch`` /
+``_harvest_batch`` pair, so a ``SuiteRunner(record_dir=...)`` emits the
+same per-(family, method) record streams under scheduled placement as
+under serial dispatch — the probe's trace arrays join the deferred
+``copy_to_host_async`` harvest, adding no extra syncs to the placement
+loop (``tests/test_recorder.py`` pins stream coverage and bitwise result
+parity for both paths).
 """
 
 from __future__ import annotations

@@ -55,6 +55,10 @@ class PendingBatch:
     cost: float = 0.0       # scheduler's relative LPT weight (telemetry)
     heavy: bool = False     # memory-heavy (method has a batch_caps entry)
     t_end: float = field(default=0.0)  # set by harvest
+    aux: object = None      # flight-recorder RunTraceAux of the probe
+    #                         dispatch (record_dir runners only)
+    resolved: list = field(default_factory=list)  # per-task resolved
+    #                         hyperparams (the record's knob block)
 
 def family_of(name: str) -> str:
     """Task-name family: the prefix before a trailing ``_<index>``
@@ -105,12 +109,24 @@ class SuiteRunner:
     """
 
     def __init__(self, iters: int = 100, seeds: int = 5, loss: str = "acc",
-                 dedup_seeds: bool = True, telemetry=None):
+                 dedup_seeds: bool = True, telemetry=None,
+                 record_dir: Optional[str] = None, record_topk: int = 8):
         import jax
 
         self.iters = iters
         self.seeds = seeds
         self.loss_fn = LOSS_FNS[loss]
+        self._loss_name = loss
+        # decision flight recorder: with a record_dir, every (task, method)
+        # pair's seed-0 PROBE dispatch carries the per-round provenance tap
+        # and lands as one record under the per-(family, method) stream
+        # `<record_dir>/<family>__<method>/<task>/` — the probe is the one
+        # program both the dedup and batched paths always run, so stream
+        # coverage is uniform across execution modes. Replay/diff with
+        # `python -m coda_tpu.cli replay <dir> [--against <dir>]`.
+        self.record_dir = record_dir
+        self.record_topk = int(record_topk)
+        self._digests: dict = {}   # task name -> dataset digest (hash once)
         # optional telemetry.Telemetry: every dispatch becomes a span on its
         # device lane, cold dispatches feed the recompile-fallback counter,
         # and HBM watermarks are sampled after each harvest
@@ -155,6 +171,47 @@ class SuiteRunner:
                           t_start=t_start, t_end=t_end, attrs=attrs)
         tele.sample_devices([device] if device is not None else None)
 
+    def _dataset_digest(self, name: str, preds=None, labels=None):
+        """Hash a task's tensors once per runner (records of every method
+        share the cached digest)."""
+        if name not in self._digests and preds is not None:
+            from coda_tpu.telemetry.recorder import dataset_digest
+
+            self._digests[name] = dataset_digest(preds, labels)
+        return self._digests.get(name)
+
+    def _write_record_stream(self, task: str, method: str, shape, result,
+                             aux, resolved: Optional[dict],
+                             n_parallel: int, dataset=None) -> str:
+        """Write one probe record into the per-(family, method) stream
+        ``<record_dir>/<family>__<method>/<task>/``."""
+        from coda_tpu.telemetry.recorder import (
+            RunRecord,
+            environment_fingerprint,
+            stream_dir,
+        )
+
+        digest = self._dataset_digest(
+            task, getattr(dataset, "preds", None),
+            getattr(dataset, "labels", None))
+        knobs = dict(resolved or {})
+        knobs.update(method=method, loss=self._loss_name, iters=self.iters,
+                     n_parallel=n_parallel)
+        fp = environment_fingerprint(knobs=knobs)
+        fp["dataset"] = {"name": task, "shape": list(shape),
+                         "digest": digest}
+        seeds_rec = int(np.asarray(result.chosen_idx).shape[0])
+        rec = RunRecord.from_result(
+            result, aux, fp,
+            run={"task": task, "method": method, "iters": self.iters,
+                 "loss": self._loss_name, "seeds": seeds_rec,
+                 "stream": "suite"})
+        out = stream_dir(self.record_dir, f"{family_of(task)}__{method}",
+                         task)
+        rec.save(out, registry=(self.telemetry.registry
+                                if self.telemetry is not None else None))
+        return out
+
     def _resolved_args(self, method: str, method_args: Optional[dict],
                        task_name: str) -> dict:
         """Method hyperparams with task-dependent values resolved.
@@ -192,20 +249,25 @@ class SuiteRunner:
         return tuple(out)
 
     def _fn_for(self, method: str, method_args: Optional[dict],
-                task_name: str, width: int = 1, n_tasks: int = 0):
+                task_name: str, width: int = 1, n_tasks: int = 0,
+                record: bool = False):
         # ``width`` = how many seed replicas this executable batches (the
         # dedup path runs batches of 1 and seeds-1): it keys the cache and
         # feeds the auto eig_mode memory budget, so the 1-seed probe is
         # never forced off the incremental kernel by replicas that don't
         # share its program. ``n_tasks`` > 0 wraps the experiment in a
         # second vmap over a leading TASK axis (the run_batched path) —
-        # the budget then sees width x n_tasks replicas.
+        # the budget then sees width x n_tasks replicas. ``record`` builds
+        # the flight-recorder program (returns ``(result, aux)``; the base
+        # outputs' trajectory is the unrecorded program's).
         from coda_tpu.cli import build_selector_factory, parse_args
 
         resolved = self._resolved_args(method, method_args, task_name)
         runtime = RUNTIME_HYPERPARAMS.get(method, ())
         static = self._static_resolved(resolved, method)
-        key = (method, tuple(sorted(static.items())), width, n_tasks)
+        trace_k = self.record_topk if record else 0
+        key = (method, tuple(sorted(static.items())), width, n_tasks,
+               trace_k)
         if key not in self._jitted:
             args = parse_args([])
             args.method = method
@@ -221,7 +283,8 @@ class SuiteRunner:
                     return make_modelpicker(preds, epsilon=eps)
             else:
                 factory = build_selector_factory(args, task_name)
-            fn = make_batched_experiment_fn(factory, self.iters, self.loss_fn)
+            fn = make_batched_experiment_fn(factory, self.iters,
+                                            self.loss_fn, trace_k=trace_k)
             if n_tasks:
                 # (T, H, N, C) preds, (T, N) labels, shared seed keys,
                 # per-task runtime hyperparams (T,)
@@ -243,16 +306,24 @@ class SuiteRunner:
         ``seeds`` experiments; pin ``eig_mode`` explicitly if strict
         cross-seed tier homogeneity matters more than the auto budget.
         """
-        extra = self._extra_args(
-            method, [self._resolved_args(method, method_args, dataset.name)])
+        resolved_one = self._resolved_args(method, method_args, dataset.name)
+        extra = self._extra_args(method, [resolved_one])
+        record = bool(self.record_dir)
         if self.dedup_seeds and self.seeds > 1:
-            fn = self._fn_for(method, method_args, dataset.name, width=1)
+            fn = self._fn_for(method, method_args, dataset.name, width=1,
+                              record=record)
             # seed 0 runs alone; deterministic -> broadcast, stochastic ->
             # run only the REMAINING seeds and concatenate (the probe result
             # is kept, never recomputed). Total device work is exactly
             # ``seeds`` experiments either way; two batch sizes (1, seeds-1)
             # get compiled per method instead of one.
             r0 = fn(dataset.preds, dataset.labels, self._keys[:1], *extra)
+            if record:
+                r0, aux = r0
+                self._write_record_stream(dataset.name, method,
+                                          dataset.shape, r0, aux,
+                                          resolved_one, n_parallel=1,
+                                          dataset=dataset)
             if not bool(np.asarray(r0.stochastic)[0]):
                 # deterministic run: every seed is identical — broadcast
                 return type(r0)(*[
@@ -266,8 +337,15 @@ class SuiteRunner:
                 np.concatenate([np.asarray(a), np.asarray(b)], axis=0)
                 for a, b in zip(r0, rest)
             ])
-        fn = self._fn_for(method, method_args, dataset.name, width=self.seeds)
-        return fn(dataset.preds, dataset.labels, self._keys, *extra)
+        fn = self._fn_for(method, method_args, dataset.name,
+                          width=self.seeds, record=record)
+        res = fn(dataset.preds, dataset.labels, self._keys, *extra)
+        if record:
+            res, aux = res
+            self._write_record_stream(dataset.name, method, dataset.shape,
+                                      res, aux, resolved_one,
+                                      n_parallel=self.seeds, dataset=dataset)
+        return res
 
     def run(
         self,
@@ -557,10 +635,20 @@ class SuiteRunner:
         cold = shape_key not in seen_shapes
         seen_shapes.add(shape_key)
         self._tele_cold(cold)
+        record = bool(self.record_dir)
+        if record:
+            # hash each task's tensors once (cached by name) while they are
+            # still at hand — the harvest may run after the group is freed
+            for i in todo:
+                self._dataset_digest(names[i], datasets[i].preds,
+                                     datasets[i].labels)
         t0 = time.perf_counter()
         probe_fn = self._fn_for(method, method_args, names_m[0],
-                                width=1, n_tasks=T)
+                                width=1, n_tasks=T, record=record)
         r0 = probe_fn(preds_m, labels_m, keys0, *extra)
+        aux = None
+        if record:
+            r0, aux = r0
         rest = None
         if self.seeds > 1:
             rest_fn = self._fn_for(method, method_args, names_m[0],
@@ -569,12 +657,12 @@ class SuiteRunner:
         if device is not None:
             # start the device-to-host copies NOW so they overlap later
             # dispatches; the harvest's np.asarray then finds them done
-            for leaf in self._jax.tree_util.tree_leaves((r0, rest)):
+            for leaf in self._jax.tree_util.tree_leaves((r0, rest, aux)):
                 leaf.copy_to_host_async()
         return PendingBatch(names=names_m, method=method,
                             shape=tuple(shape), cold=cold, r0=r0,
                             rest=rest, t_start=t0, device=device,
-                            cost=cost)
+                            cost=cost, aux=aux, resolved=resolved)
 
     def _harvest_batch(self, pend: PendingBatch, store, pairs, results,
                        progress) -> None:
@@ -596,8 +684,16 @@ class SuiteRunner:
              "cold": pend.cold, "est_cost": round(pend.cost, 4)})
         T = len(pend.names)
         method, cold = pend.method, pend.cold
+        aux_host = (self._jax.tree.map(np.asarray, pend.aux)
+                    if pend.aux is not None else None)
         for t, name in enumerate(pend.names):
             r0_t = type(r0)(*[x[t] for x in r0])
+            if aux_host is not None:
+                self._write_record_stream(
+                    name, method, pend.shape, r0_t,
+                    self._jax.tree.map(lambda x: x[t], aux_host),
+                    pend.resolved[t] if t < len(pend.resolved) else {},
+                    n_parallel=T)
             if rest is None or not bool(np.asarray(
                     r0_t.stochastic)[0]):
                 res = type(r0)(*[

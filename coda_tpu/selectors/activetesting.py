@@ -113,6 +113,10 @@ def make_activetesting(
             idx=idx.astype(jnp.int32),
             prob=prob,
             stochastic=jnp.asarray(True),
+            # proportional sampling: the utility is the (unnormalized)
+            # acquisition weight — the quantity whose ordering the flight
+            # recorder's top-k should capture
+            scores=jnp.where(state.unlabeled, acquisition_scores, -jnp.inf),
         )
 
     def update(state, idx, true_class, prob):

@@ -1171,6 +1171,7 @@ def make_coda(
             idx=idx.astype(jnp.int32),
             prob=scores[idx],
             stochastic=n_ties > 1,
+            scores=jnp.where(cand, scores, -jnp.inf),
         )
 
     def _eig_select_prefiltered(state: CODAState, cand, k_sub,
@@ -1193,10 +1194,15 @@ def make_coda(
             k_tie, scores_sub, valid, rtol=_TIE_RTOL, atol=_TIE_ATOL
         )
         subsampled = cand.sum() > hp.prefilter_n
+        # scatter the subset's scores back to full N so the recorder trace
+        # has one fixed-shape score vector in both lax.cond branches
+        scores_full = jnp.full((N,), -jnp.inf, jnp.float32).at[cand_idx].set(
+            jnp.where(valid, scores_sub, -jnp.inf))
         return SelectResult(
             idx=cand_idx[local].astype(jnp.int32),
             prob=scores_sub[local],
             stochastic=(n_ties > 1) | subsampled,
+            scores=scores_full,
         )
 
     def select(state: CODAState, key) -> SelectResult:
@@ -1242,6 +1248,7 @@ def make_coda(
             idx=idx.astype(jnp.int32),
             prob=scores[idx],
             stochastic=(n_ties > 1) | subsampled,
+            scores=jnp.where(cand, scores, -jnp.inf),
         )
 
     def update(state: CODAState, idx, true_class, prob) -> CODAState:

@@ -88,6 +88,11 @@ def make_iid(
             idx=idx.astype(jnp.int32),
             prob=1.0 / n_u.astype(jnp.float32),
             stochastic=jnp.asarray(True),
+            # uniform acquisition: each candidate's utility is its selection
+            # probability (flight-recorder top-k then reads all-equal scores,
+            # which the triage classifier treats as a maximal tie)
+            scores=jnp.where(state.unlabeled,
+                             1.0 / n_u.astype(jnp.float32), -jnp.inf),
         )
 
     return Selector(

@@ -226,6 +226,9 @@ def make_modelpicker(
             idx=idx.astype(jnp.int32),
             prob=1.0 / state.unlabeled.sum().astype(jnp.float32),
             stochastic=jnp.asarray(True),
+            # argmin acquisition -> negate so the recorder's higher-is-better
+            # top-k convention holds
+            scores=jnp.where(cand, -ent, -jnp.inf),
         )
 
     def update(state, idx, true_class, prob):
@@ -256,4 +259,8 @@ def make_modelpicker(
         name=name, init=init, select=select, update=update, best=best,
         always_stochastic=True,
         hyperparams={"epsilon": None if traced_eps else epsilon},
+        # the multiplicative-weights posterior IS this method's P(best)
+        # analog — exposed under the same extras key as CODA's so the
+        # flight recorder's posterior digest covers both posterior methods
+        extras={"get_pbest": lambda s: s.posterior},
     )

@@ -39,6 +39,13 @@ class SelectResult(NamedTuple):
     idx: jnp.ndarray        # scalar int32 — chosen data point
     prob: jnp.ndarray       # scalar float32 — selection probability / q-value
     stochastic: jnp.ndarray  # scalar bool — did randomness affect this choice?
+    # (N,) float32 acquisition-utility vector, or None. Convention: higher =
+    # more preferred (argmin acquisitions negate), non-candidates masked to
+    # -inf. Selectors already materialize this vector to take their argmax,
+    # so returning it is free — XLA dead-code-eliminates it everywhere except
+    # the flight-recorder step, which reads its top-k per round
+    # (engine/loop.py make_step_fn(trace_k=...)).
+    scores: Any = None
 
 
 @dataclass(frozen=True)

@@ -44,6 +44,7 @@ def make_uncertainty(
             idx=idx.astype(jnp.int32),
             prob=scores[idx],
             stochastic=n_ties > 1,
+            scores=jnp.where(state.unlabeled, scores, -jnp.inf),
         )
 
     return Selector(

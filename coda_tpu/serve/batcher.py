@@ -68,13 +68,17 @@ class Batcher:
     """
 
     def __init__(self, store, metrics=None, max_batch: int = 256,
-                 max_wait: float = 0.002, telemetry=None):
+                 max_wait: float = 0.002, telemetry=None, recorder=None):
         self.store = store
         self.metrics = metrics
         # optional Telemetry: each per-bucket dispatch becomes a span on the
         # "host:batcher" lane (annotated so a live jax.profiler capture
         # shows the same tick names next to the device rows)
         self.telemetry = telemetry
+        # optional SessionRecorder: every completed ticket appends one
+        # decision row to its session's record stream (the flight
+        # recorder's serving face — GET /session/{id}/trace)
+        self.recorder = recorder
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait)
         self.queue: queue.Queue = queue.Queue()
@@ -219,6 +223,19 @@ class Batcher:
                 t.session.last = results[slot]
                 if t.do_update:
                     t.session.n_labeled += 1
+                if self.recorder is not None:
+                    r = results[slot]
+                    self.recorder.append(t.session.sid, {
+                        "n_labeled": t.session.n_labeled,
+                        "do_update": t.do_update,
+                        "labeled_idx": t.idx if t.do_update else None,
+                        "label": t.label if t.do_update else None,
+                        "prob": t.prob if t.do_update else None,
+                        "next_idx": r["next_idx"],
+                        "next_prob": r["next_prob"],
+                        "best": r["best"],
+                        "stochastic": r["stochastic"],
+                    })
                 if self.metrics is not None:
                     self.metrics.record_request_latency(now - t.submitted)
                 t.done.set()

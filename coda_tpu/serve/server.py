@@ -10,6 +10,8 @@ not N device round trips.
     POST   /session                  {task?, seed?}    -> admit + first item
     POST   /session/{id}/label       {label, idx?}     -> update, next item
     GET    /session/{id}/best                          -> best (+ pbest)
+    GET    /session/{id}/trace                         -> per-round decision
+                                                          history (recorder)
     DELETE /session/{id}                               -> close, free slot
     GET    /stats                                      -> metrics snapshot
     GET    /metrics                                    -> Prometheus text
@@ -55,22 +57,39 @@ class ServeApp:
                  max_batch: int = 256, max_wait: float = 0.002,
                  default_task: Optional[str] = None,
                  spec: Optional[SelectorSpec] = None,
-                 telemetry=None):
-        from coda_tpu.telemetry import Telemetry
+                 telemetry=None, recorder=None):
+        from coda_tpu.telemetry import SessionRecorder, Telemetry
 
         self.store = SessionStore(capacity=capacity, bucket_n=bucket_n)
         self.metrics = ServeMetrics()
         # always live (registry-backed /metrics needs one); --telemetry-dir
         # upgrades it to an artifact-writing instance
         self.telemetry = telemetry if telemetry is not None else Telemetry()
+        # per-session decision streams: always live in memory (the
+        # GET /session/{id}/trace payload); --record-dir upgrades to
+        # crash-safe append-only JSONL files per session
+        self.recorder = recorder if recorder is not None \
+            else SessionRecorder()
         self.batcher = Batcher(self.store, self.metrics,
                                max_batch=max_batch, max_wait=max_wait,
-                               telemetry=self.telemetry)
+                               telemetry=self.telemetry,
+                               recorder=self.recorder)
         self.spec = spec or SelectorSpec.create("coda", n_parallel=capacity)
         self.default_task = default_task
         self.draining = False
         self._seed_lock = threading.Lock()
         self._next_seed = 0
+        # create the record/replay counters eagerly so /metrics exposes
+        # them at 0 instead of omitting them until first use
+        self.telemetry.counter(
+            "serve_record_rows_total",
+            "Per-round decision rows streamed by the serving recorder")
+        self.telemetry.counter(
+            "records_written_total",
+            "Flight-recorder run records written")
+        self.telemetry.counter(
+            "replay_verified_total",
+            "Replay verifications that matched their record")
 
     def add_task(self, name: str, preds, class_names=None, model_names=None,
                  default: bool = False) -> None:
@@ -87,6 +106,7 @@ class ServeApp:
         """Graceful shutdown: refuse new sessions, finish queued requests."""
         self.draining = True
         self.batcher.stop(drain=True, timeout=timeout)
+        self.recorder.close_all()
 
     def _auto_seed(self) -> int:
         with self._seed_lock:
@@ -111,6 +131,9 @@ class ServeApp:
             self.metrics.record_session("reject")
             raise
         self.metrics.record_session("open")
+        self.recorder.open(sess.sid, meta={
+            "task": sess.task, "method": self.spec.method,
+            "seed": sess.seed})
         # first item + prior best come from the session's first dispatch;
         # if it fails (stuck accelerator -> timeout, dispatch error) the
         # client never learns the session id, so free the slot here or it
@@ -119,6 +142,7 @@ class ServeApp:
             res = self.batcher.submit_start(sess).wait(REQUEST_TIMEOUT_S)
         except BaseException:
             self.store.close(sess.sid)
+            self.recorder.close(sess.sid)
             self.metrics.record_session("close")
             raise
         return self._payload(sess, res)
@@ -152,13 +176,34 @@ class ServeApp:
 
     def close_session(self, sid: str) -> dict:
         self.store.close(sid)
+        self.recorder.close(sid)
         self.metrics.record_session("close")
         return {"closed": sid}
+
+    def trace(self, sid: str) -> dict:
+        """The session's per-round decision history from its record stream
+        (the flight recorder's interactive face: every dispatch this
+        session rode, with the proposed item, best-model answer, and the
+        label that was applied)."""
+        sess = self.store.get(sid)   # raises UnknownSession for dead ids
+        rounds = self.recorder.history(sid) or []
+        return {"session": sid, "task": sess.task,
+                "n_labeled": sess.n_labeled, "rounds": rounds}
 
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
         snap["live_sessions"] = self.store.live_sessions()
         snap["draining"] = self.draining
+        # flight-recorder evidence, in distinct units: run RECORDS written
+        # process-wide (registry counter) vs per-dispatch decision ROWS
+        # this server streamed — plus the replay counter (a replay running
+        # in-process shows up here next to the serving numbers)
+        reg = self.telemetry.registry
+        snap["records_written"] = int(
+            reg.counter("records_written_total").value())
+        snap["record_rows_written"] = int(self.recorder.rows_written)
+        snap["replay_verified"] = int(
+            reg.counter("replay_verified_total").value())
         snap["buckets"] = [
             {"task": b.task, "method": b.spec.method,
              "shape": list(b.shape), "capacity": b.capacity, "live": b.live}
@@ -190,7 +235,7 @@ class StaleItem(ValueError):
     """The labeled idx is not the item the session proposed."""
 
 
-_SESSION_RE = re.compile(r"^/session/([0-9a-f]+)(/(label|best))?$")
+_SESSION_RE = re.compile(r"^/session/([0-9a-f]+)(/(label|best|trace))?$")
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -234,6 +279,8 @@ class Handler(BaseHTTPRequestHandler):
             return app.label(m.group(1), req["label"], idx=req.get("idx"))
         if m and method == "GET" and m.group(3) == "best":
             return app.best(m.group(1))
+        if m and method == "GET" and m.group(3) == "trace":
+            return app.trace(m.group(1))
         if m and method == "DELETE" and m.group(3) is None:
             return app.close_session(m.group(1))
         if method == "GET" and path == "/stats":
@@ -336,6 +383,12 @@ def parse_args(argv=None):
                         "+ telemetry.json (recompiles, HBM watermarks) + "
                         "metrics.prom there on shutdown; /metrics serves "
                         "the same registry live either way")
+    p.add_argument("--record-dir", default=None,
+                   help="stream each session's per-round decision history "
+                        "to an append-only session_<id>.jsonl there "
+                        "(crash-safe: every completed dispatch is flushed); "
+                        "GET /session/{id}/trace serves the same stream "
+                        "live either way")
     return p.parse_args(argv)
 
 
@@ -354,11 +407,16 @@ def build_app(args) -> ServeApp:
         from coda_tpu.telemetry import Telemetry
 
         telemetry = Telemetry(out_dir=args.telemetry_dir)
+    recorder = None
+    if getattr(args, "record_dir", None):
+        from coda_tpu.telemetry import SessionRecorder
+
+        recorder = SessionRecorder(out_dir=args.record_dir)
     app = ServeApp(
         capacity=args.capacity, bucket_n=args.bucket_n,
         max_batch=args.max_batch, max_wait=args.max_wait_ms / 1e3,
         spec=SelectorSpec.create(args.method, **spec_kwargs),
-        telemetry=telemetry,
+        telemetry=telemetry, recorder=recorder,
     )
     if args.task or args.synthetic:
         ds = load_dataset(args)

@@ -21,6 +21,7 @@ metrics. See ARCHITECTURE.md §"Observability".
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 from typing import Optional
@@ -36,21 +37,39 @@ from coda_tpu.telemetry.registry import (
     registry_hooked,
     sample_device_memory,
 )
+from coda_tpu.telemetry.recorder import (
+    CROSS_BACKEND_SCORE_TOL,
+    RECORD_SCHEMA_VERSION,
+    RunRecord,
+    SessionRecorder,
+    dataset_digest,
+    environment_fingerprint,
+    knobs_from_args,
+    stream_dir,
+)
 from coda_tpu.telemetry.spans import SpanRecorder, annotation
 
 __all__ = [
+    "CROSS_BACKEND_SCORE_TOL",
     "Counter",
     "Gauge",
+    "RECORD_SCHEMA_VERSION",
     "Registry",
+    "RunRecord",
+    "SessionRecorder",
     "SpanRecorder",
     "Telemetry",
     "annotation",
+    "dataset_digest",
+    "environment_fingerprint",
     "get_registry",
     "install_jax_hooks",
     "jax_hooks_installed",
+    "knobs_from_args",
     "registry_hooked",
     "render_prometheus",
     "sample_device_memory",
+    "stream_dir",
 ]
 
 
@@ -75,6 +94,42 @@ class Telemetry:
         # claim must not ride on some other registry's subscription
         self.hooks_live = install_jax_hooks(self.registry) \
             if install_hooks else registry_hooked(self.registry)
+        # crash safety: a run that dies mid-flight (unhandled exception,
+        # SIGTERM-turned-exit) must not lose its telemetry artifacts, so an
+        # out_dir registers an atexit fallback that flushes IF nothing was
+        # flushed explicitly. An orderly write()/__exit__ marks the flush
+        # done and retires the fallback.
+        self._flushed = False
+        self._atexit_live = False
+        if self.out_dir:
+            atexit.register(self._atexit_flush)
+            self._atexit_live = True
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # flush on BOTH clean and exceptional exits (the artifacts of a
+        # failed run are the ones you want most); never swallow the error
+        self.write()
+        return False
+
+    def _atexit_flush(self) -> None:
+        if self._flushed or not self.out_dir:
+            return
+        try:
+            self.write()
+        except Exception:
+            pass  # interpreter is going down; never mask the real exit
+
+    def _retire_atexit(self) -> None:
+        if self._atexit_live:
+            try:
+                atexit.unregister(self._atexit_flush)
+            except Exception:
+                pass
+            self._atexit_live = False
 
     # -- recording passthroughs -------------------------------------------
     def span(self, name: str, lane: str = "host", annotate: bool = False,
@@ -136,6 +191,8 @@ class Telemetry:
             json.dump(self.snapshot(extra), f, indent=2)
         with open(paths["prometheus"], "w") as f:
             f.write(render_prometheus(self.registry))
+        self._flushed = True
+        self._retire_atexit()
         return paths
 
     def flush_to_store(self, store, experiment: str = "telemetry",

@@ -1,0 +1,371 @@
+"""Decision flight recorder: per-round provenance records on disk.
+
+CODA's output is a sequence of irreversible decisions — each round picks one
+point, consumes one oracle label, and updates the posterior — so when two
+runs disagree (bf16 vs exact caches, pallas vs XLA, sharded vs unsharded,
+approx vs exact entropy) the question that matters is *which round first
+diverged and in what quantity*. This module is the capture half of that
+story; ``coda_tpu/engine/replay.py`` is the verify/triage half.
+
+What gets captured, per labeling round (``engine/loop.py`` emits it as
+auxiliary ``lax.scan`` outputs — device-side, harvested once per run,
+O(rounds·k) host traffic, no per-round sync):
+
+  * chosen index, oracle label, selection probability (the decision);
+  * top-k acquisition scores + indices, the chosen score, and the
+    argmax runner-up gap (the *why*, and how contested it was);
+  * a posterior P(best) digest — max + entropy in bits — for methods that
+    expose one (CODA, ModelPicker);
+  * the round's PRNG key counter words (so replay reconstructs the exact
+    randomness even if key derivation ever changes).
+
+Plus one run-level **environment fingerprint**: backend, jax/jaxlib
+versions, device kind, the numerics knobs (``eig_entropy``, cache dtype,
+precision, ...), a dataset digest, and ``jax_threefry_partitionable`` —
+every axis along which the PR 4 threefry/GSPMD parity bug (NOTES_r07.md)
+could have been spotted mechanically.
+
+On-disk layout of one run record (validated by
+``scripts/check_record_schema.py``)::
+
+    <dir>/record.json   # schema_version, fingerprint, run config, shapes
+    <dir>/rounds.npz    # the per-seed x per-round arrays (REQUIRED_ARRAYS)
+
+Batch runs write one record per run (``cli.py --record-dir``); the suite
+writes per-(family, method) record streams (one record per task under
+``<root>/<family>__<method>/<task>/``); the serving layer streams per-
+session JSONL rows (:class:`SessionRecorder`) since an interactive session
+has no known end. Recorder activity registers counters/gauges with the
+process-wide telemetry registry, so ``records_written_total`` /
+``replay_verified_total`` surface on ``/metrics`` next to recompiles and
+HBM watermarks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# bump on ANY field change; check_record_schema.py fails unversioned or
+# field-drifted records so downstream triage never misreads old captures
+RECORD_SCHEMA_VERSION = 1
+
+# the documented cross-backend score contract: pallas kernels vs the XLA
+# lowering agree on EIG scores to the MEASURED 2.34e-4 at the headline shape
+# (ARCHITECTURE.md §2, fusedcompute_row_max_abs_diff); replay comparisons
+# across backends/knobs use this bound, same-backend replays demand bitwise
+CROSS_BACKEND_SCORE_TOL = 2.34e-4
+
+# every array a v1 rounds.npz must carry: name -> (dtype kind, ndim with the
+# leading seed axis). trace_k (the k of the top-k columns) lives in meta.
+REQUIRED_ARRAYS = {
+    "chosen_idx": ("i", 2),        # (S, T)
+    "true_class": ("i", 2),        # (S, T)
+    "best_model": ("i", 2),        # (S, T)
+    "regret": ("f", 2),            # (S, T)
+    "cumulative_regret": ("f", 2),  # (S, T)
+    "select_prob": ("f", 2),       # (S, T)
+    "regret_at_0": ("f", 1),       # (S,)
+    "stochastic": ("b", 1),        # (S,)
+    "round_key": ("u", 3),         # (S, T, 2)
+    "topk_idx": ("i", 3),          # (S, T, k)
+    "topk_score": ("f", 3),        # (S, T, k)
+    "chosen_score": ("f", 2),      # (S, T)
+    "runner_up_gap": ("f", 2),     # (S, T)
+    "pbest_max": ("f", 2),         # (S, T)
+    "pbest_entropy": ("f", 2),     # (S, T)
+    "root_key": ("u", 2),          # (S, 2)
+    "init_key": ("u", 2),          # (S, 2)
+    "prior_key": ("u", 2),         # (S, 2)
+}
+
+REQUIRED_META = ("schema_version", "fingerprint", "run", "trace_k",
+                 "seeds", "rounds")
+
+# the knob subset of an argparse namespace worth fingerprinting: every flag
+# that can change the decision trace (numerics, acquisition, RNG layout)
+KNOB_FIELDS = (
+    "method", "loss", "iters", "seeds", "alpha", "learning_rate",
+    "multiplier", "prefilter_n", "no_diag_prior", "q", "epsilon",
+    "eig_chunk", "eig_mode", "eig_backend", "eig_precision",
+    "eig_cache_dtype", "eig_refresh", "eig_entropy", "pi_update", "mesh",
+)
+
+
+def _counters(registry=None):
+    from coda_tpu.telemetry.registry import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    return reg
+
+
+def dataset_digest(preds, labels=None, max_bytes: int = 1 << 28) -> str:
+    """Stable 16-hex digest of the prediction tensor (+ labels).
+
+    Full-byte sha256 up to ``max_bytes`` per array; beyond that a strided
+    ~16M-element subsample plus shape/dtype (DomainNet-scale tensors must
+    not turn fingerprinting into a 10 GB hash pass). Good enough to catch
+    swapped/retouched datasets, which is what replay needs."""
+    h = hashlib.sha256()
+    for arr in (preds, labels):
+        if arr is None:
+            continue
+        a = np.asarray(arr)
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        if a.nbytes <= max_bytes:
+            h.update(np.ascontiguousarray(a).tobytes())
+        else:
+            flat = a.reshape(-1)
+            stride = max(1, flat.size // (1 << 24))
+            h.update(np.ascontiguousarray(flat[::stride]).tobytes())
+    return h.hexdigest()[:16]
+
+
+def environment_fingerprint(dataset=None, knobs: Optional[dict] = None,
+                            digest: Optional[str] = None) -> dict:
+    """The run-level provenance block of a record.
+
+    Captures every environment axis that has historically moved a decision
+    trace: backend + device kind, jax/jaxlib versions, x64 and
+    ``jax_threefry_partitionable`` (the NOTES_r07 GSPMD-parity switch),
+    the numerics knobs, and a dataset digest."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", None)
+    except Exception:
+        jaxlib_version = None
+    devs = jax.devices()
+    fp = {
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+        "device_kind": devs[0].device_kind if devs else None,
+        "n_devices": jax.device_count(),
+        "threefry_partitionable": bool(
+            jax.config.jax_threefry_partitionable),
+        "x64": bool(jax.config.jax_enable_x64),
+        "knobs": dict(knobs or {}),
+    }
+    ds = {}
+    if dataset is not None:
+        ds = {"name": getattr(dataset, "name", None),
+              "shape": list(getattr(dataset, "shape", ()) or ())}
+        if digest is None and getattr(dataset, "preds", None) is not None:
+            digest = dataset_digest(dataset.preds,
+                                    getattr(dataset, "labels", None))
+    if digest is not None:
+        ds["digest"] = digest
+    fp["dataset"] = ds
+    return fp
+
+
+def knobs_from_args(args) -> dict:
+    """The fingerprint-worthy knob subset of an argparse namespace."""
+    out = {}
+    for k in KNOB_FIELDS:
+        v = getattr(args, k, None)
+        if v is not None:
+            out[k] = v
+    return out
+
+
+@dataclass
+class RunRecord:
+    """One recorded run: JSON meta + the per-seed/per-round arrays."""
+
+    meta: dict
+    arrays: dict = field(default_factory=dict)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_result(cls, result, aux, fingerprint: dict, run: dict,
+                    extra_meta: Optional[dict] = None) -> "RunRecord":
+        """Build a record from an ``(ExperimentResult, RunTraceAux)`` pair
+        (leading seed axis on both, as ``run_seeds_recorded`` returns)."""
+        arrays = {
+            "chosen_idx": np.asarray(result.chosen_idx, np.int32),
+            "true_class": np.asarray(result.true_class, np.int32),
+            "best_model": np.asarray(result.best_model, np.int32),
+            "regret": np.asarray(result.regret, np.float32),
+            "cumulative_regret": np.asarray(result.cumulative_regret,
+                                            np.float32),
+            "select_prob": np.asarray(result.select_prob, np.float32),
+            "regret_at_0": np.atleast_1d(
+                np.asarray(result.regret_at_0, np.float32)),
+            "stochastic": np.atleast_1d(np.asarray(result.stochastic, bool)),
+            "round_key": np.asarray(aux.trace.round_key, np.uint32),
+            "topk_idx": np.asarray(aux.trace.topk_idx, np.int32),
+            "topk_score": np.asarray(aux.trace.topk_score, np.float32),
+            "chosen_score": np.asarray(aux.trace.chosen_score, np.float32),
+            "runner_up_gap": np.asarray(aux.trace.runner_up_gap, np.float32),
+            "pbest_max": np.asarray(aux.trace.pbest_max, np.float32),
+            "pbest_entropy": np.asarray(aux.trace.pbest_entropy, np.float32),
+            "root_key": np.asarray(aux.root_key, np.uint32).reshape(-1, 2),
+            "init_key": np.asarray(aux.init_key, np.uint32).reshape(-1, 2),
+            "prior_key": np.asarray(aux.prior_key, np.uint32).reshape(-1, 2),
+        }
+        seeds, rounds = arrays["chosen_idx"].shape
+        meta = {
+            "schema_version": RECORD_SCHEMA_VERSION,
+            "fingerprint": fingerprint,
+            "run": run,
+            "trace_k": int(arrays["topk_idx"].shape[-1]),
+            "seeds": int(seeds),
+            "rounds": int(rounds),
+        }
+        if extra_meta:
+            meta.update(extra_meta)
+        return cls(meta=meta, arrays=arrays)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, out_dir: str, registry=None) -> dict:
+        """Write ``record.json`` + ``rounds.npz`` under ``out_dir``; returns
+        {artifact: path} and feeds the recorder counters."""
+        t0 = time.perf_counter()
+        os.makedirs(out_dir, exist_ok=True)
+        paths = {"record": os.path.join(out_dir, "record.json"),
+                 "rounds": os.path.join(out_dir, "rounds.npz")}
+        # npz first: a crash between the two writes must not leave a
+        # record.json pointing at a missing arrays file
+        with open(paths["rounds"], "wb") as f:
+            np.savez(f, **self.arrays)
+        with open(paths["record"], "w") as f:
+            json.dump(self.meta, f, indent=2, default=str)
+        reg = _counters(registry)
+        reg.counter("records_written_total",
+                    "Flight-recorder run records written").inc()
+        reg.counter("record_rounds_total",
+                    "Labeling rounds captured by the flight recorder").inc(
+                        float(self.meta["seeds"] * self.meta["rounds"]))
+        reg.gauge("recorder_last_write_seconds",
+                  "Host seconds to serialize the last run record").set(
+                      time.perf_counter() - t0)
+        return paths
+
+    @classmethod
+    def load(cls, in_dir: str) -> "RunRecord":
+        with open(os.path.join(in_dir, "record.json")) as f:
+            meta = json.load(f)
+        v = meta.get("schema_version")
+        if v != RECORD_SCHEMA_VERSION:
+            raise ValueError(
+                f"record at {in_dir!r} has schema_version={v!r}; this build "
+                f"reads v{RECORD_SCHEMA_VERSION} — re-record or use a "
+                "matching checkout")
+        with np.load(os.path.join(in_dir, "rounds.npz")) as z:
+            arrays = {k: z[k] for k in z.files}
+        return cls(meta=meta, arrays=arrays)
+
+    # -- convenience -------------------------------------------------------
+    @property
+    def seeds(self) -> int:
+        return int(self.meta["seeds"])
+
+    @property
+    def rounds(self) -> int:
+        return int(self.meta["rounds"])
+
+    def seed_arrays(self, s: int) -> dict:
+        """The per-round arrays of one seed (no leading axis)."""
+        return {k: v[s] for k, v in self.arrays.items()}
+
+
+def is_record_dir(path: str) -> bool:
+    return (os.path.isfile(os.path.join(path, "record.json"))
+            and os.path.isfile(os.path.join(path, "rounds.npz")))
+
+
+_STREAM_SAFE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+def stream_dir(root: str, *parts: str) -> str:
+    """``<root>/<part>/...`` with filesystem-hostile characters squashed
+    (task names like ``glue/cola`` must not create surprise nesting)."""
+    safe = [_STREAM_SAFE.sub("-", p) for p in parts if p]
+    return os.path.join(root, *safe)
+
+
+class SessionRecorder:
+    """Per-session decision streams for the serving layer.
+
+    An interactive session has no known end, so its record is a *stream*:
+    one in-memory history per live session (the ``GET /session/{id}/trace``
+    payload) plus, with an ``out_dir``, an append-only JSONL file per
+    session (one meta line, then one versioned row per dispatch) that
+    survives a crash mid-session — every ``append`` is flushed.
+
+    Thread-safe: the batcher thread appends, HTTP worker threads read.
+    """
+
+    def __init__(self, out_dir: Optional[str] = None, registry=None):
+        self.out_dir = out_dir
+        self._lock = threading.Lock()
+        self._history: dict[str, list] = {}
+        self._files: dict[str, object] = {}
+        self._registry = registry
+        self.rows_written = 0
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+
+    def _counter(self):
+        return _counters(self._registry).counter(
+            "serve_record_rows_total",
+            "Per-round decision rows streamed by the serving recorder")
+
+    def open(self, sid: str, meta: Optional[dict] = None) -> None:
+        with self._lock:
+            self._history[sid] = []
+            if self.out_dir:
+                f = open(os.path.join(self.out_dir,
+                                      f"session_{sid}.jsonl"), "a")
+                header = {"v": RECORD_SCHEMA_VERSION, "kind": "session_meta",
+                          "session": sid}
+                header.update(meta or {})
+                f.write(json.dumps(header, default=str) + "\n")
+                f.flush()
+                self._files[sid] = f
+
+    def append(self, sid: str, row: dict) -> None:
+        with self._lock:
+            hist = self._history.get(sid)
+            if hist is None:
+                return  # session closed (or never opened) while queued
+            row = dict(row, v=RECORD_SCHEMA_VERSION)
+            hist.append(row)
+            self.rows_written += 1
+            f = self._files.get(sid)
+            if f is not None:
+                f.write(json.dumps(row, default=str) + "\n")
+                f.flush()  # crash-mid-session keeps every completed row
+        self._counter().inc()
+
+    def history(self, sid: str) -> Optional[list]:
+        with self._lock:
+            hist = self._history.get(sid)
+            return list(hist) if hist is not None else None
+
+    def close(self, sid: str) -> None:
+        with self._lock:
+            self._history.pop(sid, None)
+            f = self._files.pop(sid, None)
+        if f is not None:
+            f.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            files = list(self._files.values())
+            self._files.clear()
+            self._history.clear()
+        for f in files:
+            f.close()

@@ -1,0 +1,181 @@
+"""Static schema validation of flight-recorder artifacts.
+
+The decision records (``coda_tpu/telemetry/recorder.py``) are replay
+evidence: a record that silently drifted from the schema — missing version
+stamp, renamed array, wrong dtype/rank, seed/round counts that disagree
+between meta and arrays — would make ``cli replay`` triage garbage instead
+of failing loudly. This checker walks a directory tree and validates every
+artifact it finds against the versioned v1 schema:
+
+  * ``record.json`` + ``rounds.npz`` pairs (batch/suite records): version
+    stamp, required meta fields, every REQUIRED_ARRAYS entry present with
+    the right dtype kind / rank / leading (seeds, rounds) extents, top-k
+    extent consistent with ``trace_k``;
+  * ``session_*.jsonl`` streams (serving records): every line JSON with a
+    ``v`` version stamp; row lines carry the decision fields.
+
+Wired into tier-1 (``tests/test_recorder.py``) the same way
+``check_clocks.py`` is, and runnable standalone::
+
+    python scripts/check_record_schema.py <dir> [<dir> ...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+_ROW_FIELDS = ("n_labeled", "do_update", "next_idx", "next_prob", "best")
+
+
+def check_record(dir_path: str) -> list[str]:
+    """Violations of one record.json + rounds.npz pair (empty = clean)."""
+    import numpy as np
+
+    from coda_tpu.telemetry.recorder import (
+        RECORD_SCHEMA_VERSION,
+        REQUIRED_ARRAYS,
+        REQUIRED_META,
+    )
+
+    out: list[str] = []
+    meta_fp = os.path.join(dir_path, "record.json")
+    rounds_fp = os.path.join(dir_path, "rounds.npz")
+    try:
+        with open(meta_fp) as f:
+            meta = json.load(f)
+    except Exception as e:
+        return [f"unreadable record.json: {e}"]
+    v = meta.get("schema_version")
+    if v is None:
+        out.append("record.json has no schema_version stamp")
+    elif v != RECORD_SCHEMA_VERSION:
+        out.append(f"schema_version {v!r} != supported "
+                   f"{RECORD_SCHEMA_VERSION}")
+    for key in REQUIRED_META:
+        if key not in meta:
+            out.append(f"record.json missing required field {key!r}")
+    if not os.path.isfile(rounds_fp):
+        out.append("rounds.npz missing")
+        return out
+    try:
+        with np.load(rounds_fp) as z:
+            arrays = {k: z[k] for k in z.files}
+    except Exception as e:
+        out.append(f"unreadable rounds.npz: {e}")
+        return out
+    S = meta.get("seeds")
+    T = meta.get("rounds")
+    k = meta.get("trace_k")
+    for name, (kind, ndim) in REQUIRED_ARRAYS.items():
+        a = arrays.get(name)
+        if a is None:
+            out.append(f"rounds.npz missing array {name!r}")
+            continue
+        if a.dtype.kind != kind:
+            out.append(f"{name}: dtype kind {a.dtype.kind!r} != "
+                       f"expected {kind!r}")
+        if a.ndim != ndim:
+            out.append(f"{name}: rank {a.ndim} != expected {ndim}")
+            continue
+        if isinstance(S, int) and a.shape[0] != S:
+            out.append(f"{name}: leading seed extent {a.shape[0]} != "
+                       f"meta seeds {S}")
+        if ndim >= 2 and name not in ("root_key", "init_key", "prior_key") \
+                and isinstance(T, int) and a.shape[1] != T:
+            out.append(f"{name}: round extent {a.shape[1]} != "
+                       f"meta rounds {T}")
+        if name in ("topk_idx", "topk_score") and isinstance(k, int) \
+                and a.ndim == 3 and a.shape[2] != k:
+            out.append(f"{name}: top-k extent {a.shape[2]} != "
+                       f"meta trace_k {k}")
+    extra = set(arrays) - set(REQUIRED_ARRAYS)
+    if extra:
+        out.append(f"unversioned field drift: unexpected arrays "
+                   f"{sorted(extra)} (bump RECORD_SCHEMA_VERSION)")
+    return out
+
+
+def check_session_stream(fp: str) -> list[str]:
+    """Violations of one serving-session JSONL stream."""
+    from coda_tpu.telemetry.recorder import RECORD_SCHEMA_VERSION
+
+    out: list[str] = []
+    try:
+        with open(fp) as f:
+            lines = f.readlines()
+    except Exception as e:
+        return [f"unreadable: {e}"]
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except Exception:
+            out.append(f"line {i}: not JSON")
+            continue
+        v = row.get("v")
+        if v is None:
+            out.append(f"line {i}: no 'v' version stamp")
+        elif v != RECORD_SCHEMA_VERSION:
+            out.append(f"line {i}: v={v!r} != supported "
+                       f"{RECORD_SCHEMA_VERSION}")
+        if row.get("kind") == "session_meta":
+            continue
+        missing = [k for k in _ROW_FIELDS if k not in row]
+        if missing:
+            out.append(f"line {i}: row missing fields {missing}")
+    return out
+
+
+def check_tree(root: str) -> dict[str, list[str]]:
+    """{relpath: violations} over every recorder artifact under ``root``."""
+    bad: dict[str, list[str]] = {}
+    n_checked = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        if "record.json" in filenames:
+            n_checked += 1
+            v = check_record(dirpath)
+            if v:
+                bad[os.path.relpath(dirpath, root) or "."] = v
+        for fn in sorted(filenames):
+            if fn.startswith("session_") and fn.endswith(".jsonl"):
+                n_checked += 1
+                v = check_session_stream(os.path.join(dirpath, fn))
+                if v:
+                    bad[os.path.relpath(os.path.join(dirpath, fn), root)] = v
+    check_tree.last_checked = n_checked  # introspection for callers/tests
+    return bad
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python scripts/check_record_schema.py <dir> [...]")
+        return 64
+    total_bad = 0
+    total_checked = 0
+    for root in argv:
+        bad = check_tree(root)
+        total_checked += check_tree.last_checked
+        for rel, violations in sorted(bad.items()):
+            for v in violations:
+                print(f"{os.path.join(root, rel)}: {v}")
+                total_bad += 1
+    if total_bad:
+        print(f"record schema check FAILED: {total_bad} violation(s)")
+        return 1
+    from coda_tpu.telemetry.recorder import RECORD_SCHEMA_VERSION
+
+    print(f"record schema check clean: {total_checked} artifact(s) "
+          f"validated against v{RECORD_SCHEMA_VERSION}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -64,6 +64,14 @@ def main(argv=None):
                         "lanes) + telemetry.json (recompiles, HBM "
                         "watermarks) + metrics.prom there; scalars also "
                         "flush into --db")
+    p.add_argument("--record-dir", default=None,
+                   help="decision flight recorder: write each (task, "
+                        "method) pair's seed-0 probe as a per-round "
+                        "provenance record under per-(family, method) "
+                        "streams <dir>/<family>__<method>/<task>/; "
+                        "diff/verify with `python -m coda_tpu.cli replay`")
+    p.add_argument("--record-topk", type=int, default=8,
+                   help="top-k scores captured per round (--record-dir)")
     args = p.parse_args(argv)
     if args.suite_devices is not None:
         args.task_batch = True  # scheduling runs through run_batched
@@ -113,7 +121,8 @@ def main(argv=None):
 
     store = None if args.no_db else TrackingStore(args.db)
     runner = SuiteRunner(iters=args.iters, seeds=args.seeds, loss=args.loss,
-                         telemetry=telemetry)
+                         telemetry=telemetry, record_dir=args.record_dir,
+                         record_topk=args.record_topk)
     t0 = time.perf_counter()
     if args.task_batch:
         # group loaders by file size (the same shape proxy the sort uses);
@@ -153,6 +162,8 @@ def main(argv=None):
         line["compute_s"] = round(stats.get("compute_s", 0.0), 2)
         line["compute_device_s"] = round(
             stats.get("compute_device_s", 0.0), 2)
+    if args.record_dir:
+        line["record_dir"] = args.record_dir
     if telemetry is not None:
         paths = telemetry.write(extra={"suite": {
             k: stats.get(k) for k in ("total_s", "compute_s",

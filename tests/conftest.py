@@ -78,6 +78,7 @@ _SLOW_TESTS = {
     "test_gather_matches_xla_path",
     "test_fused_compute_refresh_real_data_trace",
     "test_fused_compute_long_horizon_widepool_trace",
+    "test_recorder_overhead_under_five_percent",
 }
 
 

@@ -1,0 +1,623 @@
+"""Decision flight recorder + deterministic replay + divergence triage.
+
+Covers the record/replay contract end to end:
+
+  * recording does not perturb the decision trajectory (recorded
+    ExperimentResult bitwise equals the unrecorded program's);
+  * a record replays BITWISE on the same backend for every selector in
+    ``selectors/`` (the acceptance contract of ``cli replay``);
+  * an injected near-tie perturbation is localized to the correct first
+    divergent round and classified as a tie-break flip; a beyond-tolerance
+    score perturbation classifies as a score delta;
+  * the CLI record -> replay -> triage loop works through
+    ``python -m coda_tpu.cli`` entry points;
+  * suite runs write per-(family, method) record streams that pass the
+    versioned schema check;
+  * the serving layer streams per-session decision rows
+    (``GET /session/{id}/trace``) and counts them on /stats;
+  * ``Telemetry`` flushes artifacts via context manager AND via the atexit
+    fallback when a run dies mid-flight (subprocess crash test);
+  * ``scripts/check_record_schema.py`` is wired into tier-1: clean
+    artifacts pass, tampered ones fail;
+  * recorder overhead on the compiled loop stays ≤5% (slow bench).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from coda_tpu.data import make_synthetic_task
+from coda_tpu.engine.loop import run_seeds_compiled, run_seeds_recorded
+from coda_tpu.engine.replay import (
+    compare_records,
+    compare_seed,
+    format_triage,
+    replay_main,
+    verify_replay,
+)
+from coda_tpu.losses import accuracy_loss
+from coda_tpu.telemetry.recorder import (
+    RECORD_SCHEMA_VERSION,
+    RunRecord,
+    SessionRecorder,
+    dataset_digest,
+    environment_fingerprint,
+)
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _factories():
+    """Every selector family in ``selectors/`` as (name, preds->Selector)."""
+    from coda_tpu.selectors import (
+        CODAHyperparams,
+        make_activetesting,
+        make_coda,
+        make_iid,
+        make_modelpicker,
+        make_uncertainty,
+        make_vma,
+    )
+
+    hp = CODAHyperparams(eig_chunk=48, num_points=64)
+    hp_direct = CODAHyperparams(eig_chunk=48, num_points=64,
+                                eig_mode="direct")
+    return [
+        ("iid", lambda p: make_iid(p)),
+        ("uncertainty", lambda p: make_uncertainty(p)),
+        ("activetesting", lambda p: make_activetesting(p, budget=12)),
+        ("vma", lambda p: make_vma(p, budget=12)),
+        ("model_picker", lambda p: make_modelpicker(p)),
+        ("coda", lambda p: make_coda(p, hp)),
+        ("coda_direct", lambda p: make_coda(p, hp_direct)),
+    ]
+
+
+def _record_run(factory, task, iters=12, seeds=2, trace_k=5,
+                run_meta=None):
+    res, aux = run_seeds_recorded(factory, task.preds, task.labels,
+                                  iters=iters, seeds=seeds, trace_k=trace_k)
+    fp = environment_fingerprint(dataset=task, knobs={})
+    return RunRecord.from_result(
+        res, aux, fp, run=dict({"task": task.name, "iters": iters,
+                                "seeds": seeds}, **(run_meta or {})))
+
+
+# ---------------------------------------------------------------------------
+# core contract: recording is transparent, replay is bitwise
+# ---------------------------------------------------------------------------
+
+def test_recording_does_not_perturb_trajectory(tiny_task):
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    fac = lambda p: make_coda(p, CODAHyperparams(eig_chunk=48,
+                                                 num_points=64))
+    base = run_seeds_compiled(fac, tiny_task.preds, tiny_task.labels,
+                              iters=10, seeds=3)
+    rec, _aux = run_seeds_recorded(fac, tiny_task.preds, tiny_task.labels,
+                                   iters=10, seeds=3, trace_k=5)
+    for name, a, b in zip(base._fields, base, rec):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), name
+
+
+@pytest.mark.parametrize("name", [f[0] for f in _factories()])
+def test_replay_bitwise_parity_per_selector(name, tiny_task, tmp_path):
+    """Every selector's record replays bitwise on CPU — the same-backend
+    replay contract, through save/load (so the on-disk roundtrip is part
+    of the pinned path)."""
+    factory = dict(_factories())[name]
+    record = _record_run(factory, tiny_task)
+    record.save(tmp_path / name)
+    loaded = RunRecord.load(str(tmp_path / name))
+    report = verify_replay(loaded, factory, tiny_task.preds,
+                           tiny_task.labels, score_tol=0.0)
+    assert report.parity, format_triage(report)
+
+
+def test_record_trace_contents(tiny_task):
+    """Per-round provenance semantics: keys match the scan's split table,
+    the gap is top1-top2, the posterior digest is present for CODA."""
+    import jax
+
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    fac = lambda p: make_coda(p, CODAHyperparams(eig_chunk=48,
+                                                 num_points=64))
+    record = _record_run(fac, tiny_task, iters=8, seeds=1, trace_k=4)
+    arr = record.seed_arrays(0)
+    # the recorded round keys ARE the experiment's key table
+    key = jax.random.PRNGKey(0)
+    _, _, k_scan = jax.random.split(key, 3)
+    keys = np.asarray(jax.random.split(k_scan, 8), np.uint32)
+    np.testing.assert_array_equal(arr["round_key"], keys)
+    np.testing.assert_allclose(
+        arr["runner_up_gap"],
+        arr["topk_score"][:, 0] - arr["topk_score"][:, 1], rtol=0, atol=0)
+    assert np.isfinite(arr["pbest_max"]).all()
+    assert (arr["pbest_max"] > 0).all() and (arr["pbest_max"] <= 1.0).all()
+    # top-k scores are descending
+    assert (np.diff(arr["topk_score"], axis=1) <= 0).all()
+
+
+# ---------------------------------------------------------------------------
+# divergence triage
+# ---------------------------------------------------------------------------
+
+def test_injected_tiebreak_flip_localized_and_classified(tiny_task,
+                                                         tmp_path):
+    """A single-ulp score perturbation that flips the pick at round r is
+    triaged to exactly round r and classified tie-break-flip."""
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    fac = lambda p: make_coda(p, CODAHyperparams(eig_chunk=48,
+                                                 num_points=64))
+    record = _record_run(fac, tiny_task)
+    r = 6
+    arrays = {k: v.copy() for k, v in record.arrays.items()}
+    # the flip: the runner-up wins by one ulp — scores move less than any
+    # meaningful tolerance, only the argmax order changes
+    top = arrays["topk_score"][0, r, 0]
+    arrays["topk_score"][0, r, 0] = np.nextafter(top, np.float32(np.inf))
+    arrays["topk_idx"][0, r, [0, 1]] = arrays["topk_idx"][0, r, [1, 0]]
+    arrays["chosen_idx"][0, r] = arrays["topk_idx"][0, r, 0]
+    perturbed = RunRecord(meta=record.meta, arrays=arrays)
+    report = compare_records(record, perturbed, score_tol=1e-6)
+    s0 = report.seeds[0]
+    assert not s0.parity
+    assert s0.first_divergent_round == r
+    assert s0.classification == "tie-break-flip"
+    assert s0.quantity in ("chosen_idx", "true_class")
+    assert report.seeds[1].parity  # untouched seed stays clean
+
+
+def test_injected_score_delta_classified(tiny_task):
+    """A beyond-tolerance score change classifies as score-delta at its
+    round even when the pick does not change."""
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    fac = lambda p: make_coda(p, CODAHyperparams(eig_chunk=48,
+                                                 num_points=64))
+    record = _record_run(fac, tiny_task)
+    r = 3
+    arrays = {k: v.copy() for k, v in record.arrays.items()}
+    arrays["topk_score"][0, r, 1] += 1e-3
+    perturbed = RunRecord(meta=record.meta, arrays=arrays)
+    report = compare_records(record, perturbed, score_tol=1e-5)
+    s0 = report.seeds[0]
+    assert s0.first_divergent_round == r
+    assert s0.classification == "score-delta"
+    assert s0.quantity == "topk_score"
+    assert s0.quantities["topk_score"]["max_abs_delta"] == \
+        pytest.approx(1e-3, rel=1e-3)
+
+
+def test_posterior_drift_classified(tiny_task):
+    """Decisions equal, posterior digest moved -> posterior-drift (the
+    bf16-cache / update-chain numerics signature)."""
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    fac = lambda p: make_coda(p, CODAHyperparams(eig_chunk=48,
+                                                 num_points=64))
+    record = _record_run(fac, tiny_task)
+    r = 4
+    arrays = {k: v.copy() for k, v in record.arrays.items()}
+    arrays["pbest_max"][0, r:] += 5e-3
+    perturbed = RunRecord(meta=record.meta, arrays=arrays)
+    report = compare_records(record, perturbed, score_tol=1e-4)
+    s0 = report.seeds[0]
+    assert s0.first_divergent_round == r
+    assert s0.classification == "posterior-drift"
+
+
+def test_compare_records_mismatched_widths(tiny_task):
+    """Different --record-topk compares the common top-k prefix; different
+    seed counts compare common seeds and SAY so instead of claiming full
+    parity; --against auto tolerance keys off the two records' fingerprints
+    (not the current host's backend)."""
+    from coda_tpu.engine.replay import _auto_tol
+    from coda_tpu.selectors import make_iid
+
+    fac = lambda p: make_iid(p)
+    wide = _record_run(fac, tiny_task, iters=6, seeds=3, trace_k=6)
+    narrow = _record_run(fac, tiny_task, iters=6, seeds=1, trace_k=3)
+    report = compare_records(wide, narrow, score_tol=0.0)
+    assert report.parity  # common prefix of the identical run
+    assert report.meta["seed_count_mismatch"] == {"a": 3, "b": 1,
+                                                  "compared": 1}
+    assert report.meta["trace_k_compared"] == 3
+    assert "WARNING" in format_triage(report)
+
+    # --against auto tol: two same-fingerprint records -> bitwise; a
+    # fake other-backend record -> the cross-backend contract
+    assert _auto_tol(wide, {}, against=wide) == 0.0
+    other = RunRecord(meta=json.loads(json.dumps(narrow.meta)),
+                      arrays=narrow.arrays)
+    other.meta["fingerprint"]["backend"] = "tpu"
+    from coda_tpu.telemetry.recorder import CROSS_BACKEND_SCORE_TOL
+
+    assert _auto_tol(wide, {}, against=other) == CROSS_BACKEND_SCORE_TOL
+
+
+def test_max_delta_reports_nan_vs_finite():
+    """A posterior digest present in one record and absent (NaN) in the
+    other is a structural divergence and must surface as inf, not 0."""
+    rec = {"chosen_idx": np.array([1, 2], np.int32),
+           "pbest_max": np.array([0.5, 0.6], np.float32)}
+    rep = {"chosen_idx": np.array([1, 2], np.int32),
+           "pbest_max": np.array([0.5, np.nan], np.float32)}
+    s = compare_seed(rec, rep, score_tol=1e-3)
+    assert not s.parity
+    assert s.first_divergent_round == 1
+    assert s.quantities["pbest_max"]["max_abs_delta"] == np.inf
+
+
+def test_compare_seed_nan_and_inf_semantics():
+    """NaN digests (methods without a posterior) and -inf masked scores are
+    equal to themselves at every tolerance — absence is not divergence."""
+    base = {
+        "chosen_idx": np.array([1, 2], np.int32),
+        "pbest_max": np.array([np.nan, np.nan], np.float32),
+        "topk_score": np.array([[1.0, -np.inf], [0.5, -np.inf]],
+                               np.float32),
+    }
+    for tol in (0.0, 1e-6):
+        assert compare_seed(base, {k: v.copy() for k, v in base.items()},
+                            score_tol=tol).parity
+
+
+# ---------------------------------------------------------------------------
+# CLI loop: record -> replay -> triage
+# ---------------------------------------------------------------------------
+
+def test_cli_record_then_replay_roundtrip(tmp_path):
+    from coda_tpu import cli
+
+    rec_dir = str(tmp_path / "rec")
+    cli.main(["--synthetic", "5,40,3", "--iters", "6", "--seeds", "2",
+              "--method", "model_picker", "--no-mlflow",
+              "--record-dir", rec_dir])
+    assert os.path.isfile(os.path.join(rec_dir, "record.json"))
+    meta = json.load(open(os.path.join(rec_dir, "record.json")))
+    assert meta["schema_version"] == RECORD_SCHEMA_VERSION
+    fp = meta["fingerprint"]
+    assert fp["backend"] == "cpu"
+    assert "threefry_partitionable" in fp
+    assert fp["dataset"]["digest"]
+    assert fp["knobs"]["method"] == "model_picker"
+    # bitwise replay through the subcommand (exit code 0 = parity)
+    assert cli.main(["replay", rec_dir]) == 0
+    # --against itself is trivially parity
+    assert replay_main([rec_dir, "--against", rec_dir]) == 0
+
+
+def test_cli_replay_detects_tampered_record(tmp_path):
+    from coda_tpu import cli
+
+    rec_dir = str(tmp_path / "rec")
+    cli.main(["--synthetic", "5,40,3", "--iters", "6", "--seeds", "1",
+              "--method", "uncertainty", "--no-mlflow",
+              "--record-dir", rec_dir])
+    record = RunRecord.load(rec_dir)
+    record.arrays["chosen_idx"][0, 2] = \
+        record.arrays["topk_idx"][0, 2, 1]
+    record.save(rec_dir)
+    assert cli.main(["replay", rec_dir]) == 2  # divergence verdict code
+
+
+def test_dataset_digest_guards_replay(tmp_path):
+    """Replaying a record against different data fails loudly."""
+    from coda_tpu import cli
+
+    rec_dir = str(tmp_path / "rec")
+    cli.main(["--synthetic", "5,40,3", "--iters", "4", "--seeds", "1",
+              "--method", "iid", "--no-mlflow", "--record-dir", rec_dir])
+    record = RunRecord.load(rec_dir)
+    record.meta["fingerprint"]["dataset"]["digest"] = "0" * 16
+    record.save(rec_dir)
+    with pytest.raises((ValueError, SystemExit)):
+        replay_main([rec_dir])
+    # explicit escape hatch still replays (and still reaches a verdict)
+    assert replay_main([rec_dir, "--allow-digest-mismatch"]) in (0, 2)
+
+
+def test_digest_stability():
+    t1 = make_synthetic_task(seed=0, H=4, N=32, C=3)
+    t2 = make_synthetic_task(seed=0, H=4, N=32, C=3)
+    t3 = make_synthetic_task(seed=1, H=4, N=32, C=3)
+    assert dataset_digest(t1.preds, t1.labels) == \
+        dataset_digest(t2.preds, t2.labels)
+    assert dataset_digest(t1.preds, t1.labels) != \
+        dataset_digest(t3.preds, t3.labels)
+
+
+# ---------------------------------------------------------------------------
+# suite streams + schema checker wiring (tier-1, like check_clocks)
+# ---------------------------------------------------------------------------
+
+def _load_schema_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_record_schema",
+        os.path.join(REPO, "scripts", "check_record_schema.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_suite_record_streams_and_schema(tmp_path):
+    from coda_tpu.engine.suite import SuiteRunner
+
+    tasks = [make_synthetic_task(seed=i, H=4, N=40, C=3,
+                                 name=f"alpha_{i}") for i in range(2)]
+    rec_root = str(tmp_path / "streams")
+    runner = SuiteRunner(iters=4, seeds=2, record_dir=rec_root,
+                         record_topk=3)
+    results = runner.run_batched([tasks], ["iid", "model_picker"],
+                                 progress=lambda s: None)
+    # one record per task under per-(family, method) streams
+    for method in ("iid", "model_picker"):
+        for t in ("alpha_0", "alpha_1"):
+            d = os.path.join(rec_root, f"alpha__{method}", t)
+            assert os.path.isfile(os.path.join(d, "record.json")), d
+    # recorded run results match an unrecorded runner bitwise
+    plain = SuiteRunner(iters=4, seeds=2)
+    base = plain.run_batched([tasks], ["iid", "model_picker"],
+                             progress=lambda s: None)
+    for key in base:
+        for a, b in zip(results[key], base[key]):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), key
+    # the streams validate against the versioned schema
+    mod = _load_schema_checker()
+    assert mod.check_tree(rec_root) == {}
+    assert mod.check_tree.last_checked == 4
+    # two same-family records diff clean under the record-vs-record path
+    a = RunRecord.load(os.path.join(rec_root, "alpha__iid", "alpha_0"))
+    assert compare_records(a, a, score_tol=0.0).parity
+
+
+def test_check_record_schema_flags_drift(tmp_path):
+    """Tier-1 wiring of scripts/check_record_schema.py: unversioned or
+    field-drifted records fail, clean ones pass."""
+    from coda_tpu.selectors import make_iid
+
+    record = _record_run(lambda p: make_iid(p),
+                         make_synthetic_task(seed=0, H=4, N=32, C=3),
+                         iters=4, seeds=1, trace_k=3)
+    good = tmp_path / "good"
+    record.save(str(good))
+    mod = _load_schema_checker()
+    assert mod.check_tree(str(tmp_path)) == {}
+
+    # unversioned record
+    meta = json.load(open(good / "record.json"))
+    del meta["schema_version"]
+    bad1 = tmp_path / "bad1"
+    os.makedirs(bad1)
+    json.dump(meta, open(bad1 / "record.json", "w"))
+    import shutil
+
+    shutil.copy(good / "rounds.npz", bad1 / "rounds.npz")
+    # field drift: an array vanished, another appeared
+    bad2 = tmp_path / "bad2"
+    arrays = {k: v for k, v in record.arrays.items()}
+    arrays["surprise"] = np.zeros(3)
+    del arrays["topk_score"]
+    RunRecord(meta=record.meta, arrays=arrays).save(str(bad2))
+
+    bad = mod.check_tree(str(tmp_path))
+    assert any("schema_version" in v for v in bad.get("bad1", []))
+    assert any("topk_score" in v for v in bad.get("bad2", []))
+    assert any("unversioned field drift" in v for v in bad.get("bad2", []))
+    assert mod.main([str(tmp_path)]) == 1
+    assert mod.main([str(good)]) == 0
+
+    # session stream validation
+    stream = tmp_path / "good" / "session_ab12.jsonl"
+    with open(stream, "w") as f:
+        f.write(json.dumps({"v": RECORD_SCHEMA_VERSION,
+                            "kind": "session_meta"}) + "\n")
+        f.write(json.dumps({"v": RECORD_SCHEMA_VERSION, "n_labeled": 0,
+                            "do_update": False, "next_idx": 1,
+                            "next_prob": 0.5, "best": 0}) + "\n")
+    assert mod.check_tree(str(good)) == {}
+    with open(stream, "a") as f:
+        f.write(json.dumps({"next_idx": 2}) + "\n")  # no version stamp
+    assert any("version stamp" in v
+               for v in mod.check_tree(str(good)).get(
+                   "session_ab12.jsonl", []))
+
+
+# ---------------------------------------------------------------------------
+# serving streams
+# ---------------------------------------------------------------------------
+
+def test_serve_session_trace_stream(tmp_path):
+    from coda_tpu.serve.server import ServeApp
+    from coda_tpu.serve.state import SelectorSpec
+
+    task = make_synthetic_task(seed=0, H=4, N=32, C=3)
+    app = ServeApp(capacity=4, spec=SelectorSpec.create("iid"),
+                   recorder=SessionRecorder(out_dir=str(tmp_path)))
+    app.add_task(task.name, task.preds)
+    app.start()
+    try:
+        s = app.open_session()
+        sid = s["session"]
+        for _ in range(3):
+            s = app.label(sid, label=0, idx=s["idx"])
+        tr = app.trace(sid)
+        assert tr["n_labeled"] == 3
+        assert len(tr["rounds"]) == 4  # start dispatch + 3 labels
+        assert tr["rounds"][0]["do_update"] is False
+        assert tr["rounds"][1]["do_update"] is True
+        assert tr["rounds"][1]["labeled_idx"] is not None
+        assert all(r["v"] == RECORD_SCHEMA_VERSION for r in tr["rounds"])
+        stats = app.stats()
+        assert stats["record_rows_written"] >= 4
+        assert "records_written" in stats and "replay_verified" in stats
+        # crash-safe stream on disk, one meta line + one row per dispatch
+        fp = os.path.join(str(tmp_path), f"session_{sid}.jsonl")
+        lines = [json.loads(x) for x in open(fp).read().splitlines()]
+        assert lines[0]["kind"] == "session_meta"
+        assert len(lines) == 5
+        mod = _load_schema_checker()
+        assert mod.check_tree(str(tmp_path)) == {}
+        app.close_session(sid)
+        assert app.recorder.history(sid) is None
+    finally:
+        app.drain(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# telemetry flush: context manager + crash atexit fallback
+# ---------------------------------------------------------------------------
+
+def test_telemetry_context_manager_flushes(tmp_path):
+    from coda_tpu.telemetry import Telemetry
+
+    out = str(tmp_path / "tele")
+    with Telemetry(out_dir=out, install_hooks=False) as tele:
+        tele.counter("ctx_test_total").inc()
+    for fn in ("trace.json", "telemetry.json", "metrics.prom"):
+        assert os.path.isfile(os.path.join(out, fn)), fn
+
+    # exceptional exit still flushes, and does not swallow the error
+    out2 = str(tmp_path / "tele2")
+    with pytest.raises(RuntimeError):
+        with Telemetry(out_dir=out2, install_hooks=False):
+            raise RuntimeError("mid-flight death")
+    assert os.path.isfile(os.path.join(out2, "telemetry.json"))
+
+
+def test_crash_mid_run_still_yields_valid_artifacts(tmp_path):
+    """A run that dies on an unhandled exception still leaves telemetry
+    artifacts (atexit fallback) and schema-valid record streams (per-row
+    JSONL flush) behind."""
+    out = str(tmp_path / "crash")
+    script = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+from coda_tpu.telemetry import SessionRecorder, Telemetry
+
+tele = Telemetry(out_dir={out!r})
+tele.counter("crash_total").inc()
+rec = SessionRecorder(out_dir={out!r})
+rec.open("dead0", meta={{"task": "t"}})
+rec.append("dead0", {{"n_labeled": 0, "do_update": False, "next_idx": 3,
+                      "next_prob": 0.5, "best": 1, "stochastic": False}})
+raise RuntimeError("simulated mid-run crash")
+"""
+    proc = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0  # it really crashed
+    assert "simulated mid-run crash" in proc.stderr
+    for fn in ("trace.json", "telemetry.json", "metrics.prom",
+               "session_dead0.jsonl"):
+        assert os.path.isfile(os.path.join(out, fn)), (fn, proc.stderr)
+    tele = json.load(open(os.path.join(out, "telemetry.json")))
+    assert tele["metrics"]["crash_total"]["values"][""] == 1.0
+    mod = _load_schema_checker()
+    assert mod.check_tree(out) == {}
+    assert mod.check_tree.last_checked == 1
+
+
+# ---------------------------------------------------------------------------
+# overhead bench (slow: wall-clock measurement)
+# ---------------------------------------------------------------------------
+
+def test_recorder_overhead_under_five_percent():
+    """The trace tap adds ≤5% to the compiled loop: the extra work per
+    round is O(N) top-k + O(H) digest against the selector's
+    O(N·C·H)-class scoring.
+
+    The ≤5% bound is asserted on XLA's own cost analysis (FLOPs +
+    transcendentals of the compiled executables) — deterministic, unlike
+    wall clock on this container, where two fresh compiles of the SAME
+    program differ by up to ~8% in codegen quality. Wall is still
+    measured (interleaved min-of-7) as a gross-regression tripwire and
+    committed as evidence in BENCH_RECORDER_CPU_r08.json (measured
+    +0.1%..+3.2% across shapes)."""
+    import jax
+
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    # a shape where the EIG scoring chain dominates (the realistic regime:
+    # the recorder's O(N) top-k + O(H) digest amortize against O(N·C·H)
+    # scoring); measured +0.1%..+3.2% on this container across shapes
+    task = make_synthetic_task(seed=0, H=32, N=4096, C=8)
+    fac = lambda p: make_coda(p, CODAHyperparams(eig_chunk=4096,
+                                                 num_points=128))
+    # the persistent compile cache must not skew the comparison: a
+    # cache-DESERIALIZED executable runs measurably faster than the same
+    # HLO fresh-compiled in-process (observed 3.4x on this container), so
+    # whichever side happened to be cached by an earlier session would win
+    # unfairly — force both sides to fresh codegen
+    prev_cache = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+
+    def once(fn):
+        out = fn()
+        # recorded runs return (ExperimentResult, aux); plain runs
+        # return the (NamedTuple) result directly
+        (out if hasattr(out, "regret") else out[0]) \
+            .regret.block_until_ready()
+
+    run_base = lambda: run_seeds_compiled(
+        fac, task.preds, task.labels, iters=30, seeds=2,
+        loss_fn=accuracy_loss)
+    run_rec = lambda: run_seeds_recorded(
+        fac, task.preds, task.labels, iters=30, seeds=2,
+        loss_fn=accuracy_loss, trace_k=8)
+    try:
+        # the deterministic bound: compiled-executable cost analysis
+        def cost(fn, trace_k):
+            from coda_tpu.engine.loop import make_batched_experiment_fn
+            from coda_tpu.losses import LOSS_FNS
+
+            f = make_batched_experiment_fn(fac, 30, LOSS_FNS["acc"],
+                                           trace_k=trace_k)
+            keys = jax.numpy.stack([jax.random.PRNGKey(s)
+                                    for s in range(2)])
+            compiled = jax.jit(f).lower(task.preds, task.labels,
+                                        keys).compile()
+            (ca,) = compiled.cost_analysis() \
+                if isinstance(compiled.cost_analysis(), list) \
+                else (compiled.cost_analysis(),)
+            return (float(ca.get("flops", 0.0))
+                    + float(ca.get("transcendentals", 0.0)))
+
+        c_base = cost(fac, 0)
+        c_rec = cost(fac, 8)
+        flop_overhead = c_rec / c_base - 1.0
+        assert flop_overhead <= 0.05, (
+            f"recorder op-count overhead {flop_overhead:.2%} exceeds the "
+            f"5% bound (base {c_base:.3e}, recorded {c_rec:.3e})")
+
+        once(run_base)  # warm-up: pay both compiles outside the timing
+        once(run_rec)
+        # interleaved min-of-7: back-to-back pairs cancel the container's
+        # load drift, min strips scheduler noise from each side
+        base_walls, rec_walls = [], []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            once(run_base)
+            base_walls.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            once(run_rec)
+            rec_walls.append(time.perf_counter() - t0)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache)
+    base, recorded = min(base_walls), min(rec_walls)
+    overhead = recorded / base - 1.0
+    # gross tripwire only: per-compile codegen variance on this container
+    # is larger than the 5% claim, which the cost analysis above pins
+    assert overhead <= 0.25, (
+        f"recorder wall overhead {overhead:.1%} — far beyond the expected "
+        f"few percent (base {base:.3f}s, recorded {recorded:.3f}s)")
