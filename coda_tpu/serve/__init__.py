@@ -1,17 +1,24 @@
 """Batched multi-session serving of interactive active model selection.
 
 Multiplexes many concurrent human-in-the-loop selection sessions onto one
-accelerator: a fixed-capacity slab of vmapped selector carries
-(:mod:`~coda_tpu.serve.state`), a micro-batching dispatcher that executes
-one compiled masked step per tick (:mod:`~coda_tpu.serve.batcher`), a
-dependency-free HTTP/JSON front door with admission control
-(:mod:`~coda_tpu.serve.server`), and per-dispatch metrics
+accelerator: a fixed-capacity slab of vmapped selector carries with
+AOT-warmed, buffer-donated executables (:mod:`~coda_tpu.serve.state`), a
+continuous-batching dispatcher that executes one compiled masked step per
+tick (:mod:`~coda_tpu.serve.batcher`), a dependency-free asyncio HTTP/JSON
+front door with admission control and a warm-pool readiness gate
+(:mod:`~coda_tpu.serve.server`), and per-dispatch metrics including the
+queue-wait/dispatch/step attribution triplet
 (:mod:`~coda_tpu.serve.metrics`). See ARCHITECTURE.md §"Serving".
 """
 
 from coda_tpu.serve.batcher import Batcher, Ticket
 from coda_tpu.serve.metrics import ServeMetrics
-from coda_tpu.serve.server import ServeApp, build_app, make_server
+from coda_tpu.serve.server import (
+    AsyncHTTPServer,
+    ServeApp,
+    build_app,
+    make_server,
+)
 from coda_tpu.serve.state import (
     Bucket,
     SelectorSpec,
@@ -25,6 +32,7 @@ from coda_tpu.serve.state import (
 )
 
 __all__ = [
+    "AsyncHTTPServer",
     "Batcher",
     "Bucket",
     "SelectorSpec",

@@ -1,19 +1,36 @@
-"""Micro-batching dispatcher: many sessions' requests, one program launch.
+"""Continuous-batching dispatcher: many sessions' requests, one launch.
 
-The host half of the serving layer. HTTP worker threads submit one request
-per user action (session start, oracle label) and block on a ticket; a
-single batcher thread drains the queue, coalesces everything that arrived
-within a ``max_wait`` window (up to ``max_batch``), groups by bucket, and
-executes ONE compiled masked slab step per bucket
-(:func:`coda_tpu.serve.state.make_slab_step`). Accelerator dispatch cost is
-thus amortized over every concurrent session instead of paid per click —
-the standard batched-inference serving move, applied to the paper's
-select/update/best loop.
+The host half of the serving layer. Front-door workers (asyncio handlers or
+in-process callers) submit one request per user action (session start,
+oracle label) and wait on a ticket; a single batcher thread drains the
+queue, forms a batch, groups by bucket, and executes ONE compiled masked
+slab step per bucket (:func:`coda_tpu.serve.state.make_slab_step`).
+Accelerator dispatch cost is thus amortized over every concurrent session
+instead of paid per click.
+
+Batch formation is **continuous**: a completed tick immediately starts
+forming the next one from whatever queued while it ran — no fixed wait
+gates a ready batch, and tickets arriving while a batch forms join it up
+to ``max_batch``. Formation then lingers only while arrivals keep
+flowing: each arrival refreshes a ``max_wait`` quiet-gap budget, so the
+cohort the previous tick just answered can resubmit as a burst and ride
+this tick instead of the next (the masked slab step costs the same at
+any occupancy, so a few ms of pickup buys half the slab a whole tick of
+latency), while a single idle request is dispatched ``max_wait`` after
+it arrives. Total formation time is hard-capped by ``max_linger`` so
+steady trickle arrival can never stretch a tick's formation window
+indefinitely — the cap, not the gap, is the worst-case bound.
 
 Two requests for the same slot never ride one tick (the second would read
 the first's pre-update state); the collision is requeued for the next tick.
 Closed-loop clients can't produce collisions (they wait for their reply),
 so this path only guards misbehaving open-loop callers.
+
+Tickets resolve exactly once (a lock arbitrates dispatch completion against
+wait-timeout cancellation — the loser of the race is a no-op), and a
+resolution wakes both the blocking ``wait()`` path and any asyncio waiter
+registered by ``wait_async()`` (the front door's bridge from the batcher
+thread into the event loop).
 """
 
 from __future__ import annotations
@@ -28,7 +45,15 @@ from typing import Optional
 
 @dataclass
 class Ticket:
-    """One submitted request and its rendezvous."""
+    """One submitted request and its rendezvous.
+
+    Resolution (result, error, or cancellation) happens EXACTLY once: the
+    first of {dispatch completion, dispatch failure, cancel} wins under
+    ``_lock`` and fires ``done`` plus any registered asyncio futures; later
+    attempts return False and change nothing. This is what makes a
+    wait-timeout racing an in-flight dispatch safe — the ticket is never
+    double-completed, whichever side wins.
+    """
 
     session: object                 # state.Session
     do_update: bool
@@ -36,44 +61,147 @@ class Ticket:
     label: int = 0
     prob: float = 0.0
     submitted: float = field(default_factory=time.perf_counter)
+    collected: float = 0.0          # when the batcher picked it into a batch
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[dict] = None
     error: Optional[BaseException] = None
     cancelled: bool = False
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _async_waiters: list = field(default_factory=list)  # (loop, future)
 
+    # -- resolution (exactly once) ----------------------------------------
+    def _fire(self) -> None:
+        self.done.set()
+        waiters, self._async_waiters = self._async_waiters, []
+        for loop, fut in waiters:
+            try:
+                loop.call_soon_threadsafe(self._resolve_future, fut)
+            except RuntimeError:
+                pass  # loop already closed; the waiter is gone anyway
+
+    def _resolve_future(self, fut) -> None:
+        if fut.done():
+            return
+        if self.error is not None:
+            fut.set_exception(self.error)
+        else:
+            fut.set_result(self.result)
+
+    def complete(self, result: dict, collector: Optional[dict] = None
+                 ) -> bool:
+        """Resolve with a result. With a ``collector`` ({loop: [(ticket,
+        future), ...]}), async waiters are appended there instead of each
+        paying its own ``call_soon_threadsafe`` — the dispatcher flushes
+        one cross-thread wakeup per event loop per tick instead of one per
+        ticket (256 tickets = 256 loop wakeups otherwise, a measurable
+        slice of the tick cycle on a busy host)."""
+        with self._lock:
+            if self.done.is_set():
+                return False
+            self.result = result
+            if collector is None:
+                self._fire()
+                return True
+            self.done.set()
+            waiters, self._async_waiters = self._async_waiters, []
+        for loop, fut in waiters:
+            collector.setdefault(loop, []).append((self, fut))
+        return True
+
+    def fail(self, error: BaseException) -> bool:
+        with self._lock:
+            if self.done.is_set():
+                return False
+            self.error = error
+            self._fire()
+            return True
+
+    def cancel(self, reason: str = "timeout") -> bool:
+        """Mark the ticket dead-on-arrival for the dispatcher. Wins only if
+        nothing resolved it yet (a dispatch that already completed it keeps
+        its result — the caller lost the race and gets the real answer)."""
+        with self._lock:
+            if self.done.is_set():
+                return False
+            self.cancelled = True
+            self.error = RuntimeError(f"request cancelled ({reason})")
+            self._fire()
+            return True
+
+    # -- waiting -----------------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> dict:
         """Block for the result. On timeout the ticket is CANCELLED before
         raising: a still-queued request must not fire later against a slot
         the caller has given up on (it could have been freed and reassigned
         — the dispatch would advance another session's PRNG stream — or,
         for a label the client will retry, apply the same update twice).
-        Best-effort: a ticket already inside a dispatch completes."""
+        Best-effort: a ticket already inside a dispatch completes, and if
+        the dispatch resolves the ticket before the cancel lands, the real
+        result is returned instead of raising."""
         if not self.done.wait(timeout):
-            self.cancelled = True
-            raise TimeoutError("serve dispatch timed out")
+            if self.cancel("serve dispatch timed out"):
+                raise TimeoutError("serve dispatch timed out")
+            # lost the race: a dispatch completed us during the timeout
         if self.error is not None:
             raise self.error
         return self.result
+
+    async def wait_async(self, timeout: Optional[float] = None) -> dict:
+        """Awaitable twin of :meth:`wait` for the asyncio front door: the
+        batcher thread resolves the future via ``call_soon_threadsafe``, so
+        the event loop never blocks on accelerator work."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        with self._lock:
+            if self.done.is_set():
+                self._resolve_future(fut)
+            else:
+                self._async_waiters.append((loop, fut))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            if self.cancel("serve dispatch timed out"):
+                raise TimeoutError("serve dispatch timed out") from None
+            if self.error is not None:
+                raise self.error
+            return self.result
+
+
+def _deliver_batch(items: list) -> None:
+    """Resolve many tickets' futures inside their event loop (one
+    ``call_soon_threadsafe`` delivered this whole list)."""
+    for t, fut in items:
+        t._resolve_future(fut)
 
 
 class Batcher:
     """The dispatcher thread around a :class:`SessionStore`.
 
-    ``max_batch`` caps requests per tick; ``max_wait`` is how long the tick
-    lingers after the FIRST request for stragglers to coalesce (the
-    latency/occupancy dial). ``start()``/``stop()`` manage the thread;
-    ``pause()``/``resume()`` freeze ticking with the queue still accepting —
-    the deterministic-occupancy hook the lockstep load generator and the
+    ``max_batch`` caps requests per tick. ``max_wait`` is the quiet-gap
+    budget: a tick dispatches once no new ticket has arrived for
+    ``max_wait`` (a full batch never waits at all — continuous batching
+    admits everything already queued immediately). ``max_linger`` bounds
+    TOTAL formation time of any tick regardless of arrival pattern
+    (default ``4x max_wait``); pause time is excluded, since a paused
+    batcher deliberately holds its batch (the lockstep hook).
+    ``start()``/``stop()`` manage the thread; ``pause()``/``resume()``
+    freeze ticking with the queue still accepting — the
+    deterministic-occupancy hook the lockstep load generator and the
     batching tests use.
     """
 
     def __init__(self, store, metrics=None, max_batch: int = 256,
-                 max_wait: float = 0.002, telemetry=None, recorder=None):
+                 max_wait: float = 0.002, max_linger: Optional[float] = None,
+                 telemetry=None, recorder=None):
         self.store = store
         self.metrics = metrics
         # optional Telemetry: each per-bucket dispatch becomes a span on the
         # "host:batcher" lane (annotated so a live jax.profiler capture
-        # shows the same tick names next to the device rows)
+        # shows the same tick names next to the device rows), with the
+        # slab-step execution as a nested "step/<task>" span for the
+        # queue-wait / dispatch / step attribution
         self.telemetry = telemetry
         # optional SessionRecorder: every completed ticket appends one
         # decision row to its session's record stream (the flight
@@ -81,6 +209,8 @@ class Batcher:
         self.recorder = recorder
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait)
+        self.max_linger = (4.0 * self.max_wait if max_linger is None
+                           else float(max_linger))
         self.queue: queue.Queue = queue.Queue()
         self._running = False
         self._paused = threading.Event()
@@ -115,8 +245,7 @@ class Batcher:
                 t = self.queue.get_nowait()
             except queue.Empty:
                 break
-            t.error = RuntimeError("server stopped")
-            t.done.set()
+            t.fail(RuntimeError("server stopped"))
 
     def pause(self) -> None:
         self._paused.clear()
@@ -124,7 +253,7 @@ class Batcher:
     def resume(self) -> None:
         self._paused.set()
 
-    # -- submission (HTTP worker threads) ----------------------------------
+    # -- submission (front-door workers) -----------------------------------
     def submit(self, ticket: Ticket) -> Ticket:
         self.queue.put(ticket)
         return ticket
@@ -139,31 +268,66 @@ class Batcher:
 
     # -- the tick ----------------------------------------------------------
     def _collect(self) -> list:
-        """Block for the first ticket, then linger ``max_wait`` for more.
+        """Form one batch: block briefly for the first ticket, drain what's
+        already queued, then linger while arrivals keep flowing.
 
-        A pause() landing mid-collect (the thread may already hold a ticket
-        from its blocking get) HOLDS the partial batch and restarts the
-        linger window on resume, so everything submitted while paused still
-        rides this one dispatch — without this, lockstep's
-        one-dispatch-per-round guarantee would be a race against the first
-        submitter."""
+        Each arrival refreshes a ``max_wait`` quiet-gap budget, so the
+        window ends ``max_wait`` after the LAST arrival — but the total
+        unpaused formation time is hard-capped at ``max_linger``, so
+        steady trickle arrival bounds a tick's formation by time, not
+        only by ``max_batch``.
+
+        A pause() landing mid-collect (the thread may already hold tickets
+        from its blocking get) HOLDS the batch — full or partial — until
+        resume, and admits everything submitted during the pause (up to
+        ``max_batch``) into this one dispatch; without the hold,
+        lockstep's one-dispatch-per-round guarantee would be a race
+        against the first submitter."""
         try:
             first = self.queue.get(timeout=0.05)
         except queue.Empty:
             return []
         batch = [first]
-        deadline = time.perf_counter() + self.max_wait
+        # continuous-batching fast path: everything that queued while the
+        # previous tick ran joins this one with zero added wait
         while len(batch) < self.max_batch:
-            if not self._paused.is_set():
-                self._paused.wait()
-                deadline = time.perf_counter() + self.max_wait
-            remaining = deadline - time.perf_counter()
-            if remaining <= 0:
-                break
             try:
-                batch.append(self.queue.get(timeout=remaining))
+                batch.append(self.queue.get_nowait())
             except queue.Empty:
                 break
+        # adaptive pickup linger: while arrivals keep flowing (gaps under
+        # max_wait), keep collecting — the cohort the previous tick just
+        # answered resubmits as a burst, and riding THIS tick instead of
+        # the next saves it a whole slab step (which costs the same at any
+        # occupancy). Each arrival refreshes the max_wait gap budget, so
+        # the window ends max_wait after the LAST arrival, not the first;
+        # the total unpaused formation time is hard-capped at max_linger
+        # so steady trickle arrival bounds a tick's formation by time, not
+        # only by max_batch.
+        spent = 0.0  # unpaused linger seconds consumed (the cap's measure)
+        while len(batch) < self.max_batch and spent < self.max_linger:
+            if not self._paused.is_set():
+                break  # pause-hold below owns the batch from here
+            gap = min(self.max_wait, self.max_linger - spent)
+            if gap <= 0:
+                break
+            t0 = time.perf_counter()
+            try:
+                batch.append(self.queue.get(timeout=gap))
+            except queue.Empty:
+                break  # arrivals went quiet for a full max_wait
+            finally:
+                spent += time.perf_counter() - t0
+        # pause-hold: NEVER hand a batch (even a full one) to dispatch
+        # while paused — wait out the pause and admit everything submitted
+        # during it, so a lockstep round rides exactly one dispatch
+        while not self._paused.is_set():
+            self._paused.wait()
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self.queue.get_nowait())
+                except queue.Empty:
+                    break
         return batch
 
     def _loop(self) -> None:
@@ -178,14 +342,16 @@ class Batcher:
         # group by bucket; at most one ticket per slot per tick. Cancelled
         # tickets (wait-timeout) and tickets whose session closed while
         # queued are dropped HERE, not dispatched — their slot may already
-        # belong to someone else (see Ticket.wait)
+        # belong to someone else (see Ticket.wait). Their slot entry is
+        # never marked pending, so the next tick sees a clean slab.
+        now = time.perf_counter()
         per_bucket: dict = {}
         requeue: list = []
         for t in batch:
+            t.collected = now
             if t.cancelled or not self.store.alive(t.session.sid):
-                t.error = RuntimeError("request cancelled (timeout or "
-                                       "session closed while queued)")
-                t.done.set()
+                t.fail(RuntimeError("request cancelled (timeout or "
+                                    "session closed while queued)"))
                 continue
             slots = per_bucket.setdefault(t.session.bucket, {})
             if t.session.slot in slots:
@@ -213,18 +379,28 @@ class Batcher:
                     results = bucket.dispatch(reqs)
             except BaseException as e:  # surface to every waiter, keep going
                 for t in slots.values():
-                    t.error = e
-                    t.done.set()
+                    t.fail(e)
                 continue
             dt = time.perf_counter() - t0
+            deliveries: dict = {}  # loop -> [(ticket, future), ...]
+            timing = dict(bucket.last_timing)
+            if self.telemetry is not None and timing.get("step_s"):
+                # the slab-step execution as its own span, nested inside
+                # the tick: tick minus step is host-side build/fan-out
+                t_end = time.perf_counter()
+                s0 = t_end - timing["step_s"]
+                self.telemetry.spans.record(
+                    f"step/{bucket.task}", lane="host:batcher",
+                    t_start=s0, t_end=t_end,
+                    attrs={"requests": len(slots),
+                           "source": "aot" if bucket.is_warm else "jit"})
             now = time.perf_counter()
             for slot, t in slots.items():
-                t.result = results[slot]
-                t.session.last = results[slot]
+                r = results[slot]
+                t.session.last = r
                 if t.do_update:
                     t.session.n_labeled += 1
                 if self.recorder is not None:
-                    r = results[slot]
                     self.recorder.append(t.session.sid, {
                         "n_labeled": t.session.n_labeled,
                         "do_update": t.do_update,
@@ -238,8 +414,17 @@ class Batcher:
                     })
                 if self.metrics is not None:
                     self.metrics.record_request_latency(now - t.submitted)
-                t.done.set()
+                    self.metrics.record_queue_wait(t.collected - t.submitted)
+                t.complete(r, collector=deliveries)
+            for loop, items in deliveries.items():
+                try:
+                    loop.call_soon_threadsafe(_deliver_batch, items)
+                except RuntimeError:  # loop closed; waiters are gone
+                    pass
             if self.metrics is not None:
-                self.metrics.record_dispatch(len(slots), depth, dt)
+                self.metrics.record_dispatch(
+                    len(slots), depth, dt,
+                    step_seconds=timing.get("step_s"),
+                    warm=bucket.is_warm)
         for t in requeue:
             self.queue.put(t)

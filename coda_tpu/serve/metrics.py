@@ -52,16 +52,24 @@ class ServeMetrics:
         self.sessions_closed = 0
         self.sessions_rejected = 0   # admission-control refusals (slab full)
         self.requests_rejected = 0   # draining / bad-session refusals
+        # warm pool: AOT-precompiled executables vs lazy-jit fallbacks
+        self.warm_hits = 0           # dispatches served by an AOT executable
+        self.warm_misses = 0         # dispatches that fell back to lazy jit
+        self.warm_pool_size = 0      # precompiled executables in the pool
+        self.warm_pool_seconds = None  # warm-up wall time (None = no warm)
         # gauges / rings
         self.max_occupancy = 0       # most requests ever served by one dispatch
         self._occupancy = collections.deque(maxlen=_RING)   # reqs per dispatch
         self._queue_depth = collections.deque(maxlen=_RING)  # at tick start
-        self._dispatch_s = collections.deque(maxlen=_RING)  # slab-step seconds
+        self._dispatch_s = collections.deque(maxlen=_RING)  # dispatch wall
+        self._step_s = collections.deque(maxlen=_RING)      # slab-step exec
         self._request_s = collections.deque(maxlen=_RING)   # submit->result
+        self._queue_wait_s = collections.deque(maxlen=_RING)  # submit->tick
 
     # -- recording (request path: O(1), no reductions) ---------------------
     def record_dispatch(self, n_requests: int, queue_depth: int,
-                        seconds: float) -> None:
+                        seconds: float, step_seconds: float = None,
+                        warm: bool = None) -> None:
         with self._lock:
             self.dispatches += 1
             self.requests += n_requests
@@ -69,10 +77,27 @@ class ServeMetrics:
             self._occupancy.append(n_requests)
             self._queue_depth.append(queue_depth)
             self._dispatch_s.append(seconds)
+            if step_seconds is not None:
+                self._step_s.append(step_seconds)
+            if warm is not None:
+                if warm:
+                    self.warm_hits += 1
+                else:
+                    self.warm_misses += 1
 
     def record_request_latency(self, seconds: float) -> None:
         with self._lock:
             self._request_s.append(seconds)
+
+    def record_queue_wait(self, seconds: float) -> None:
+        with self._lock:
+            self._queue_wait_s.append(seconds)
+
+    def record_warm_pool(self, size: int, seconds: float) -> None:
+        """One warm-up pass finished: pool size + wall time it took."""
+        with self._lock:
+            self.warm_pool_size = int(size)
+            self.warm_pool_seconds = float(seconds)
 
     def record_session(self, event: str) -> None:
         with self._lock:
@@ -108,6 +133,17 @@ class ServeMetrics:
                                      else None),
                 "dispatch_latency": _percentiles(self._dispatch_s),
                 "request_latency": _percentiles(self._request_s),
+                # the p99 attribution triplet: where a request's wall time
+                # went — queued behind a tick, host-side dispatch fan-out,
+                # or the compiled slab step itself
+                "queue_wait": _percentiles(self._queue_wait_s),
+                "step_latency": _percentiles(self._step_s),
+                "warm_pool": {
+                    "size": self.warm_pool_size,
+                    "warm_s": self.warm_pool_seconds,
+                    "hits": self.warm_hits,
+                    "misses": self.warm_misses,
+                },
                 # ring fill: how much recent-window evidence backs the
                 # percentiles above (fill == capacity -> the ring has
                 # wrapped and older events have been evicted)
@@ -117,6 +153,8 @@ class ServeMetrics:
                     "queue_depth": len(self._queue_depth),
                     "dispatch_latency": len(self._dispatch_s),
                     "request_latency": len(self._request_s),
+                    "queue_wait": len(self._queue_wait_s),
+                    "step_latency": len(self._step_s),
                 },
             }
         return snap

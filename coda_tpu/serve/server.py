@@ -1,11 +1,16 @@
-"""Threaded HTTP/JSON API over the batched session store.
+"""Asyncio HTTP/JSON front door over the batched session store.
 
-The serving front door, in the dependency-free ``http.server`` style of
-``demo/app.py`` (gradio/flask are not in TPU images). Worker threads do
-pure host work — parse JSON, admission-control, enqueue a ticket, block on
-the rendezvous — while ALL accelerator work funnels through the single
-batcher thread, so N concurrent users cost one compiled slab step per tick,
-not N device round trips.
+The serving front door, dependency-free (stdlib ``asyncio`` — gradio/flask
+are not in TPU images). One event loop multiplexes every connection, so
+256+ concurrent sessions cost file descriptors, not OS threads: the
+thread-per-request stdlib server this replaced paid thread-scheduling
+jitter per click at high session counts. Handlers do pure host work —
+parse JSON, admission-control, enqueue a ticket, ``await`` the rendezvous —
+while ALL accelerator work funnels through the single batcher thread
+(tickets bridge back into the loop via ``call_soon_threadsafe``), so N
+concurrent users cost one compiled slab step per tick, not N device round
+trips. Blocking host sections (admission's bucket lock, posterior reads)
+run on the default executor so the loop never stalls behind them.
 
     POST   /session                  {task?, seed?}    -> admit + first item
     POST   /session/{id}/label       {label, idx?}     -> update, next item
@@ -15,12 +20,20 @@ not N device round trips.
     DELETE /session/{id}                               -> close, free slot
     GET    /stats                                      -> metrics snapshot
     GET    /metrics                                    -> Prometheus text
-    GET    /healthz                                    -> liveness/draining
+    GET    /healthz                                    -> readiness/liveness
 
 Admission control: a full slab answers 503 (the client's retry signal), as
 does a draining server. ``ServeApp.drain()`` stops admitting, finishes the
 queued work, and flushes metrics — the graceful-shutdown half of the
 contract.
+
+Warm pool: ``ServeApp.start()`` ahead-of-time compiles every (task, spec)
+bucket's slab-step/init/pbest executables (``jit(...).lower().compile()``)
+so first-hit compilation never lands under a user's click, and ``/healthz``
+answers 503 until the pool is warm — the readiness gate a load balancer
+keys on. With ``--compilation-cache-dir`` the executables persist across
+restarts: a second start deserializes instead of recompiling (0 fresh
+backend compiles, pinned by the warm-restart test).
 
 Run:  python -m coda_tpu.cli serve [--task T | --synthetic H,N,C] [--port P]
 """
@@ -28,10 +41,12 @@ Run:  python -m coda_tpu.cli serve [--task T | --synthetic H,N,C] [--port P]
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import re
+import socket
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import time
 from typing import Optional
 
 from coda_tpu.serve.batcher import Batcher
@@ -43,24 +58,27 @@ from coda_tpu.serve.state import (
     UnknownSession,
 )
 
-# how long an HTTP worker waits on its ticket before giving up (a stuck
-# accelerator should surface as 504s, not piled-up threads)
+# how long a front-door handler waits on its ticket before giving up (a
+# stuck accelerator should surface as 504s, not piled-up waiters)
 REQUEST_TIMEOUT_S = 60.0
 
 
 class ServeApp:
-    """Store + batcher + metrics + admission policy, bundled for the
-    handler (and for in-process embedding — tests and the load generator
-    drive a ServeApp directly)."""
+    """Store + batcher + metrics + admission policy + warm pool, bundled
+    for the front door (and for in-process embedding — tests and the load
+    generator drive a ServeApp directly)."""
 
     def __init__(self, capacity: int = 64, bucket_n: int = 1,
                  max_batch: int = 256, max_wait: float = 0.002,
+                 max_linger: Optional[float] = None,
                  default_task: Optional[str] = None,
                  spec: Optional[SelectorSpec] = None,
+                 step_impl: Optional[str] = None, donate: bool = True,
                  telemetry=None, recorder=None):
         from coda_tpu.telemetry import SessionRecorder, Telemetry
 
-        self.store = SessionStore(capacity=capacity, bucket_n=bucket_n)
+        self.store = SessionStore(capacity=capacity, bucket_n=bucket_n,
+                                  step_impl=step_impl, donate=donate)
         self.metrics = ServeMetrics()
         # always live (registry-backed /metrics needs one); --telemetry-dir
         # upgrades it to an artifact-writing instance
@@ -72,13 +90,27 @@ class ServeApp:
             else SessionRecorder()
         self.batcher = Batcher(self.store, self.metrics,
                                max_batch=max_batch, max_wait=max_wait,
+                               max_linger=max_linger,
                                telemetry=self.telemetry,
                                recorder=self.recorder)
         self.spec = spec or SelectorSpec.create("coda", n_parallel=capacity)
         self.default_task = default_task
         self.draining = False
+        # readiness: set once the warm pool is compiled (or warm-up was
+        # explicitly skipped). /healthz answers 503 until then — the load
+        # balancer's signal to keep traffic off a still-compiling replica.
+        self.ready = threading.Event()
+        self.warm_info: dict = {}
         self._seed_lock = threading.Lock()
         self._next_seed = 0
+        # blocking-verb executor for the asyncio front door: sized for a
+        # thundering herd of admissions (each blocks ~one init executable,
+        # not a slab step — admission writes are staged, see state.py), so
+        # the default 5-thread loop executor never becomes the bottleneck
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="serve-verb")
         # create the record/replay counters eagerly so /metrics exposes
         # them at 0 instead of omitting them until first use
         self.telemetry.counter(
@@ -98,8 +130,50 @@ class ServeApp:
         if default or self.default_task is None:
             self.default_task = name
 
-    def start(self) -> "ServeApp":
+    # -- warm pool ---------------------------------------------------------
+    def warm(self) -> dict:
+        """AOT-compile every (task, spec) bucket's executables.
+
+        Enumerates the warm pool — each registered task under this server's
+        selector spec — builds the bucket (selector statics) and compiles
+        its slab step, per-slot init, and pbest read ahead of time. Backed
+        by a persistent compilation cache (``--compilation-cache-dir``)
+        this is a deserialization pass on restart, not a compile pass.
+        Sets readiness when done; returns {size, warm_s, buckets}."""
+        t0 = time.perf_counter()
+        n_exec = 0
+        tasks = self.store.tasks()
+        for task in tasks:
+            bucket = self.store._bucket_for(task, self.spec)
+            n_exec += bucket.warm()["executables"]
+        wall = time.perf_counter() - t0
+        self.metrics.record_warm_pool(n_exec, wall)
+        self.warm_info = {"size": n_exec, "warm_s": wall,
+                          "buckets": len(tasks)}
+        self.ready.set()
+        return dict(self.warm_info)
+
+    def _warm_background(self) -> None:
+        try:
+            info = self.warm()
+            print(f"warm pool ready: {info['size']} executables in "
+                  f"{info['warm_s']:.1f}s")
+        except Exception as e:  # degraded but serviceable: the lazy-jit
+            # fallback still answers; readiness unblocks so the server
+            # isn't bricked by one bucket's warm-up failure
+            print(f"warm-up failed ({e}); serving with lazy compilation")
+            self.ready.set()
+
+    def start(self, warm: bool = True,
+              warm_async: bool = False) -> "ServeApp":
         self.batcher.start()
+        if not warm:
+            self.ready.set()
+        elif warm_async:
+            threading.Thread(target=self._warm_background, daemon=True,
+                             name="serve-warmup").start()
+        else:
+            self.warm()
         return self
 
     def drain(self, timeout: float = 30.0) -> None:
@@ -107,6 +181,7 @@ class ServeApp:
         self.draining = True
         self.batcher.stop(drain=True, timeout=timeout)
         self.recorder.close_all()
+        self._executor.shutdown(wait=False)
 
     def _auto_seed(self) -> int:
         with self._seed_lock:
@@ -114,9 +189,10 @@ class ServeApp:
             self._next_seed += 1
             return s
 
-    # -- the session verbs (shared by HTTP handler and in-process callers) -
-    def open_session(self, task: Optional[str] = None,
-                     seed: Optional[int] = None) -> dict:
+    # -- the session verbs (shared by the front door and in-process
+    #    callers; *_begin/_abort split out so the asyncio path can run the
+    #    blocking host half on an executor and await only the ticket) ------
+    def _open_begin(self, task: Optional[str], seed: Optional[int]):
         if self.draining:
             self.metrics.record_session("reject")
             raise Draining()
@@ -134,20 +210,57 @@ class ServeApp:
         self.recorder.open(sess.sid, meta={
             "task": sess.task, "method": self.spec.method,
             "seed": sess.seed})
+        return sess, self.batcher.submit_start(sess)
+
+    def _open_abort(self, sess) -> None:
         # first item + prior best come from the session's first dispatch;
         # if it fails (stuck accelerator -> timeout, dispatch error) the
         # client never learns the session id, so free the slot here or it
         # leaks until restart
+        self.store.close(sess.sid)
+        self.recorder.close(sess.sid)
+        self.metrics.record_session("close")
+
+    def open_session(self, task: Optional[str] = None,
+                     seed: Optional[int] = None) -> dict:
+        sess, ticket = self._open_begin(task, seed)
         try:
-            res = self.batcher.submit_start(sess).wait(REQUEST_TIMEOUT_S)
+            res = ticket.wait(REQUEST_TIMEOUT_S)
         except BaseException:
-            self.store.close(sess.sid)
-            self.recorder.close(sess.sid)
-            self.metrics.record_session("close")
+            self._open_abort(sess)
             raise
         return self._payload(sess, res)
 
-    def label(self, sid: str, label: int, idx: Optional[int] = None) -> dict:
+    async def open_session_async(self, task: Optional[str] = None,
+                                 seed: Optional[int] = None) -> dict:
+        loop = asyncio.get_running_loop()
+        if (self.recorder.out_dir is None
+                and self.store.has_fast_admission(
+                    task or self.default_task or "", self.spec)):
+            # warm-pool fast path: admission is sub-ms host work (free-slot
+            # pop + staged cached-init write), so run it inline — a
+            # thundering herd of opens then queues in one burst instead of
+            # trickling through executor threads and stretching the first
+            # tick's formation window to its cap. A file-backed recorder
+            # disqualifies the fast path: recorder.open() would do disk
+            # I/O (and contend on the recorder lock with the batcher's
+            # per-row flushes) on the event loop.
+            sess, ticket = self._open_begin(task, seed)
+        else:
+            # unseen (task, spec) or cold bucket: bucket construction /
+            # per-admission init compute runs for real — never on the
+            # event loop
+            sess, ticket = await loop.run_in_executor(
+                self._executor, self._open_begin, task, seed)
+        try:
+            res = await ticket.wait_async(REQUEST_TIMEOUT_S)
+        except BaseException:
+            await loop.run_in_executor(self._executor, self._open_abort,
+                                       sess)
+            raise
+        return self._payload(sess, res)
+
+    def _label_begin(self, sid: str, label: int, idx: Optional[int]):
         sess = self.store.get(sid)
         cur = sess.last
         if not cur:
@@ -160,10 +273,19 @@ class ServeApp:
         if not 0 <= label < sess.bucket.n_classes:
             raise ValueError(f"label {label} out of range "
                              f"[0, {sess.bucket.n_classes})")
-        res = self.batcher.submit_label(
-            sess, idx=cur["next_idx"], label=label,
-            prob=cur["next_prob"]).wait(REQUEST_TIMEOUT_S)
-        return self._payload(sess, res)
+        return sess, self.batcher.submit_label(
+            sess, idx=cur["next_idx"], label=label, prob=cur["next_prob"])
+
+    def label(self, sid: str, label: int, idx: Optional[int] = None) -> dict:
+        sess, ticket = self._label_begin(sid, label, idx)
+        return self._payload(sess, ticket.wait(REQUEST_TIMEOUT_S))
+
+    async def label_async(self, sid: str, label: int,
+                          idx: Optional[int] = None) -> dict:
+        # no executor hop: _label_begin is pure host-dict work (session
+        # lookup, bounds checks, queue.put) — microseconds on the loop
+        sess, ticket = self._label_begin(sid, label, idx)
+        return self._payload(sess, await ticket.wait_async(REQUEST_TIMEOUT_S))
 
     def best(self, sid: str) -> dict:
         sess = self.store.get(sid)
@@ -190,10 +312,16 @@ class ServeApp:
         return {"session": sid, "task": sess.task,
                 "n_labeled": sess.n_labeled, "rounds": rounds}
 
+    def healthz(self) -> dict:
+        ready = self.ready.is_set()
+        return {"ok": ready and not self.draining, "ready": ready,
+                "draining": self.draining}
+
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
         snap["live_sessions"] = self.store.live_sessions()
         snap["draining"] = self.draining
+        snap["ready"] = self.ready.is_set()
         # flight-recorder evidence, in distinct units: run RECORDS written
         # process-wide (registry counter) vs per-dispatch decision ROWS
         # this server streamed — plus the replay counter (a replay running
@@ -206,7 +334,10 @@ class ServeApp:
             reg.counter("replay_verified_total").value())
         snap["buckets"] = [
             {"task": b.task, "method": b.spec.method,
-             "shape": list(b.shape), "capacity": b.capacity, "live": b.live}
+             "shape": list(b.shape), "capacity": b.capacity, "live": b.live,
+             "warm": b.is_warm, "warm_s": b.warm_s,
+             "warm_hits": b.warm_hits, "warm_misses": b.warm_misses,
+             "failed": b.failed}
             for b in self.store.buckets()
         ]
         return snap
@@ -237,117 +368,228 @@ class StaleItem(ValueError):
 
 _SESSION_RE = re.compile(r"^/session/([0-9a-f]+)(/(label|best|trace))?$")
 
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            409: "Conflict", 500: "Internal Server Error",
+            503: "Service Unavailable", 504: "Gateway Timeout"}
 
-class Handler(BaseHTTPRequestHandler):
-    app: ServeApp = None  # set by make_server
+_JSON = "application/json"
+_PROM = "text/plain; version=0.0.4; charset=utf-8"
 
-    def log_message(self, *a):  # quiet
-        pass
+# idle keep-alive connections are reaped after this many seconds so a
+# slow-loris client can't pin loop resources forever
+_IDLE_TIMEOUT_S = 120.0
 
-    def _json(self, obj, code: int = 200):
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+# request bodies are small JSON (a label, a seed); a client declaring more
+# than this is broken or hostile and must not make the loop buffer it
+_MAX_BODY_BYTES = 1 << 20
 
-    def _text(self, body: str, content_type: str, code: int = 200):
-        data = body.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
 
-    def _body(self) -> dict:
-        n = int(self.headers.get("Content-Length", 0))
-        return json.loads(self.rfile.read(n) or b"{}")
+class AsyncHTTPServer:
+    """Asyncio front door with the stdlib server's surface.
 
-    def _route(self, method: str):
+    The listening socket binds at construction (``server_address`` is
+    immediately readable, ``port=0`` picks a free port — the test hook);
+    ``serve_forever()`` runs the event loop in the calling thread;
+    ``shutdown()`` (any thread) stops it and blocks until it has;
+    ``server_close()`` releases the socket. Drop-in for the
+    ``ThreadingHTTPServer`` it replaced, so embedders and tests are
+    unchanged.
+
+    The protocol half is deliberately minimal HTTP/1.1 — request line,
+    headers, Content-Length bodies, keep-alive — which is all the JSON API
+    (and every stdlib/urllib client) needs, and keeps the no-new-deps
+    stance of the rest of the stack.
+    """
+
+    def __init__(self, app: ServeApp, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.app = app
+        self._sock = socket.create_server((host, port), backlog=512)
+        self.server_address = self._sock.getsockname()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._stop: Optional[asyncio.Event] = None
+        self._started = threading.Event()
+        self._finished = threading.Event()
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_forever(self) -> None:
+        asyncio.run(self._main())
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        server = await asyncio.start_server(self._serve_conn,
+                                            sock=self._sock)
+        self._started.set()
+        try:
+            await self._stop.wait()
+        finally:
+            server.close()
+            try:
+                await server.wait_closed()
+            except Exception:
+                pass
+            self._closed = True
+            self._finished.set()
+
+    def shutdown(self) -> None:
+        loop, stop = self._loop, self._stop
+        if loop is not None and stop is not None and loop.is_running():
+            loop.call_soon_threadsafe(stop.set)
+            self._finished.wait(timeout=30.0)
+
+    def server_close(self) -> None:
+        if not self._closed:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._closed = True
+
+    # -- one connection ----------------------------------------------------
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    line = await asyncio.wait_for(reader.readline(),
+                                                  _IDLE_TIMEOUT_S)
+                except asyncio.TimeoutError:
+                    break
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                parts = line.decode("latin1").split()
+                if len(parts) != 3:
+                    break
+                method, target, version = parts
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if not h or h in (b"\r\n", b"\n"):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                try:
+                    n = int(headers.get("content-length") or 0)
+                except ValueError:
+                    n = -1
+                if 0 <= n <= _MAX_BODY_BYTES:
+                    body = await reader.readexactly(n) if n > 0 else b""
+                    status, payload, ctype = await self._handle(
+                        method, target.split("?")[0], body)
+                else:
+                    # malformed or oversized Content-Length: answer a JSON
+                    # error (never a dropped connection) and close — the
+                    # unread body makes the stream unusable for keep-alive
+                    headers["connection"] = "close"
+                    status, payload, ctype = (
+                        400, {"error": "bad request: invalid or oversized "
+                                       "Content-Length"}, _JSON)
+                data = (payload.encode() if isinstance(payload, str)
+                        else json.dumps(payload).encode())
+                keep = (version == "HTTP/1.1"
+                        and headers.get("connection", "").lower() != "close")
+                head = (f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
+                        f"Content-Type: {ctype}\r\n"
+                        f"Content-Length: {len(data)}\r\n"
+                        "Connection: "
+                        f"{'keep-alive' if keep else 'close'}\r\n\r\n")
+                writer.write(head.encode("latin1") + data)
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # client went away mid-request; nothing to answer
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    # -- routing (same error envelope as the session verbs raise) ----------
+    async def _handle(self, method: str, path: str, body: bytes):
         app = self.app
-        path = self.path.split("?")[0]
-        m = _SESSION_RE.match(path)
-        if method == "POST" and path == "/session":
-            req = self._body()
-            return app.open_session(task=req.get("task"),
-                                    seed=req.get("seed"))
-        if m and method == "POST" and m.group(3) == "label":
-            req = self._body()
-            if "label" not in req:
-                raise ValueError("missing 'label'")
-            return app.label(m.group(1), req["label"], idx=req.get("idx"))
-        if m and method == "GET" and m.group(3) == "best":
-            return app.best(m.group(1))
-        if m and method == "GET" and m.group(3) == "trace":
-            return app.trace(m.group(1))
-        if m and method == "DELETE" and m.group(3) is None:
-            return app.close_session(m.group(1))
-        if method == "GET" and path == "/stats":
-            return app.stats()
         if method == "GET" and path == "/healthz":
-            return {"ok": not app.draining, "draining": app.draining}
-        return None
-
-    def _handle(self, method: str):
-        if method == "GET" and self.path.split("?")[0] == "/metrics":
+            # the readiness gate: 503 until the warm pool is compiled, so
+            # a restarting replica takes no traffic while executables are
+            # still being built/deserialized. Draining stays 200 — the
+            # process is live and still answering existing sessions.
+            h = app.healthz()
+            return (200 if h["ready"] else 503), h, _JSON
+        if method == "GET" and path == "/metrics":
             # Prometheus text exposition, not JSON: registry counters
-            # (recompiles, HBM watermarks) + the serve snapshot (dispatches,
-            # occupancy, queue depth, latency quantiles). Same error
-            # envelope as every other route: a render failure must answer
-            # a JSON 500, never drop the connection.
+            # (recompiles, cache hits/misses, HBM watermarks) + the serve
+            # snapshot (dispatches, occupancy, queue depth, latency
+            # quantiles, warm pool). Same error envelope as every other
+            # route: a render failure must answer a JSON 500, never drop
+            # the connection.
             try:
                 from coda_tpu.telemetry import render_prometheus
 
-                body = render_prometheus(self.app.telemetry.registry,
-                                         serve_metrics=self.app.metrics)
+                text = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: render_prometheus(
+                        app.telemetry.registry, serve_metrics=app.metrics))
             except Exception as e:
-                self._json({"error": f"internal: {e}"}, 500)
-            else:
-                self._text(body,
-                           "text/plain; version=0.0.4; charset=utf-8")
-            return
+                return 500, {"error": f"internal: {e}"}, _JSON
+            return 200, text, _PROM
         try:
-            out = self._route(method)
+            out = await self._route(method, path, body)
         except Draining:
-            self._json({"error": "draining: not admitting new sessions"},
-                       503)
+            return (503, {"error": "draining: not admitting new sessions"},
+                    _JSON)
         except SlabFull as e:
-            self._json({"error": f"busy: {e}"}, 503)
+            return 503, {"error": f"busy: {e}"}, _JSON
         except UnknownSession as e:
-            self.app.metrics.record_session("request_reject")
-            self._json({"error": f"unknown session {e}"}, 404)
+            app.metrics.record_session("request_reject")
+            return 404, {"error": f"unknown session {e}"}, _JSON
         except StaleItem as e:
-            self.app.metrics.record_session("request_reject")
-            self._json({"error": str(e)}, 409)
+            app.metrics.record_session("request_reject")
+            return 409, {"error": str(e)}, _JSON
         except TimeoutError as e:
-            self._json({"error": str(e)}, 504)
+            return 504, {"error": str(e)}, _JSON
         except (ValueError, TypeError, KeyError) as e:
-            self._json({"error": f"bad request: {e}"}, 400)
+            return 400, {"error": f"bad request: {e}"}, _JSON
         except Exception as e:  # cancelled tickets, dispatch failures: the
             # client must get a JSON error, never a dropped connection
-            self._json({"error": f"internal: {e}"}, 500)
-        else:
-            if out is None:
-                self._json({"error": "not found"}, 404)
-            else:
-                self._json(out)
+            return 500, {"error": f"internal: {e}"}, _JSON
+        if out is None:
+            return 404, {"error": "not found"}, _JSON
+        return 200, out, _JSON
 
-    def do_GET(self):
-        self._handle("GET")
-
-    def do_POST(self):
-        self._handle("POST")
-
-    def do_DELETE(self):
-        self._handle("DELETE")
+    async def _route(self, method: str, path: str, raw: bytes):
+        app = self.app
+        loop = asyncio.get_running_loop()
+        m = _SESSION_RE.match(path)
+        if method == "POST" and path == "/session":
+            req = json.loads(raw or b"{}")
+            return await app.open_session_async(task=req.get("task"),
+                                                seed=req.get("seed"))
+        if m and method == "POST" and m.group(3) == "label":
+            req = json.loads(raw or b"{}")
+            if "label" not in req:
+                raise ValueError("missing 'label'")
+            return await app.label_async(m.group(1), req["label"],
+                                         idx=req.get("idx"))
+        if m and method == "GET" and m.group(3) == "best":
+            return await loop.run_in_executor(app._executor, app.best,
+                                              m.group(1))
+        if m and method == "GET" and m.group(3) == "trace":
+            return await loop.run_in_executor(app._executor, app.trace,
+                                              m.group(1))
+        if m and method == "DELETE" and m.group(3) is None:
+            return await loop.run_in_executor(app._executor,
+                                              app.close_session, m.group(1))
+        if method == "GET" and path == "/stats":
+            return await loop.run_in_executor(app._executor, app.stats)
+        return None
 
 
 def make_server(app: ServeApp, port: int = 0,
-                host: str = "127.0.0.1") -> ThreadingHTTPServer:
-    """Bind the HTTP server; ``port=0`` picks a free port (for tests)."""
-    handler = type("BoundHandler", (Handler,), {"app": app})
-    return ThreadingHTTPServer((host, port), handler)
+                host: str = "127.0.0.1") -> AsyncHTTPServer:
+    """Bind the front door; ``port=0`` picks a free port (for tests)."""
+    return AsyncHTTPServer(app, port=port, host=host)
 
 
 def parse_args(argv=None):
@@ -371,7 +613,29 @@ def parse_args(argv=None):
                    help="max requests coalesced into one dispatch")
     p.add_argument("--max-wait-ms", type=float, default=2.0,
                    help="linger after the first queued request before "
-                        "dispatching (the latency/occupancy dial)")
+                        "dispatching, when the batcher was IDLE at arrival "
+                        "(after a busy tick the next starts immediately — "
+                        "continuous batching)")
+    p.add_argument("--max-linger-ms", type=float, default=None,
+                   help="hard cap on one tick's total formation window "
+                        "regardless of arrival pattern "
+                        "(default 4x --max-wait-ms)")
+    p.add_argument("--step-impl", default=None,
+                   choices=["map", "vmap"],
+                   help="slab-step lowering: 'map' keeps bitwise parity "
+                        "with the sequential reference (CPU default), "
+                        "'vmap' feeds the slot axis to the parallel units "
+                        "(TPU/GPU default)")
+    p.add_argument("--no-donate", action="store_true",
+                   help="keep the per-tick slab copy instead of donating "
+                        "the carry buffers to the step (debug/parity aid)")
+    p.add_argument("--no-warm", action="store_true",
+                   help="skip the AOT warm pool: first dispatch per bucket "
+                        "pays lazy jit compilation (readiness is immediate)")
+    p.add_argument("--compilation-cache-dir", default=None,
+                   help="persistent jax compilation cache: warm-pool "
+                        "executables serialize here, so a restarted server "
+                        "deserializes instead of recompiling")
     p.add_argument("--port", type=int, default=7861)
     p.add_argument("--platform", default=None,
                    help="force a jax platform (cpu/tpu) — same as main.py")
@@ -379,10 +643,11 @@ def parse_args(argv=None):
                    help="flush serving metrics into this MLflow-schema "
                         "sqlite DB on shutdown")
     p.add_argument("--telemetry-dir", default=None,
-                   help="write trace.json (Perfetto spans: batcher ticks) "
-                        "+ telemetry.json (recompiles, HBM watermarks) + "
-                        "metrics.prom there on shutdown; /metrics serves "
-                        "the same registry live either way")
+                   help="write trace.json (Perfetto spans: batcher ticks + "
+                        "slab steps) + telemetry.json (recompiles, cache "
+                        "hits, HBM watermarks) + metrics.prom there on "
+                        "shutdown; /metrics serves the same registry live "
+                        "either way")
     p.add_argument("--record-dir", default=None,
                    help="stream each session's per-round decision history "
                         "to an append-only session_<id>.jsonl there "
@@ -395,7 +660,9 @@ def parse_args(argv=None):
 def build_app(args) -> ServeApp:
     """ServeApp from parsed args (shared with the load generator)."""
     from coda_tpu.cli import load_dataset
+    from coda_tpu.utils.platform import enable_compilation_cache
 
+    enable_compilation_cache(getattr(args, "compilation_cache_dir", None))
     spec_kwargs = {}
     if args.method.startswith("coda"):
         # every slot carries its own incremental cache; the auto eig_mode
@@ -412,10 +679,14 @@ def build_app(args) -> ServeApp:
         from coda_tpu.telemetry import SessionRecorder
 
         recorder = SessionRecorder(out_dir=args.record_dir)
+    max_linger_ms = getattr(args, "max_linger_ms", None)
     app = ServeApp(
         capacity=args.capacity, bucket_n=args.bucket_n,
         max_batch=args.max_batch, max_wait=args.max_wait_ms / 1e3,
+        max_linger=(None if max_linger_ms is None else max_linger_ms / 1e3),
         spec=SelectorSpec.create(args.method, **spec_kwargs),
+        step_impl=getattr(args, "step_impl", None),
+        donate=not getattr(args, "no_donate", False),
         telemetry=telemetry, recorder=recorder,
     )
     if args.task or args.synthetic:
@@ -435,11 +706,16 @@ def main(argv=None):
 
     pin_platform(args.platform)
 
-    app = build_app(args).start()
+    app = build_app(args)
+    # warm in the background so the socket binds immediately and /healthz
+    # gates traffic until the pool is compiled (or deserialized)
+    app.start(warm=not args.no_warm, warm_async=True)
     srv = make_server(app, args.port)
     print(f"serving {app.default_task!r} ({app.spec.method}) on "
           f"http://127.0.0.1:{srv.server_address[1]}/ — capacity "
-          f"{app.store.capacity} sessions/bucket")
+          f"{app.store.capacity} sessions/bucket"
+          + ("" if args.no_warm else "; warming pool (healthz 503 until "
+             "ready)"))
     try:
         srv.serve_forever()
     except KeyboardInterrupt:

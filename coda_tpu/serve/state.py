@@ -231,16 +231,17 @@ class Bucket:
 
     def __init__(self, preds, spec: SelectorSpec, capacity: int,
                  n_valid: Optional[int] = None, task: str = "",
-                 step_impl: Optional[str] = None):
+                 step_impl: Optional[str] = None, donate: bool = True):
         import jax
         import jax.numpy as jnp
 
         self.task = task
         self.spec = spec
         self.capacity = int(capacity)
-        # serializes this bucket's slab swaps: allocate/release and the
-        # batcher's dispatch functionally replace the slab arrays, but only
-        # against each other — other buckets never contend on it
+        # serializes this bucket's slab ACCESS (the batcher's dispatch,
+        # posterior reads) — allocate/release never take it; they stage
+        # writes under _host_lock instead (see allocate). Other buckets
+        # never contend on it.
         self.lock = threading.RLock()
         self.preds = jnp.asarray(preds)
         H, N, C = self.preds.shape
@@ -249,9 +250,54 @@ class Bucket:
         self.n_classes = C
         self.selector = spec.factory()(self.preds)
         self._init = jax.jit(self.selector.init)
-        self._step = jax.jit(make_slab_step(self.selector, impl=step_impl))
+        # donated slab buffers: the step's (states, keys) carry is updated
+        # in place instead of allocating a fresh slab copy per tick (the
+        # per-tick copy was measurable at serving rates). Donation only
+        # changes buffer lifetime, never numerics — pinned bitwise against
+        # the undonated path by test_serve_donated_step_bitwise. donate=False
+        # keeps the copying path for that pin and for callers that alias
+        # slab arrays across dispatches.
+        self.donate = bool(donate)
+        self._step = jax.jit(make_slab_step(self.selector, impl=step_impl),
+                             donate_argnums=(0, 1) if self.donate else ())
         get_pbest = self.selector.extras.get("get_pbest")
         self._get_pbest = None if get_pbest is None else jax.jit(get_pbest)
+        # admission writes a single slot row; donating the slab to the
+        # writer makes that O(row) in place instead of an O(slab) copy —
+        # at capacity 256 the copy was ~40 ms per admission, which turned
+        # a 256-session thundering herd into a 10 s convoy
+        if self.donate:
+            def _write(states, keys, slot, state, key):
+                return (jax.tree.map(lambda s, x: s.at[slot].set(x),
+                                     states, state),
+                        keys.at[slot].set(key))
+
+            self._write_slot = jax.jit(_write, donate_argnums=(0, 1))
+        else:
+            self._write_slot = None
+        # AOT warm pool (see warm()): compiled executables, used by
+        # dispatch/allocate/pbest when present so the compile happens at
+        # server start, not under the first user's click
+        self._step_exec = None
+        self._init_exec = None
+        self._pbest_exec = None
+        self._write_exec = None
+        # warm() probes whether init is key-independent (true for every
+        # selector in this framework: priors/caches are deterministic
+        # functions of preds); when it is, this caches the one init state
+        # and admission skips the init executable entirely — the
+        # thundering-herd lever (CODA's init fills the incremental P(best)
+        # cache, ~11 update-steps of compute per admission otherwise)
+        self._init_state = None
+        self.warm_s: Optional[float] = None   # wall seconds spent in warm()
+        self._n_warm = 0      # executables the last successful warm() built
+        self.warm_hits = 0    # dispatches served by the AOT executable
+        self.warm_misses = 0  # dispatches that fell back to lazy jit
+        # a step failure that consumed donated carries leaves the slab
+        # unrecoverable; record WHY so later dispatches/admissions fail
+        # loudly and attributably instead of with 'Array has been deleted'
+        self.failed: Optional[str] = None
+        self.last_timing: dict = {}  # per-dispatch phase wall times
         # the slab: state pytree with a leading (capacity,) slot axis. All
         # slots start from init(key=0) — real sessions overwrite their slot
         # at admission, so the filler only fixes shapes/dtypes.
@@ -259,36 +305,174 @@ class Bucket:
         self.states = jax.jit(jax.vmap(self.selector.init))(dummy)
         self.keys = jnp.zeros((self.capacity, 2), jnp.uint32)
         # LIFO free list: a just-closed slot is the next one reused, which
-        # keeps the slab's live region dense and is trivially testable
+        # keeps the slab's live region dense and is trivially testable.
+        # The free list and the staged-write buffer live under their own
+        # cheap host lock so admission/close NEVER wait out an in-flight
+        # dispatch (which holds ``self.lock`` for a full slab step — at
+        # high capacity that made a thundering herd of opens a convoy).
+        self._host_lock = threading.Lock()
         self._free = list(range(self.capacity - 1, -1, -1))
+        # admission writes staged here as (slot, state, key), applied to
+        # the slab under ``self.lock`` at the next slab access (dispatch /
+        # slot_state) — the only writers of the slab arrays are therefore
+        # lock holders, while allocate() itself only computes the init
+        # state (no slab access at all)
+        self._staged: list = []
 
-    # -- slot lifecycle (caller holds this bucket's lock) ------------------
-    def allocate(self, seed: int) -> int:
+    # -- AOT warm-up -------------------------------------------------------
+    @property
+    def is_warm(self) -> bool:
+        return self._step_exec is not None
+
+    def warm(self) -> dict:
+        """Ahead-of-time compile this bucket's executables.
+
+        ``jit(...).lower().compile()`` for the masked slab step, the
+        per-slot init, and the pbest read — the three programs a session's
+        lifetime touches — so first-hit compilation never lands under live
+        traffic. With a persistent compilation cache directory configured
+        (``--compilation-cache-dir``), a restarted server deserializes
+        these instead of recompiling (0 fresh backend compiles — asserted
+        by the warm-restart test via the persistent-cache miss counter).
+
+        Runs under the bucket (dispatch) lock: a background warm-up racing
+        live traffic (``start(warm_async=True)`` with clients that ignore
+        the readiness gate) must not read slab buffers a donating dispatch
+        is invalidating. Early dispatches therefore serialize behind the
+        warm-up — the same wait they would have spent lazily compiling.
+        Idempotent and retryable: the compiled executables are published
+        atomically at the end, so a mid-compile failure leaves the bucket
+        fully cold. Returns {executables, seconds}.
+        """
+        import time as _time
+
         import jax
         import jax.numpy as jnp
 
-        if not self._free:
-            raise SlabFull(
-                f"bucket {self.task}/{self.spec.method}: all "
-                f"{self.capacity} slots live")
-        slot = self._free.pop()
+        with self.lock:
+            if self.is_warm:
+                return {"executables": self._n_warm,
+                        "seconds": self.warm_s or 0.0}
+            t0 = _time.perf_counter()
+            S = self.capacity
+            req = SlotRequest(
+                pending=jnp.zeros(S, bool), do_update=jnp.zeros(S, bool),
+                idx=jnp.zeros(S, jnp.int32), label=jnp.zeros(S, jnp.int32),
+                prob=jnp.zeros(S, jnp.float32))
+            # NOTE: after lower().compile(), dispatch must call the
+            # RETURNED executable — calling the jit-wrapped function again
+            # would trace and compile a second, separate program
+            step_exec = self._step.lower(self.states, self.keys,
+                                         req).compile()
+            init_exec = self._init.lower(jnp.zeros(2, jnp.uint32)).compile()
+            n = 2
+            pbest_exec = write_exec = None
+            if self._get_pbest is not None:
+                pbest_exec = self._get_pbest.lower(
+                    self.slot_state(0)).compile()
+                n += 1
+            if self._write_slot is not None:
+                write_exec = self._write_slot.lower(
+                    self.states, self.keys, jnp.int32(0),
+                    self.slot_state(0), jnp.zeros(2, jnp.uint32)).compile()
+                n += 1
+            # key-independence probe: if init ignores its key (true for
+            # every selector here — the state is a deterministic function
+            # of preds), two distinct keys produce bitwise-identical
+            # states, and admission can reuse ONE cached init state
+            # instead of re-running the init executable per session. The
+            # PRNG choreography is untouched: the session's key stream
+            # still consumes its init split.
+            s_a = init_exec(jnp.zeros(2, jnp.uint32))
+            s_b = init_exec(
+                jnp.asarray([0x9e3779b9, 0x85ebca6b], jnp.uint32))
+            init_state = None
+            if all(np.asarray(x).tobytes() == np.asarray(y).tobytes()
+                   for x, y in zip(jax.tree.leaves(s_a),
+                                   jax.tree.leaves(s_b))):
+                if self.n_valid < self.shape[1]:
+                    s_a = _deactivate_padded(s_a, self.n_valid)
+                init_state = s_a
+            # publish atomically (everything or nothing; is_warm keys off
+            # _step_exec, so a failure above leaves the bucket retryable)
+            self._init_exec = init_exec
+            self._pbest_exec = pbest_exec
+            self._write_exec = write_exec
+            self._init_state = init_state
+            self.warm_s = _time.perf_counter() - t0
+            self._n_warm = n
+            self._step_exec = step_exec
+            return {"executables": n, "seconds": self.warm_s}
+
+    # -- slot lifecycle (no bucket lock needed: slab writes are staged) ----
+    def allocate(self, seed: int) -> int:
+        """Take a free slot and stage its freshly-initialized state.
+
+        Runs WITHOUT the bucket (dispatch) lock: the init computation
+        touches no slab array, and the produced (slot, state, key) row is
+        staged for the next lock holder to apply — so admission latency is
+        one init executable, never an in-flight slab step."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.failed is not None:
+            raise RuntimeError(
+                f"bucket {self.task}/{self.spec.method} is failed "
+                f"(restart to recover): {self.failed}")
+        with self._host_lock:
+            if not self._free:
+                raise SlabFull(
+                    f"bucket {self.task}/{self.spec.method}: all "
+                    f"{self.capacity} slots live")
+            slot = self._free.pop()
         # reference key stream: PRNGKey(seed); init() consumes one split
+        # (always — even when the cached init state makes its VALUE moot)
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
-        state = self._init(sub)
-        if self.n_valid < self.shape[1]:
-            state = _deactivate_padded(state, self.n_valid)
-        self.states = jax.tree.map(
-            lambda slab, x: slab.at[slot].set(x), self.states, state)
-        self.keys = self.keys.at[slot].set(key.astype(jnp.uint32))
+        if self._init_state is not None:
+            state = self._init_state
+        else:
+            init = (self._init_exec if self._init_exec is not None
+                    else self._init)
+            state = init(sub.astype(jnp.uint32))
+            if self.n_valid < self.shape[1]:
+                state = _deactivate_padded(state, self.n_valid)
+        with self._host_lock:
+            self._staged.append((slot, state, key.astype(jnp.uint32)))
         return slot
 
     def release(self, slot: int) -> None:
-        self._free.append(slot)
+        """Return a slot to the free list; drops any still-staged write for
+        it (an open that aborted before its first dispatch). No dispatch
+        lock: the slot's slab rows stay garbage until reallocation."""
+        with self._host_lock:
+            self._staged = [(s, st, k) for s, st, k in self._staged
+                            if s != slot]
+            self._free.append(slot)
+
+    def _apply_staged(self) -> None:
+        """Write staged admissions into the slab (caller holds ``lock``)."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._host_lock:
+            staged, self._staged = self._staged, []
+        for slot, state, key in staged:
+            if self._write_slot is not None:
+                write = (self._write_exec if self._write_exec is not None
+                         else self._write_slot)
+                self.states, self.keys = write(
+                    self.states, self.keys, jnp.int32(slot), state, key)
+            else:
+                self.states = jax.tree.map(
+                    lambda slab, x: slab.at[slot].set(x), self.states,
+                    state)
+                self.keys = self.keys.at[slot].set(key)
 
     @property
     def live(self) -> int:
-        return self.capacity - len(self._free)
+        with self._host_lock:
+            return self.capacity - len(self._free)
 
     # -- the dispatch (batcher thread, holding this bucket's lock) ---------
     def dispatch(self, requests: dict) -> dict:
@@ -296,10 +480,23 @@ class Bucket:
 
         ``requests``: slot -> dict(do_update, idx, label, prob). Every slot
         executes; only requesting slots advance state/keys and get a result
-        row back. Returns slot -> result dict (host scalars)."""
+        row back. Returns slot -> result dict (host scalars).
+
+        Phase wall times land in ``last_timing`` (build = host input prep,
+        step = executable call through host sync) so the batcher can
+        attribute its tick span mechanically — the queue-wait / dispatch /
+        step breakdown the load generator reports."""
+        import time as _time
+
         import jax
         import jax.numpy as jnp
 
+        if self.failed is not None:
+            raise RuntimeError(
+                f"bucket {self.task}/{self.spec.method} is failed "
+                f"(restart to recover): {self.failed}")
+        t0 = _time.perf_counter()
+        self._apply_staged()  # admissions since the last slab access
         S = self.capacity
         pending = np.zeros(S, bool)
         do_update = np.zeros(S, bool)
@@ -316,8 +513,32 @@ class Bucket:
             pending=jnp.asarray(pending), do_update=jnp.asarray(do_update),
             idx=jnp.asarray(idx), label=jnp.asarray(label),
             prob=jnp.asarray(prob))
-        self.states, self.keys, out = self._step(self.states, self.keys, req)
+        t1 = _time.perf_counter()
+        if self._step_exec is not None:
+            self.warm_hits += 1
+            step = self._step_exec
+        else:
+            # lazy-jit fallback: a bucket serving traffic before (or
+            # without) warm() pays first-hit compilation here — counted so
+            # /stats can show the warm pool actually covered the traffic
+            self.warm_misses += 1
+            step = self._step
+        try:
+            self.states, self.keys, out = step(self.states, self.keys, req)
+        except BaseException as e:
+            # with donation, a failed execution may have consumed the
+            # carry buffers — the slab is then unrecoverable: mark the
+            # bucket failed so every later dispatch/admission gets an
+            # attributable error instead of 'Array has been deleted'
+            if self.donate and any(
+                    getattr(x, "is_deleted", lambda: False)()
+                    for x in jax.tree.leaves((self.states, self.keys))):
+                self.failed = (f"slab step failed after consuming donated "
+                               f"carries: {e!r}")
+            raise
         out = jax.tree.map(np.asarray, out)  # one host sync for the batch
+        t2 = _time.perf_counter()
+        self.last_timing = {"build_s": t1 - t0, "step_s": t2 - t1}
         return {
             slot: {
                 "next_idx": int(out.next_idx[slot]),
@@ -332,6 +553,7 @@ class Bucket:
     def slot_state(self, slot: int):
         import jax
 
+        self._apply_staged()  # a pre-first-dispatch read must see its init
         return jax.tree.map(lambda x: x[slot], self.states)
 
     def pbest(self, slot: int):
@@ -339,7 +561,9 @@ class Bucket:
         ``get_pbest`` extra) — the cheap posterior read behind GET /best."""
         if self._get_pbest is None:
             return None
-        return np.asarray(self._get_pbest(self.slot_state(slot)))
+        fn = self._pbest_exec if self._pbest_exec is not None \
+            else self._get_pbest
+        return np.asarray(fn(self.slot_state(slot)))
 
 
 # ---------------------------------------------------------------------------
@@ -371,17 +595,18 @@ class SessionStore:
     quantum (see module docstring; 1 = exact shapes).
     Thread safety, three tiers so one bucket's work never stalls another's:
     the store lock guards only the host dicts (tasks/buckets/sessions —
-    microseconds); each BUCKET's lock serializes that bucket's slab swaps
-    (admission writes vs. the batcher's dispatch; admission to a busy
-    bucket waits out at most one in-flight dispatch — fine, since session
-    creation itself needs a dispatch to learn its first item); and bucket
-    CONSTRUCTION (selector statics + init compile, potentially seconds)
-    runs under a dedicated build lock with no other lock held, so standing
-    traffic keeps flowing while a new (task, spec) warms up.
+    microseconds); each BUCKET's dispatch lock serializes slab ACCESS only
+    (the batcher's step, posterior reads) while admission/close never take
+    it — they stage their slot writes under the bucket's cheap host mutex
+    for the next lock holder to apply, so a burst of opens never convoys
+    behind an in-flight slab step; and bucket CONSTRUCTION (selector
+    statics + init compile, potentially seconds) runs under a dedicated
+    build lock with no other lock held, so standing traffic keeps flowing
+    while a new (task, spec) warms up.
     """
 
     def __init__(self, capacity: int = 64, bucket_n: int = 1,
-                 step_impl: Optional[str] = None):
+                 step_impl: Optional[str] = None, donate: bool = True):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         if bucket_n < 1:
@@ -389,6 +614,7 @@ class SessionStore:
         self.capacity = capacity
         self.bucket_n = bucket_n
         self.step_impl = step_impl
+        self.donate = donate
         self._tasks: dict[str, Any] = {}     # name -> (H, N, C) ndarray
         self._meta: dict[str, dict] = {}     # name -> class/model names
         self._buckets: dict[tuple, Bucket] = {}
@@ -420,6 +646,22 @@ class SessionStore:
         with self.lock:
             return dict(self._meta[name])
 
+    def has_fast_admission(self, task: str, spec: SelectorSpec) -> bool:
+        """Whether admission for this (task, spec) is pure sub-ms host
+        work: the bucket exists AND warm() cached its key-independent init
+        state (the front door's inline-fast-path test). A missing bucket
+        means seconds of selector statics + compiles; a cold one still
+        runs a full init computation per admission — neither belongs on
+        an event loop."""
+        with self.lock:
+            preds = self._tasks.get(task)
+            if preds is None:
+                return False
+            H, N, C = preds.shape
+            key = (task, spec, (H, _round_up(N, self.bucket_n), C))
+            b = self._buckets.get(key)
+        return b is not None and b._init_state is not None
+
     def _bucket_for(self, task: str, spec: SelectorSpec) -> Bucket:
         with self.lock:
             preds = self._tasks[task]
@@ -441,7 +683,7 @@ class SessionStore:
             if n_pad != N:
                 preds = np.pad(preds, ((0, 0), (0, n_pad - N), (0, 0)))
             b = Bucket(preds, spec, self.capacity, n_valid=N, task=task,
-                       step_impl=self.step_impl)
+                       step_impl=self.step_impl, donate=self.donate)
             with self.lock:
                 self._buckets[key] = b
             return b
@@ -453,8 +695,9 @@ class SessionStore:
                 raise KeyError(f"unknown task {task!r}; registered: "
                                f"{self.tasks()}")
         bucket = self._bucket_for(task, spec)
-        with bucket.lock:
-            slot = bucket.allocate(seed)  # raises SlabFull when exhausted
+        # no bucket (dispatch) lock: allocate stages its slab write, so
+        # admission never waits out an in-flight slab step
+        slot = bucket.allocate(seed)  # raises SlabFull when exhausted
         sess = Session(sid=secrets.token_hex(8), task=task,
                        bucket=bucket, slot=slot, seed=seed)
         with self.lock:
@@ -477,8 +720,7 @@ class SessionStore:
             sess = self._sessions.pop(sid, None)
         if sess is None:
             raise UnknownSession(sid)
-        with sess.bucket.lock:
-            sess.bucket.release(sess.slot)
+        sess.bucket.release(sess.slot)
 
     def live_sessions(self) -> int:
         with self.lock:

@@ -160,6 +160,14 @@ class Telemetry:
                 "recompiles": _values("jit_compiles_total").get("", 0.0),
                 "compile_seconds": _values(
                     "jit_compile_seconds_total").get("", 0.0),
+                # with a persistent compilation cache live, the compile
+                # event above also fires for deserializations — the
+                # hit/miss split is the fresh-compile truth (the serve
+                # warm-restart contract asserts on misses)
+                "persistent_cache_hits": _values(
+                    "persistent_cache_hits_total").get("", 0.0),
+                "persistent_cache_misses": _values(
+                    "persistent_cache_misses_total").get("", 0.0),
                 "source": ("jax.monitoring" if self.hooks_live
                            else "cold-attribution-fallback"),
                 "cold_dispatches": _values(

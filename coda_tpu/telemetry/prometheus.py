@@ -107,12 +107,33 @@ _SERVE_SUMMARIES = [
      "Slab-step dispatch seconds over the recent ring"),
     ("request_latency", "serve_request_latency_seconds", "requests",
      "Submit-to-result request seconds over the recent ring"),
+    ("queue_wait", "serve_queue_wait_seconds", "requests",
+     "Submit-to-tick-start queue wait seconds over the recent ring"),
+    ("step_latency", "serve_step_latency_seconds", "dispatches",
+     "Compiled slab-step execution seconds over the recent ring"),
+]
+
+# warm-pool evidence: (warm_pool snapshot key, metric suffix, kind, help)
+_SERVE_WARM = [
+    ("size", "serve_warm_pool_size", "gauge",
+     "AOT-precompiled executables in the warm pool"),
+    ("warm_s", "serve_warm_pool_seconds", "gauge",
+     "Wall seconds the warm-up pass took"),
+    ("hits", "serve_warm_pool_hits_total", "counter",
+     "Dispatches served by an AOT-precompiled executable"),
+    ("misses", "serve_warm_pool_misses_total", "counter",
+     "Dispatches that fell back to lazy jit compilation"),
 ]
 
 
 def _render_serve(out: list, snap: dict, prefix: str) -> None:
     for key, suffix, kind, help in _SERVE_SCALARS:
         v = snap.get(key)
+        if v is not None:
+            _family(out, _name(prefix, suffix), kind, help, [({}, v)])
+    warm = snap.get("warm_pool") or {}
+    for key, suffix, kind, help in _SERVE_WARM:
+        v = warm.get(key)
         if v is not None:
             _family(out, _name(prefix, suffix), kind, help, [({}, v)])
     fills = snap.get("ring_fill") or {}

@@ -129,6 +129,15 @@ def get_registry() -> Registry:
 # -- jit compile hooks -------------------------------------------------------
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# persistent-compilation-cache evidence: in jax's event stream the
+# backend_compile duration above fires for BOTH fresh compiles and
+# cache-deserialized executables (it wraps compile_or_get_cached), so the
+# hit/miss events are the only way to count FRESH compiles when a
+# --compilation-cache-dir is live — the serve warm-pool restart contract
+# ("second start performs 0 fresh backend compiles") asserts on the miss
+# counter, not on jit_compiles_total
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 _hooks_lock = threading.Lock()
 _listener_registered = False
 # every registry that asked for compile evidence; jax.monitoring has no
@@ -156,11 +165,29 @@ def _on_compile_duration(event: str, duration: float, **kw) -> None:
     for reg in regs:
         reg.counter(
             "jit_compiles_total",
-            "XLA backend compiles observed via jax.monitoring").inc()
+            "XLA backend compiles observed via jax.monitoring "
+            "(includes persistent-cache deserializations)").inc()
         reg.counter(
             "jit_compile_seconds_total",
             "Seconds spent in XLA backend compiles").inc(
                 max(0.0, float(duration)))
+
+
+def _on_event(event: str, **kw) -> None:
+    if event == _CACHE_HIT_EVENT:
+        name, help = ("persistent_cache_hits_total",
+                      "Executables deserialized from the persistent "
+                      "compilation cache instead of freshly compiled")
+    elif event == _CACHE_MISS_EVENT:
+        name, help = ("persistent_cache_misses_total",
+                      "Fresh XLA compiles performed with a persistent "
+                      "compilation cache live (cache misses)")
+    else:
+        return
+    with _hooks_lock:
+        regs = list(_hooked_registries)
+    for reg in regs:
+        reg.counter(name, help).inc()
 
 
 def install_jax_hooks(registry: Optional[Registry] = None) -> bool:
@@ -182,6 +209,12 @@ def install_jax_hooks(registry: Optional[Registry] = None) -> bool:
                     _on_compile_duration)
             except Exception:
                 return False
+            try:
+                # plain (non-duration) events: persistent-cache hits/misses.
+                # Best-effort — a jax without them still counts compiles.
+                monitoring.register_event_listener(_on_event)
+            except Exception:
+                pass
             _listener_registered = True
         _hooked_registries.add(reg)
         return True

@@ -19,3 +19,30 @@ def pin_platform(platform: Optional[str]) -> None:
     import jax
 
     jax.config.update("jax_platforms", platform)
+
+
+def enable_compilation_cache(cache_dir: Optional[str]) -> None:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    A restarted process deserializes executables instead of recompiling —
+    NOTES_r08 measured cache-deserialized executables 3.4x faster to obtain
+    than fresh in-process compiles, which is what makes the serve warm pool
+    a cold-start lever and not just a steady-state one. The two threshold
+    knobs are zeroed because this framework's hot programs (slab steps,
+    selector inits) are exactly the small-but-recompiled-often executables
+    the defaults would skip. No-op when ``cache_dir`` is falsy; must run
+    before the first compile to cover everything (later is harmless — it
+    covers everything compiled after the call)."""
+    if not cache_dir:
+        return
+    import os
+
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # knob absent on older jax: size gating stays default
+        pass

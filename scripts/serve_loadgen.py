@@ -1,29 +1,40 @@
 """Closed-loop load generator for the serving layer -> BENCH_SERVE_*.json.
 
 Spins up an in-process :class:`coda_tpu.serve.ServeApp` + HTTP server (or
-targets a running one via ``--url``), then drives W closed-loop workers:
-each opens a session, labels ``--labels`` proposed items (answering
-``idx % C`` — the serving cost is label-independent), and closes. Reports
-sessions/sec, requests/sec, client-side latency percentiles, and the
-server's own dispatch metrics (batch occupancy — the number the subsystem
-exists to maximize) into one JSON artifact.
+targets a running one via ``--url``), then drives closed-loop sessions:
+each opens, labels ``--labels`` proposed items (answering ``idx % C`` — the
+serving cost is label-independent), and closes. Reports sessions/sec,
+requests/sec, client-side latency percentiles, the server's own dispatch
+metrics (batch occupancy — the number the subsystem exists to maximize),
+and the **latency breakdown** (queue-wait vs dispatch vs slab-step, from
+the server's phase rings and telemetry spans) into one JSON artifact — so
+a p99 regression is attributable mechanically, not by eyeball.
 
-Two arrival models:
+Three arrival models:
 
-  * default — workers free-run; occupancy emerges from the batcher's
-    ``max_wait`` coalescing window (the realistic number);
+  * default — ``--workers`` threads free-run through the session budget;
+    occupancy emerges from the batcher's coalescing (the thread-client
+    number, comparable to r06);
+  * ``--mux`` — sessions are asyncio coroutines multiplexed on ONE event
+    loop (``--workers`` bounds concurrent live sessions), driving the
+    app's async verbs in-process or — with ``--http`` — one persistent
+    keep-alive connection per session against the asyncio front door.
+    This is how 256+ concurrent sessions are driven without 256 OS
+    threads contending for the GIL, i.e. without the client becoming the
+    tail;
   * ``--lockstep`` — workers rendezvous at a barrier each round while the
     batcher is paused, so every round's W requests ride ONE dispatch. This
     is the deterministic-occupancy mode the tier-1 smoke test pins ≥16
     sessions/dispatch with (in-process only).
 
-    python scripts/serve_loadgen.py --workers 32 --sessions 64 \
+    python scripts/serve_loadgen.py --mux --workers 256 --sessions 256 \
         --synthetic 8,512,10 --out BENCH_SERVE_cpu.json
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import sys
 import threading
@@ -36,7 +47,7 @@ import numpy as np
 
 
 # ---------------------------------------------------------------------------
-# client: in-process (drives a ServeApp directly) or HTTP (urllib, stdlib)
+# clients: in-process (drives a ServeApp directly) or HTTP (urllib, stdlib)
 # ---------------------------------------------------------------------------
 
 class InprocClient:
@@ -81,6 +92,43 @@ class HttpClient:
 
     def stats(self):
         return self._req("GET", "/stats")
+
+
+class AsyncConn:
+    """One persistent keep-alive connection to the asyncio front door —
+    each mux session coroutine holds its own, so 256 concurrent sessions
+    are 256 sockets on one event loop, not 256 threads."""
+
+    def __init__(self, host, port):
+        self.host, self.port = host, port
+        self.r = self.w = None
+
+    async def connect(self):
+        self.r, self.w = await asyncio.open_connection(self.host, self.port)
+
+    async def req(self, method, path, body=None):
+        data = b"" if body is None else json.dumps(body).encode()
+        head = (f"{method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(data)}\r\n\r\n")
+        self.w.write(head.encode() + data)
+        await self.w.drain()
+        line = await self.r.readline()
+        status = int(line.split()[1])
+        clen = 0
+        while True:
+            h = await self.r.readline()
+            if not h or h in (b"\r\n", b"\n"):
+                break
+            k, _, v = h.decode().partition(":")
+            if k.strip().lower() == "content-length":
+                clen = int(v)
+        payload = await self.r.readexactly(clen) if clen else b"{}"
+        return status, json.loads(payload)
+
+    def close(self):
+        if self.w is not None:
+            self.w.close()
 
 
 # ---------------------------------------------------------------------------
@@ -135,6 +183,80 @@ def _free_run(client, n_classes, workers, sessions, labels_per_session,
         t.join()
 
 
+def _mux(app, http_port, n_classes, concurrency, sessions,
+         labels_per_session, latencies, errors, ramp_s=0.0):
+    """Asyncio arrival model: every session is a coroutine, ``concurrency``
+    of them live at once, all multiplexed on one event loop. In-process it
+    drives the app's async verbs (the front door's own path, minus TCP);
+    with an ``http_port`` each session holds one keep-alive connection to
+    the real asyncio server."""
+
+    async def one_inproc(seed):
+        t0 = time.perf_counter()
+        out = await app.open_session_async(seed=seed)
+        latencies.append(time.perf_counter() - t0)
+        sid = out["session"]
+        try:
+            for _ in range(labels_per_session):
+                t0 = time.perf_counter()
+                out = await app.label_async(sid, int(out["idx"]) % n_classes)
+                latencies.append(time.perf_counter() - t0)
+        finally:
+            await asyncio.get_running_loop().run_in_executor(
+                None, app.close_session, sid)
+
+    async def one_http(seed):
+        conn = AsyncConn("127.0.0.1", http_port)
+        await conn.connect()
+        sid = None
+        try:
+            t0 = time.perf_counter()
+            status, out = await conn.req("POST", "/session", {"seed": seed})
+            if status != 200:
+                raise RuntimeError(f"open -> {status}: {out}")
+            latencies.append(time.perf_counter() - t0)
+            sid = out["session"]
+            for _ in range(labels_per_session):
+                t0 = time.perf_counter()
+                status, out = await conn.req(
+                    "POST", f"/session/{sid}/label",
+                    {"label": int(out["idx"]) % n_classes})
+                if status != 200:
+                    raise RuntimeError(f"label -> {status}: {out}")
+                latencies.append(time.perf_counter() - t0)
+            await conn.req("DELETE", f"/session/{sid}")
+            sid = None
+        finally:
+            if sid is not None:
+                try:
+                    await conn.req("DELETE", f"/session/{sid}")
+                except Exception:
+                    pass
+            conn.close()
+
+    one = one_http if http_port is not None else one_inproc
+
+    async def main():
+        sem = asyncio.Semaphore(concurrency)
+
+        async def bounded(seed):
+            if ramp_s > 0:
+                # spread session arrivals over the ramp window: real fleets
+                # don't open every session in the same microsecond, and a
+                # thundering herd of admissions would otherwise dominate
+                # the p99 with a startup transient instead of steady state
+                await asyncio.sleep(seed * ramp_s / max(1, sessions))
+            async with sem:
+                try:
+                    await one(seed)
+                except Exception as e:
+                    errors.append(repr(e))
+
+        await asyncio.gather(*(bounded(s) for s in range(sessions)))
+
+    asyncio.run(main())
+
+
 def _lockstep(app, client, n_classes, workers, labels_per_session,
               latencies, errors):
     """Deterministic occupancy: open W sessions, then label all W in
@@ -166,17 +288,45 @@ def _lockstep(app, client, n_classes, workers, labels_per_session,
         client.close(sid)
 
 
+def _span_breakdown(app) -> dict:
+    """Mechanical p99 attribution from the telemetry spans: busy seconds
+    of the batcher lane split into tick (dispatch incl. host fan-out) and
+    step (compiled slab-step execution) — tick minus step is host-side
+    build/fan-out, wall minus tick is queue/idle."""
+    if app is None:
+        return {}
+    spans = app.telemetry.spans
+    events = spans.events()
+    tick_s = sum(t1 - t0 for name, lane, t0, t1, _ in events
+                 if name.startswith("tick/"))
+    step_s = sum(t1 - t0 for name, lane, t0, t1, _ in events
+                 if name.startswith("step/"))
+    n_ticks = sum(1 for name, *_ in events if name.startswith("tick/"))
+    return {
+        "tick_busy_s": tick_s,
+        "step_busy_s": step_s,
+        "host_overhead_s": max(0.0, tick_s - step_s),
+        "n_tick_spans": n_ticks,
+    }
+
+
 def run_loadgen(args) -> dict:
     """Run the configured load and return the report dict (the script's
     JSON payload; the smoke test calls this directly)."""
     from coda_tpu.serve.server import build_app, make_server
 
     app = srv = None
+    warm_s = None
     if args.url:
         client = HttpClient(args.url)
         n_classes = args.classes
     else:
-        app = build_app(args).start()
+        app = build_app(args)
+        # warm synchronously so compilation is excluded from (and reported
+        # next to) the traffic measurement — mirroring a production server
+        # that passes its readiness gate before taking load
+        app.start(warm=not args.no_warm)
+        warm_s = (app.warm_info or {}).get("warm_s")
         meta = app.store.task_meta(app.default_task)
         n_classes = len(meta["class_names"])
         if args.http:
@@ -196,13 +346,24 @@ def run_loadgen(args) -> dict:
         n_sessions = args.workers
         _lockstep(app, client, n_classes, args.workers, args.labels,
                   latencies, errors)
+        mode = "lockstep"
+    elif args.mux:
+        if app is None:
+            raise SystemExit("--mux needs an in-process app (no --url)")
+        n_sessions = args.sessions
+        _mux(app, srv.server_address[1] if srv is not None else None,
+             n_classes, args.workers, args.sessions, args.labels,
+             latencies, errors, ramp_s=args.ramp_s)
+        mode = "mux"
     else:
         n_sessions = args.sessions
         _free_run(client, n_classes, args.workers, args.sessions,
                   args.labels, latencies, errors)
+        mode = "free_run"
     wall = time.perf_counter() - t_start
 
-    stats = client.stats()
+    stats = client.stats() if app is None else app.stats()
+    spans = _span_breakdown(app)
     if srv is not None:
         srv.shutdown()
         srv.server_close()
@@ -213,12 +374,13 @@ def run_loadgen(args) -> dict:
     n_requests = len(latencies)
     report = {
         "bench": "serve_loadgen",
-        "mode": "lockstep" if args.lockstep else "free_run",
+        "mode": mode,
         "transport": ("http" if (args.url or args.http) else "inproc"),
         "workers": args.workers,
         "sessions": n_sessions,
         "labels_per_session": args.labels,
         "wall_s": wall,
+        "warm_s": warm_s,
         "sessions_per_s": n_sessions / wall,
         "requests_per_s": n_requests / wall,
         "latency_ms": {
@@ -237,11 +399,27 @@ def run_loadgen(args) -> dict:
             "dispatch_latency": stats.get("dispatch_latency"),
             "request_latency": stats.get("request_latency"),
         },
+        # where a request's time went: queued behind a tick vs the
+        # dispatch (host fan-out + step) vs the compiled step itself —
+        # the rings give percentiles, the spans give busy-time totals
+        "breakdown": {
+            "queue_wait": stats.get("queue_wait"),
+            "dispatch": stats.get("dispatch_latency"),
+            "step": stats.get("step_latency"),
+            "spans": spans,
+        },
+        "warm_pool": stats.get("warm_pool"),
         "config": {
             "method": args.method,
             "capacity": args.capacity,
             "max_batch": args.max_batch,
             "max_wait_ms": args.max_wait_ms,
+            "max_linger_ms": args.max_linger_ms,
+            "step_impl": args.step_impl,
+            "donate": not args.no_donate,
+            "warm": not args.no_warm,
+            "compilation_cache_dir": args.compilation_cache_dir,
+            "ramp_s": args.ramp_s,
             "task": args.task or args.synthetic or "default",
         },
     }
@@ -254,20 +432,37 @@ def parse_args(argv=None):
     # reuse the server's flags (task/method/capacity/batching) and add the
     # load shape on top
     base = server_args([])
+    # None-default flags carry no type to clone; name the numeric ones
+    numeric = {"max_linger_ms": float}
     p = argparse.ArgumentParser(description=__doc__)
     for a, v in vars(base).items():
-        if a != "port":
+        if a == "port":
+            continue
+        if isinstance(v, bool):
+            p.add_argument("--" + a.replace("_", "-"), default=v,
+                           action="store_true" if not v
+                           else "store_false")
+        else:
             p.add_argument("--" + a.replace("_", "-"),
                            default=v, type=(type(v) if v is not None
-                                            else str))
-    p.add_argument("--workers", type=int, default=32)
+                                            else numeric.get(a, str)))
+    p.add_argument("--workers", type=int, default=32,
+                   help="free-run: OS threads; mux: max concurrent "
+                        "session coroutines")
     p.add_argument("--sessions", type=int, default=64,
-                   help="total sessions to run (free-run mode)")
+                   help="total sessions to run (free-run / mux modes)")
     p.add_argument("--labels", type=int, default=8,
                    help="labels per session")
     p.add_argument("--lockstep", action="store_true",
                    help="barrier arrivals: every round of W labels rides "
                         "one dispatch (deterministic occupancy)")
+    p.add_argument("--mux", action="store_true",
+                   help="asyncio arrival: sessions are coroutines on one "
+                        "event loop (in-process verbs, or per-session "
+                        "keep-alive connections with --http)")
+    p.add_argument("--ramp-s", type=float, default=0.0,
+                   help="mux: spread session arrivals over this many "
+                        "seconds instead of a thundering herd at t=0")
     p.add_argument("--http", action="store_true",
                    help="drive the in-process app over real HTTP instead "
                         "of direct calls")

@@ -509,3 +509,441 @@ def test_serve_loadgen_smoke(tmp_path, monkeypatch):
     with open(out, "w") as f:
         json.dump(report, f)
     assert json.loads(out.read_text())["server"]["max_occupancy"] >= 16
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: linger cap + cancellation race + staged admission
+# ---------------------------------------------------------------------------
+
+def test_serve_linger_cap_bounds_formation(serve_task):
+    """Steady trickle arrival (every gap < max_wait refreshes the window)
+    must NOT stretch a tick's formation indefinitely: the total-linger cap
+    dispatches the batch max_linger after its first ticket, bounded by
+    time, not only by max_batch (the regression this PR's satellite
+    pins)."""
+    import time
+
+    from coda_tpu.serve import Batcher, SelectorSpec, ServeMetrics, SessionStore
+
+    store = SessionStore(capacity=8)
+    store.register_task("t", serve_task.preds)
+    spec = SelectorSpec.create("iid")
+    sessions = [store.open("t", spec, seed=s) for s in range(8)]
+    # warm the bucket's step outside the measurement (compile time would
+    # otherwise swamp the formation-window assertion)
+    sessions[0].bucket.warm()
+    metrics = ServeMetrics()
+    batcher = Batcher(store, metrics, max_batch=256,
+                      max_wait=0.05, max_linger=0.15).start()
+    try:
+        t0 = time.perf_counter()
+        first = batcher.submit_start(sessions[0])
+        # trickle: a new ticket every ~25 ms (< max_wait, so the adaptive
+        # gap window would refresh forever without the cap)
+        feeder_done = threading.Event()
+
+        def feeder():
+            for s in sessions[1:]:
+                time.sleep(0.025)
+                batcher.submit_start(s)
+            feeder_done.set()
+
+        threading.Thread(target=feeder, daemon=True).start()
+        first.wait(10.0)
+        waited = time.perf_counter() - t0
+        feeder_done.wait(5.0)
+    finally:
+        batcher.stop(drain=True, timeout=10.0)
+    # the first ticket's tick must have formed within the cap (plus the
+    # step itself and scheduling slack) — NOT after all 8 trickled in
+    # (8 x 25 ms = 200 ms > max_linger alone, before the step)
+    assert waited < 0.15 + 1.0, waited
+    snap = metrics.snapshot()
+    assert snap["dispatches"] >= 2  # the trickle spilled into later ticks
+    assert snap["requests"] == 8    # everyone was served eventually
+
+
+def test_serve_ticket_resolution_exactly_once(serve_task):
+    """The cancel/complete race is arbitrated: whichever resolves first
+    wins, the loser is a no-op — a ticket cancelled between collect and
+    dispatch is never double-completed, and a result that lands before
+    the cancel is kept (wait() returns it instead of raising)."""
+    from coda_tpu.serve import Ticket
+
+    # complete then cancel: result preserved, cancel loses
+    t = Ticket(session=None, do_update=False)
+    assert t.complete({"next_idx": 1}) is True
+    assert t.cancel() is False
+    assert not t.cancelled
+    assert t.wait(0.1) == {"next_idx": 1}  # lost-race wait gets the result
+
+    # cancel then complete: cancel wins, completion is a no-op
+    t = Ticket(session=None, do_update=False)
+    assert t.cancel() is True
+    assert t.complete({"next_idx": 2}) is False
+    assert t.result is None
+    with pytest.raises(RuntimeError, match="cancelled"):
+        t.wait(0.1)
+
+    # fail after cancel: also a no-op (the dispatcher's drop path racing
+    # a wait()-timeout must not overwrite the first resolution)
+    t = Ticket(session=None, do_update=False)
+    assert t.cancel() is True
+    assert t.fail(ValueError("boom")) is False
+    with pytest.raises(RuntimeError, match="cancelled"):
+        t.wait(0.1)
+
+
+def test_serve_cancel_between_collect_and_dispatch(serve_task):
+    """A ticket cancelled after submission but before its tick dispatches
+    is dropped with its slot clean: the next tick serves the same slot's
+    session normally and the cancelled ticket is resolved exactly once."""
+    from coda_tpu.serve import Batcher, SelectorSpec, ServeMetrics, SessionStore
+
+    store = SessionStore(capacity=2)
+    store.register_task("t", serve_task.preds)
+    spec = SelectorSpec.create("iid")
+    batcher = Batcher(store, ServeMetrics(), max_wait=0.001).start()
+    try:
+        s1 = store.open("t", spec, seed=0)
+        batcher.pause()
+        # queued but cancelled before the batcher can dispatch it
+        t_cancelled = batcher.submit_start(s1)
+        assert t_cancelled.cancel() is True
+        t_live = batcher.submit_start(s1)  # same SLOT, next in queue
+        batcher.resume()
+        res = t_live.wait(30.0)
+        assert res["next_idx"] >= 0  # the slot dispatched cleanly
+        with pytest.raises(RuntimeError, match="cancelled"):
+            t_cancelled.wait(1.0)
+        assert t_cancelled.result is None  # never double-completed
+        # the session advanced exactly once (one live ticket, one dropped)
+        assert s1.last == res
+    finally:
+        batcher.stop(drain=False, timeout=10.0)
+
+
+def test_serve_staged_admission_lifecycle(serve_task):
+    """Admission stages its slab write (no dispatch lock); an open that is
+    closed before any dispatch drops its staged write, and the slot's next
+    tenant gets its own correct state — pinned against a fresh store."""
+    from coda_tpu.serve import SelectorSpec, SessionStore
+
+    spec = SelectorSpec.create("coda", n_parallel=2)
+
+    store = SessionStore(capacity=2)
+    store.register_task("t", serve_task.preds)
+    a = store.open("t", spec, seed=7)
+    store.close(a.sid)              # staged write dropped, slot freed
+    b = store.open("t", spec, seed=11)
+    assert b.slot == a.slot
+    got = b.bucket.dispatch({b.slot: {"do_update": False}})[b.slot]
+
+    ref_store = SessionStore(capacity=2)
+    ref_store.register_task("t", serve_task.preds)
+    r = ref_store.open("t", spec, seed=11)
+    want = r.bucket.dispatch({r.slot: {"do_update": False}})[r.slot]
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# donated slab buffers: the bitwise pin (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_serve_donated_step_bitwise(serve_task):
+    """Donated (in-place carries, AOT-warm) and undonated (copying,
+    lazy-jit) slab-step paths produce bitwise-identical session
+    trajectories AND slab states — donation changes buffer lifetime, never
+    numerics."""
+    from coda_tpu.serve import SelectorSpec, SessionStore
+
+    labels = np.asarray(serve_task.labels)
+
+    def run(donate: bool, warm: bool):
+        store = SessionStore(capacity=4, donate=donate)
+        store.register_task("t", serve_task.preds)
+        spec = SelectorSpec.create("coda", n_parallel=4)
+        if warm:
+            store._bucket_for("t", spec).warm()
+        sessions = [store.open("t", spec, seed=s) for s in range(3)]
+        bucket = sessions[0].bucket
+        rows = []
+        res = bucket.dispatch({se.slot: {"do_update": False}
+                               for se in sessions})
+        for se in sessions:
+            se.last = res[se.slot]
+        rows.append([res[se.slot] for se in sessions])
+        for _ in range(4):
+            reqs = {se.slot: {"do_update": True,
+                              "idx": se.last["next_idx"],
+                              "label": int(labels[se.last["next_idx"]]),
+                              "prob": se.last["next_prob"]}
+                    for se in sessions}
+            res = bucket.dispatch(reqs)
+            for se in sessions:
+                se.last = res[se.slot]
+            rows.append([res[se.slot] for se in sessions])
+        states = [bucket.slot_state(se.slot) for se in sessions]
+        return rows, states
+
+    rows_d, states_d = run(donate=True, warm=True)
+    rows_u, states_u = run(donate=False, warm=False)
+    # next_prob floats compare EXACTLY (dict equality on python floats
+    # from float32 — same bits or bust), as do idx/best/stochastic
+    assert rows_d == rows_u
+    for sd, su in zip(states_d, states_u):
+        for a, b in zip(sd, su):
+            if a is not None:
+                assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# warm pool: readiness gate + restart with a persistent compilation cache
+# ---------------------------------------------------------------------------
+
+def test_serve_healthz_readiness_gate(serve_task):
+    """/healthz answers 503 until the warm pool is compiled, 200 after —
+    the load balancer's signal to keep traffic off a cold replica."""
+    from coda_tpu.serve import ServeApp, SelectorSpec, make_server
+
+    app = ServeApp(capacity=2, max_wait=0.001,
+                   spec=SelectorSpec.create("iid"))
+    app.add_task("tiny", serve_task.preds)
+    app.batcher.start()             # serving thread up, pool NOT warm
+    srv = make_server(app, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    port = srv.server_address[1]
+    try:
+        status, h = _req(port, "GET", "/healthz")
+        assert status == 503
+        assert h["ready"] is False and h["ok"] is False
+        info = app.warm()
+        assert info["size"] >= 2 and app.ready.is_set()
+        status, h = _req(port, "GET", "/healthz")
+        assert status == 200
+        assert h["ready"] is True and h["ok"] is True
+        # stats carries the warm-pool evidence
+        status, stats = _req(port, "GET", "/stats")
+        assert stats["ready"] is True
+        assert stats["warm_pool"]["size"] == info["size"]
+        assert stats["buckets"][0]["warm"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        app.drain(timeout=5.0)
+
+
+def test_serve_warm_pool_restart_zero_fresh_compiles(tmp_path):
+    """The acceptance criterion: a second server start against a populated
+    --compilation-cache-dir performs 0 fresh backend compiles — every
+    warm-pool executable deserializes (persistent-cache miss counter stays
+    0 while the hit counter counts the pool)."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import json, sys
+from coda_tpu.utils.platform import enable_compilation_cache
+enable_compilation_cache(sys.argv[1])
+from coda_tpu.data import make_synthetic_task
+from coda_tpu.serve import ServeApp, SelectorSpec
+from coda_tpu.telemetry import get_registry
+task = make_synthetic_task(seed=0, H=3, N=16, C=3)
+app = ServeApp(capacity=2, max_wait=0.001, spec=SelectorSpec.create("iid"))
+app.add_task("t", task.preds)
+app.start(warm=True)
+out = app.open_session(seed=0)      # one warm dispatch over the pool
+app.close_session(out["session"])
+app.drain(timeout=10)
+reg = get_registry()
+print(json.dumps({
+    "misses": reg.counter("persistent_cache_misses_total").value(),
+    "hits": reg.counter("persistent_cache_hits_total").value(),
+    "compile_events": reg.counter("jit_compiles_total").value(),
+    "warm_size": app.warm_info.get("size"),
+    "warm_misses": app.metrics.warm_misses,
+    "ready": app.ready.is_set(),
+}))
+"""
+    cache = str(tmp_path / "jaxcache")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def start_once():
+        out = subprocess.run(
+            [sys.executable, "-c", script, cache], env=env,
+            capture_output=True, text=True, timeout=420,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = start_once()
+    assert cold["ready"] and cold["warm_size"] >= 2
+    assert cold["misses"] > 0          # cold start compiled for real
+    assert cold["warm_misses"] == 0    # but never under traffic
+
+    warm = start_once()
+    assert warm["ready"] and warm["warm_size"] == cold["warm_size"]
+    assert warm["misses"] == 0, (
+        f"second start performed {warm['misses']} fresh backend compiles "
+        "against a populated compilation cache")
+    assert warm["hits"] > 0            # the pool deserialized
+    assert warm["warm_misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# record/replay: continuous batching must not change any trajectory
+# ---------------------------------------------------------------------------
+
+def test_serve_recorder_stream_invariant_to_tick_grouping(serve_task):
+    """The same sessions driven through COALESCED ticks (lockstep: all
+    sessions ride one dispatch per round) and through CONTINUOUS one-at-a-
+    time dispatches produce bitwise-identical per-session recorder streams
+    — tick grouping is a scheduling detail, never a numerics input (the
+    record/replay compatibility pin)."""
+    from coda_tpu.serve import ServeApp, SelectorSpec
+
+    labels = np.asarray(serve_task.labels)
+    n_sessions, rounds = 3, 4
+
+    def drive(coalesced: bool):
+        app = ServeApp(capacity=n_sessions, max_wait=0.001,
+                       spec=SelectorSpec.create("model_picker"))
+        app.add_task("t", serve_task.preds)
+        app.start(warm=True)
+        sids = [app.open_session(seed=s)["session"]
+                for s in range(n_sessions)]
+        for _ in range(rounds):
+            if coalesced:
+                # all sessions' labels ride ONE dispatch (lockstep hook)
+                app.batcher.pause()
+                tickets = []
+                for sid in sids:
+                    sess = app.store.get(sid)
+                    cur = sess.last
+                    tickets.append(app.batcher.submit_label(
+                        sess, idx=cur["next_idx"],
+                        label=int(labels[cur["next_idx"]]),
+                        prob=cur["next_prob"]))
+                app.batcher.resume()
+                for t in tickets:
+                    t.wait(30.0)
+            else:
+                # one dispatch per request: maximally different grouping
+                for sid in sids:
+                    sess = app.store.get(sid)
+                    cur = sess.last
+                    app.label(sid, int(labels[cur["next_idx"]]),
+                              idx=cur["next_idx"])
+        streams = {sid: app.recorder.history(sid) for sid in sids}
+        app.drain(timeout=10.0)
+        return streams
+
+    coalesced = drive(True)
+    continuous = drive(False)
+    for sid_c, sid_s in zip(coalesced, continuous):
+        rows_c, rows_s = coalesced[sid_c], continuous[sid_s]
+        assert len(rows_c) == len(rows_s) == rounds + 1
+        for rc, rs in zip(rows_c, rows_s):
+            assert rc == rs  # dict equality: floats must match exactly
+
+
+# ---------------------------------------------------------------------------
+# loadgen mux mode + the committed-bench gate (tier-1 wiring)
+# ---------------------------------------------------------------------------
+
+def test_serve_loadgen_mux_smoke(tmp_path):
+    """Asyncio mux arrival end to end (the >=256-session driver, at smoke
+    scale): 0 errors, warm pool hit on every dispatch, and the queue-wait /
+    dispatch / step breakdown present for mechanical p99 attribution."""
+    import scripts.serve_loadgen as lg
+
+    args = lg.parse_args([
+        "--synthetic", "4,48,4", "--method", "coda",
+        "--mux", "--workers", "12", "--sessions", "18", "--labels", "2",
+        "--capacity", "12", "--max-wait-ms", "5", "--max-linger-ms", "40",
+    ])
+    report = lg.run_loadgen(args)
+    assert report["n_errors"] == 0, report["errors"]
+    assert report["mode"] == "mux"
+    assert report["sessions"] == 18
+    assert report["warm_pool"]["size"] >= 3
+    assert report["warm_pool"]["misses"] == 0
+    assert report["latency_ms"]["p99"] is not None
+    for phase in ("queue_wait", "dispatch", "step"):
+        assert report["breakdown"][phase]["p99_ms"] is not None, phase
+    assert report["breakdown"]["spans"]["n_tick_spans"] >= 1
+
+
+def _load_check_serve_bench():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_serve_bench",
+        os.path.join(repo, "scripts", "check_serve_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_serve_bench_gates_committed_artifact():
+    """Tier-1 wiring of scripts/check_serve_bench.py: the committed
+    BENCH_SERVE_CPU_r09.json satisfies the schema and the committed
+    latency bounds (>= 256 sessions, 0 errors, p99 within the 10x-vs-r06
+    contract), and a regressed/degraded report is rejected."""
+    import copy
+    import os
+
+    mod = _load_check_serve_bench()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "BENCH_SERVE_CPU_r09.json")
+    with open(path) as f:
+        report = json.load(f)
+    assert mod.check_report(report) == []
+
+    bad = copy.deepcopy(report)
+    bad["latency_ms"]["p99"] = mod.P99_MS_MAX + 1
+    assert any("p99" in v for v in mod.check_report(bad))
+    bad = copy.deepcopy(report)
+    bad["n_errors"] = 3
+    assert any("n_errors" in v for v in mod.check_report(bad))
+    bad = copy.deepcopy(report)
+    del bad["breakdown"]
+    assert any("breakdown" in v for v in mod.check_report(bad))
+    bad = copy.deepcopy(report)
+    bad["warm_pool"]["misses"] = 2
+    assert any("misses" in v for v in mod.check_report(bad))
+    assert mod.main([path]) == 0
+
+
+def test_serve_pause_holds_even_full_batches(serve_task):
+    """pause() freezes ticking even when a full max_batch is already
+    queued: nothing dispatches until resume (the lockstep determinism
+    contract), and everything queued is then served."""
+    import time
+
+    from coda_tpu.serve import Batcher, SelectorSpec, ServeMetrics, SessionStore
+
+    store = SessionStore(capacity=6)
+    store.register_task("t", serve_task.preds)
+    spec = SelectorSpec.create("iid")
+    sessions = [store.open("t", spec, seed=s) for s in range(6)]
+    sessions[0].bucket.warm()
+    metrics = ServeMetrics()
+    batcher = Batcher(store, metrics, max_batch=4, max_wait=0.001).start()
+    try:
+        batcher.pause()
+        tickets = [batcher.submit_start(s) for s in sessions]  # 6 > max_batch
+        time.sleep(0.3)
+        assert metrics.snapshot()["dispatches"] == 0  # frozen while paused
+        batcher.resume()
+        for t in tickets:
+            assert t.wait(30.0)["next_idx"] >= 0
+        snap = metrics.snapshot()
+        assert snap["requests"] == 6
+        assert snap["dispatches"] == 2  # max_batch split: 4 + 2
+    finally:
+        batcher.stop(drain=False, timeout=10.0)

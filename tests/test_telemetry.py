@@ -295,10 +295,13 @@ def test_metrics_endpoint_http_exposition():
         resp = conn.getresponse()
         assert resp.status == 200
         sid = json.loads(resp.read())["session"]
-        out = None
         conn.request("POST", f"/session/{sid}/label", body=json.dumps(
             {"label": 0}), headers={"Content-Type": "application/json"})
-        assert conn.getresponse().status in (200, 504) or out
+        resp = conn.getresponse()
+        # keep-alive front door: the body must be drained before the next
+        # response on this connection (HTTP/1.1 semantics)
+        resp.read()
+        assert resp.status in (200, 504)
 
         conn.request("GET", "/metrics")
         resp = conn.getresponse()
