@@ -332,6 +332,14 @@ def main(argv=None):
         from coda_tpu.engine.replay import replay_main
 
         return replay_main(argv[1:])
+    if argv and argv[0] == "replay-serve":
+        # `python -m coda_tpu.cli replay-serve <record-dir> ...`: verify
+        # serving-session JSONL streams (a serve --record-dir) by bitwise
+        # replay through a fresh slab — the interactive-session twin of
+        # `replay`, and the offline face of crash restore
+        from coda_tpu.serve.recovery import replay_serve_main
+
+        return replay_serve_main(argv[1:])
     if argv and argv[0] == "suite":
         # `python -m coda_tpu.cli suite ...`: the in-process sweep driver
         # (scripts/run_suite.py) — grows --task-batch/--suite-devices/

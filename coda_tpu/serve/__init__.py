@@ -6,13 +6,27 @@ AOT-warmed, buffer-donated executables (:mod:`~coda_tpu.serve.state`), a
 continuous-batching dispatcher that executes one compiled masked step per
 tick (:mod:`~coda_tpu.serve.batcher`), a dependency-free asyncio HTTP/JSON
 front door with admission control and a warm-pool readiness gate
-(:mod:`~coda_tpu.serve.server`), and per-dispatch metrics including the
+(:mod:`~coda_tpu.serve.server`), per-dispatch metrics including the
 queue-wait/dispatch/step attribution triplet
-(:mod:`~coda_tpu.serve.metrics`). See ARCHITECTURE.md §"Serving".
+(:mod:`~coda_tpu.serve.metrics`), fault tolerance — session
+checkpoint/restore + migration, bucket self-healing from recorder
+streams, crash restore (:mod:`~coda_tpu.serve.recovery`) — and a
+deterministic fault-injection harness that exercises every recovery path
+(:mod:`~coda_tpu.serve.faults`). See ARCHITECTURE.md §"Serving".
 """
 
 from coda_tpu.serve.batcher import Batcher, Ticket
+from coda_tpu.serve.faults import FaultInjected, FaultInjector
 from coda_tpu.serve.metrics import ServeMetrics
+from coda_tpu.serve.recovery import (
+    BucketHealer,
+    ImportRejected,
+    ReplayMismatch,
+    export_session,
+    heal_bucket,
+    import_session,
+    restore_app_sessions,
+)
 from coda_tpu.serve.server import (
     AsyncHTTPServer,
     ServeApp,
@@ -21,6 +35,7 @@ from coda_tpu.serve.server import (
 )
 from coda_tpu.serve.state import (
     Bucket,
+    BucketQuarantined,
     SelectorSpec,
     Session,
     SessionStore,
@@ -35,6 +50,12 @@ __all__ = [
     "AsyncHTTPServer",
     "Batcher",
     "Bucket",
+    "BucketHealer",
+    "BucketQuarantined",
+    "FaultInjected",
+    "FaultInjector",
+    "ImportRejected",
+    "ReplayMismatch",
     "SelectorSpec",
     "ServeApp",
     "ServeMetrics",
@@ -46,6 +67,10 @@ __all__ = [
     "Ticket",
     "UnknownSession",
     "build_app",
+    "export_session",
+    "heal_bucket",
+    "import_session",
     "make_server",
     "make_slab_step",
+    "restore_app_sessions",
 ]

@@ -60,6 +60,7 @@ class Ticket:
     idx: int = 0
     label: int = 0
     prob: float = 0.0
+    request_id: Optional[str] = None  # client idempotency token (labels)
     submitted: float = field(default_factory=time.perf_counter)
     collected: float = 0.0          # when the batcher picked it into a batch
     done: threading.Event = field(default_factory=threading.Event)
@@ -194,9 +195,18 @@ class Batcher:
 
     def __init__(self, store, metrics=None, max_batch: int = 256,
                  max_wait: float = 0.002, max_linger: Optional[float] = None,
-                 telemetry=None, recorder=None):
+                 telemetry=None, recorder=None, faults=None):
         self.store = store
         self.metrics = metrics
+        # optional FaultInjector: tick-boundary crash points (the batcher
+        # is where "the process died between ticks" is a meaningful,
+        # deterministic place to die)
+        self.faults = faults
+        # recovery hook: called as on_bucket_failure(bucket, error) when a
+        # dispatch leaves a bucket quarantined — the ServeApp wires this
+        # to BucketHealer.schedule so the slab rebuild starts immediately,
+        # off this thread
+        self.on_bucket_failure = None
         # optional Telemetry: each per-bucket dispatch becomes a span on the
         # "host:batcher" lane (annotated so a live jax.profiler capture
         # shows the same tick names next to the device rows), with the
@@ -240,12 +250,18 @@ class Batcher:
         self._thread.join(timeout=timeout)
         self._thread = None
         # fail any tickets stranded by a non-drained stop
+        self._flush_queue(RuntimeError("server stopped"))
+
+    def _flush_queue(self, error: BaseException) -> None:
+        """Fail everything currently queued (exactly-once resolution makes
+        racing a live dispatch safe — whichever side resolves first wins)."""
         while True:
             try:
                 t = self.queue.get_nowait()
             except queue.Empty:
                 break
-            t.fail(RuntimeError("server stopped"))
+            self._forget_pending(t)
+            t.fail(error)
 
     def pause(self) -> None:
         self._paused.clear()
@@ -255,16 +271,32 @@ class Batcher:
 
     # -- submission (front-door workers) -----------------------------------
     def submit(self, ticket: Ticket) -> Ticket:
+        if not self._running:
+            # fail fast with a retryable error instead of blackholing the
+            # ticket until the request timeout: during a rolling restart
+            # the client's retry/backoff loop needs to see the drain NOW
+            # so it can land on the restored server
+            self._forget_pending(ticket)
+            ticket.fail(RuntimeError("server draining: batcher stopped"))
+            return ticket
         self.queue.put(ticket)
+        if not self._running:
+            # raced a concurrent stop(): its final flush may have run
+            # before our put landed, which would strand the ticket until
+            # the request timeout — flush again (failing an already-
+            # resolved ticket is a no-op)
+            self._flush_queue(RuntimeError("server draining: batcher "
+                                           "stopped"))
         return ticket
 
     def submit_start(self, session) -> Ticket:
         return self.submit(Ticket(session=session, do_update=False))
 
-    def submit_label(self, session, idx: int, label: int,
-                     prob: float) -> Ticket:
+    def submit_label(self, session, idx: int, label: int, prob: float,
+                     request_id: Optional[str] = None) -> Ticket:
         return self.submit(Ticket(session=session, do_update=True, idx=idx,
-                                  label=label, prob=prob))
+                                  label=label, prob=prob,
+                                  request_id=request_id))
 
     # -- the tick ----------------------------------------------------------
     def _collect(self) -> list:
@@ -338,21 +370,50 @@ class Batcher:
                 continue
             self._dispatch(batch)
 
+    @staticmethod
+    def _forget_pending(t: Ticket) -> None:
+        """Drop a failed/dropped ticket's idempotency registration so the
+        client's retry resubmits instead of re-joining a dead ticket.
+
+        Identity-guarded: a cancelled ticket collected LATE must not
+        erase the registration of the newer live ticket its client's
+        retry already re-registered under the same request_id — that
+        would reopen the double-apply window."""
+        if t.request_id is not None:
+            pending = t.session.pending
+            if pending.get(t.request_id) is t:
+                pending.pop(t.request_id, None)
+
     def _dispatch(self, batch: list) -> None:
         # group by bucket; at most one ticket per slot per tick. Cancelled
         # tickets (wait-timeout) and tickets whose session closed while
         # queued are dropped HERE, not dispatched — their slot may already
         # belong to someone else (see Ticket.wait). Their slot entry is
         # never marked pending, so the next tick sees a clean slab.
+        if self.faults is not None:
+            self.faults.fire("tick_pre")    # crash_before_tick
         now = time.perf_counter()
         per_bucket: dict = {}
         requeue: list = []
         for t in batch:
             t.collected = now
             if t.cancelled or not self.store.alive(t.session.sid):
+                self._forget_pending(t)
                 t.fail(RuntimeError("request cancelled (timeout or "
                                     "session closed while queued)"))
                 continue
+            if t.request_id is not None:
+                done = t.session.recent.get(t.request_id)
+                if done is not None:
+                    # an earlier ticket for this request_id already
+                    # committed its result — possible when the client's
+                    # wait-timeout cancel lost the race to that ticket's
+                    # in-flight dispatch and the retry resubmitted before
+                    # the commit landed. Answer from the committed result;
+                    # dispatching would apply the oracle answer twice.
+                    self._forget_pending(t)
+                    t.complete(dict(done))
+                    continue
             slots = per_bucket.setdefault(t.session.bucket, {})
             if t.session.slot in slots:
                 requeue.append(t)  # same-slot collision -> next tick
@@ -360,6 +421,27 @@ class Batcher:
                 slots[t.session.slot] = t
         depth = self.queue.qsize() + len(requeue)
         for bucket, slots in per_bucket.items():
+            if bucket.quarantined is not None or bucket.failed is not None:
+                # fail fast WITHOUT the bucket lock: the healer holds it
+                # for the entire slab rebuild, and blocking here would
+                # stall this thread — and with it every OTHER bucket's
+                # dispatches — behind one bucket's recovery. The heal was
+                # scheduled when the quarantine was set; waiters just need
+                # the retryable error now.
+                try:
+                    bucket._check_available()
+                except BaseException as e:
+                    for t in slots.values():
+                        self._forget_pending(t)
+                        t.fail(e)
+                    if bucket.quarantined is not None and \
+                            self.on_bucket_failure is not None:
+                        # a quarantine set OUTSIDE this thread's dispatch
+                        # path (an import/restore replay dispatch failed)
+                        # has no heal scheduled yet — kick it here;
+                        # schedule() is a no-op while one is in flight
+                        self.on_bucket_failure(bucket, e)
+                    continue
             reqs = {
                 slot: {"do_update": t.do_update, "idx": t.idx,
                        "label": t.label, "prob": t.prob}
@@ -379,7 +461,14 @@ class Batcher:
                     results = bucket.dispatch(reqs)
             except BaseException as e:  # surface to every waiter, keep going
                 for t in slots.values():
+                    self._forget_pending(t)
                     t.fail(e)
+                if bucket.quarantined is not None and \
+                        self.on_bucket_failure is not None:
+                    # the slab was lost to this failure: kick off the
+                    # rebuild-from-streams heal (off this thread) so the
+                    # waiters' retries find a healed bucket, not a corpse
+                    self.on_bucket_failure(bucket, e)
                 continue
             dt = time.perf_counter() - t0
             deliveries: dict = {}  # loop -> [(ticket, future), ...]
@@ -400,6 +489,20 @@ class Batcher:
                 t.session.last = r
                 if t.do_update:
                     t.session.n_labeled += 1
+                if t.request_id is not None:
+                    # idempotency: the result is committed BEFORE the
+                    # ticket resolves, so a client retry racing the
+                    # response can only ever read, never re-apply
+                    recent = t.session.recent
+                    recent[t.request_id] = r
+                    while len(recent) > 128:  # bounded retry window
+                        recent.pop(next(iter(recent)))
+                    # identity-guarded (like _forget_pending): if a cancel
+                    # of THIS ticket already let the client's retry
+                    # re-register the request_id, popping here would strip
+                    # the newer ticket's registration mid-flight
+                    if t.session.pending.get(t.request_id) is t:
+                        t.session.pending.pop(t.request_id, None)
                 if self.recorder is not None:
                     self.recorder.append(t.session.sid, {
                         "n_labeled": t.session.n_labeled,
@@ -407,10 +510,13 @@ class Batcher:
                         "labeled_idx": t.idx if t.do_update else None,
                         "label": t.label if t.do_update else None,
                         "prob": t.prob if t.do_update else None,
+                        "request_id": t.request_id,
                         "next_idx": r["next_idx"],
                         "next_prob": r["next_prob"],
                         "best": r["best"],
                         "stochastic": r["stochastic"],
+                        "pbest_max": r.get("pbest_max"),
+                        "pbest_entropy": r.get("pbest_entropy"),
                     })
                 if self.metrics is not None:
                     self.metrics.record_request_latency(now - t.submitted)
@@ -428,3 +534,5 @@ class Batcher:
                     warm=bucket.is_warm)
         for t in requeue:
             self.queue.put(t)
+        if self.faults is not None:
+            self.faults.fire("tick_post")   # crash_after_tick

@@ -57,6 +57,15 @@ class ServeMetrics:
         self.warm_misses = 0         # dispatches that fell back to lazy jit
         self.warm_pool_size = 0      # precompiled executables in the pool
         self.warm_pool_seconds = None  # warm-up wall time (None = no warm)
+        # fault tolerance: checkpoint/restore + bucket self-healing events
+        self.recovery = {
+            "exported": 0,     # sessions serialized for migration
+            "imported": 0,     # sessions restored from export payloads
+            "restored": 0,     # sessions rebuilt from streams after crash
+            "quarantined": 0,  # buckets quarantined by a step failure
+            "healed": 0,       # slab rebuilds that digest-verified
+            "heal_failed": 0,  # rebuilds degraded to terminal
+        }
         # gauges / rings
         self.max_occupancy = 0       # most requests ever served by one dispatch
         self._occupancy = collections.deque(maxlen=_RING)   # reqs per dispatch
@@ -98,6 +107,13 @@ class ServeMetrics:
         with self._lock:
             self.warm_pool_size = int(size)
             self.warm_pool_seconds = float(seconds)
+
+    def record_recovery(self, event: str) -> None:
+        """One fault-tolerance event (see the ``recovery`` counter keys)."""
+        with self._lock:
+            if event not in self.recovery:
+                raise ValueError(f"unknown recovery event {event!r}")
+            self.recovery[event] += 1
 
     def record_session(self, event: str) -> None:
         with self._lock:
@@ -144,6 +160,7 @@ class ServeMetrics:
                     "hits": self.warm_hits,
                     "misses": self.warm_misses,
                 },
+                "recovery": dict(self.recovery),
                 # ring fill: how much recent-window evidence backs the
                 # percentiles above (fill == capacity -> the ring has
                 # wrapped and older events have been evicted)

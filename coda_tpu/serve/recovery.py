@@ -1,0 +1,849 @@
+"""Fault-tolerant serving: checkpoint/restore, self-healing, migration.
+
+The serving layer's state story before this module: a failed slab step
+left its bucket permanently dead, and a process crash lost every live
+session — even though each session already had a crash-safe per-round
+JSONL stream from the flight recorder, and the replay engine already
+proved those streams re-execute bitwise on the same backend. This module
+closes the loop: **the posterior is the valuable state; the process (and
+the slab) are disposable.**
+
+Three capabilities, all pinned by the same bitwise replay machinery:
+
+  * **Session checkpoint/restore** — :func:`export_session` serializes a
+    session as a versioned payload: its recorder stream (the portable
+    session log) plus an optional fingerprint-guarded snapshot of the
+    slot's carries, host-materialized under the dispatch lock so a donated
+    step can never consume them mid-read. :func:`import_session` restores
+    it on any server of the same task: the snapshot fast path is accepted
+    only when its posterior digest matches the stream's last recorded
+    digest bitwise; otherwise (cross-fingerprint, digest drift, no
+    snapshot) the session is rebuilt by replaying its oracle answers
+    through the bucket's precompiled step, every replayed round verified
+    bitwise against the stream. The restored session keeps its id — the
+    client's handle survives the migration. This is the single-host
+    prerequisite for the ROADMAP's replica migration.
+  * **Bucket self-healing** — :func:`heal_bucket` rebuilds a quarantined
+    slab (a step failure consumed the donated carries) by replaying every
+    live slot's stream into a freshly allocated slab, one dispatch per
+    round for ALL slots (warm-pool executables make this fast), verifying
+    each replayed round — including the P(best) digest — bitwise.
+    :class:`BucketHealer` runs it off the batcher thread with bounded
+    retries and exponential backoff; only a digest mismatch or exhausted
+    retries degrade to the old terminal state.
+  * **Crash restore** — :func:`restore_app_sessions` scans a
+    ``--record-dir`` for streams without a close marker and re-imports
+    each one, so a SIGKILLed server restarted against the same directory
+    resumes every live session, replay-verified.
+
+``replay_serve_main`` (``python -m coda_tpu.cli replay-serve <dir>``) is
+the offline face: verify any session stream against a fresh slab without
+a server, the way ``cli replay`` verifies batch records.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import re
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from coda_tpu.serve.state import BucketQuarantined, SelectorSpec
+
+#: bump on any change to the export payload's fields
+SESSION_EXPORT_VERSION = 1
+
+# the only session ids this package ever mints (uuid4 hex): imports must
+# match, both because the HTTP routes can address nothing else and because
+# the id lands in a recorder file path (session_<id>.jsonl)
+_SID_RE = re.compile(r"^[0-9a-f]{1,64}$")
+
+# result-row quantities a replayed round must reproduce bitwise
+_INT_QUANTITIES = ("next_idx", "best")
+_FLOAT_QUANTITIES = ("next_prob", "pbest_max", "pbest_entropy")
+
+
+class ReplayMismatch(RuntimeError):
+    """A replayed round diverged bitwise from its recorded row."""
+
+
+class ImportRejected(ValueError):
+    """The import payload cannot be restored here (wrong task/method/data,
+    or its stream failed replay verification)."""
+
+
+def _counter(name: str, help: str = ""):
+    from coda_tpu.telemetry import get_registry
+
+    return get_registry().counter(name, help)
+
+
+def _schema_version() -> int:
+    from coda_tpu.telemetry.recorder import SESSION_SCHEMA_VERSION
+
+    return SESSION_SCHEMA_VERSION
+
+
+# ---------------------------------------------------------------------------
+# array <-> JSON-safe codec for snapshot carries
+# ---------------------------------------------------------------------------
+
+def _pack(arr) -> dict:
+    a = np.ascontiguousarray(np.asarray(arr))
+    return {"dtype": str(a.dtype), "shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _unpack(d: dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(d["data"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"]).copy()
+
+
+# ---------------------------------------------------------------------------
+# bitwise row verification (the restore/heal contract)
+# ---------------------------------------------------------------------------
+
+def _f32_bits_equal(a, b) -> bool:
+    return (np.float32(a).tobytes() == np.float32(b).tobytes())
+
+
+def check_row(recorded: dict, replayed: dict, round_i: int,
+              sid: str = "?") -> None:
+    """Raise :class:`ReplayMismatch` naming the first diverging quantity.
+
+    Integers compare exact; floats compare BITWISE (same-backend replay
+    through the identical compiled step admits nothing less — and a NaN
+    poisoned into the recorded stream can never silently verify against a
+    finite replay)."""
+    for q in _INT_QUANTITIES:
+        if int(recorded[q]) != int(replayed[q]):
+            raise ReplayMismatch(
+                f"session {sid} round {round_i}: {q} recorded "
+                f"{recorded[q]} != replayed {replayed[q]}")
+    for q in _FLOAT_QUANTITIES:
+        rec = recorded.get(q)
+        rep = replayed.get(q)
+        if rec is None and rep is None:
+            continue  # method exposes no posterior digest
+        if (rec is None) != (rep is None):
+            raise ReplayMismatch(
+                f"session {sid} round {round_i}: {q} present on only one "
+                f"side (recorded {rec!r}, replayed {rep!r})")
+        if not _f32_bits_equal(rec, rep):
+            raise ReplayMismatch(
+                f"session {sid} round {round_i}: {q} recorded {rec!r} != "
+                f"replayed {rep!r} (bitwise)")
+
+
+def data_rows(rows) -> list:
+    """The decision rows of a stream (meta/close marker lines dropped)."""
+    return [r for r in (rows or []) if not r.get("kind")]
+
+
+def last_digest(rows) -> Optional[tuple]:
+    """The last recorded (pbest_max, pbest_entropy) of a stream, or None
+    when the stream is empty or the method records no posterior digest."""
+    rows = data_rows(rows)
+    if not rows or rows[-1].get("pbest_max") is None:
+        return None
+    return (rows[-1]["pbest_max"], rows[-1].get("pbest_entropy"))
+
+
+def _request_from_row(row: dict) -> dict:
+    if row.get("do_update"):
+        return {"do_update": True, "idx": int(row["labeled_idx"]),
+                "label": int(row["label"]), "prob": float(row["prob"])}
+    return {"do_update": False}
+
+
+def replay_live_coalesced(bucket, live, *, dispatch, alive=None,
+                          on_fail=None) -> int:
+    """Drive many slots' recorded rows through ``bucket`` with ONE masked
+    dispatch serving every live slot per round — the shared choreography
+    of :func:`heal_bucket` and :func:`restore_app_sessions` (a serial
+    per-session replay would run capacity-times more full-slab steps).
+
+    ``live`` maps ``slot -> (sid, rows)`` and is MUTATED: a slot whose
+    session dies mid-replay (``alive``) or fails is removed, so the caller
+    reads the survivors out of it. ``dispatch(reqs)`` runs one coalesced
+    round (the caller owns locking/flags). Without ``on_fail`` any failure
+    raises — the heal contract, where one divergence invalidates the whole
+    rebuild. With ``on_fail(sid, err)``, a :class:`ReplayMismatch` drops
+    only that slot, and a dispatch-level error drops every slot in the
+    round's request set then stops — the restore contract, where one
+    corrupt stream must not brick the others. Returns the number of
+    replayed rounds."""
+    n = 0
+    max_rounds = max((len(r) for _, r in live.values()), default=0)
+    for k in range(max_rounds):
+        reqs = {}
+        for slot, (sid, rows) in list(live.items()):
+            if k >= len(rows):
+                continue
+            if alive is not None and not alive(sid):
+                # closed by its client mid-replay (close/release are
+                # lock-free): a finished session needs no rebuild — its
+                # slot's rows stay garbage until reallocation, like any
+                # released slot
+                del live[slot]
+                continue
+            reqs[slot] = _request_from_row(rows[k])
+        if not reqs:
+            break
+        try:
+            res = dispatch(reqs)
+        except BaseException as e:
+            if on_fail is None:
+                raise
+            # the bucket itself is down (e.g. the step consumed its
+            # donated carries): every session still rebuilding here fails
+            # attributably; the caller's heal hook takes over
+            for slot in list(reqs):
+                sid, _ = live.pop(slot)
+                on_fail(sid, e)
+            break
+        for slot in reqs:
+            sid, rows = live[slot]
+            try:
+                check_row(rows[k], res[slot], k, sid=sid)
+            except ReplayMismatch as e:
+                if on_fail is None:
+                    raise
+                del live[slot]
+                on_fail(sid, e)
+        n = k + 1
+    return n
+
+
+def replay_rows_into_slot(bucket, slot: int, rows, sid: str = "?",
+                          verify: bool = True) -> Optional[dict]:
+    """Re-drive a session's recorded rows through the bucket's compiled
+    step into ``slot`` (freshly staged with the session's init — see
+    ``Bucket.stage_fresh``), one dispatch per row, verifying each round
+    bitwise. Returns the last replayed result row."""
+    last = None
+    for k, row in enumerate(data_rows(rows)):
+        with bucket.lock:
+            res = bucket.dispatch({slot: _request_from_row(row)})[slot]
+        if verify:
+            check_row(row, res, k, sid=sid)
+        last = res
+    return last
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+def snapshot_fingerprint(bucket) -> dict:
+    """The axes along which a carries snapshot is bit-portable: same
+    backend + jax version + selector config + padded shape + step
+    lowering. Anything else restores via the replay path instead."""
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "method": bucket.spec.method,
+        "spec_kwargs": [list(kv) for kv in bucket.spec.kwargs],
+        "shape": list(bucket.shape),
+        "n_valid": bucket.n_valid,
+        "step_impl": bucket.step_impl,
+    }
+
+
+def export_session(app, sid: str) -> dict:
+    """Serialize one live session as a self-contained, versioned payload.
+
+    Always carries the recorder stream (the portable, replayable session
+    log — ``n_labeled``/``last`` are derived from it, the single source of
+    truth). When the slab is readable, also a fingerprint-guarded snapshot
+    of the slot's carries for the import fast path; a quarantined bucket
+    exports stream-only (the stream IS the session). Leaves the session
+    live — the drain flow closes it separately once the peer confirms the
+    import."""
+    sess = app.store.get(sid)
+    if sess.restoring:
+        # mid-restore the slot and the recorder history are half-built;
+        # an export now would serialize an empty stream as the session
+        raise BucketQuarantined(
+            f"session {sid} is being restored; retry shortly")
+    bucket = sess.bucket
+    payload = {
+        "v": SESSION_EXPORT_VERSION,
+        "kind": "session_export",
+        "session": sess.sid,
+        "task": sess.task,
+        "method": bucket.spec.method,
+        "spec_kwargs": [list(kv) for kv in bucket.spec.kwargs],
+        "seed": sess.seed,
+        "dataset": {k: app.store.task_meta(sess.task).get(k)
+                    for k in ("shape", "digest")},
+        "fingerprint": snapshot_fingerprint(bucket),
+        "carries": None,
+        "key": None,
+    }
+    # snapshot FIRST (host-materialized under the bucket lock — see
+    # Bucket.snapshot_slot for the donation race), stream second: if a
+    # dispatch lands between the two, the stream is ahead of the snapshot,
+    # the import-side digest check fails, and restore falls back to the
+    # replay path — never a torn state
+    try:
+        leaves, key = bucket.snapshot_slot(sess.slot)
+        payload["carries"] = [_pack(x) for x in leaves]
+        payload["key"] = _pack(key)
+    except (BucketQuarantined, RuntimeError):
+        pass  # slab lost: the stream-only export is still complete
+    rows = data_rows(app.recorder.history(sid))
+    payload["rows"] = rows
+    payload["n_labeled"] = sum(1 for r in rows if r.get("do_update"))
+    payload["last"] = dict(rows[-1]) if rows else None
+    app.metrics.record_recovery("exported")
+    _counter("serve_sessions_exported_total",
+             "Sessions serialized for checkpoint/migration").inc()
+    return payload
+
+
+def export_all(app) -> list[dict]:
+    """Export every live session (the drain/migrate sweep). A session
+    closed by its client between the listing and its export is skipped —
+    a finished session needs no migration."""
+    from coda_tpu.serve.state import UnknownSession
+
+    with app.store.lock:
+        sids = list(app.store._sessions)
+    out = []
+    for sid in sids:
+        try:
+            out.append(export_session(app, sid))
+        except UnknownSession:
+            pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# import / restore
+# ---------------------------------------------------------------------------
+
+def _fingerprint_compatible(fp: dict, bucket) -> bool:
+    return fp == snapshot_fingerprint(bucket)
+
+
+def _close_quietly(store, sid: str) -> None:
+    """Cleanup close on an error path: a racing client DELETE may have
+    already popped the sid — that must not mask the original error."""
+    try:
+        store.close(sid)
+    except Exception:
+        pass
+
+
+def _finalize_restored(sess, rows) -> None:
+    """Rebuild a restored session's host bookkeeping from its rows:
+    label count, last result row, and the idempotency cache — a label the
+    client retries across the migration must dedupe on the new server."""
+    sess.n_labeled = sum(1 for r in rows if r.get("do_update"))
+    sess.last = dict(rows[-1]) if rows else {}
+    for row in rows:
+        rid = row.get("request_id")
+        if rid:
+            sess.recent[rid] = {
+                k: row.get(k) for k in ("next_idx", "next_prob",
+                                        "best", "stochastic",
+                                        "pbest_max", "pbest_entropy")}
+
+
+def import_session(app, payload: dict) -> dict:
+    """Restore an exported session into this server; returns
+    ``{restored_via, session, n_labeled, rounds}``.
+
+    Restore order: (1) snapshot fast path — carries present AND
+    fingerprint matches this bucket AND the standalone posterior digest of
+    the written slot equals the stream's last recorded digest bitwise;
+    (2) replay path — re-drive the stream through the bucket's compiled
+    step from the session's init, every round verified bitwise. A session
+    that fails both is rejected whole (attributable), never half-admitted.
+    """
+    if payload.get("v") != SESSION_EXPORT_VERSION:
+        raise ImportRejected(
+            f"export payload v={payload.get('v')!r}; this build imports "
+            f"v{SESSION_EXPORT_VERSION}")
+    task = payload["task"]
+    if task not in app.store.tasks():
+        raise ImportRejected(f"task {task!r} is not registered here")
+    meta = app.store.task_meta(task)
+    want_ds = payload.get("dataset") or {}
+    if want_ds.get("digest") and want_ds["digest"] != meta.get("digest"):
+        raise ImportRejected(
+            f"dataset digest mismatch for task {task!r}: session was "
+            f"served on {want_ds['digest']}, this server has "
+            f"{meta.get('digest')} — restoring against different data "
+            "answers a different question")
+    if payload["method"] != app.spec.method or \
+            [list(kv) for kv in app.spec.kwargs] != payload["spec_kwargs"]:
+        raise ImportRejected(
+            f"selector config mismatch: session ran "
+            f"{payload['method']}{payload['spec_kwargs']}, this server "
+            f"serves {app.spec.method}{[list(k) for k in app.spec.kwargs]}")
+    sid = payload.get("session")
+    if not isinstance(sid, str) or not _SID_RE.match(sid):
+        # an unchecked id would flow into a recorder file path AND create
+        # a session the hex-only HTTP routes can never address again
+        raise ImportRejected(
+            f"invalid session id {sid!r}: expected the lowercase-hex id "
+            "the export was taken under")
+    rows = data_rows(payload.get("rows"))
+    # published gated: the sid is addressable from here (the client's
+    # handle must resolve), but labels answer retryable 503 until the
+    # posterior AND the request_id dedupe cache are rebuilt — a retry
+    # landing mid-restore must neither 404 nor double-apply
+    sess = app.store.open(task, app.spec, seed=int(payload["seed"]),
+                          sid=sid, restoring=True)
+    bucket = sess.bucket
+    try:
+        restored_via = None
+        if payload.get("carries") is not None and _fingerprint_compatible(
+                payload.get("fingerprint") or {}, bucket):
+            bucket.restore_slot(sess.slot,
+                                [_unpack(d) for d in payload["carries"]],
+                                _unpack(payload["key"]))
+            want = last_digest(rows)
+            if want is not None:
+                with bucket.lock:
+                    got = bucket.digest(sess.slot)
+                if got is not None and \
+                        _f32_bits_equal(got[0], want[0]) and \
+                        _f32_bits_equal(got[1], want[1]):
+                    restored_via = "snapshot"
+            # no digest on either side -> the snapshot is UNVERIFIABLE;
+            # fall through to the replay path, which verifies every round
+        if restored_via is None:
+            bucket.stage_fresh(sess.slot, sess.seed)
+            replay_rows_into_slot(bucket, sess.slot, rows, sid=sess.sid)
+            restored_via = "replay"
+        _finalize_restored(sess, rows)
+        app.recorder.import_history(
+            sess.sid, meta={"task": task, "method": payload["method"],
+                            "spec_kwargs": payload["spec_kwargs"],
+                            "seed": sess.seed,
+                            "shape": meta.get("shape"),
+                            "digest": meta.get("digest"),
+                            "imported_via": restored_via},
+            rows=rows)
+    except ReplayMismatch as e:
+        _close_quietly(app.store, sess.sid)
+        raise ImportRejected(f"stream failed replay verification: {e}")
+    except BaseException:
+        _close_quietly(app.store, sess.sid)
+        raise
+    sess.restoring = False  # fully rebuilt: labels flow again
+    app.metrics.record_session("open")  # pairs with close_session's 'close'
+    app.metrics.record_recovery("imported")
+    _counter("serve_sessions_imported_total",
+             "Sessions restored from checkpoint/migration payloads").inc()
+    return {"restored_via": restored_via, "session": sess.sid,
+            "n_labeled": sess.n_labeled, "rounds": len(rows)}
+
+
+# ---------------------------------------------------------------------------
+# crash restore: rebuild live sessions from a --record-dir
+# ---------------------------------------------------------------------------
+
+def load_session_stream(path: str):
+    """``(meta, rows, closed)`` from one ``session_<id>.jsonl``.
+
+    Crash-tolerant: a process killed mid-write leaves at most one
+    truncated FINAL line, which is dropped; a torn line anywhere else is
+    real corruption and raises."""
+    meta: dict = {}
+    rows: list = []
+    closed = False
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            if i == len(lines) - 1:
+                break  # torn final line: the crash the recorder flushes for
+            raise
+        kind = row.get("kind")
+        if kind == "session_meta":
+            meta = row
+        elif kind == "session_close":
+            closed = True
+        else:
+            rows.append(row)
+            # a data row AFTER a close marker means the stream was resumed
+            # (same-dir migration): the session is live again
+            closed = False
+    return meta, rows, closed
+
+
+def iter_session_streams(record_dir: str):
+    """Yield ``(sid, path)`` for every session stream in a record dir."""
+    for fn in sorted(os.listdir(record_dir)):
+        if fn.startswith("session_") and fn.endswith(".jsonl"):
+            yield fn[len("session_"):-len(".jsonl")], \
+                os.path.join(record_dir, fn)
+
+
+def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
+    """Restore every un-closed session stream found in ``record_dir``
+    (default: the app's own recorder directory) — the crash-restart path.
+
+    Two phases: every restorable stream is first admitted GATED
+    (``Session.restoring`` — the sid resolves, labels answer retryable
+    503), then all sessions sharing a bucket are replayed COALESCED —
+    one masked slab dispatch serves every restoring slot per round, the
+    same choreography :func:`heal_bucket` uses. A serial
+    per-session replay would run ``capacity`` times more full-slab steps
+    at exactly the moment (crash under full load) this path exists for.
+
+    Per-session failures are collected, not raised: one corrupt stream
+    must not brick the whole restart. Returns
+    ``{restored: [sid], skipped_closed: n, failed: {sid: reason}}``."""
+    d = record_dir or app.recorder.out_dir
+    report = {"restored": [], "skipped_closed": 0, "failed": {}}
+    if not d or not os.path.isdir(d):
+        return report
+    # phase 1: admit gated (no replay yet); collect per-stream failures
+    staged: list = []          # (sess, rows, meta)
+    for sid, path in iter_session_streams(d):
+        try:
+            meta, rows, closed = load_session_stream(path)
+        except Exception as e:
+            report["failed"][sid] = f"unreadable stream: {e}"
+            continue
+        v, want_v = meta.get("v"), _schema_version()
+        if v is not None and v != want_v:
+            # a pre-upgrade stream lacks the per-round digest fields; its
+            # replay would misreport them as divergence — name the real
+            # incompatibility instead
+            report["failed"][sid] = (f"stream schema v{v}; this build "
+                                     f"replays v{want_v}")
+            continue
+        if closed:
+            report["skipped_closed"] += 1
+            continue
+        if app.store.alive(sid):
+            continue  # already live (e.g. double restore call)
+        if not _SID_RE.match(sid):
+            report["failed"][sid] = f"invalid session id {sid!r} in stream"
+            continue
+        rows = data_rows(rows)
+        task = meta.get("task")
+        try:
+            if task not in app.store.tasks():
+                raise ImportRejected(f"task {task!r} is not registered "
+                                     "here")
+            want_dg = meta.get("digest")
+            have_dg = app.store.task_meta(task).get("digest")
+            if want_dg and want_dg != have_dg:
+                raise ImportRejected(
+                    f"dataset digest mismatch for task {task!r}: stream "
+                    f"was recorded on {want_dg}, this server has {have_dg}")
+            if meta.get("method") and meta["method"] != app.spec.method:
+                raise ImportRejected(
+                    f"selector config mismatch: stream ran "
+                    f"{meta['method']}, this server serves "
+                    f"{app.spec.method}")
+            want_kw = meta.get("spec_kwargs")
+            have_kw = [list(kv) for kv in app.spec.kwargs]
+            if want_kw is not None and [list(kv) for kv in want_kw] \
+                    != have_kw:
+                # without this, a kwargs-mismatched restart surfaces as
+                # a per-round "bitwise divergence" instead of the named
+                # config error (import_session already checks both)
+                raise ImportRejected(
+                    f"selector config mismatch: stream ran "
+                    f"{meta['method']}{want_kw}, this server serves "
+                    f"{app.spec.method}{have_kw}")
+            sess = app.store.open(task, app.spec,
+                                  seed=int(meta.get("seed", 0)),
+                                  sid=sid, restoring=True)
+            sess.bucket.stage_fresh(sess.slot, sess.seed)
+        except Exception as e:
+            report["failed"][sid] = repr(e)
+            continue
+        staged.append((sess, rows, meta))
+    # phase 2: coalesced bitwise-verified replay, one dispatch per round
+    # per bucket; a diverging stream fails ONLY its session
+    by_bucket: dict = {}
+    for sess, rows, meta in staged:
+        by_bucket.setdefault(id(sess.bucket), (sess.bucket, []))[1].append(
+            (sess, rows, meta))
+    for bucket, items in by_bucket.values():
+        live = {sess.slot: (sess.sid, rows) for sess, rows, _ in items}
+
+        def locked_dispatch(reqs, _bucket=bucket):
+            with _bucket.lock:
+                return _bucket.dispatch(reqs)
+
+        def on_fail(sid, e):
+            if isinstance(e, ReplayMismatch):
+                report["failed"][sid] = repr(ImportRejected(
+                    f"stream failed replay verification: {e}"))
+            else:
+                report["failed"][sid] = f"restore dispatch failed: {e!r}"
+            _close_quietly(app.store, sid)
+
+        # per-session isolation: a diverging stream fails ONLY its session
+        # (restoring sessions are close-gated, so no `alive` check needed)
+        replay_live_coalesced(bucket, live, dispatch=locked_dispatch,
+                              on_fail=on_fail)
+        for sess, rows, meta in items:
+            if sess.slot not in live:
+                continue
+            _finalize_restored(sess, rows)
+            app.recorder.import_history(
+                sess.sid, meta={"task": sess.task,
+                                "method": meta.get("method")
+                                or app.spec.method,
+                                "spec_kwargs": meta.get("spec_kwargs")
+                                or [list(kv) for kv in app.spec.kwargs],
+                                "seed": sess.seed,
+                                "shape": meta.get("shape"),
+                                "digest": meta.get("digest"),
+                                "imported_via": "replay"},
+                rows=rows)
+            sess.restoring = False
+            report["restored"].append(sess.sid)
+            app.metrics.record_session("open")
+            app.metrics.record_recovery("restored")
+            _counter("serve_sessions_restored_total",
+                     "Sessions rebuilt from their JSONL streams after a "
+                     "crash").inc()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# bucket self-healing
+# ---------------------------------------------------------------------------
+
+def heal_bucket(bucket, store, recorder) -> dict:
+    """Rebuild a quarantined bucket's slab from its sessions' streams.
+
+    Under the bucket lock: allocate a fresh slab, re-stage every live
+    slot's init, then replay round-by-round — ONE dispatch serves every
+    rebuilding slot per round (the same coalescing the serving path uses),
+    with each slot's replayed row verified bitwise against its stream,
+    posterior digest included. On full verification the quarantine lifts;
+    a mismatch raises :class:`ReplayMismatch` (the caller degrades the
+    bucket to terminal — a rebuild that cannot be verified must never
+    silently re-admit)."""
+    t0 = time.perf_counter()
+    sessions = store.sessions_on(bucket)
+    live = {
+        s.slot: (s.sid, data_rows(recorder.history(s.sid)) or [])
+        for s in sessions
+    }
+    with bucket.lock:
+        # the quarantine flag stays SET for the whole rebuild: allocate()
+        # never takes this lock (staged admission), so lifting the flag
+        # early would let a concurrent open stage a write that our own
+        # dispatches below apply into a slot mid-rebuild. Admissions stay
+        # 503-refused until the rebuild is verified; our dispatches go
+        # through the `_healing` override.
+        bucket.reset_slab()
+        for s in sessions:
+            bucket.stage_fresh(s.slot, s.seed)
+        # no on_fail: one divergence invalidates the WHOLE rebuild (the
+        # caller degrades the bucket to terminal)
+        n_replayed = replay_live_coalesced(
+            bucket, live,
+            dispatch=lambda reqs: bucket.dispatch(reqs, _healing=True),
+            alive=store.alive)
+        bucket.heals += 1
+        bucket.quarantined = None
+    return {"sessions": len(sessions), "rounds": n_replayed,
+            "seconds": time.perf_counter() - t0}
+
+
+class BucketHealer:
+    """Runs :func:`heal_bucket` off the batcher thread when a dispatch
+    quarantines a bucket, with bounded retries and exponential backoff.
+
+    One heal thread per bucket at a time; a digest mismatch degrades the
+    bucket to terminal immediately (an unverifiable rebuild must not
+    serve), exhausted retries likewise — everything else re-admits. A
+    bucket that keeps getting re-quarantined is capped at ``max_heals``
+    lifetime rebuilds before degrading (a persistently failing step is a
+    bug, not weather)."""
+
+    def __init__(self, store, recorder, metrics=None, max_attempts: int = 3,
+                 backoff_s: float = 0.05, max_heals: int = 8):
+        self.store = store
+        self.recorder = recorder
+        self.metrics = metrics
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.max_heals = int(max_heals)
+        self._lock = threading.Lock()
+        self._inflight: set = set()
+        self.last_report: dict = {}
+
+    def schedule(self, bucket, error: Optional[BaseException] = None,
+                 sync: bool = False) -> bool:
+        """Kick off a heal for ``bucket`` (idempotent while one is in
+        flight). ``sync`` heals in the calling thread — the test hook."""
+        with self._lock:
+            if bucket.failed is not None or id(bucket) in self._inflight:
+                return False
+            if bucket.heals >= self.max_heals:
+                bucket.failed = (
+                    f"bucket exceeded {self.max_heals} slab rebuilds — "
+                    f"persistent step failure (last: {error!r})")
+                bucket.quarantined = None
+                self._fail_metrics()  # terminal degradation must count
+                return False          # like every other one
+            self._inflight.add(id(bucket))
+        _counter("serve_buckets_quarantined_total",
+                 "Buckets quarantined by a step failure that consumed "
+                 "donated carries").inc()
+        if self.metrics is not None:
+            self.metrics.record_recovery("quarantined")
+        if sync:
+            self._run(bucket)
+            return True
+        threading.Thread(target=self._run, args=(bucket,),
+                         name=f"serve-heal-{bucket.task}",
+                         daemon=True).start()
+        return True
+
+    def _run(self, bucket) -> None:
+        try:
+            last_err: Optional[BaseException] = None
+            for attempt in range(self.max_attempts):
+                try:
+                    info = heal_bucket(bucket, self.store, self.recorder)
+                except ReplayMismatch as e:
+                    bucket.failed = (f"slab rebuild failed digest "
+                                     f"verification: {e}")
+                    bucket.quarantined = None
+                    self._fail_metrics()
+                    return
+                except BaseException as e:
+                    last_err = e
+                    time.sleep(self.backoff_s * (2 ** attempt))
+                    continue
+                self.last_report = info
+                _counter("serve_buckets_healed_total",
+                         "Quarantined buckets rebuilt from session "
+                         "streams and digest-verified").inc()
+                if self.metrics is not None:
+                    self.metrics.record_recovery("healed")
+                return
+            bucket.failed = (f"slab rebuild failed after "
+                             f"{self.max_attempts} attempts: {last_err!r}")
+            bucket.quarantined = None
+            self._fail_metrics()
+        finally:
+            with self._lock:
+                self._inflight.discard(id(bucket))
+
+    def _fail_metrics(self) -> None:
+        _counter("serve_heal_failures_total",
+                 "Bucket rebuilds degraded to terminal (digest mismatch "
+                 "or exhausted retries)").inc()
+        if self.metrics is not None:
+            self.metrics.record_recovery("heal_failed")
+
+
+# ---------------------------------------------------------------------------
+# offline stream verification: `python -m coda_tpu.cli replay-serve <dir>`
+# ---------------------------------------------------------------------------
+
+def verify_session_stream(store, meta: dict, rows, sid: str = "?") -> dict:
+    """Replay one stream into a fresh slab slot and verify it bitwise.
+
+    Returns ``{parity, rounds}``; raises :class:`ReplayMismatch` (or
+    ValueError for a structurally unusable stream) otherwise."""
+    v, want_v = meta.get("v"), _schema_version()
+    if v is not None and v != want_v:
+        raise ValueError(f"stream schema v{v}; this build replays "
+                         f"v{want_v}")
+    task = meta.get("task")
+    if task not in store.tasks():
+        raise ValueError(f"stream's task {task!r} not loaded")
+    want = meta.get("digest")
+    have = store.task_meta(task).get("digest")
+    if want and want != have:
+        raise ValueError(
+            f"dataset digest mismatch: stream recorded {want}, loaded "
+            f"data hashes to {have}")
+    kwargs = {k: v for k, v in (meta.get("spec_kwargs") or [])}
+    spec = SelectorSpec.create(meta.get("method", "coda"), **kwargs)
+    sess = store.open(task, spec, seed=int(meta.get("seed", 0)))
+    try:
+        rows = data_rows(rows)
+        replay_rows_into_slot(sess.bucket, sess.slot, rows, sid=sid)
+    finally:
+        store.close(sess.sid)
+    return {"parity": True, "rounds": len(rows)}
+
+
+def replay_serve_main(argv=None) -> int:
+    """``python -m coda_tpu.cli replay-serve <record-dir> [...]``: verify
+    every serving-session JSONL stream in a record dir by bitwise replay
+    against a fresh slab (exit 2 on any divergence) — the offline twin of
+    ``cli replay`` for the interactive-session records."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="coda_tpu.cli replay-serve",
+        description="replay-verify serving session streams "
+                    "(session_<id>.jsonl) bitwise against a fresh slab")
+    p.add_argument("record_dir", help="a serve --record-dir")
+    p.add_argument("--task", default=None)
+    p.add_argument("--data-dir", default="data")
+    p.add_argument("--synthetic", default=None, metavar="H,N,C",
+                   help="the seeded synthetic task the server ran "
+                        "(must match the recorded dataset digest)")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--session", default=None,
+                   help="verify only this session id")
+    args = p.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
+
+    from coda_tpu.cli import load_dataset
+    from coda_tpu.serve.state import SessionStore
+
+    if args.task or args.synthetic:
+        ds = load_dataset(args)
+    else:
+        from coda_tpu.data import make_synthetic_task
+
+        ds = make_synthetic_task(seed=0, H=8, N=512, C=10)
+    store = SessionStore(capacity=2)
+    store.register_task(ds.name, ds.preds)
+
+    n_ok = n_bad = 0
+    for sid, path in iter_session_streams(args.record_dir):
+        if args.session and sid != args.session:
+            continue
+        try:
+            meta, rows, closed = load_session_stream(path)
+            meta = dict(meta, task=ds.name)  # verify against loaded data
+            info = verify_session_stream(store, meta, rows, sid=sid)
+        except Exception as e:
+            print(f"  session {sid}: DIVERGED/unusable — {e}")
+            n_bad += 1
+            continue
+        print(f"  session {sid}: PARITY ({info['rounds']} rounds"
+              + (", closed" if closed else ", live") + ")")
+        n_ok += 1
+    print(f"verdict: {'PARITY' if n_bad == 0 else 'DIVERGED'} "
+          f"({n_ok} verified, {n_bad} failed)")
+    return 0 if n_bad == 0 else 2

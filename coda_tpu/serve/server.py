@@ -13,14 +13,21 @@ trips. Blocking host sections (admission's bucket lock, posterior reads)
 run on the default executor so the loop never stalls behind them.
 
     POST   /session                  {task?, seed?}    -> admit + first item
-    POST   /session/{id}/label       {label, idx?}     -> update, next item
+    POST   /session/{id}/label       {label, idx?,
+                                      request_id?}     -> update, next item
+                                                          (idempotent on
+                                                          request_id)
     GET    /session/{id}/best                          -> best (+ pbest)
     GET    /session/{id}/trace                         -> per-round decision
                                                           history (recorder)
+    POST   /session/{id}/export      {close?}          -> migration payload
+    POST   /session/import           <export payload>  -> restore, same id
     DELETE /session/{id}                               -> close, free slot
     GET    /stats                                      -> metrics snapshot
     GET    /metrics                                    -> Prometheus text
     GET    /healthz                                    -> readiness/liveness
+                                                          (ok|degraded|
+                                                          unready)
 
 Admission control: a full slab answers 503 (the client's retry signal), as
 does a draining server. ``ServeApp.drain()`` stops admitting, finishes the
@@ -51,7 +58,9 @@ from typing import Optional
 
 from coda_tpu.serve.batcher import Batcher
 from coda_tpu.serve.metrics import ServeMetrics
+from coda_tpu.serve.recovery import ImportRejected
 from coda_tpu.serve.state import (
+    BucketQuarantined,
     SelectorSpec,
     SessionStore,
     SlabFull,
@@ -74,11 +83,18 @@ class ServeApp:
                  default_task: Optional[str] = None,
                  spec: Optional[SelectorSpec] = None,
                  step_impl: Optional[str] = None, donate: bool = True,
-                 telemetry=None, recorder=None):
+                 telemetry=None, recorder=None,
+                 fault_spec: Optional[str] = None):
+        from coda_tpu.serve.faults import FaultInjector
+        from coda_tpu.serve.recovery import BucketHealer
         from coda_tpu.telemetry import SessionRecorder, Telemetry
 
+        # deterministic fault injection (--fault-spec); inert when unset —
+        # every site checks `faults is not None` first
+        self.faults = FaultInjector(fault_spec) if fault_spec else None
         self.store = SessionStore(capacity=capacity, bucket_n=bucket_n,
-                                  step_impl=step_impl, donate=donate)
+                                  step_impl=step_impl, donate=donate,
+                                  faults=self.faults)
         self.metrics = ServeMetrics()
         # always live (registry-backed /metrics needs one); --telemetry-dir
         # upgrades it to an artifact-writing instance
@@ -87,20 +103,33 @@ class ServeApp:
         # GET /session/{id}/trace payload); --record-dir upgrades to
         # crash-safe append-only JSONL files per session
         self.recorder = recorder if recorder is not None \
-            else SessionRecorder()
+            else SessionRecorder(faults=self.faults)
+        if self.faults is not None and \
+                getattr(self.recorder, "faults", None) is None:
+            # an injected recorder joins the fault domain too (record_eio)
+            self.recorder.faults = self.faults
         self.batcher = Batcher(self.store, self.metrics,
                                max_batch=max_batch, max_wait=max_wait,
                                max_linger=max_linger,
                                telemetry=self.telemetry,
-                               recorder=self.recorder)
+                               recorder=self.recorder,
+                               faults=self.faults)
+        # bucket self-healing: a dispatch that quarantines a bucket (step
+        # failure consumed the donated carries) schedules a slab rebuild
+        # from the sessions' recorder streams, digest-verified
+        self.healer = BucketHealer(self.store, self.recorder,
+                                   metrics=self.metrics)
+        self.batcher.on_bucket_failure = self.healer.schedule
         self.spec = spec or SelectorSpec.create("coda", n_parallel=capacity)
         self.default_task = default_task
         self.draining = False
+        self.warm_error: Optional[str] = None  # last warm-up failure
         # readiness: set once the warm pool is compiled (or warm-up was
         # explicitly skipped). /healthz answers 503 until then — the load
         # balancer's signal to keep traffic off a still-compiling replica.
         self.ready = threading.Event()
         self.warm_info: dict = {}
+        self._warm_requested = False  # whether start() asked for the pool
         self._seed_lock = threading.Lock()
         self._next_seed = 0
         # blocking-verb executor for the asyncio front door: sized for a
@@ -160,12 +189,28 @@ class ServeApp:
                   f"{info['warm_s']:.1f}s")
         except Exception as e:  # degraded but serviceable: the lazy-jit
             # fallback still answers; readiness unblocks so the server
-            # isn't bricked by one bucket's warm-up failure
-            print(f"warm-up failed ({e}); serving with lazy compilation")
+            # isn't bricked by one bucket's warm-up failure. Routed
+            # through the telemetry registry (not a bare print) so the
+            # failure is visible on /metrics, /stats, and /healthz
+            # (status "degraded"), not just a scrolled-away console line.
+            self._record_warm_failure(e)
             self.ready.set()
+
+    def _record_warm_failure(self, e: BaseException) -> None:
+        self.warm_error = repr(e)
+        reg = self.telemetry.registry
+        reg.counter("serve_warmup_failures_total",
+                    "Warm-pool compilations that failed (server degraded "
+                    "to lazy jit)").inc()
+        reg.gauge("serve_warmup_last_failure_timestamp",
+                  "Unix time of the last warm-pool failure").set(
+                      # wall-clock: a *_timestamp gauge carries Unix time
+                      time.time())
+        print(f"warm-up failed ({e}); serving with lazy compilation")
 
     def start(self, warm: bool = True,
               warm_async: bool = False) -> "ServeApp":
+        self._warm_requested = warm
         self.batcher.start()
         if not warm:
             self.ready.set()
@@ -173,13 +218,32 @@ class ServeApp:
             threading.Thread(target=self._warm_background, daemon=True,
                              name="serve-warmup").start()
         else:
-            self.warm()
+            # same degrade-don't-crash contract as the background path: a
+            # warm-up failure leaves a serviceable lazy-jit server (the
+            # --restore startup warms synchronously and must not be
+            # bricked by one bucket's compile failure)
+            self._warm_background()
         return self
+
+    def quiesce(self, timeout: float = 30.0, hard: bool = False) -> None:
+        """Stop admitting and stop ticking — but keep sessions, recorder
+        streams, and the executor alive. The migration half-step: after
+        quiesce, every live session can be exported
+        (``recovery.export_all``) and handed to a fresh server; ``drain``
+        completes the shutdown.
+
+        Default: finish queued work first. ``hard`` cuts immediately —
+        queued tickets fail with a retryable error and land on the new
+        server via client retry; under LIVE retrying load this is the
+        only cut that leaves sessions to migrate (a soft drain races the
+        clients, who keep finishing and closing sessions while the queue
+        waits to go quiet)."""
+        self.draining = True
+        self.batcher.stop(drain=not hard, timeout=timeout)
 
     def drain(self, timeout: float = 30.0) -> None:
         """Graceful shutdown: refuse new sessions, finish queued requests."""
-        self.draining = True
-        self.batcher.stop(drain=True, timeout=timeout)
+        self.quiesce(timeout=timeout)
         self.recorder.close_all()
         self._executor.shutdown(wait=False)
 
@@ -207,9 +271,16 @@ class ServeApp:
             self.metrics.record_session("reject")
             raise
         self.metrics.record_session("open")
+        tm = self.store.task_meta(sess.task)
+        # everything crash restore / offline replay needs to rebuild this
+        # session from its stream alone: selector config, and the dataset
+        # shape+digest guard (replaying against different data answers a
+        # different question)
         self.recorder.open(sess.sid, meta={
             "task": sess.task, "method": self.spec.method,
-            "seed": sess.seed})
+            "spec_kwargs": [list(kv) for kv in self.spec.kwargs],
+            "seed": sess.seed, "shape": tm.get("shape"),
+            "digest": tm.get("digest")})
         return sess, self.batcher.submit_start(sess)
 
     def _open_abort(self, sess) -> None:
@@ -260,8 +331,40 @@ class ServeApp:
             raise
         return self._payload(sess, res)
 
-    def _label_begin(self, sid: str, label: int, idx: Optional[int]):
+    def _label_begin(self, sid: str, label: int, idx: Optional[int],
+                     request_id: Optional[str] = None):
+        from coda_tpu.serve.batcher import Ticket
+
         sess = self.store.get(sid)
+        if sess.restoring:
+            # import/restore is mid-replay: the posterior and the dedupe
+            # cache are not rebuilt yet, so a label now could double-apply
+            # — retryable 503, same contract as the quarantine heal
+            raise BucketQuarantined(
+                f"session {sid} is being restored; retry shortly")
+        # idempotent retries: a request_id the session has already applied
+        # (or has in flight) is answered from the committed result / the
+        # live ticket — the oracle answer is applied to the posterior
+        # EXACTLY once no matter how many times the client retries. Checked
+        # BEFORE the stale-idx guard: a retry of an applied label is stale
+        # by definition, and that staleness is precisely what it means to
+        # have already been applied. Restore/import repopulate the cache
+        # from the recorder stream, so dedupe survives migration too.
+        if request_id is not None:
+            with self.store.lock:
+                done = sess.recent.get(request_id)
+                inflight = None if done is not None else \
+                    sess.pending.get(request_id)
+                if inflight is not None and inflight.done.is_set() \
+                        and inflight.error is not None:
+                    inflight = None  # dead ticket: let the retry resubmit
+            if done is not None:
+                t = Ticket(session=sess, do_update=True,
+                           request_id=request_id)
+                t.complete(dict(done))
+                return sess, t
+            if inflight is not None:
+                return sess, inflight
         cur = sess.last
         if not cur:
             raise UnknownSession(sid)  # start dispatch never completed
@@ -273,22 +376,47 @@ class ServeApp:
         if not 0 <= label < sess.bucket.n_classes:
             raise ValueError(f"label {label} out of range "
                              f"[0, {sess.bucket.n_classes})")
-        return sess, self.batcher.submit_label(
-            sess, idx=cur["next_idx"], label=label, prob=cur["next_prob"])
+        ticket = Ticket(session=sess, do_update=True, idx=cur["next_idx"],
+                        label=label, prob=cur["next_prob"],
+                        request_id=request_id)
+        if request_id is not None:
+            # registration is atomic with a re-check, so two concurrent
+            # retries of the same request_id can never BOTH submit
+            with self.store.lock:
+                done = sess.recent.get(request_id)
+                if done is None:
+                    existing = sess.pending.get(request_id)
+                    if existing is not None and not (
+                            existing.done.is_set()
+                            and existing.error is not None):
+                        return sess, existing
+                    sess.pending[request_id] = ticket
+            if done is not None:
+                ticket.complete(dict(done))
+                return sess, ticket
+        return sess, self.batcher.submit(ticket)
 
-    def label(self, sid: str, label: int, idx: Optional[int] = None) -> dict:
-        sess, ticket = self._label_begin(sid, label, idx)
+    def label(self, sid: str, label: int, idx: Optional[int] = None,
+              request_id: Optional[str] = None) -> dict:
+        sess, ticket = self._label_begin(sid, label, idx, request_id)
         return self._payload(sess, ticket.wait(REQUEST_TIMEOUT_S))
 
     async def label_async(self, sid: str, label: int,
-                          idx: Optional[int] = None) -> dict:
+                          idx: Optional[int] = None,
+                          request_id: Optional[str] = None) -> dict:
         # no executor hop: _label_begin is pure host-dict work (session
         # lookup, bounds checks, queue.put) — microseconds on the loop
-        sess, ticket = self._label_begin(sid, label, idx)
+        sess, ticket = self._label_begin(sid, label, idx, request_id)
         return self._payload(sess, await ticket.wait_async(REQUEST_TIMEOUT_S))
 
     def best(self, sid: str) -> dict:
         sess = self.store.get(sid)
+        if sess.restoring:
+            # the slot holds a partially-replayed posterior and n_labeled
+            # is still 0 — answering now would serve a wrong best-model
+            # estimate with a 200; same retryable contract as label
+            raise BucketQuarantined(
+                f"session {sid} is being restored; retry shortly")
         out = self._payload(sess, sess.last or None)
         with sess.bucket.lock:
             pbest = sess.bucket.pbest(sess.slot)
@@ -297,6 +425,12 @@ class ServeApp:
         return out
 
     def close_session(self, sid: str) -> dict:
+        if self.store.get(sid).restoring:
+            # freeing the slot mid-replay would let a new admission take
+            # it while the restore keeps dispatching recorded rounds into
+            # it — corrupting whichever session lands there
+            raise BucketQuarantined(
+                f"session {sid} is being restored; retry shortly")
         self.store.close(sid)
         self.recorder.close(sid)
         self.metrics.record_session("close")
@@ -308,14 +442,92 @@ class ServeApp:
         session rode, with the proposed item, best-model answer, and the
         label that was applied)."""
         sess = self.store.get(sid)   # raises UnknownSession for dead ids
+        if sess.restoring:
+            # import_history lands only after the replay verifies; a trace
+            # served now would be empty/partial, not the session's history
+            raise BucketQuarantined(
+                f"session {sid} is being restored; retry shortly")
         rounds = self.recorder.history(sid) or []
         return {"session": sid, "task": sess.task,
                 "n_labeled": sess.n_labeled, "rounds": rounds}
 
+    def export_session(self, sid: str, close: bool = False) -> dict:
+        """The migration verb behind ``POST /session/{id}/export``: a
+        self-contained payload (recorder stream + fingerprint-guarded
+        carries snapshot) any same-task server can import. ``close`` frees
+        the slot once the payload is built — the drain handoff."""
+        from coda_tpu.serve import recovery
+
+        payload = recovery.export_session(self, sid)
+        if close:
+            self.close_session(sid)
+        return payload
+
+    def import_session(self, payload: dict) -> dict:
+        """The restore verb behind ``POST /session/import``: admit the
+        exported session under its ORIGINAL id (the client's handle
+        survives the migration), restore its posterior via the
+        digest-verified snapshot fast path or bitwise stream replay, and
+        answer like a normal session verb."""
+        from coda_tpu.serve import recovery
+
+        if self.draining:
+            self.metrics.record_session("reject")
+            raise Draining()
+        try:
+            info = recovery.import_session(self, payload)
+        except BaseException:
+            # a restore replay dispatch that consumed donated carries
+            # quarantines its bucket WITHOUT passing through the batcher's
+            # failure hook (imports never ride a tick) — kick the heal
+            # here so retried imports find a rebuilt slab, not a 503 loop
+            self._heal_quarantined()
+            raise
+        sess = self.store.get(info["session"])
+        out = self._payload(sess, sess.last or None)
+        out.update(restored_via=info["restored_via"],
+                   rounds=info["rounds"])
+        return out
+
+    def restore_sessions(self, record_dir: Optional[str] = None) -> dict:
+        """Rebuild every un-closed session stream in ``record_dir`` (the
+        crash-restart path; ``--restore`` runs it at startup)."""
+        from coda_tpu.serve import recovery
+
+        report = recovery.restore_app_sessions(self, record_dir)
+        self._heal_quarantined()  # a failed restore replay must not leave
+        return report             # a bucket 503-refused with no heal queued
+
+    def _heal_quarantined(self) -> None:
+        for b in self.store.buckets():
+            if b.quarantined is not None:
+                self.healer.schedule(b)
+
     def healthz(self) -> dict:
         ready = self.ready.is_set()
+        # three-state readiness for the load balancer: "unready" (warm
+        # pool still compiling — take no traffic), "degraded" (serving,
+        # but something needs attention: a failed/quarantined/lazy bucket,
+        # a warm-up failure, or recorder streams downgraded to
+        # memory-only), "ok". Degraded stays 200 — the process is live
+        # and answering; the status string is the operator's signal.
+        buckets = self.store.buckets()
+        problems = []
+        if self.warm_error:
+            problems.append("warmup_failed")
+        if any(b.failed is not None for b in buckets):
+            problems.append("buckets_failed")
+        if any(b.quarantined is not None for b in buckets):
+            problems.append("buckets_quarantined")
+        if self._warm_requested and any(not b.is_warm for b in buckets):
+            problems.append("buckets_lazy")
+        if getattr(self.recorder, "degraded_streams", 0):
+            problems.append("recorder_degraded")
+        status = ("unready" if not ready
+                  else "degraded" if problems else "ok")
         return {"ok": ready and not self.draining, "ready": ready,
-                "draining": self.draining}
+                "draining": self.draining, "status": status,
+                "problems": problems}
 
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
@@ -337,9 +549,16 @@ class ServeApp:
              "shape": list(b.shape), "capacity": b.capacity, "live": b.live,
              "warm": b.is_warm, "warm_s": b.warm_s,
              "warm_hits": b.warm_hits, "warm_misses": b.warm_misses,
-             "failed": b.failed}
+             "failed": b.failed, "quarantined": b.quarantined,
+             "heals": b.heals}
             for b in self.store.buckets()
         ]
+        snap["warm_error"] = self.warm_error
+        snap["recorder_degraded_streams"] = int(
+            getattr(self.recorder, "degraded_streams", 0))
+        snap["status"] = self.healthz()["status"]
+        if self.faults is not None:
+            snap["faults"] = self.faults.snapshot()
         return snap
 
     def _payload(self, sess, res: Optional[dict]) -> dict:
@@ -366,7 +585,8 @@ class StaleItem(ValueError):
     """The labeled idx is not the item the session proposed."""
 
 
-_SESSION_RE = re.compile(r"^/session/([0-9a-f]+)(/(label|best|trace))?$")
+_SESSION_RE = re.compile(
+    r"^/session/([0-9a-f]+)(/(label|best|trace|export))?$")
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             409: "Conflict", 500: "Internal Server Error",
@@ -541,6 +761,12 @@ class AsyncHTTPServer:
                     _JSON)
         except SlabFull as e:
             return 503, {"error": f"busy: {e}"}, _JSON
+        except BucketQuarantined as e:
+            # the slab is being rebuilt from session streams — transient,
+            # retryable: 503 like every other backpressure signal
+            return 503, {"error": f"healing: {e}"}, _JSON
+        except ImportRejected as e:
+            return 409, {"error": f"import rejected: {e}"}, _JSON
         except UnknownSession as e:
             app.metrics.record_session("request_reject")
             return 404, {"error": f"unknown session {e}"}, _JSON
@@ -562,6 +788,12 @@ class AsyncHTTPServer:
         app = self.app
         loop = asyncio.get_running_loop()
         m = _SESSION_RE.match(path)
+        if method == "POST" and path == "/session/import":
+            # restore an exported session (replay/snapshot verification is
+            # real compute — never on the event loop)
+            req = json.loads(raw or b"{}")
+            return await loop.run_in_executor(app._executor,
+                                              app.import_session, req)
         if method == "POST" and path == "/session":
             req = json.loads(raw or b"{}")
             return await app.open_session_async(task=req.get("task"),
@@ -571,7 +803,14 @@ class AsyncHTTPServer:
             if "label" not in req:
                 raise ValueError("missing 'label'")
             return await app.label_async(m.group(1), req["label"],
-                                         idx=req.get("idx"))
+                                         idx=req.get("idx"),
+                                         request_id=req.get("request_id"))
+        if m and method == "POST" and m.group(3) == "export":
+            req = json.loads(raw or b"{}")
+            return await loop.run_in_executor(
+                app._executor,
+                lambda: app.export_session(m.group(1),
+                                           close=bool(req.get("close"))))
         if m and method == "GET" and m.group(3) == "best":
             return await loop.run_in_executor(app._executor, app.best,
                                               m.group(1))
@@ -654,6 +893,19 @@ def parse_args(argv=None):
                         "(crash-safe: every completed dispatch is flushed); "
                         "GET /session/{id}/trace serves the same stream "
                         "live either way")
+    p.add_argument("--restore", action="store_true",
+                   help="at startup, rebuild every un-closed session "
+                        "stream found in --record-dir by bitwise replay "
+                        "(the crash-restart path: a SIGKILLed server "
+                        "restarted with --restore resumes its sessions)")
+    p.add_argument("--fault-spec", default=None, metavar="SPEC",
+                   help="deterministic fault injection (serve/faults.py): "
+                        "'name:param=v,...[;name:...]' with names "
+                        "step_raise | step_nan | record_eio | slow_step | "
+                        "crash_before_tick | crash_after_tick and triggers "
+                        "after=N / every=N / p=F,seed=S (e.g. "
+                        "'step_raise:after=100') — exercises the recovery "
+                        "paths under real traffic")
     return p.parse_args(argv)
 
 
@@ -688,6 +940,7 @@ def build_app(args) -> ServeApp:
         step_impl=getattr(args, "step_impl", None),
         donate=not getattr(args, "no_donate", False),
         telemetry=telemetry, recorder=recorder,
+        fault_spec=getattr(args, "fault_spec", None),
     )
     if args.task or args.synthetic:
         ds = load_dataset(args)
@@ -707,9 +960,20 @@ def main(argv=None):
     pin_platform(args.platform)
 
     app = build_app(args)
-    # warm in the background so the socket binds immediately and /healthz
-    # gates traffic until the pool is compiled (or deserialized)
-    app.start(warm=not args.no_warm, warm_async=True)
+    if args.restore and args.record_dir:
+        # crash restore BEFORE taking traffic: rebuild every un-closed
+        # session stream (bitwise replay-verified), then open the doors
+        app.start(warm=not args.no_warm)   # restore wants warm executables
+        report = app.restore_sessions(args.record_dir)
+        print(f"restored {len(report['restored'])} session(s) from "
+              f"{args.record_dir} "
+              f"({report['skipped_closed']} closed, "
+              f"{len(report['failed'])} failed"
+              + (f": {report['failed']}" if report["failed"] else "") + ")")
+    else:
+        # warm in the background so the socket binds immediately and
+        # /healthz gates traffic until the pool is compiled/deserialized
+        app.start(warm=not args.no_warm, warm_async=True)
     srv = make_server(app, args.port)
     print(f"serving {app.default_task!r} ({app.spec.method}) on "
           f"http://127.0.0.1:{srv.server_address[1]}/ — capacity "

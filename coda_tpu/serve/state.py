@@ -48,6 +48,13 @@ class UnknownSession(KeyError):
     """No live session with that id."""
 
 
+class BucketQuarantined(RuntimeError):
+    """The bucket's slab was lost to a step failure and is being rebuilt
+    from its sessions' recorder streams (``serve/recovery.py``) — retry
+    shortly. Distinct from the terminal ``failed`` state, which only a
+    digest mismatch or exhausted heal retries produces."""
+
+
 # ---------------------------------------------------------------------------
 # selector specs: a picklable/hashable description of a selector config
 # ---------------------------------------------------------------------------
@@ -112,6 +119,13 @@ class SlotResult(NamedTuple):
     next_prob: Any   # (S,) float32 — its selection probability / q-value
     best: Any        # (S,) int32 — current best-model estimate
     stochastic: Any  # (S,) bool — did RNG affect this slot's step?
+    # P(best) posterior digest of the post-update state (NaN when the
+    # method exposes no ``get_pbest``): the same (max, entropy-bits) pair
+    # the flight recorder captures per round. Computed INSIDE the one
+    # compiled step — no extra dispatch — it is what makes restored /
+    # healed sessions verifiable bitwise against their recorder streams.
+    pbest_max: Any      # (S,) float32
+    pbest_entropy: Any  # (S,) float32
 
 
 def _tree_where(flag, new, old):
@@ -164,6 +178,10 @@ def make_slab_step(selector, impl: Optional[str] = None):
         raise ValueError(f"unknown slab-step impl {impl!r} "
                          "(use 'vmap' or 'map')")
 
+    from coda_tpu.ops.masked import entropy2
+
+    get_pbest = selector.extras.get("get_pbest")
+
     def one(state0, key0, req):
         # masked oracle update: compute unconditionally (every slot runs one
         # program), keep only where requested
@@ -176,6 +194,14 @@ def make_slab_step(selector, impl: Optional[str] = None):
         key2, k_best = jax.random.split(key1)
         res = selector.select(state1, k_sel)
         best, b_stoch = selector.best(state1, k_best)
+        # posterior digest of the post-update state (mirrors the flight
+        # recorder's per-round pbest_max/pbest_entropy capture exactly)
+        if get_pbest is not None:
+            pb = get_pbest(state1).astype(jnp.float32)
+            d_max, d_ent = pb.max(), entropy2(pb)
+        else:
+            d_max = jnp.asarray(jnp.nan, jnp.float32)
+            d_ent = jnp.asarray(jnp.nan, jnp.float32)
         state_out = _tree_where(req.pending, state1, state0)
         key_out = jnp.where(req.pending, key2, key0)
         return state_out, key_out, SlotResult(
@@ -183,6 +209,8 @@ def make_slab_step(selector, impl: Optional[str] = None):
             next_prob=res.prob.astype(jnp.float32),
             best=best.astype(jnp.int32),
             stochastic=res.stochastic | b_stoch,
+            pbest_max=d_max,
+            pbest_entropy=d_ent,
         )
 
     if impl == "map":
@@ -231,13 +259,15 @@ class Bucket:
 
     def __init__(self, preds, spec: SelectorSpec, capacity: int,
                  n_valid: Optional[int] = None, task: str = "",
-                 step_impl: Optional[str] = None, donate: bool = True):
+                 step_impl: Optional[str] = None, donate: bool = True,
+                 faults=None):
         import jax
         import jax.numpy as jnp
 
         self.task = task
         self.spec = spec
         self.capacity = int(capacity)
+        self.step_impl = step_impl  # as requested (None = backend default)
         # serializes this bucket's slab ACCESS (the batcher's dispatch,
         # posterior reads) — allocate/release never take it; they stage
         # writes under _host_lock instead (see allocate). Other buckets
@@ -293,16 +323,31 @@ class Bucket:
         self._n_warm = 0      # executables the last successful warm() built
         self.warm_hits = 0    # dispatches served by the AOT executable
         self.warm_misses = 0  # dispatches that fell back to lazy jit
-        # a step failure that consumed donated carries leaves the slab
-        # unrecoverable; record WHY so later dispatches/admissions fail
-        # loudly and attributably instead of with 'Array has been deleted'
+        # a step failure that consumed donated carries loses the slab.
+        # ``quarantined`` marks the slab lost-but-healable: recovery
+        # rebuilds it by replaying every live session's recorder stream
+        # (serve/recovery.py) and clears the mark on digest-verified
+        # success. ``failed`` stays the TERMINAL state — only a digest
+        # mismatch during heal or exhausted heal retries set it — so
+        # later dispatches/admissions fail loudly and attributably
+        # instead of with 'Array has been deleted'.
         self.failed: Optional[str] = None
+        self.quarantined: Optional[str] = None
+        self.heals = 0           # successful slab rebuilds (stats evidence)
+        self._faults = faults    # optional FaultInjector (serve/faults.py)
+        # standalone posterior-digest read (built lazily in digest()):
+        # mirrors the in-step digest so an imported snapshot verifies
+        # against the stream's last recorded digest without a dispatch
+        self._digest_fn = None
         self.last_timing: dict = {}  # per-dispatch phase wall times
         # the slab: state pytree with a leading (capacity,) slot axis. All
         # slots start from init(key=0) — real sessions overwrite their slot
-        # at admission, so the filler only fixes shapes/dtypes.
+        # at admission, so the filler only fixes shapes/dtypes. Kept as a
+        # bound jit so the heal path can reallocate a fresh slab without
+        # re-tracing (reset_slab).
+        self._slab_init = jax.jit(jax.vmap(self.selector.init))
         dummy = jnp.zeros((self.capacity, 2), jnp.uint32)
-        self.states = jax.jit(jax.vmap(self.selector.init))(dummy)
+        self.states = self._slab_init(dummy)
         self.keys = jnp.zeros((self.capacity, 2), jnp.uint32)
         # LIFO free list: a just-closed slot is the next one reused, which
         # keeps the slab's live region dense and is trivially testable.
@@ -404,29 +449,26 @@ class Bucket:
             self._step_exec = step_exec
             return {"executables": n, "seconds": self.warm_s}
 
-    # -- slot lifecycle (no bucket lock needed: slab writes are staged) ----
-    def allocate(self, seed: int) -> int:
-        """Take a free slot and stage its freshly-initialized state.
-
-        Runs WITHOUT the bucket (dispatch) lock: the init computation
-        touches no slab array, and the produced (slot, state, key) row is
-        staged for the next lock holder to apply — so admission latency is
-        one init executable, never an in-flight slab step."""
-        import jax
-        import jax.numpy as jnp
-
+    def _check_available(self) -> None:
+        """Raise attributably when the slab cannot be touched."""
         if self.failed is not None:
             raise RuntimeError(
                 f"bucket {self.task}/{self.spec.method} is failed "
                 f"(restart to recover): {self.failed}")
-        with self._host_lock:
-            if not self._free:
-                raise SlabFull(
-                    f"bucket {self.task}/{self.spec.method}: all "
-                    f"{self.capacity} slots live")
-            slot = self._free.pop()
-        # reference key stream: PRNGKey(seed); init() consumes one split
-        # (always — even when the cached init state makes its VALUE moot)
+        if self.quarantined is not None:
+            raise BucketQuarantined(
+                f"bucket {self.task}/{self.spec.method} is quarantined "
+                f"(slab rebuild in progress, retry shortly): "
+                f"{self.quarantined}")
+
+    def _fresh_slot_state(self, seed: int):
+        """Reference-choreography ``(state, key)`` for a new session:
+        ``PRNGKey(seed)``, init consumes one split (always — even when the
+        cached key-independent init state makes its VALUE moot). Shared by
+        admission and the heal/restore replay paths."""
+        import jax
+        import jax.numpy as jnp
+
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         if self._init_state is not None:
@@ -437,8 +479,26 @@ class Bucket:
             state = init(sub.astype(jnp.uint32))
             if self.n_valid < self.shape[1]:
                 state = _deactivate_padded(state, self.n_valid)
+        return state, key.astype(jnp.uint32)
+
+    # -- slot lifecycle (no bucket lock needed: slab writes are staged) ----
+    def allocate(self, seed: int) -> int:
+        """Take a free slot and stage its freshly-initialized state.
+
+        Runs WITHOUT the bucket (dispatch) lock: the init computation
+        touches no slab array, and the produced (slot, state, key) row is
+        staged for the next lock holder to apply — so admission latency is
+        one init executable, never an in-flight slab step."""
+        self._check_available()
         with self._host_lock:
-            self._staged.append((slot, state, key.astype(jnp.uint32)))
+            if not self._free:
+                raise SlabFull(
+                    f"bucket {self.task}/{self.spec.method}: all "
+                    f"{self.capacity} slots live")
+            slot = self._free.pop()
+        state, key = self._fresh_slot_state(seed)
+        with self._host_lock:
+            self._staged.append((slot, state, key))
         return slot
 
     def release(self, slot: int) -> None:
@@ -475,12 +535,17 @@ class Bucket:
             return self.capacity - len(self._free)
 
     # -- the dispatch (batcher thread, holding this bucket's lock) ---------
-    def dispatch(self, requests: dict) -> dict:
+    def dispatch(self, requests: dict, _healing: bool = False) -> dict:
         """Run ONE compiled masked step over the whole slab.
 
         ``requests``: slot -> dict(do_update, idx, label, prob). Every slot
         executes; only requesting slots advance state/keys and get a result
         row back. Returns slot -> result dict (host scalars).
+
+        ``_healing`` is the rebuild path's override: ``heal_bucket`` keeps
+        the quarantine flag SET while it replays streams into the fresh
+        slab (so admissions stay 503-refused for the whole rebuild) and
+        dispatches through it with this flag.
 
         Phase wall times land in ``last_timing`` (build = host input prep,
         step = executable call through host sync) so the batcher can
@@ -491,10 +556,13 @@ class Bucket:
         import jax
         import jax.numpy as jnp
 
-        if self.failed is not None:
-            raise RuntimeError(
-                f"bucket {self.task}/{self.spec.method} is failed "
-                f"(restart to recover): {self.failed}")
+        if _healing:
+            if self.failed is not None:
+                raise RuntimeError(
+                    f"bucket {self.task}/{self.spec.method} is failed "
+                    f"(restart to recover): {self.failed}")
+        else:
+            self._check_available()
         t0 = _time.perf_counter()
         self._apply_staged()  # admissions since the last slab access
         S = self.capacity
@@ -523,28 +591,52 @@ class Bucket:
             # /stats can show the warm pool actually covered the traffic
             self.warm_misses += 1
             step = self._step
+        if self._faults is not None:
+            self._faults.fire("step_pre", task=self.task)  # slow_step
         try:
-            self.states, self.keys, out = step(self.states, self.keys, req)
+            new_states, new_keys, out = step(self.states, self.keys, req)
+            if self._faults is not None:
+                # step_raise injects HERE: the executable has run, so with
+                # donation the old carries are already consumed — exactly
+                # the production failure the quarantine path recovers from
+                self._faults.fire("step_post", task=self.task)
+            self.states, self.keys = new_states, new_keys
         except BaseException as e:
             # with donation, a failed execution may have consumed the
-            # carry buffers — the slab is then unrecoverable: mark the
-            # bucket failed so every later dispatch/admission gets an
-            # attributable error instead of 'Array has been deleted'
+            # carry buffers — the slab is then LOST, but not the sessions:
+            # quarantine the bucket so recovery can rebuild the slab from
+            # the sessions' recorder streams (serve/recovery.py); until it
+            # does, dispatch/admission get an attributable error instead
+            # of 'Array has been deleted'
             if self.donate and any(
                     getattr(x, "is_deleted", lambda: False)()
                     for x in jax.tree.leaves((self.states, self.keys))):
-                self.failed = (f"slab step failed after consuming donated "
-                               f"carries: {e!r}")
+                self.quarantined = (
+                    f"slab step failed after consuming donated carries: "
+                    f"{e!r}")
             raise
         out = jax.tree.map(np.asarray, out)  # one host sync for the batch
+        if self._faults is not None and "step_nan" in self._faults.fire(
+                "step_out", task=self.task):
+            # simulated numeric corruption: poison the outputs the digest
+            # verification must catch (the silent-degradation probe)
+            out = out._replace(
+                next_prob=np.full_like(out.next_prob, np.nan),
+                pbest_max=np.full_like(out.pbest_max, np.nan),
+                pbest_entropy=np.full_like(out.pbest_entropy, np.nan))
         t2 = _time.perf_counter()
         self.last_timing = {"build_s": t1 - t0, "step_s": t2 - t1}
+        has_digest = self._get_pbest is not None
         return {
             slot: {
                 "next_idx": int(out.next_idx[slot]),
                 "next_prob": float(out.next_prob[slot]),
                 "best": int(out.best[slot]),
                 "stochastic": bool(out.stochastic[slot]),
+                "pbest_max": (float(out.pbest_max[slot]) if has_digest
+                              else None),
+                "pbest_entropy": (float(out.pbest_entropy[slot])
+                                  if has_digest else None),
             }
             for slot in requests
         }
@@ -561,9 +653,99 @@ class Bucket:
         ``get_pbest`` extra) — the cheap posterior read behind GET /best."""
         if self._get_pbest is None:
             return None
+        self._check_available()
         fn = self._pbest_exec if self._pbest_exec is not None \
             else self._get_pbest
         return np.asarray(fn(self.slot_state(slot)))
+
+    # -- checkpoint / heal support (serve/recovery.py drives these) --------
+    def digest(self, slot: int):
+        """(pbest_max, pbest_entropy) of one slot's CURRENT state, or None
+        when the method exposes no posterior — the same two float32 words
+        the slab step emits per round, read standalone so an imported
+        snapshot verifies against its stream's last recorded digest
+        without spending a dispatch. Caller holds ``lock``."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._get_pbest is None:
+            return None
+        if self._digest_fn is None:
+            from coda_tpu.ops.masked import entropy2
+
+            get_pbest = self.selector.extras["get_pbest"]
+
+            def _digest(state):
+                pb = get_pbest(state).astype(jnp.float32)
+                return pb.max(), entropy2(pb)
+
+            self._digest_fn = jax.jit(_digest)
+        m, e = self._digest_fn(self.slot_state(slot))
+        return float(np.asarray(m)), float(np.asarray(e))
+
+    def snapshot_slot(self, slot: int):
+        """Host-materialized ``(state leaves, key)`` of one slot.
+
+        Takes the dispatch lock and converts every leaf to numpy BEFORE
+        returning: with donated buffers, the next slab step CONSUMES the
+        arrays a lock-free reader would still be holding ('Array has been
+        deleted' mid-export) — the export/donation race. The snapshot is
+        therefore a stable host copy no later dispatch can invalidate."""
+        import jax
+
+        with self.lock:
+            self._check_available()
+            state = self.slot_state(slot)
+            leaves = [np.asarray(x) for x in jax.tree.leaves(state)]
+            key = np.asarray(self.keys[slot])
+        return leaves, key
+
+    def restore_slot(self, slot: int, leaves, key) -> None:
+        """Overwrite a slot's carries with imported host leaves (staged
+        like an admission write; the slot must already be allocated). The
+        leaf list is order/shape/dtype-checked against this bucket's own
+        state structure — the structural half of the import fingerprint
+        guard."""
+        import jax
+        import jax.numpy as jnp
+
+        ref, _ = self._fresh_slot_state(0)
+        ref_leaves, treedef = jax.tree.flatten(ref)
+        if len(leaves) != len(ref_leaves):
+            raise ValueError(
+                f"snapshot carries {len(leaves)} leaves; this bucket's "
+                f"state has {len(ref_leaves)}")
+        cast = []
+        for got, want in zip(leaves, ref_leaves):
+            arr = np.asarray(got)
+            if arr.shape != want.shape or arr.dtype != want.dtype:
+                raise ValueError(
+                    f"snapshot leaf {arr.dtype}{arr.shape} != bucket "
+                    f"state leaf {want.dtype}{want.shape}")
+            cast.append(jnp.asarray(arr))
+        state = jax.tree.unflatten(treedef, cast)
+        with self._host_lock:
+            self._staged.append(
+                (slot, state, jnp.asarray(np.asarray(key), jnp.uint32)))
+
+    def stage_fresh(self, slot: int, seed: int) -> None:
+        """Stage a freshly-initialized state for an ALLOCATED slot — the
+        replay-restore entry point: replay starts from the reference init
+        (overriding any previously staged snapshot write; staged rows
+        apply in order, last write wins)."""
+        state, key = self._fresh_slot_state(seed)
+        with self._host_lock:
+            self._staged.append((slot, state, key))
+
+    def reset_slab(self) -> None:
+        """Reallocate a fresh zero slab in place of one lost to a failed
+        donated step — the heal path's first move (caller holds ``lock``
+        and then replays every live slot's stream into the new slab)."""
+        import jax.numpy as jnp
+
+        dummy = jnp.zeros((self.capacity, 2), jnp.uint32)
+        self.states = self._slab_init(dummy)
+        self.keys = jnp.zeros((self.capacity, 2), jnp.uint32)
 
 
 # ---------------------------------------------------------------------------
@@ -581,6 +763,18 @@ class Session:
     seed: int
     n_labeled: int = 0
     last: dict = field(default_factory=dict)  # most recent SlotResult row
+    # idempotent-label bookkeeping: client-supplied request_id -> the
+    # completed result row (bounded LRU), and -> the in-flight Ticket. A
+    # retried label with a known request_id is answered from here instead
+    # of re-applied to the posterior; restore/import repopulate ``recent``
+    # from the recorder stream so retries survive a process death too.
+    recent: dict = field(default_factory=dict)
+    pending: dict = field(default_factory=dict)
+    # set while import/restore is mid-replay: the sid is already published
+    # (the client's handle must resolve) but the posterior and the dedupe
+    # cache are not rebuilt yet — label dispatches answer retryable 503
+    # instead of 404-ing or double-applying (cleared when restore completes)
+    restoring: bool = False
 
 
 def _round_up(n: int, quantum: int) -> int:
@@ -606,7 +800,8 @@ class SessionStore:
     """
 
     def __init__(self, capacity: int = 64, bucket_n: int = 1,
-                 step_impl: Optional[str] = None, donate: bool = True):
+                 step_impl: Optional[str] = None, donate: bool = True,
+                 faults=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         if bucket_n < 1:
@@ -615,6 +810,7 @@ class SessionStore:
         self.bucket_n = bucket_n
         self.step_impl = step_impl
         self.donate = donate
+        self.faults = faults                 # shared FaultInjector or None
         self._tasks: dict[str, Any] = {}     # name -> (H, N, C) ndarray
         self._meta: dict[str, dict] = {}     # name -> class/model names
         self._buckets: dict[tuple, Bucket] = {}
@@ -628,6 +824,8 @@ class SessionStore:
         preds = np.asarray(preds, np.float32)
         if preds.ndim != 3:
             raise ValueError(f"preds must be (H, N, C), got {preds.shape}")
+        from coda_tpu.telemetry.recorder import dataset_digest
+
         with self.lock:
             self._tasks[name] = preds
             H, N, C = preds.shape
@@ -636,6 +834,12 @@ class SessionStore:
                                     or [f"class {c}" for c in range(C)]),
                 "model_names": list(model_names
                                     or [f"model {h}" for h in range(H)]),
+                # once per task, not per session: the digest rides every
+                # session's record-stream meta so export/import and the
+                # offline stream verifier can refuse to replay a session
+                # against different data
+                "shape": [H, N, C],
+                "digest": dataset_digest(preds),
             }
 
     def tasks(self) -> list[str]:
@@ -683,24 +887,38 @@ class SessionStore:
             if n_pad != N:
                 preds = np.pad(preds, ((0, 0), (0, n_pad - N), (0, 0)))
             b = Bucket(preds, spec, self.capacity, n_valid=N, task=task,
-                       step_impl=self.step_impl, donate=self.donate)
+                       step_impl=self.step_impl, donate=self.donate,
+                       faults=self.faults)
             with self.lock:
                 self._buckets[key] = b
             return b
 
     # -- sessions ----------------------------------------------------------
-    def open(self, task: str, spec: SelectorSpec, seed: int = 0) -> Session:
+    def open(self, task: str, spec: SelectorSpec, seed: int = 0,
+             sid: Optional[str] = None, restoring: bool = False) -> Session:
+        """Admit a session. ``sid`` pins the session id — the
+        import/restore path, where the client already holds its handle
+        from the exporting server and must keep it across the migration.
+        ``restoring`` publishes the session already gated (see
+        :class:`Session`) so no label can slip in before the flag is set."""
         with self.lock:
             if task not in self._tasks:
                 raise KeyError(f"unknown task {task!r}; registered: "
                                f"{self.tasks()}")
+            if sid is not None and sid in self._sessions:
+                raise ValueError(f"session id {sid!r} already live here")
         bucket = self._bucket_for(task, spec)
         # no bucket (dispatch) lock: allocate stages its slab write, so
         # admission never waits out an in-flight slab step
         slot = bucket.allocate(seed)  # raises SlabFull when exhausted
-        sess = Session(sid=secrets.token_hex(8), task=task,
-                       bucket=bucket, slot=slot, seed=seed)
+        sess = Session(sid=sid or secrets.token_hex(8), task=task,
+                       bucket=bucket, slot=slot, seed=seed,
+                       restoring=restoring)
         with self.lock:
+            if sess.sid in self._sessions:  # lost an import race
+                bucket.release(slot)
+                raise ValueError(f"session id {sess.sid!r} already live "
+                                 "here")
             self._sessions[sess.sid] = sess
         return sess
 
@@ -729,3 +947,10 @@ class SessionStore:
     def buckets(self) -> list[Bucket]:
         with self.lock:
             return list(self._buckets.values())
+
+    def sessions_on(self, bucket: Bucket) -> list[Session]:
+        """The live sessions riding one bucket's slab (the heal path's
+        worklist — every one of them must be rebuilt and verified)."""
+        with self.lock:
+            return [s for s in self._sessions.values()
+                    if s.bucket is bucket]

@@ -55,8 +55,16 @@ from typing import Optional
 import numpy as np
 
 # bump on ANY field change; check_record_schema.py fails unversioned or
-# field-drifted records so downstream triage never misreads old captures
+# field-drifted records so downstream triage never misreads old captures.
+# Batch records (record.json + rounds.npz) and serving-session JSONL
+# streams version INDEPENDENTLY — a stream-only field change must not
+# invalidate every previously captured batch record.
 RECORD_SCHEMA_VERSION = 1
+# v2: session-stream rows gained request_id + pbest_max/pbest_entropy
+# (the in-step posterior digest) and the session_close marker kind — a v1
+# stream replayed by this build would misreport the absent digests as a
+# divergence, so the version gate rejects it with the real reason instead
+SESSION_SCHEMA_VERSION = 2
 
 # the documented cross-backend score contract: pallas kernels vs the XLA
 # lowering agree on EIG scores to the MEASURED 2.34e-4 at the headline shape
@@ -296,6 +304,43 @@ def stream_dir(root: str, *parts: str) -> str:
     return os.path.join(root, *safe)
 
 
+def _truncate_torn_tail(path: str) -> None:
+    """Drop a torn final line (no trailing newline) from a JSONL stream —
+    the leftover of a crash mid-write. Keeps everything through the last
+    newline; a file that is ONE torn line truncates to empty."""
+    with open(path, "rb+") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            return
+        back = min(size, 1 << 20)
+        f.seek(size - back)
+        tail = f.read(back)
+        if tail.endswith(b"\n"):
+            return
+        cut = tail.rfind(b"\n")
+        f.truncate(size - back + cut + 1 if cut >= 0 else 0)
+
+
+def _count_stream_rows(path: str) -> tuple:
+    """``(n_data_rows, resumable)`` for a session stream file. Not
+    resumable when a ``session_close`` marker is present (the stream
+    ended here — anything after it is a new incarnation, not a
+    continuation) or a line fails to parse."""
+    n = 0
+    with open(path) as f:
+        for line in f:
+            try:
+                kind = json.loads(line).get("kind")
+            except ValueError:
+                return n, False
+            if kind == "session_close":
+                return n, False
+            if kind != "session_meta":
+                n += 1
+    return n, True
+
+
 class SessionRecorder:
     """Per-session decision streams for the serving layer.
 
@@ -306,15 +351,29 @@ class SessionRecorder:
     survives a crash mid-session — every ``append`` is flushed.
 
     Thread-safe: the batcher thread appends, HTTP worker threads read.
+
+    Failure semantics (the disk-full recovery path): a stream write that
+    raises (``OSError`` — full disk, dead mount, or the injected
+    ``record_eio`` fault) DEGRADES that session's stream to memory-only
+    instead of failing the request: the file handle is dropped, the
+    session keeps serving, ``degraded_streams`` counts the evidence (and
+    rides the ``serve_record_write_errors_total`` registry counter +
+    ``/healthz`` degraded status). A clean close writes a
+    ``session_close`` marker row so crash restore can tell a finished
+    session from one that was live at process death.
     """
 
-    def __init__(self, out_dir: Optional[str] = None, registry=None):
+    def __init__(self, out_dir: Optional[str] = None, registry=None,
+                 faults=None):
         self.out_dir = out_dir
         self._lock = threading.Lock()
         self._history: dict[str, list] = {}
         self._files: dict[str, object] = {}
         self._registry = registry
+        self.faults = faults        # optional FaultInjector (record_eio)
+        self._task_of: dict[str, str] = {}  # sid -> task (fault filter)
         self.rows_written = 0
+        self.degraded_streams = 0   # streams downgraded to memory-only
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
 
@@ -323,31 +382,104 @@ class SessionRecorder:
             "serve_record_rows_total",
             "Per-round decision rows streamed by the serving recorder")
 
+    def _write(self, sid: str, f, line: str) -> None:
+        """One flushed stream write; degrades the stream on failure.
+        Caller holds ``_lock`` and has already committed the row to the
+        in-memory history — a full disk must not lose the session."""
+        try:
+            if self.faults is not None:
+                self.faults.fire("record_write",
+                                 task=self._task_of.get(sid))
+            f.write(line)
+            f.flush()  # crash-mid-session keeps every completed row
+        except OSError as e:
+            self._files.pop(sid, None)
+            self.degraded_streams += 1
+            try:
+                f.close()
+            except OSError:
+                pass
+            _counters(self._registry).counter(
+                "serve_record_write_errors_total",
+                "Recorder stream writes that failed; the stream degraded "
+                "to memory-only").inc()
+            import sys
+
+            print(f"recorder: stream for session {sid} degraded to "
+                  f"memory-only ({e})", file=sys.stderr)
+
     def open(self, sid: str, meta: Optional[dict] = None) -> None:
         with self._lock:
             self._history[sid] = []
+            if meta and meta.get("task"):
+                self._task_of[sid] = meta["task"]
             if self.out_dir:
                 f = open(os.path.join(self.out_dir,
                                       f"session_{sid}.jsonl"), "a")
-                header = {"v": RECORD_SCHEMA_VERSION, "kind": "session_meta",
+                self._files[sid] = f
+                header = {"v": SESSION_SCHEMA_VERSION, "kind": "session_meta",
                           "session": sid}
                 header.update(meta or {})
-                f.write(json.dumps(header, default=str) + "\n")
-                f.flush()
-                self._files[sid] = f
+                self._write(sid, f, json.dumps(header, default=str) + "\n")
+
+    def import_history(self, sid: str, meta: Optional[dict] = None,
+                       rows=()) -> None:
+        """Seed a session's history from an imported/restored stream.
+
+        The portable session log moves WITH the session: on a fresh
+        record dir the full history (meta + rows) is written so the new
+        server's stream is self-contained; when the stream file already
+        exists here AND is a live prefix of the imported rows (crash
+        restore against the same dir), it is resumed by appending only
+        the missing suffix — a file that is closed (the session migrated
+        away from this dir and is now coming back), unreadable, or ahead
+        of the payload is rewritten whole, since appending after a close
+        marker or a row gap would leave a stream a later crash restore
+        replays into a false divergence. A resumed file may end in a
+        TORN line (the crash the restore is recovering from happened
+        mid-write); that tail is truncated before appending —
+        concatenating a new row onto the fragment would corrupt a
+        mid-file line and make the stream unreadable."""
+        rows = [dict(r) for r in rows]
+        path = (os.path.join(self.out_dir, f"session_{sid}.jsonl")
+                if self.out_dir else None)
+        resume = path is not None and os.path.exists(path)
+        n_existing = 0
+        if resume:
+            _truncate_torn_tail(path)
+            n_existing, resumable = _count_stream_rows(path)
+            if not resumable or n_existing > len(rows):
+                resume, n_existing = False, 0
+        with self._lock:
+            self._history[sid] = rows
+            if meta and meta.get("task"):
+                self._task_of[sid] = meta["task"]
+            if path is None:
+                return
+            f = open(path, "a" if resume else "w")
+            self._files[sid] = f
+            lines = []
+            if not resume:
+                header = {"v": SESSION_SCHEMA_VERSION,
+                          "kind": "session_meta", "session": sid}
+                header.update(meta or {})
+                lines.append(json.dumps(header, default=str))
+            lines += [json.dumps(dict(r, v=SESSION_SCHEMA_VERSION),
+                                 default=str) for r in rows[n_existing:]]
+            if lines:
+                self._write(sid, f, "\n".join(lines) + "\n")
 
     def append(self, sid: str, row: dict) -> None:
         with self._lock:
             hist = self._history.get(sid)
             if hist is None:
                 return  # session closed (or never opened) while queued
-            row = dict(row, v=RECORD_SCHEMA_VERSION)
+            row = dict(row, v=SESSION_SCHEMA_VERSION)
             hist.append(row)
             self.rows_written += 1
             f = self._files.get(sid)
             if f is not None:
-                f.write(json.dumps(row, default=str) + "\n")
-                f.flush()  # crash-mid-session keeps every completed row
+                self._write(sid, f, json.dumps(row, default=str) + "\n")
         self._counter().inc()
 
     def history(self, sid: str) -> Optional[list]:
@@ -358,14 +490,35 @@ class SessionRecorder:
     def close(self, sid: str) -> None:
         with self._lock:
             self._history.pop(sid, None)
+            self._task_of.pop(sid, None)
             f = self._files.pop(sid, None)
+            if f is not None:
+                # the clean-shutdown marker crash restore keys on: a
+                # stream WITHOUT it was live when the process died
+                try:
+                    f.write(json.dumps(
+                        {"v": SESSION_SCHEMA_VERSION,
+                         "kind": "session_close", "session": sid}) + "\n")
+                    f.flush()
+                except OSError:
+                    pass
         if f is not None:
-            f.close()
+            try:
+                f.close()
+            except OSError:
+                pass
 
     def close_all(self) -> None:
         with self._lock:
-            files = list(self._files.values())
+            files = list(self._files.items())
             self._files.clear()
             self._history.clear()
-        for f in files:
-            f.close()
+            self._task_of.clear()
+        for sid, f in files:
+            try:
+                f.write(json.dumps(
+                    {"v": SESSION_SCHEMA_VERSION, "kind": "session_close",
+                     "session": sid}) + "\n")
+                f.close()
+            except OSError:
+                pass

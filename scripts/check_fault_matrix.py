@@ -1,0 +1,331 @@
+"""Fault-injection matrix gate: every injection point ends recovered.
+
+The recovery layer (``coda_tpu/serve/recovery.py``) is only as real as the
+failures it has actually been driven through. This checker runs the full
+fault matrix — each ``serve/faults.py`` injection point against an
+in-process server under retrying closed-loop traffic — and fails on:
+
+  * an **unrecovered session**: any client request that still errors after
+    retries, any session that did not reach its label budget, any bucket
+    left terminally failed by a fault that has a recovery path;
+  * **silent degradation**: a healed/poisoned posterior that replay
+    verification does NOT flag (the ``step_nan`` scenario *must* produce a
+    digest divergence — if the corrupted stream replays "clean", the
+    digest check is dead and corruption would ship silently);
+  * **double application**: more labels applied to a posterior than the
+    client issued logical labels (retry dedupe broken).
+
+Scenarios (fault → expected recovery → verification):
+
+  ==================  ==============================  ====================
+  step_raise          bucket quarantine → slab heal   streams replay clean
+  step_nan            none (corruption is recorded)   replay MUST diverge
+  record_eio          stream degrades to memory-only  session completes
+  slow_step           none needed                     0 errors, all served
+  crash_before_tick   restart + restore from streams  all sessions rebuilt
+  crash_after_tick    restart + restore from streams  all sessions rebuilt
+  ==================  ==============================  ====================
+
+The two crash scenarios spawn a child process that kills itself at the
+injected tick boundary (exit 17); ``--skip-crash`` omits them (the tier-1
+wiring test does, since ``tests/test_recovery.py`` covers crash recovery
+with a full bitwise-vs-control comparison). Runnable standalone::
+
+    python scripts/check_fault_matrix.py [--skip-crash]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import uuid
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+# the matrix shape: small enough to compile fast, big enough that every
+# fault lands under multi-session traffic
+H, N, C = 4, 48, 4
+CAPACITY = 6
+SESSIONS = 6
+ROUNDS = 4
+RETRIES = 10
+BACKOFF_S = 0.03
+
+
+def _make_app(fault_spec, record_dir=None, capacity=CAPACITY):
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.serve import SelectorSpec, ServeApp
+    from coda_tpu.telemetry import SessionRecorder
+
+    task = make_synthetic_task(seed=0, H=H, N=N, C=C)
+    recorder = SessionRecorder(out_dir=record_dir) if record_dir else None
+    app = ServeApp(capacity=capacity, max_wait=0.001,
+                   spec=SelectorSpec.create("coda", n_parallel=capacity),
+                   fault_spec=fault_spec, recorder=recorder)
+    app.add_task(task.name, task.preds)
+    app.start(warm=True)
+    return app, task
+
+
+def _drive(app, n_sessions=SESSIONS, rounds=ROUNDS, retries=RETRIES):
+    """Closed-loop retrying traffic (the loadgen's client discipline:
+    idempotent request_id per logical label). Returns (sids, errors)."""
+    from scripts.serve_loadgen import with_retries
+
+    sids = [None] * n_sessions
+    errors: list = []
+
+    def worker(i):
+        try:
+            out = with_retries(lambda: app.open_session(seed=i),
+                               retries, BACKOFF_S)
+            sids[i] = out["session"]
+            for _ in range(rounds):
+                lab = int(out["idx"]) % C
+                rid = uuid.uuid4().hex
+                out = with_retries(
+                    lambda: app.label(sids[i], lab, request_id=rid),
+                    retries, BACKOFF_S)
+        except Exception as e:
+            errors.append(f"session {i}: {e!r}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sids, errors
+
+
+def _common_checks(app, sids, errors, scenario) -> list[str]:
+    out = []
+    for e in errors:
+        out.append(f"{scenario}: unrecovered request after retries — {e}")
+    for i, sid in enumerate(sids):
+        if sid is None:
+            out.append(f"{scenario}: session {i} never opened")
+            continue
+        n = app.store.get(sid).n_labeled
+        if n != ROUNDS:
+            out.append(f"{scenario}: session {sid} applied {n} labels, "
+                       f"client issued {ROUNDS} (lost or double-applied)")
+    return out
+
+
+def _verify_streams(app, sids):
+    """Offline bitwise replay of each session's stream against a FRESH
+    slab; returns {sid: None | 'divergence reason'}."""
+    from coda_tpu.serve import SessionStore
+    from coda_tpu.serve.recovery import verify_session_stream
+
+    store = SessionStore(capacity=2)
+    preds = app.store._tasks[app.default_task]
+    store.register_task(app.default_task, preds)
+    verdicts = {}
+    for sid in sids:
+        meta = {"task": app.default_task, "method": app.spec.method,
+                "spec_kwargs": [list(kv) for kv in app.spec.kwargs],
+                "seed": app.store.get(sid).seed}
+        try:
+            verify_session_stream(store, meta, app.recorder.history(sid),
+                                  sid=sid)
+            verdicts[sid] = None
+        except Exception as e:
+            verdicts[sid] = repr(e)
+    return verdicts
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_step_raise() -> list[str]:
+    """Step failure consuming donated carries → quarantine → digest-
+    verified slab rebuild; traffic rides through on retries."""
+    app, _ = _make_app("step_raise:after=3")
+    try:
+        sids, errors = _drive(app)
+        out = _common_checks(app, sids, errors, "step_raise")
+        b = app.store.buckets()[0]
+        if b.heals < 1:
+            out.append("step_raise: fault fired but no slab heal ran")
+        if b.failed is not None:
+            out.append(f"step_raise: bucket degraded to terminal: "
+                       f"{b.failed}")
+        if b.quarantined is not None:
+            out.append("step_raise: bucket still quarantined after drive")
+        for sid, verdict in _verify_streams(app, filter(None, sids)).items():
+            if verdict is not None:
+                out.append(f"step_raise: healed session {sid} failed "
+                           f"replay verification — {verdict}")
+        if app.healthz()["status"] != "ok":
+            out.append(f"step_raise: healthz {app.healthz()} after heal")
+        return out
+    finally:
+        app.drain(timeout=10)
+
+
+def scenario_step_nan() -> list[str]:
+    """Silent posterior corruption: the run completes (NaN is not an
+    exception), but replay verification MUST flag the poisoned stream —
+    a clean verdict here means corruption ships silently."""
+    app, _ = _make_app("step_nan:after=3")
+    try:
+        sids, errors = _drive(app)
+        out = [f"step_nan: {e}" for e in errors]
+        verdicts = _verify_streams(app, filter(None, sids))
+        n_flagged = sum(1 for v in verdicts.values() if v is not None)
+        if n_flagged < 1:
+            out.append(
+                "step_nan: SILENT DEGRADATION — a NaN-poisoned round was "
+                "recorded but replay verification flagged nothing (the "
+                "digest check is dead)")
+        return out
+    finally:
+        app.drain(timeout=10)
+
+
+def scenario_record_eio() -> list[str]:
+    """Recorder disk write fails → the stream degrades to memory-only,
+    the session keeps serving, and the degradation is visible."""
+    with tempfile.TemporaryDirectory() as d:
+        app, _ = _make_app("record_eio:after=2", record_dir=d)
+        try:
+            sids, errors = _drive(app)
+            out = _common_checks(app, sids, errors, "record_eio")
+            if app.recorder.degraded_streams < 1:
+                out.append("record_eio: fault fired but no stream was "
+                           "marked degraded")
+            if "recorder_degraded" not in app.healthz()["problems"]:
+                out.append(f"record_eio: degradation invisible on "
+                           f"/healthz: {app.healthz()}")
+            # in-memory histories stay authoritative: still replayable
+            for sid, verdict in _verify_streams(
+                    app, filter(None, sids)).items():
+                if verdict is not None:
+                    out.append(f"record_eio: session {sid} memory stream "
+                               f"failed replay — {verdict}")
+            return out
+        finally:
+            app.drain(timeout=10)
+
+
+def scenario_slow_step() -> list[str]:
+    """A stalling step is tail pain, not a fault: everything completes."""
+    app, _ = _make_app("slow_step:every=2,ms=40,times=6")
+    try:
+        sids, errors = _drive(app)
+        return _common_checks(app, sids, errors, "slow_step")
+    finally:
+        app.drain(timeout=10)
+
+
+_CRASH_CHILD = r"""
+import sys
+from scripts.check_fault_matrix import _make_app, _drive
+app, _ = _make_app(sys.argv[1], record_dir=sys.argv[2])
+_drive(app, retries=0)          # the injected crash kills us mid-drive
+app.drain(timeout=10)           # only reached if the fault never fired
+print("NO_CRASH")
+"""
+
+
+def scenario_crash(site: str) -> list[str]:
+    """Process death at a tick boundary → restart restores every live
+    session from its JSONL stream, replay-verified."""
+    from coda_tpu.serve.recovery import iter_session_streams
+
+    scenario = site
+    out: list[str] = []
+    with tempfile.TemporaryDirectory() as d:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        child = subprocess.run(
+            [sys.executable, "-c", _CRASH_CHILD, f"{site}:after=3", d],
+            env=env, cwd=repo, capture_output=True, text=True, timeout=600)
+        if child.returncode != 17:
+            return [f"{scenario}: child exited {child.returncode}, "
+                    f"expected the injected crash (17): "
+                    f"{child.stderr[-500:]}"]
+        streams = list(iter_session_streams(d))
+        if not streams:
+            return [f"{scenario}: crashed child left no session streams"]
+        app, _ = _make_app(None, record_dir=d)
+        try:
+            report = app.restore_sessions(d)
+            if report["failed"]:
+                out.append(f"{scenario}: restore failures: "
+                           f"{report['failed']}")
+            n_live = len(report["restored"])
+            if n_live + report["skipped_closed"] != len(streams):
+                out.append(f"{scenario}: {len(streams)} streams but only "
+                           f"{n_live} restored + "
+                           f"{report['skipped_closed']} closed")
+            # restored sessions must still serve
+            for sid in report["restored"]:
+                sess = app.store.get(sid)
+                if sess.last:
+                    app.label(sid, int(sess.last["next_idx"]) % C)
+            return out
+        finally:
+            app.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+
+SCENARIOS = {
+    "step_raise": scenario_step_raise,
+    "step_nan": scenario_step_nan,
+    "record_eio": scenario_record_eio,
+    "slow_step": scenario_slow_step,
+    "crash_before_tick": lambda: scenario_crash("crash_before_tick"),
+    "crash_after_tick": lambda: scenario_crash("crash_after_tick"),
+}
+
+
+def run_matrix(skip_crash: bool = False) -> dict[str, list[str]]:
+    """{scenario: violations} (empty lists = clean)."""
+    results = {}
+    for name, fn in SCENARIOS.items():
+        if skip_crash and name.startswith("crash_"):
+            continue
+        results[name] = fn()
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--skip-crash", action="store_true",
+                   help="omit the two subprocess crash scenarios")
+    p.add_argument("--out", default=None,
+                   help="write the {scenario: violations} JSON here")
+    args = p.parse_args(argv)
+
+    results = run_matrix(skip_crash=args.skip_crash)
+    bad = 0
+    for name, violations in results.items():
+        for v in violations:
+            print(f"FAIL {v}")
+            bad += 1
+        if not violations:
+            print(f"ok   {name}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    if bad:
+        print(f"fault matrix FAILED: {bad} violation(s)")
+        return 1
+    print(f"fault matrix clean: {len(results)} scenario(s), every "
+          "injection point recovered or attributably detected")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

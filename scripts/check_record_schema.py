@@ -29,7 +29,12 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
-_ROW_FIELDS = ("n_labeled", "do_update", "next_idx", "next_prob", "best")
+_ROW_FIELDS = ("n_labeled", "do_update", "next_idx", "next_prob", "best",
+               # the v2 additions the version bump exists for: the replay
+               # verifier's digest pair and the idempotency token (present
+               # with value None when unused — absence is writer drift)
+               "stochastic", "labeled_idx", "label", "prob", "request_id",
+               "pbest_max", "pbest_entropy")
 
 
 def check_record(dir_path: str) -> list[str]:
@@ -102,7 +107,7 @@ def check_record(dir_path: str) -> list[str]:
 
 def check_session_stream(fp: str) -> list[str]:
     """Violations of one serving-session JSONL stream."""
-    from coda_tpu.telemetry.recorder import RECORD_SCHEMA_VERSION
+    from coda_tpu.telemetry.recorder import SESSION_SCHEMA_VERSION
 
     out: list[str] = []
     try:
@@ -121,10 +126,16 @@ def check_session_stream(fp: str) -> list[str]:
         v = row.get("v")
         if v is None:
             out.append(f"line {i}: no 'v' version stamp")
-        elif v != RECORD_SCHEMA_VERSION:
+        elif v != SESSION_SCHEMA_VERSION:
             out.append(f"line {i}: v={v!r} != supported "
-                       f"{RECORD_SCHEMA_VERSION}")
-        if row.get("kind") == "session_meta":
+                       f"{SESSION_SCHEMA_VERSION}")
+        kind = row.get("kind")
+        if kind is not None:
+            # marker lines: the open header and the clean-close marker
+            # (crash restore keys on its absence); anything else is drift
+            if kind not in ("session_meta", "session_close"):
+                out.append(f"line {i}: unknown row kind {kind!r} "
+                           "(bump SESSION_SCHEMA_VERSION)")
             continue
         missing = [k for k in _ROW_FIELDS if k not in row]
         if missing:
@@ -170,10 +181,14 @@ def main(argv=None) -> int:
     if total_bad:
         print(f"record schema check FAILED: {total_bad} violation(s)")
         return 1
-    from coda_tpu.telemetry.recorder import RECORD_SCHEMA_VERSION
+    from coda_tpu.telemetry.recorder import (
+        RECORD_SCHEMA_VERSION,
+        SESSION_SCHEMA_VERSION,
+    )
 
     print(f"record schema check clean: {total_checked} artifact(s) "
-          f"validated against v{RECORD_SCHEMA_VERSION}")
+          f"validated against record v{RECORD_SCHEMA_VERSION} / "
+          f"stream v{SESSION_SCHEMA_VERSION}")
     return 0
 
 

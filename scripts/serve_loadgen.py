@@ -29,6 +29,24 @@ Three arrival models:
 
     python scripts/serve_loadgen.py --mux --workers 256 --sessions 256 \
         --synthetic 8,512,10 --out BENCH_SERVE_cpu.json
+
+Chaos mode: combine ``--fault-spec`` (deterministic server-side fault
+injection, ``coda_tpu/serve/faults.py``) with ``--retries``/
+``--backoff-ms`` (client-side retry with idempotent ``request_id``
+labels) — the run must then finish with 0 errors and every absorbed
+retry counted in ``n_retries``::
+
+    python scripts/serve_loadgen.py --synthetic 4,64,4 --workers 8 \
+        --sessions 16 --fault-spec step_raise:after=40 --retries 8
+
+Rolling-restart mode: ``--rolling-restart-at S`` drains the server mid-
+run, exports every live session, restarts fresh, imports (each stream
+independently replay-verified bitwise), and swaps the retrying clients
+over — the report's ``migration`` section must then show
+``exported == imported == replay_verified`` with 0 errors::
+
+    python scripts/serve_loadgen.py --synthetic 4,64,4 --workers 8 \
+        --sessions 24 --labels 6 --rolling-restart-at 0.5 --retries 10
 """
 
 from __future__ import annotations
@@ -39,6 +57,7 @@ import json
 import sys
 import threading
 import time
+import uuid
 
 # importable from any cwd (the aggregate_results.py convention)
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
@@ -57,11 +76,23 @@ class InprocClient:
     def open(self, seed):
         return self.app.open_session(seed=seed)
 
-    def label(self, sid, label):
-        return self.app.label(sid, label)
+    def label(self, sid, label, request_id=None):
+        return self.app.label(sid, label, request_id=request_id)
 
     def close(self, sid):
-        return self.app.close_session(sid)
+        app = self.app
+        out = app.close_session(sid)
+        if self.app is not app:
+            # a rolling restart swapped the app while this close was in
+            # flight: the session may already have been exported+imported,
+            # so the close that just landed on the OLD store would leak
+            # the migrated copy live on the new server — follow it there
+            # (already-closed/never-imported is fine)
+            try:
+                self.app.close_session(sid)
+            except Exception:
+                pass
+        return out
 
     def stats(self):
         return self.app.stats()
@@ -84,14 +115,61 @@ class HttpClient:
     def open(self, seed):
         return self._req("POST", "/session", {"seed": seed})
 
-    def label(self, sid, label):
-        return self._req("POST", f"/session/{sid}/label", {"label": label})
+    def label(self, sid, label, request_id=None):
+        body = {"label": label}
+        if request_id is not None:
+            body["request_id"] = request_id
+        return self._req("POST", f"/session/{sid}/label", body)
 
     def close(self, sid):
         return self._req("DELETE", f"/session/{sid}")
 
     def stats(self):
         return self._req("GET", "/stats")
+
+
+# ---------------------------------------------------------------------------
+# client-side retry/backoff (the chaos-mode / rolling-restart companion)
+# ---------------------------------------------------------------------------
+
+#: HTTP statuses worth retrying: backpressure/draining/healing (503), a
+#: stuck dispatch (504), and transient internal errors (500). 4xx client
+#: errors are not retried — they would fail identically forever.
+_RETRY_STATUSES = (500, 503, 504)
+
+
+def _retryable(e: Exception) -> bool:
+    import urllib.error
+
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code in _RETRY_STATUSES
+    if isinstance(e, (urllib.error.URLError, ConnectionError,
+                      TimeoutError)):
+        return True  # server restarting / socket dropped / dispatch stuck
+    # in-process verbs raise these for the same transient conditions
+    if isinstance(e, (ValueError, KeyError, TypeError)):
+        return False
+    return isinstance(e, Exception)
+
+
+def with_retries(fn, retries: int, backoff_s: float, counter=None):
+    """Run ``fn`` with exponential backoff on transient failures.
+
+    Pair with an idempotent ``request_id`` on label calls: the server
+    dedupes replays, so a retry can never double-apply an oracle answer
+    to a posterior — which is what makes retrying SAFE, not just
+    convenient."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:
+            if attempt >= retries or not _retryable(e):
+                raise
+            if counter is not None:
+                counter.append(repr(e))
+            time.sleep(backoff_s * (2 ** attempt))
+            attempt += 1
 
 
 class AsyncConn:
@@ -136,7 +214,7 @@ class AsyncConn:
 # ---------------------------------------------------------------------------
 
 def _free_run(client, n_classes, workers, sessions, labels_per_session,
-              latencies, errors):
+              latencies, errors, retries=0, backoff_s=0.05, retried=None):
     """Default arrival model: W workers race through the session budget."""
     counter = {"next": 0}
     lock = threading.Lock()
@@ -157,13 +235,28 @@ def _free_run(client, n_classes, workers, sessions, labels_per_session,
             sid = None
             try:
                 t0 = time.perf_counter()
-                out = client.open(seed)
+                out = with_retries(lambda: client.open(seed),
+                                   retries, backoff_s, retried)
                 sid = out["session"]
                 latencies.append(time.perf_counter() - t0)
                 for _ in range(labels_per_session):
                     t0 = time.perf_counter()
-                    out = client.label(sid, int(out["idx"]) % n_classes)
+                    # one request_id per LOGICAL label, stable across its
+                    # retries: the server dedupes, so a retried label is
+                    # applied to the posterior exactly once
+                    lab, rid = int(out["idx"]) % n_classes, uuid.uuid4().hex
+                    out = with_retries(
+                        lambda: client.label(sid, lab, request_id=rid),
+                        retries, backoff_s, retried)
                     latencies.append(time.perf_counter() - t0)
+                # the double-apply sentinel: the server-side label count
+                # must equal the labels this client issued — a broken
+                # retry dedupe (or a lossy migration) shows up here
+                n = out.get("n_labeled")
+                if n is not None and n != labels_per_session:
+                    errors.append(
+                        f"session {sid}: server applied {n} labels, "
+                        f"client issued {labels_per_session}")
                 client.close(sid)
                 sid = None
             except Exception as e:  # keep the run alive; report at the end
@@ -184,22 +277,39 @@ def _free_run(client, n_classes, workers, sessions, labels_per_session,
 
 
 def _mux(app, http_port, n_classes, concurrency, sessions,
-         labels_per_session, latencies, errors, ramp_s=0.0):
+         labels_per_session, latencies, errors, ramp_s=0.0,
+         retries=0, backoff_s=0.05, retried=None):
     """Asyncio arrival model: every session is a coroutine, ``concurrency``
     of them live at once, all multiplexed on one event loop. In-process it
     drives the app's async verbs (the front door's own path, minus TCP);
     with an ``http_port`` each session holds one keep-alive connection to
     the real asyncio server."""
 
+    async def _aretry(thunk):
+        """Async twin of ``with_retries`` (same request_id across tries)."""
+        attempt = 0
+        while True:
+            try:
+                return await thunk()
+            except Exception as e:
+                if attempt >= retries or not _retryable(e):
+                    raise
+                if retried is not None:
+                    retried.append(repr(e))
+                await asyncio.sleep(backoff_s * (2 ** attempt))
+                attempt += 1
+
     async def one_inproc(seed):
         t0 = time.perf_counter()
-        out = await app.open_session_async(seed=seed)
+        out = await _aretry(lambda: app.open_session_async(seed=seed))
         latencies.append(time.perf_counter() - t0)
         sid = out["session"]
         try:
             for _ in range(labels_per_session):
                 t0 = time.perf_counter()
-                out = await app.label_async(sid, int(out["idx"]) % n_classes)
+                lab, rid = int(out["idx"]) % n_classes, uuid.uuid4().hex
+                out = await _aretry(lambda: app.label_async(
+                    sid, lab, request_id=rid))
                 latencies.append(time.perf_counter() - t0)
         finally:
             await asyncio.get_running_loop().run_in_executor(
@@ -209,20 +319,34 @@ def _mux(app, http_port, n_classes, concurrency, sessions,
         conn = AsyncConn("127.0.0.1", http_port)
         await conn.connect()
         sid = None
+
+        async def checked(method, path, body, what):
+            try:
+                status, out = await conn.req(method, path, body)
+            except (ConnectionError, asyncio.IncompleteReadError,
+                    OSError) as e:
+                # dropped keep-alive (server restart): reconnect, then let
+                # the retry loop resubmit with the SAME request_id
+                await conn.connect()
+                raise TimeoutError(f"{what} connection dropped: {e!r}")
+            if status in _RETRY_STATUSES:
+                raise TimeoutError(f"{what} -> {status}: {out}")  # retryable
+            if status != 200:
+                raise RuntimeError(f"{what} -> {status}: {out}")
+            return out
+
         try:
             t0 = time.perf_counter()
-            status, out = await conn.req("POST", "/session", {"seed": seed})
-            if status != 200:
-                raise RuntimeError(f"open -> {status}: {out}")
+            out = await _aretry(lambda: checked(
+                "POST", "/session", {"seed": seed}, "open"))
             latencies.append(time.perf_counter() - t0)
             sid = out["session"]
             for _ in range(labels_per_session):
                 t0 = time.perf_counter()
-                status, out = await conn.req(
+                lab, rid = int(out["idx"]) % n_classes, uuid.uuid4().hex
+                out = await _aretry(lambda: checked(
                     "POST", f"/session/{sid}/label",
-                    {"label": int(out["idx"]) % n_classes})
-                if status != 200:
-                    raise RuntimeError(f"label -> {status}: {out}")
+                    {"label": lab, "request_id": rid}, "label"))
                 latencies.append(time.perf_counter() - t0)
             await conn.req("DELETE", f"/session/{sid}")
             sid = None
@@ -310,6 +434,97 @@ def _span_breakdown(app) -> dict:
     }
 
 
+def _rolling_restart(client, args, migration: dict, errors: list) -> None:
+    """The drain -> export -> restart -> import cycle, under live load.
+
+    At ``--rolling-restart-at`` seconds: quiesce the serving app (stop
+    ticking, keep sessions; in-flight retries now see fast retryable
+    errors), export every live session, stand up a FRESH app, import each
+    payload (snapshot fast path or bitwise-verified stream replay), then
+    swap the client over. Every exported stream is ALSO independently
+    replay-verified against a fresh slab — the migration's evidence is a
+    bitwise check, not an absence of errors. Retrying workers ride
+    through; their idempotent request_ids make the handoff exactly-once.
+    """
+    from coda_tpu.serve import SessionStore, recovery
+    from coda_tpu.serve.server import build_app
+
+    time.sleep(args.rolling_restart_at)
+    old = client.app
+    # the demo must cut MID-LOAD: wait until the old server is actually
+    # serving (first dispatches can outlast a small --rolling-restart-at),
+    # so there are live sessions to migrate, not an idle slab
+    deadline = time.perf_counter() + 30
+    while time.perf_counter() < deadline:
+        with old.store.lock:
+            n_live = len(old.store._sessions)
+        if n_live >= max(1, args.workers // 2) and \
+                old.metrics.snapshot()["requests"] > 0:
+            break
+        time.sleep(0.005)
+    t0 = time.perf_counter()
+    try:
+        # hard cut: a soft drain would race the retrying clients (they
+        # keep completing and closing sessions while the queue empties),
+        # leaving nothing live to migrate; the stranded tickets fail
+        # retryably and land on the new server
+        old.quiesce(timeout=10, hard=True)
+        requests_at_cut = old.metrics.snapshot().get("requests")
+        payloads = recovery.export_all(old)
+        new = build_app(args)
+        new.start(warm=not args.no_warm)
+        # independent bitwise verification of every exported stream (the
+        # import repeats this for the replay path and digest-checks the
+        # snapshot path; doing it standalone makes the evidence explicit)
+        vstore = SessionStore(capacity=2)
+        vstore.register_task(new.default_task,
+                             new.store._tasks[new.default_task])
+        verified = 0
+        for p in payloads:
+            meta = {"task": new.default_task, "method": p["method"],
+                    "spec_kwargs": p["spec_kwargs"], "seed": p["seed"]}
+            recovery.verify_session_stream(vstore, meta, p["rows"],
+                                           sid=p["session"])
+            verified += 1
+        via: dict = {}
+        reclosed = 0
+        for p in payloads:
+            if not old.store.alive(p["session"]):
+                # closed on the OLD app after export_all captured it (the
+                # worker's final label landed just before the cut): the
+                # client is done with this session — importing it would
+                # leak an unclosable slot on the new server
+                reclosed += 1
+                continue
+            info = new.import_session(p)
+            via[info["restored_via"]] = via.get(info["restored_via"], 0) + 1
+        client.app = new      # the handoff: retries land on the new app
+        # reconcile closes that raced the import loop itself: any close
+        # issued against the old app before the handoff must follow its
+        # session to the new server
+        for p in payloads:
+            sid = p["session"]
+            if not old.store.alive(sid) and new.store.alive(sid):
+                new.close_session(sid)
+                reclosed += 1
+        migration.update(
+            at_s=args.rolling_restart_at,
+            requests_at_cut=requests_at_cut,
+            exported=len(payloads),
+            imported=sum(via.values()),
+            reclosed=reclosed,
+            restored_via=via,
+            replay_verified=verified,
+            seconds=time.perf_counter() - t0,
+        )
+        # the old app's batcher is stopped and its sessions are handed
+        # off; release its executor without writing close markers (the
+        # sessions are LIVE — on the new server)
+        old._executor.shutdown(wait=False)
+    except Exception as e:
+        errors.append(f"rolling restart failed: {e!r}")
+
+
 def run_loadgen(args) -> dict:
     """Run the configured load and return the report dict (the script's
     JSON payload; the smoke test calls this directly)."""
@@ -339,6 +554,22 @@ def run_loadgen(args) -> dict:
 
     latencies: list = []
     errors: list = []
+    retried: list = []
+    backoff_s = args.backoff_ms / 1e3
+    migration: dict = {}
+    if getattr(args, "rolling_restart_at", None) is not None:
+        if app is None or args.http or args.mux or args.lockstep:
+            raise SystemExit("--rolling-restart-at needs the in-process "
+                             "free-run client (no --url/--http/--mux/"
+                             "--lockstep)")
+        if args.retries < 1:
+            raise SystemExit("--rolling-restart-at needs --retries >= 1: "
+                             "requests in the drain window are refused "
+                             "with a retryable error, not queued")
+        threading.Thread(
+            target=_rolling_restart,
+            args=(client, args, migration, errors),
+            daemon=True, name="loadgen-migrate").start()
     t_start = time.perf_counter()
     if args.lockstep:
         if app is None:
@@ -353,15 +584,20 @@ def run_loadgen(args) -> dict:
         n_sessions = args.sessions
         _mux(app, srv.server_address[1] if srv is not None else None,
              n_classes, args.workers, args.sessions, args.labels,
-             latencies, errors, ramp_s=args.ramp_s)
+             latencies, errors, ramp_s=args.ramp_s,
+             retries=args.retries, backoff_s=backoff_s, retried=retried)
         mode = "mux"
     else:
         n_sessions = args.sessions
         _free_run(client, n_classes, args.workers, args.sessions,
-                  args.labels, latencies, errors)
+                  args.labels, latencies, errors,
+                  retries=args.retries, backoff_s=backoff_s,
+                  retried=retried)
         mode = "free_run"
     wall = time.perf_counter() - t_start
 
+    if migration and isinstance(client, InprocClient):
+        app = client.app   # stats/drain target the post-migration server
     stats = client.stats() if app is None else app.stats()
     spans = _span_breakdown(app)
     if srv is not None:
@@ -390,6 +626,15 @@ def run_loadgen(args) -> dict:
         },
         "errors": errors[:20],
         "n_errors": len(errors),
+        # transient failures absorbed by client-side retry/backoff (chaos
+        # mode / rolling restarts): these are NOT errors — every one was
+        # eventually served, idempotently via its request_id
+        "n_retries": len(retried),
+        "retried": retried[:20],
+        # the rolling-restart cycle's evidence (when --rolling-restart-at
+        # ran): exported == imported == replay_verified means zero dropped
+        # sessions and every migrated stream bitwise-verified
+        "migration": migration or None,
         "server": {
             "dispatches": stats.get("dispatches"),
             "requests": stats.get("requests"),
@@ -420,6 +665,10 @@ def run_loadgen(args) -> dict:
             "warm": not args.no_warm,
             "compilation_cache_dir": args.compilation_cache_dir,
             "ramp_s": args.ramp_s,
+            "retries": args.retries,
+            "fault_spec": getattr(args, "fault_spec", None),
+            "rolling_restart_at": getattr(args, "rolling_restart_at",
+                                          None),
             "task": args.task or args.synthetic or "default",
         },
     }
@@ -463,6 +712,21 @@ def parse_args(argv=None):
     p.add_argument("--ramp-s", type=float, default=0.0,
                    help="mux: spread session arrivals over this many "
                         "seconds instead of a thundering herd at t=0")
+    p.add_argument("--retries", type=int, default=0,
+                   help="client-side retries per request on transient "
+                        "failures (503/504/500/conn-drop), exponential "
+                        "backoff; labels carry an idempotent request_id "
+                        "so a retry can never double-apply an oracle "
+                        "answer (chaos-mode / rolling-restart companion)")
+    p.add_argument("--backoff-ms", type=float, default=50.0,
+                   help="base retry backoff (doubles per attempt)")
+    p.add_argument("--rolling-restart-at", type=float, default=None,
+                   metavar="S",
+                   help="at S seconds into the run: quiesce the server, "
+                        "export every live session, stand up a fresh one, "
+                        "import (replay-verified), and swap the clients "
+                        "over — the zero-drop migration demo (in-process "
+                        "free-run only; needs --retries)")
     p.add_argument("--http", action="store_true",
                    help="drive the in-process app over real HTTP instead "
                         "of direct calls")

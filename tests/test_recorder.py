@@ -46,6 +46,7 @@ from coda_tpu.engine.replay import (
 from coda_tpu.losses import accuracy_loss
 from coda_tpu.telemetry.recorder import (
     RECORD_SCHEMA_VERSION,
+    SESSION_SCHEMA_VERSION,
     RunRecord,
     SessionRecorder,
     dataset_digest,
@@ -421,12 +422,26 @@ def test_check_record_schema_flags_drift(tmp_path):
     # session stream validation
     stream = tmp_path / "good" / "session_ab12.jsonl"
     with open(stream, "w") as f:
-        f.write(json.dumps({"v": RECORD_SCHEMA_VERSION,
+        f.write(json.dumps({"v": SESSION_SCHEMA_VERSION,
                             "kind": "session_meta"}) + "\n")
-        f.write(json.dumps({"v": RECORD_SCHEMA_VERSION, "n_labeled": 0,
-                            "do_update": False, "next_idx": 1,
-                            "next_prob": 0.5, "best": 0}) + "\n")
+        f.write(json.dumps({"v": SESSION_SCHEMA_VERSION, "n_labeled": 0,
+                            "do_update": False, "labeled_idx": None,
+                            "label": None, "prob": None, "request_id": None,
+                            "next_idx": 1, "next_prob": 0.5, "best": 0,
+                            "stochastic": False, "pbest_max": 0.5,
+                            "pbest_entropy": 0.9}) + "\n")
     assert mod.check_tree(str(good)) == {}
+    # a v2 row missing the fields the version bump added IS drift
+    with open(stream, "a") as f:
+        f.write(json.dumps({"v": SESSION_SCHEMA_VERSION, "n_labeled": 1,
+                            "do_update": True, "next_idx": 2,
+                            "next_prob": 0.5, "best": 0}) + "\n")
+    assert any("missing fields" in v
+               for v in mod.check_tree(str(good)).get(
+                   "session_ab12.jsonl", []))
+    with open(stream, "w") as f:
+        f.write(json.dumps({"v": SESSION_SCHEMA_VERSION,
+                            "kind": "session_meta"}) + "\n")
     with open(stream, "a") as f:
         f.write(json.dumps({"next_idx": 2}) + "\n")  # no version stamp
     assert any("version stamp" in v
@@ -458,7 +473,7 @@ def test_serve_session_trace_stream(tmp_path):
         assert tr["rounds"][0]["do_update"] is False
         assert tr["rounds"][1]["do_update"] is True
         assert tr["rounds"][1]["labeled_idx"] is not None
-        assert all(r["v"] == RECORD_SCHEMA_VERSION for r in tr["rounds"])
+        assert all(r["v"] == SESSION_SCHEMA_VERSION for r in tr["rounds"])
         stats = app.stats()
         assert stats["record_rows_written"] >= 4
         assert "records_written" in stats and "replay_verified" in stats
@@ -510,8 +525,11 @@ tele = Telemetry(out_dir={out!r})
 tele.counter("crash_total").inc()
 rec = SessionRecorder(out_dir={out!r})
 rec.open("dead0", meta={{"task": "t"}})
-rec.append("dead0", {{"n_labeled": 0, "do_update": False, "next_idx": 3,
-                      "next_prob": 0.5, "best": 1, "stochastic": False}})
+rec.append("dead0", {{"n_labeled": 0, "do_update": False,
+                      "labeled_idx": None, "label": None, "prob": None,
+                      "request_id": None, "next_idx": 3, "next_prob": 0.5,
+                      "best": 1, "stochastic": False, "pbest_max": 0.5,
+                      "pbest_entropy": 0.9}})
 raise RuntimeError("simulated mid-run crash")
 """
     proc = subprocess.run([sys.executable, "-c", script], cwd=REPO,
