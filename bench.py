@@ -42,35 +42,16 @@ import numpy as np
 
 BASELINE_CACHE = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
 
-# published peak dense-matmul FLOP/s per chip (bf16); fp32 on the MXU runs
-# at a fraction of this, so fp32 MFU vs the bf16 peak is a conservative lower
-# bound on how well the kernel uses the hardware
-_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-# published HBM bandwidth per chip (bytes/s). The incremental EIG is
-# bandwidth-bound: its per-round FLOP/byte ratio is ~19-32 at the headline
-# config (8.3e10 FLOPs over 4.4e9 bytes with the exact pi-hat path that
-# 'auto' picks on TPU, 2.6e9 with the delta path it picks on CPU), still
-# far below the ~240 FLOP/byte machine balance of a v5e — so MBU against
-# this peak, not MFU against the matmul peak, is the roofline that
-# describes it.
-_PEAK_HBM_BPS = {
-    "TPU v4": 1228e9,
-    "TPU v5 lite": 819e9,
-    "TPU v5e": 819e9,
-    "TPU v5": 2765e9,
-    "TPU v5p": 2765e9,
-    "TPU v6 lite": 1640e9,
-    "TPU v6e": 1640e9,
-}
+# published per-chip peaks: ONE table, owned by coda_tpu/telemetry/costs.py
+# (the roofline classifier serve /stats and the suite cost book share).
+# The incremental EIG is bandwidth-bound — its per-round FLOP/byte ratio
+# is ~19-32 at the headline config, far below a v5e's ~240 FLOP/byte
+# machine balance — so MBU against the HBM peak, not MFU against the
+# matmul peak, is the roofline that describes it.
+from coda_tpu.telemetry.costs import (  # noqa: E402
+    PEAK_FLOPS as _PEAK_FLOPS,
+    PEAK_HBM_BPS as _PEAK_HBM_BPS,
+)
 
 # measured-at-size protocol constants: FIXED regardless of --small/--iters so
 # the same-named metric always means the same measurement
@@ -339,6 +320,20 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     dev = jax.devices()[0]
     peak = _PEAK_FLOPS.get(dev.device_kind)
     peak_bw = _PEAK_HBM_BPS.get(dev.device_kind)
+    # the machine-readable cost section (telemetry/costs.py): XLA's own
+    # analysis of the timed executable (program-level; scan bodies counted
+    # once — see _flops_of) plus the roofline classification of the
+    # ANALYTIC per-step model, which is the honest per-round
+    # flops/bytes pair. Harvested into the process cost book too, so a
+    # --telemetry-dir run carries it in telemetry.json.
+    from coda_tpu.telemetry import costs as _costs
+
+    xla_cost = _costs.harvest_executable_cost(
+        compiled, f"bench/coda/{H}x{N}x{C}/i{iters}", site="bench",
+        device_kind=dev.device_kind,
+        extra={"H": H, "N": N, "C": C, "iters": iters})
+    if xla_cost is None:  # harvesting disabled/unavailable: analyze once
+        xla_cost = _costs.analyze_compiled(compiled) or {}
     bytes_per_step = _analytic_step_bytes(
         H, N, C, mode=mode,
         cache_bytes=np.dtype(eig_opts["eig_cache_dtype"]).itemsize,
@@ -389,6 +384,21 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         "platform": dev.platform,
         "peak_flops_per_sec": peak,
         "mfu": (achieved / peak) if (peak and achieved) else None,
+        "cost": {
+            # whole-program XLA analysis of the timed executable
+            "xla_flops": xla_cost.get("flops"),
+            "xla_bytes_accessed": xla_cost.get("bytes_accessed"),
+            "argument_bytes": xla_cost.get("argument_bytes"),
+            "output_bytes": xla_cost.get("output_bytes"),
+            "temp_bytes": xla_cost.get("temp_bytes"),
+            "peak_hbm_bytes": xla_cost.get("peak_hbm_bytes"),
+            # per-step roofline off the analytic models (the MFU/MBU
+            # numerators above); class vs the shared peak table, with a
+            # documented generic host balance on unknown device kinds
+            **_costs.roofline(flops_per_step, bytes_per_step,
+                              dev.device_kind),
+            "flop_accounting": "analytic_per_step",
+        },
     }
 
 
@@ -607,6 +617,15 @@ def main():
               file=sys.stderr)
 
     base = reference_baseline(C, skip=args.skip_reference)
+    # environment fingerprint (telemetry/recorder.py): the provenance
+    # block that makes this capture attributable and cross-round
+    # comparable — scripts/check_perf.py keys same-fingerprint regression
+    # comparisons on it
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    fingerprint = environment_fingerprint(
+        knobs=dict(eig_opts, iters=args.iters or iters, small=args.small,
+                   eig_chunk=chunk))
     out = {
         "metric": f"coda-selection-steps/sec (M={H}, N={N}, C={C})",
         "value": round(ours["steps_per_sec"], 4),
@@ -620,6 +639,8 @@ def main():
         "devices": {k: ours[k] for k in
                     ("device_kind", "n_devices", "platform")},
         "device_fallback": device_fallback,
+        "cost": ours["cost"],
+        "fingerprint": fingerprint,
         "compute": {k: ours[k] for k in
                     ("eig_mode", "eig_backend", "eig_precision",
                      "eig_cache_dtype", "eig_refresh", "eig_entropy",

@@ -167,6 +167,11 @@ def parse_args(argv=None):
     p.add_argument("--record-topk", type=int, default=8,
                    help="how many top-scored candidates the flight "
                         "recorder captures per round (with --record-dir)")
+    p.add_argument("--no-cost-capture", action="store_true",
+                   help="skip per-executable XLA cost attribution "
+                        "(telemetry/costs.py): the engine entry then "
+                        "compiles through the plain jit path and "
+                        "telemetry.json carries no 'costs' section")
     p.add_argument("--debug-viz", action="store_true",
                    help="log P(best) / regret-curve charts as artifacts to "
                         "the tracking store (reference _DEBUG_VIZ analog)")
@@ -367,6 +372,10 @@ def main(argv=None):
 
     pin_platform(args.platform)
     enable_compilation_cache(args.compilation_cache_dir)
+    if args.no_cost_capture:
+        from coda_tpu.telemetry import costs as _costs
+
+        _costs.set_enabled(False)
 
     import jax
 
@@ -529,10 +538,11 @@ def _run_all_seeds(args, factory, selector, dataset, model_losses, loss_fn):
         return run_seeds_recorded(factory, dataset.preds, dataset.labels,
                                   iters=args.iters, seeds=args.seeds,
                                   loss_fn=loss_fn,
-                                  trace_k=getattr(args, "record_topk", 8))
+                                  trace_k=getattr(args, "record_topk", 8),
+                                  cost_label=args.method)
     result = run_seeds_compiled(factory, dataset.preds, dataset.labels,
                                 iters=args.iters, seeds=args.seeds,
-                                loss_fn=loss_fn)
+                                loss_fn=loss_fn, cost_label=args.method)
     return result, None
 
 

@@ -266,6 +266,31 @@ def build_recording_experiment_fn(
     return experiment
 
 
+def _engine_cost_name(preds, seeds: int, iters: int, factory,
+                      label: Optional[str] = None,
+                      recorded: bool = False) -> str:
+    # selector identity keeps two methods at the same (shape, seeds,
+    # iters) from overwriting each other's cost-book entry; callers that
+    # know the method name (cli) pass it, anonymous factories fall back
+    # to the callable's name (a bare lambda stays ambiguous — cost
+    # attribution is best-effort telemetry, never load-bearing)
+    if label is None:
+        label = getattr(factory, "__name__", None) or "anon"
+    shape = "x".join(str(int(s)) for s in getattr(preds, "shape", ()))
+    return (f"engine/run_seeds/{label}/{shape}/s{seeds}x{iters}"
+            + ("/rec" if recorded else ""))
+
+
+def _aot(jit_fn, args: tuple, name: str):
+    """AOT-compile, cost-harvest, and execute one engine entry program
+    (``telemetry/costs.py``): same HLO, same compile — now with its
+    FLOPs/bytes/peak-HBM attribution in the process cost book. Falls back
+    to the plain jit call wherever AOT is unavailable."""
+    from coda_tpu.telemetry.costs import aot_call
+
+    return aot_call(jit_fn, args, name, site="engine")
+
+
 def run_seeds_recorded(
     selector_factory: Callable[[jnp.ndarray], Selector],
     preds: jnp.ndarray,
@@ -274,13 +299,16 @@ def run_seeds_recorded(
     seeds: int = 5,
     loss_fn: Callable = accuracy_loss,
     trace_k: int = 8,
+    cost_label: Optional[str] = None,
 ):
     """:func:`run_seeds_compiled` with the flight recorder on: returns
     ``(ExperimentResult, RunTraceAux)``, both with a leading seed axis."""
     fn = make_batched_experiment_fn(selector_factory, iters, loss_fn,
                                     trace_k=trace_k)
     keys = jnp.stack([jax.random.PRNGKey(s) for s in range(seeds)])
-    return jax.jit(fn)(preds, labels, keys)
+    return _aot(jax.jit(fn), (preds, labels, keys),
+                _engine_cost_name(preds, seeds, iters, selector_factory,
+                                  label=cost_label, recorded=True))
 
 
 def run_experiment(
@@ -312,6 +340,7 @@ def run_seeds_compiled(
     iters: int = 100,
     seeds: int = 5,
     loss_fn: Callable = accuracy_loss,
+    cost_label: Optional[str] = None,
 ) -> ExperimentResult:
     """All seeds, with the prediction tensor as a *traced jit argument*.
 
@@ -325,7 +354,9 @@ def run_seeds_compiled(
     """
     fn = make_batched_experiment_fn(selector_factory, iters, loss_fn)
     keys = jnp.stack([jax.random.PRNGKey(s) for s in range(seeds)])
-    return jax.jit(fn)(preds, labels, keys)
+    return _aot(jax.jit(fn), (preds, labels, keys),
+                _engine_cost_name(preds, seeds, iters, selector_factory,
+                                  label=cost_label))
 
 
 def make_batched_experiment_fn(

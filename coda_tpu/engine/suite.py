@@ -110,7 +110,8 @@ class SuiteRunner:
 
     def __init__(self, iters: int = 100, seeds: int = 5, loss: str = "acc",
                  dedup_seeds: bool = True, telemetry=None,
-                 record_dir: Optional[str] = None, record_topk: int = 8):
+                 record_dir: Optional[str] = None, record_topk: int = 8,
+                 cost_capture: bool = True):
         import jax
 
         self.iters = iters
@@ -137,6 +138,16 @@ class SuiteRunner:
         # remaining seeds. Cuts 5x compute for CODA/uncertainty on tie-free
         # tasks at the cost of one extra (1-seed) compile per method.
         self.dedup_seeds = dedup_seeds
+        # per-executable cost attribution (telemetry/costs.py): each jitted
+        # experiment program is wrapped in a CostTracked that AOT-compiles
+        # per argument signature (the same one compile the jit cache would
+        # pay) and harvests XLA's cost/memory analysis — so the scheduler's
+        # per-device executables and the serial path's per-shape programs
+        # all land in the process cost book with FLOPs/bytes/roofline.
+        # This per-runner knob composes with the process-wide kill switch
+        # (costs.set_enabled, the cli's --no-cost-capture): harvesting
+        # happens only when BOTH are on.
+        self.cost_capture = bool(cost_capture)
         self._jitted: dict = {}
         # cold attribution persists across run()/run_batched() calls, like
         # the jit cache it mirrors: a warm RERUN on the same runner pays no
@@ -290,7 +301,29 @@ class SuiteRunner:
                 # per-task runtime hyperparams (T,)
                 in_axes = (0, 0, None) + (0,) * len(runtime)
                 fn = self._jax.vmap(fn, in_axes=in_axes)
-            self._jitted[key] = self._jax.jit(fn)
+            jfn = self._jax.jit(fn)
+            if self.cost_capture:
+                import hashlib
+
+                from coda_tpu.telemetry.costs import CostTracked
+
+                label = (f"suite/{method}/w{width}"
+                         + (f"/x{n_tasks}" if n_tasks else "")
+                         + ("/rec" if trace_k else ""))
+                if static:
+                    # static hyperparams key the _jitted cache; they must
+                    # key the cost-book name too or two configs of one
+                    # method would silently overwrite each other's entry
+                    label += "/h" + hashlib.sha256(
+                        repr(sorted(static.items())).encode()
+                    ).hexdigest()[:6]
+                jfn = CostTracked(
+                    jfn, name=label, site="suite",
+                    registry=(self.telemetry.registry
+                              if self.telemetry is not None else None),
+                    extra={"method": method, "width": width,
+                           "n_tasks": n_tasks})
+            self._jitted[key] = jfn
         return self._jitted[key]
 
     def run_one(self, method: str, dataset, method_args: Optional[dict] = None):

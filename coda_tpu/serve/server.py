@@ -104,6 +104,10 @@ class ServeApp:
         # crash-safe append-only JSONL files per session
         self.recorder = recorder if recorder is not None \
             else SessionRecorder(faults=self.faults)
+        # warm-pool cost gauges must land in THIS app's registry (the one
+        # /metrics renders), not the process-global default — a custom-
+        # registry Telemetry would otherwise never show its buckets' costs
+        self.store.registry = self.telemetry.registry
         if self.faults is not None and \
                 getattr(self.recorder, "faults", None) is None:
             # an injected recorder joins the fault domain too (record_eio)
@@ -550,7 +554,13 @@ class ServeApp:
              "warm": b.is_warm, "warm_s": b.warm_s,
              "warm_hits": b.warm_hits, "warm_misses": b.warm_misses,
              "failed": b.failed, "quarantined": b.quarantined,
-             "heals": b.heals}
+             "heals": b.heals,
+             # the warm pool's XLA cost attribution per program (step/
+             # init/pbest/write_slot): FLOPs, bytes accessed, peak
+             # device-resident bytes, roofline class — populated by
+             # warm(), empty before it (or where cost_analysis is
+             # unavailable)
+             "cost": dict(b.cost_info)}
             for b in self.store.buckets()
         ]
         snap["warm_error"] = self.warm_error

@@ -260,7 +260,7 @@ class Bucket:
     def __init__(self, preds, spec: SelectorSpec, capacity: int,
                  n_valid: Optional[int] = None, task: str = "",
                  step_impl: Optional[str] = None, donate: bool = True,
-                 faults=None):
+                 faults=None, registry=None):
         import jax
         import jax.numpy as jnp
 
@@ -320,6 +320,13 @@ class Bucket:
         # cache, ~11 update-steps of compute per admission otherwise)
         self._init_state = None
         self.warm_s: Optional[float] = None   # wall seconds spent in warm()
+        # per-executable XLA cost attribution of the warm pool, harvested
+        # by warm() (telemetry/costs.py): program name -> {flops,
+        # bytes_accessed, peak_hbm_bytes, roofline_class, ...}. Surfaced
+        # per bucket on /stats and as executable_* gauges on /metrics —
+        # "the tick is one capacity-bound slab step" as a machine-read
+        # field instead of a NOTES sentence.
+        self.cost_info: dict = {}
         self._n_warm = 0      # executables the last successful warm() built
         self.warm_hits = 0    # dispatches served by the AOT executable
         self.warm_misses = 0  # dispatches that fell back to lazy jit
@@ -335,6 +342,10 @@ class Bucket:
         self.quarantined: Optional[str] = None
         self.heals = 0           # successful slab rebuilds (stats evidence)
         self._faults = faults    # optional FaultInjector (serve/faults.py)
+        # telemetry registry the warm-pool cost gauges land in (None =
+        # the process-global one); the app threads its own through the
+        # store so /metrics renders the costs of ITS buckets
+        self._registry = registry
         # standalone posterior-digest read (built lazily in digest()):
         # mirrors the in-step digest so an imported snapshot verifies
         # against the stream's last recorded digest without a dispatch
@@ -440,6 +451,30 @@ class Bucket:
                 init_state = s_a
             # publish atomically (everything or nothing; is_warm keys off
             # _step_exec, so a failure above leaves the bucket retryable)
+            # cost attribution of the pool: XLA's own analysis of each
+            # freshly (de)serialized executable — the step program is the
+            # bucket's steady-state cost; init/pbest/write are the
+            # admission/read paths. Best-effort by contract: a backend
+            # without cost_analysis leaves cost_info empty, never fails
+            # the warm-up.
+            from coda_tpu.telemetry.costs import harvest_executable_cost
+
+            H_, N_, C_ = self.shape
+            prefix = (f"serve/{self.task}/{self.spec.method}/"
+                      f"{H_}x{N_}x{C_}")
+            extra = {"task": self.task, "method": self.spec.method,
+                     "shape": list(self.shape), "capacity": self.capacity}
+            for pname, ex in (("step", step_exec), ("init", init_exec),
+                              ("pbest", pbest_exec),
+                              ("write_slot", write_exec)):
+                if ex is None:
+                    continue
+                entry = harvest_executable_cost(
+                    ex, f"{prefix}/{pname}", site="serve",
+                    registry=self._registry,
+                    extra=dict(extra, program=pname))
+                if entry is not None:
+                    self.cost_info[pname] = entry
             self._init_exec = init_exec
             self._pbest_exec = pbest_exec
             self._write_exec = write_exec
@@ -801,7 +836,7 @@ class SessionStore:
 
     def __init__(self, capacity: int = 64, bucket_n: int = 1,
                  step_impl: Optional[str] = None, donate: bool = True,
-                 faults=None):
+                 faults=None, registry=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         if bucket_n < 1:
@@ -811,6 +846,9 @@ class SessionStore:
         self.step_impl = step_impl
         self.donate = donate
         self.faults = faults                 # shared FaultInjector or None
+        self.registry = registry             # cost-gauge registry (or None
+        #                                      = process-global); ServeApp
+        #                                      sets its telemetry's here
         self._tasks: dict[str, Any] = {}     # name -> (H, N, C) ndarray
         self._meta: dict[str, dict] = {}     # name -> class/model names
         self._buckets: dict[tuple, Bucket] = {}
@@ -888,7 +926,7 @@ class SessionStore:
                 preds = np.pad(preds, ((0, 0), (0, n_pad - N), (0, 0)))
             b = Bucket(preds, spec, self.capacity, n_valid=N, task=task,
                        step_impl=self.step_impl, donate=self.donate,
-                       faults=self.faults)
+                       faults=self.faults, registry=self.registry)
             with self.lock:
                 self._buckets[key] = b
             return b

@@ -26,6 +26,16 @@ import json
 import os
 from typing import Optional
 
+from coda_tpu.telemetry.costs import (
+    COSTS,
+    CostBook,
+    CostTracked,
+    analyze_compiled,
+    aot_call,
+    harvest_executable_cost,
+    roofline,
+)
+from coda_tpu.telemetry.prometheus import lint as lint_prometheus
 from coda_tpu.telemetry.prometheus import render as render_prometheus
 from coda_tpu.telemetry.registry import (
     Counter,
@@ -50,7 +60,10 @@ from coda_tpu.telemetry.recorder import (
 from coda_tpu.telemetry.spans import SpanRecorder, annotation
 
 __all__ = [
+    "COSTS",
     "CROSS_BACKEND_SCORE_TOL",
+    "CostBook",
+    "CostTracked",
     "Counter",
     "Gauge",
     "RECORD_SCHEMA_VERSION",
@@ -59,15 +72,20 @@ __all__ = [
     "SessionRecorder",
     "SpanRecorder",
     "Telemetry",
+    "analyze_compiled",
     "annotation",
+    "aot_call",
     "dataset_digest",
     "environment_fingerprint",
     "get_registry",
+    "harvest_executable_cost",
     "install_jax_hooks",
     "jax_hooks_installed",
     "knobs_from_args",
+    "lint_prometheus",
     "registry_hooked",
     "render_prometheus",
+    "roofline",
     "sample_device_memory",
     "stream_dir",
 ]
@@ -178,6 +196,11 @@ class Telemetry:
                 for dev, v in _values("device_peak_bytes").items()
             },
             "spans": self.spans.summary(),
+            # per-executable XLA cost attribution (telemetry/costs.py):
+            # every compiled program harvested this process — FLOPs, bytes
+            # accessed, peak working set, roofline class — keyed by site
+            # (serve warm pool / suite / engine / bench)
+            "costs": COSTS.snapshot(),
         }
         if extra:
             snap.update(extra)

@@ -1,0 +1,387 @@
+"""Per-executable XLA cost attribution + roofline classification.
+
+Every performance decision this repo makes — where an amortized Dirichlet
+approximation would pay at C=1000, whether a serve tick is compute- or
+HBM-bound, which suite dispatch deserves a bigger device — ultimately asks
+the same question of a *compiled executable*: how many FLOPs does it do,
+how many bytes does it move, and which side of the machine balance does
+that put it on? Until now the answer lived in NOTES files, derived by
+hand. This module makes it a harvested, machine-readable field:
+
+  * :func:`analyze_compiled` reads XLA's own ``cost_analysis()`` /
+    ``memory_analysis()`` off a ``jax.stages.Compiled`` — FLOPs, bytes
+    accessed, argument/output/temp buffer sizes, and a peak-HBM estimate
+    (arguments + outputs + temporaries, the executable's resident
+    working set);
+  * :func:`roofline` classifies the executable against a small
+    per-device-kind peak table (the one table shared with ``bench.py``'s
+    MFU/MBU math): arithmetic intensity below the machine balance means
+    HBM-bound, above means compute-bound. Unknown device kinds (CPU
+    containers) fall back to a documented generic host balance so the
+    *classification* still exists — the peak fields stay honest (absent);
+  * :class:`CostBook` is the process-wide ledger every harvest lands in,
+    surfaced as the ``costs`` section of ``telemetry.json``, as per-bucket
+    ``cost`` blocks on serve ``/stats``, and as ``executable_*`` gauge
+    families on ``/metrics``.
+
+Harvest sites (the three compile sites of the stack):
+
+  * **serve warm pool** — ``Bucket.warm()`` already AOT-compiles every
+    slab-step/init/pbest/write executable; harvesting there is free;
+  * **suite / scheduler** — :class:`CostTracked` wraps the runner's jitted
+    experiment programs: the first call per argument signature compiles
+    ahead-of-time (``lower().compile()`` — the same compile the jit cache
+    would have paid, through the same persistent compilation cache) and
+    harvests the cost analysis; later calls reuse the compiled executable.
+    Per-device scheduler placements key separate signatures, so each
+    device's executable is attributed individually;
+  * **engine entry** — :func:`aot_call` does the same for the one-shot
+    ``run_seeds_compiled`` / ``run_seeds_recorded`` programs the CLI runs.
+
+Caveat carried from ``bench.py``: XLA's FLOP counter counts ``lax.scan`` /
+``lax.map`` bodies ONCE regardless of trip count, so a whole-experiment
+executable's ``flops`` is not per-step work — it is the per-*invocation*
+program profile, comparable across executables and rounds, which is what
+regression gating and placement decisions need. Per-step rooflines stay
+the analytic models' job (``bench.py``).
+
+Every helper here is best-effort: a backend without cost analysis (or a
+lowering that refuses AOT) degrades to the plain jit path and records
+nothing — cost attribution must never be able to fail a run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Optional
+
+# -- the per-chip peak table (moved from bench.py; ONE definition) ----------
+
+# published peak dense-matmul FLOP/s per chip (bf16); fp32 on the MXU runs
+# at a fraction of this, so fp32 MFU vs the bf16 peak is a conservative
+# lower bound on how well a kernel uses the hardware
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+# published HBM bandwidth per chip (bytes/s) — the denominator of MBU and
+# the other axis of the machine balance
+PEAK_HBM_BPS = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+# machine balance (FLOP/byte) fallback for device kinds not in the table
+# (CPU containers, future chips before their entry lands): a generic
+# server-CPU ballpark — tens of fp32 GFLOP/s against ~10 GB/s of per-core
+# memory bandwidth. Coarse by design; entries in the tables above always
+# win, and the ``peak_source`` field says which was used so a CPU-container
+# roofline class is never mistaken for silicon evidence.
+DEFAULT_MACHINE_BALANCE = 8.0
+
+
+def peaks_for(device_kind: Optional[str]) -> dict:
+    """Peak FLOP/s + HBM B/s for a device kind (None values if unknown)."""
+    pf = PEAK_FLOPS.get(device_kind) if device_kind else None
+    pb = PEAK_HBM_BPS.get(device_kind) if device_kind else None
+    return {"peak_flops_per_sec": pf, "peak_hbm_bytes_per_sec": pb,
+            "peak_source": "table" if (pf and pb) else "default_balance"}
+
+
+def roofline(flops: float, bytes_accessed: float,
+             device_kind: Optional[str] = None) -> dict:
+    """Arithmetic intensity vs machine balance -> bound classification.
+
+    ``class`` is ``compute-bound`` when the executable's FLOP/byte ratio
+    clears the device's machine balance, ``memory-bound`` below it, and
+    ``unknown`` when XLA reported no byte traffic to divide by. With an
+    unknown device kind the balance falls back to
+    :data:`DEFAULT_MACHINE_BALANCE` (``peak_source: default_balance``).
+    """
+    peaks = peaks_for(device_kind)
+    pf, pb = peaks["peak_flops_per_sec"], peaks["peak_hbm_bytes_per_sec"]
+    balance = (pf / pb) if (pf and pb) else DEFAULT_MACHINE_BALANCE
+    flops = max(0.0, float(flops or 0.0))
+    bytes_accessed = max(0.0, float(bytes_accessed or 0.0))
+    if bytes_accessed <= 0.0:
+        cls, ai = "unknown", 0.0
+    else:
+        ai = flops / bytes_accessed
+        cls = "compute-bound" if ai >= balance else "memory-bound"
+    return {
+        "arithmetic_intensity": ai,
+        "machine_balance": balance,
+        "roofline_class": cls,
+        **peaks,
+    }
+
+
+def analyze_compiled(compiled) -> Optional[dict]:
+    """XLA cost + memory analysis of one compiled executable, or None.
+
+    ``flops`` / ``bytes accessed`` come from ``cost_analysis()`` (list-of-
+    dicts on older APIs), buffer sizes from ``memory_analysis()``;
+    ``peak_hbm_bytes`` is arguments + outputs + temporaries + aliases —
+    the executable's device-resident working set, the number the HBM
+    budgeting (scheduler ``max_inflight``, serve capacity) reasons about.
+    """
+    out: dict = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        out["flops"] = max(0.0, float(cost.get("flops", 0.0)))
+        out["bytes_accessed"] = max(
+            0.0, float(cost.get("bytes accessed", 0.0)))
+    except Exception:
+        return None
+    try:
+        ma = compiled.memory_analysis()
+        arg = float(getattr(ma, "argument_size_in_bytes", 0) or 0)
+        res = float(getattr(ma, "output_size_in_bytes", 0) or 0)
+        tmp = float(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        ali = float(getattr(ma, "alias_size_in_bytes", 0) or 0)
+        out.update(argument_bytes=arg, output_bytes=res, temp_bytes=tmp,
+                   generated_code_bytes=float(
+                       getattr(ma, "generated_code_size_in_bytes", 0) or 0),
+                   peak_hbm_bytes=arg + res + tmp + ali)
+    except Exception:
+        # cost without memory is still worth recording (older runtimes)
+        out.update(argument_bytes=None, output_bytes=None, temp_bytes=None,
+                   generated_code_bytes=None, peak_hbm_bytes=None)
+    return out
+
+
+def _default_device_kind() -> Optional[str]:
+    try:
+        import jax
+
+        devs = jax.devices()
+        return devs[0].device_kind if devs else None
+    except Exception:
+        return None
+
+
+# -- the process-wide cost ledger -------------------------------------------
+
+class CostBook:
+    """Thread-safe ledger of harvested executables: name -> cost entry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+
+    def record(self, name: str, entry: dict) -> None:
+        with self._lock:
+            self._entries[name] = dict(entry)
+
+    def get(self, name: str) -> Optional[dict]:
+        with self._lock:
+            e = self._entries.get(name)
+            return dict(e) if e is not None else None
+
+    def snapshot(self, site: Optional[str] = None) -> dict:
+        """JSON-able {name: entry}, optionally filtered to one harvest
+        site (``serve`` | ``suite`` | ``engine`` | ``bench``)."""
+        with self._lock:
+            return {k: dict(v) for k, v in sorted(self._entries.items())
+                    if site is None or v.get("site") == site}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+COSTS = CostBook()
+
+_ENABLED = True
+
+
+def set_enabled(flag: bool) -> None:
+    """Process-wide kill switch (``--no-cost-capture``): harvesting AND
+    the AOT-compile-and-reuse wrappers degrade to the plain jit path."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _feed_gauges(name: str, entry: dict, registry=None) -> None:
+    from coda_tpu.telemetry.registry import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    labels = {"site": entry.get("site", ""), "name": name}
+    reg.gauge("executable_flops",
+              "XLA cost-model FLOPs of a compiled executable (scan/map "
+              "bodies counted once)").set(entry["flops"], **labels)
+    reg.gauge("executable_bytes_accessed",
+              "XLA cost-model bytes accessed by a compiled "
+              "executable").set(entry["bytes_accessed"], **labels)
+    if entry.get("peak_hbm_bytes") is not None:
+        reg.gauge("executable_peak_hbm_bytes",
+                  "Device-resident working set of a compiled executable "
+                  "(arguments + outputs + temporaries)").set(
+                      entry["peak_hbm_bytes"], **labels)
+    reg.gauge("executable_arithmetic_intensity",
+              "FLOPs per byte accessed of a compiled executable").set(
+                  entry["arithmetic_intensity"], **labels)
+    reg.gauge("executable_roofline",
+              "Roofline classification marker (value is always 1; the "
+              "class label carries the verdict)").set(
+                  1.0, **labels, **{"class": entry["roofline_class"]})
+
+
+def harvest(compiled, name: str, site: str = "engine",
+            device_kind: Optional[str] = None, registry=None,
+            extra: Optional[dict] = None) -> Optional[dict]:
+    """Analyze + classify + ledger one compiled executable. Never raises;
+    returns the recorded entry (or None when analysis is unavailable)."""
+    if not _ENABLED:
+        return None
+    try:
+        xla = analyze_compiled(compiled)
+        if xla is None:
+            return None
+        if device_kind is None:
+            device_kind = _default_device_kind()
+        entry = {"site": site, "device_kind": device_kind, **xla,
+                 **roofline(xla["flops"], xla["bytes_accessed"],
+                            device_kind)}
+        if extra:
+            entry.update(extra)
+        COSTS.record(name, entry)
+        _feed_gauges(name, entry, registry)
+        return entry
+    except Exception:
+        return None
+
+
+# -- harvest-at-compile wrappers --------------------------------------------
+
+def _leaf_sig(x) -> tuple:
+    shape = tuple(getattr(x, "shape", ()) or ())
+    dtype = str(getattr(x, "dtype", type(x).__name__))
+    try:
+        devs = tuple(sorted(str(d) for d in x.devices()))
+    except Exception:
+        devs = ()
+    return (shape, dtype, devs)
+
+
+def _signature(args: tuple) -> tuple:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (str(treedef),) + tuple(_leaf_sig(x) for x in leaves)
+
+
+def _sig_tag(sig: tuple) -> str:
+    return hashlib.sha256(repr(sig).encode()).hexdigest()[:8]
+
+
+class CostTracked:
+    """Wrap a jitted function so every distinct argument signature is
+    compiled ahead-of-time ONCE and cost-harvested.
+
+    Call-compatible with the jit function it wraps (the suite runner's
+    ``_jitted`` cache stores these). Compilation cost is identical to the
+    jit path it replaces — one XLA compile per signature, served by the
+    same persistent compilation cache — and the compiled program is the
+    same HLO, so results are bitwise those of the lazy-jit path (the same
+    contract the serve warm pool is pinned on). Any AOT failure (an
+    argument XLA refuses to lower ahead-of-time, an aval mismatch at call
+    time) falls back to the plain jit call for that signature, recorded as
+    ``aot: false`` so coverage gaps are visible, never silent.
+    """
+
+    def __init__(self, jit_fn, name: str, site: str = "suite",
+                 registry=None, extra: Optional[dict] = None):
+        self._jit = jit_fn
+        self._name = name
+        self._site = site
+        self._registry = registry
+        self._extra = extra
+        self._lock = threading.Lock()
+        self._compiled: dict = {}   # signature -> Compiled | None(fallback)
+
+    def __call__(self, *args):
+        if not _ENABLED:
+            return self._jit(*args)
+        try:
+            sig = _signature(args)
+        except Exception:
+            return self._jit(*args)
+        with self._lock:
+            known = sig in self._compiled
+            compiled = self._compiled.get(sig)
+        if not known:
+            compiled = self._compile(sig, args)
+        if compiled is None:
+            return self._jit(*args)
+        try:
+            return compiled(*args)
+        except Exception:
+            # aval/sharding mismatch the signature didn't key: degrade this
+            # signature to the jit path permanently — and overwrite the
+            # harvested entry so the book never implies an AOT-attributed
+            # program that actually runs lazy (the never-silent contract)
+            with self._lock:
+                self._compiled[sig] = None
+            COSTS.record(f"{self._name}@{_sig_tag(sig)}",
+                         {"site": self._site, "aot": False,
+                          "degraded": "call"})
+            return self._jit(*args)
+
+    def _compile(self, sig: tuple, args: tuple):
+        try:
+            compiled = self._jit.lower(*args).compile()
+        except Exception:
+            compiled = None
+        with self._lock:
+            self._compiled[sig] = compiled
+        name = f"{self._name}@{_sig_tag(sig)}"
+        if compiled is not None:
+            extra = dict(self._extra or {})
+            extra["signature"] = [list(map(str, s)) for s in sig[1:]]
+            harvest(compiled, name, site=self._site,
+                    registry=self._registry, extra=extra)
+        else:
+            COSTS.record(name, {"site": self._site, "aot": False})
+        return compiled
+
+
+# package-level alias: `from coda_tpu.telemetry import
+# harvest_executable_cost` reads better than a bare `harvest`
+harvest_executable_cost = harvest
+
+
+def aot_call(jit_fn, args: tuple, name: str, site: str = "engine",
+             registry=None, extra: Optional[dict] = None):
+    """One-shot AOT-compile + harvest + execute (the engine entry's
+    ``jax.jit(fn)(*args)`` with cost attribution). The jit path is the
+    fallback for anything AOT refuses."""
+    if not _ENABLED:
+        return jit_fn(*args)
+    try:
+        compiled = jit_fn.lower(*args).compile()
+    except Exception:
+        return jit_fn(*args)
+    harvest(compiled, name, site=site, registry=registry, extra=extra)
+    try:
+        return compiled(*args)
+    except Exception:
+        return jit_fn(*args)

@@ -126,6 +126,129 @@ _SERVE_WARM = [
 ]
 
 
+# -- exposition lint ---------------------------------------------------------
+
+_METRIC_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+# one sample line: name{labels} value — labels quoted, escapes resolved by
+# the tokenizer below, value a float or NaN/+Inf/-Inf
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>NaN|[+-]Inf|[+-]?[0-9][0-9.eE+-]*)$")
+_LABEL_PAIR = re.compile(
+    r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\\n]|\\["\\n])*)"')
+# the WHOLE label body must be comma-separated pairs (an optional trailing
+# comma is legal exposition) — substring matching alone would tolerate
+# missing separators like k1="a"k2="b"
+_LABELS_BODY = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*")*,?$')
+
+
+def lint(text: str) -> list[str]:
+    """Violations of the v0.0.4 text-exposition contract (empty = clean).
+
+    The checks a scraping Prometheus would actually choke or mis-ingest
+    on: malformed sample lines, unescaped label values or missing label
+    separators, duplicate series (same name + label set twice), a HELP
+    after its family's TYPE, a family re-opened after other families
+    interleaved (duplicate TYPE), samples with no TYPE, bad metric/label
+    names, and values that are not valid floats (NaN/±Inf must use the
+    canonical spellings). Summary ``_count``/``_sum`` suffixed samples
+    belong to their base family.
+    """
+    out: list[str] = []
+    typed: dict[str, str] = {}       # family -> kind
+    helped: set[str] = set()
+    closed: set[str] = set()         # families a later line may not reopen
+    series: set[tuple] = set()       # (name, canonical labels) seen
+    current: str = ""
+
+    def _family_of(name: str) -> str:
+        base = name
+        for suffix in ("_count", "_sum", "_bucket"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                base = name[: -len(suffix)]
+        return base
+
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 3:
+                out.append(f"line {i}: malformed HELP")
+                continue
+            name = parts[2]
+            if name in helped:
+                out.append(f"line {i}: duplicate HELP for {name}")
+            if name in typed:
+                out.append(f"line {i}: HELP for {name} after its TYPE")
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4:
+                out.append(f"line {i}: malformed TYPE")
+                continue
+            name, kind = parts[2], parts[3]
+            if not _METRIC_NAME.match(name):
+                out.append(f"line {i}: bad metric name {name!r}")
+            if kind not in ("counter", "gauge", "summary", "histogram",
+                            "untyped"):
+                out.append(f"line {i}: unknown TYPE kind {kind!r}")
+            if name in typed:
+                out.append(f"line {i}: duplicate TYPE for {name}")
+            if name in closed:
+                out.append(f"line {i}: family {name} reopened after other "
+                           "families (non-contiguous)")
+            if current and current != name:
+                closed.add(current)
+            typed[name] = kind
+            current = name
+            continue
+        if line.startswith("#"):
+            continue  # comments are legal anywhere
+        m = _SAMPLE.match(line)
+        if not m:
+            out.append(f"line {i}: unparseable sample {line!r}")
+            continue
+        name = m.group("name")
+        fam = _family_of(name)
+        if fam not in typed:
+            out.append(f"line {i}: sample {name} has no TYPE header")
+        elif fam != current:
+            out.append(f"line {i}: sample {name} outside its family block")
+        labels = m.group("labels")
+        pairs: list = []
+        if labels is not None:
+            if not (labels == "" or _LABELS_BODY.match(labels)):
+                out.append(f"line {i}: malformed/unescaped labels "
+                           f"{labels!r} (pairs must be comma-separated "
+                           "with escaped quoted values)")
+            else:
+                seen = []
+                for lm in _LABEL_PAIR.finditer(labels):
+                    if lm.group("k") in seen:
+                        out.append(f"line {i}: duplicate label "
+                                   f"{lm.group('k')!r}")
+                    seen.append(lm.group("k"))
+                    pairs.append((lm.group("k"), lm.group("v")))
+        key = (name, tuple(sorted(pairs)))
+        if key in series:
+            out.append(f"line {i}: duplicate series {name}"
+                       f"{{{dict(pairs)}}} (same name + label set "
+                       "emitted twice)")
+        series.add(key)
+        val = m.group("value")
+        if val not in ("NaN", "+Inf", "-Inf"):
+            try:
+                float(val)
+            except ValueError:
+                out.append(f"line {i}: bad value {val!r}")
+    return out
+
+
 def _render_serve(out: list, snap: dict, prefix: str) -> None:
     for key, suffix, kind, help in _SERVE_SCALARS:
         v = snap.get(key)

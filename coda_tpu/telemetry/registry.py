@@ -222,6 +222,51 @@ def install_jax_hooks(registry: Optional[Registry] = None) -> bool:
 
 # -- device memory sampling --------------------------------------------------
 
+def _read_rss_bytes() -> Optional[int]:
+    """Current process resident-set size, or None where unreadable."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os
+
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        pass
+    try:
+        import resource
+        import sys
+
+        # ru_maxrss is the PEAK, not current — still honest memory
+        # evidence on hosts without /proc. Units differ by platform:
+        # bytes on macOS, KiB on Linux/BSD.
+        scale = 1 if sys.platform == "darwin" else 1024
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * scale
+    except Exception:
+        return None
+
+
+def sample_process_rss(registry: Optional[Registry] = None) -> Optional[int]:
+    """Record the process RSS gauge + watermark (``source="rss"``).
+
+    The CPU-container fallback for memory evidence: ``memory_stats()`` is
+    None there, so captures carried NO memory numbers at all. Host RSS is
+    not device HBM — the ``source`` label keeps the two families distinct
+    (``device_*`` gauges stay strictly ``memory_stats()``-backed) — but it
+    bounds the working set the same artifacts need to reason about."""
+    reg = registry or REGISTRY
+    rss = _read_rss_bytes()
+    if rss is None:
+        return None
+    reg.gauge("process_rss_bytes",
+              "Resident-set size of this process (host memory; the "
+              "CPU-container fallback for device memory evidence)").set(
+                  float(rss), source="rss")
+    reg.gauge("process_peak_rss_bytes",
+              "High-water process RSS across samples").set_max(
+                  float(rss), source="rss")
+    return int(rss)
+
+
 def sample_device_memory(registry: Optional[Registry] = None,
                          devices=None) -> dict:
     """Record per-device HBM gauges + watermarks; returns what was sampled.
@@ -261,4 +306,10 @@ def sample_device_memory(registry: Optional[Registry] = None,
         peak.set_max(float(pk), device=dev)
         out[dev] = {"bytes_in_use": int(used),
                     "peak_bytes_in_use": int(pk)}
+    if not out:
+        # no device reported memory_stats (CPU backend): fall back to
+        # process RSS so the capture still carries memory evidence. The
+        # returned dict stays device-only — RSS is a registry gauge, not a
+        # device sample.
+        sample_process_rss(reg)
     return out

@@ -351,6 +351,23 @@ def main(argv=None):
         "eig_entropy": args.eig_entropy or "exact",
         "vs_baseline": 0.0,
     }
+    # provenance + cost attribution: the environment fingerprint makes the
+    # capture cross-round comparable (scripts/check_perf.py keys regression
+    # comparisons on it), and the cost section is the suite's per-
+    # executable XLA attribution (FLOPs/bytes/peak-HBM/roofline per
+    # compiled program, harvested at compile by the runner's CostTracked
+    # wrappers)
+    from coda_tpu.telemetry.costs import COSTS
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    line["fingerprint"] = environment_fingerprint(knobs={
+        "methods": args.methods, "seeds": args.seeds, "iters": args.iters,
+        "eig_chunk": args.eig_chunk, "eig_backend": args.eig_backend,
+        "eig_entropy": args.eig_entropy, "small": args.small,
+        "task_batch": bool(args.task_batch),
+        "suite_devices": args.suite_devices, "schedule": args.schedule,
+        "mesh": args.mesh})
+    line["cost"] = COSTS.snapshot(site="suite")
     if args.suite_devices is not None:
         # wall vs summed device-seconds diverge exactly when placement
         # achieves concurrency; both are recorded so speedup math stays

@@ -608,8 +608,33 @@ def run_loadgen(args) -> dict:
 
     lat_ms = np.asarray(latencies, np.float64) * 1e3
     n_requests = len(latencies)
+    # provenance stamp: the shared environment fingerprint that makes this
+    # capture attributable and lets scripts/check_perf.py run same-
+    # fingerprint cross-round regression comparisons on serve latency
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    fingerprint = environment_fingerprint(knobs={
+        "method": args.method, "capacity": args.capacity,
+        "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
+        "max_linger_ms": args.max_linger_ms,
+        "sessions": args.sessions, "labels": args.labels,
+        "workers": args.workers, "step_impl": args.step_impl,
+        # the workload-shaping axes too: two captures that differ in
+        # arrival model or transport must never share a regression key
+        "mode": mode,
+        "transport": "http" if (args.url or args.http) else "inproc",
+        "ramp_s": args.ramp_s,
+        "task": args.task or args.synthetic or "default"})
+    # per-bucket executable cost attribution (warm-pool harvest): which
+    # side of the roofline the slab step sits on, machine-read
+    bucket_costs = [
+        {"task": b.get("task"), "method": b.get("method"),
+         "cost": b.get("cost")}
+        for b in stats.get("buckets", [])] or None
     report = {
         "bench": "serve_loadgen",
+        "fingerprint": fingerprint,
+        "bucket_costs": bucket_costs,
         "mode": mode,
         "transport": ("http" if (args.url or args.http) else "inproc"),
         "workers": args.workers,

@@ -149,3 +149,51 @@ def test_analytic_bytes_prices_fused_pallas_backend():
     # saves the defensive copy XLA inserts around the DUS (not priced —
     # the model charges pure algorithmic traffic for both backends)
     assert pal_b == jnp_b + 8.0 * N * H
+
+
+def test_bench_cost_section_present_and_finite():
+    """The machine-read cost section of the bench output (acceptance:
+    fields present-and-finite on CPU, no hard-coded backend numbers): XLA
+    whole-program analysis of the timed executable + the analytic
+    per-step roofline classification against the shared peak table."""
+    import math
+
+    ours = bench.bench_ours(8, 64, 3, iters=2, eig_chunk=64, reps=2)
+    cost = ours["cost"]
+    for key in ("xla_flops", "xla_bytes_accessed", "peak_hbm_bytes",
+                "arithmetic_intensity", "machine_balance"):
+        assert isinstance(cost[key], (int, float)) and \
+            math.isfinite(cost[key]), key
+    assert cost["xla_flops"] > 0 and cost["xla_bytes_accessed"] > 0
+    assert cost["roofline_class"] in ("compute-bound", "memory-bound")
+    assert cost["flop_accounting"] == "analytic_per_step"
+    # on an unknown device kind the classification uses the documented
+    # default balance and SAYS so; on a known chip it cites the table
+    assert cost["peak_source"] in ("table", "default_balance")
+    if cost["peak_source"] == "default_balance":
+        assert cost["peak_flops_per_sec"] is None
+    else:
+        assert cost["peak_flops_per_sec"] > 0
+    # the harvest also landed in the process cost book (telemetry.json's
+    # costs section)
+    from coda_tpu.telemetry import COSTS
+
+    assert any(k.startswith("bench/coda/8x64x3/")
+               for k in COSTS.snapshot(site="bench"))
+
+
+def test_bench_output_is_fingerprinted():
+    """bench.py stamps the recorder's environment fingerprint so captures
+    are attributable and cross-round comparable (check_perf keys
+    same-fingerprint regression on it)."""
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    fp = environment_fingerprint(knobs={"eig_entropy": "approx"})
+    assert fp["backend"] == "cpu"
+    assert fp["knobs"]["eig_entropy"] == "approx"
+    # the peak table bench reports MFU/MBU against is the ONE shared
+    # table in telemetry/costs.py
+    from coda_tpu.telemetry import costs
+
+    assert bench._PEAK_FLOPS is costs.PEAK_FLOPS
+    assert bench._PEAK_HBM_BPS is costs.PEAK_HBM_BPS

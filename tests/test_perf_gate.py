@@ -1,0 +1,309 @@
+"""Tier-1 wiring of the committed-artifact perf gate + evidence manifest.
+
+``scripts/check_perf.py`` is the generalized descendant of
+``check_serve_bench.py``: a declarative contract registry over every
+``BENCH_*``/``EVIDENCE_*`` artifact at the repo root. These tests pin the
+gate's three promises:
+
+  * the committed artifact set passes clean (regenerating an artifact
+    weaker — or adding one with no declared contract — fails tier-1);
+  * tampering a gated bound or deleting a required field is caught;
+  * the fingerprint policy grandfathers pre-r11 artifacts EXPLICITLY
+    (recorded note, never silence) while new rounds must stamp, and the
+    same-fingerprint cross-round regression comparison fires on a
+    regressed re-capture.
+
+Plus the capture half: ``scripts/capture_evidence.py``'s manifest format
+satisfies the EVIDENCE contract it will be held to.
+"""
+
+from __future__ import annotations
+
+import copy
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass field-annotation resolution looks the module up by name
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def check_perf():
+    return _load("check_perf")
+
+
+# ---------------------------------------------------------------------------
+# the committed set
+# ---------------------------------------------------------------------------
+
+def test_committed_artifacts_pass_the_gate(check_perf):
+    """Every BENCH_*/EVIDENCE_* at the repo root has a contract and
+    satisfies it — the tier-1 gate itself."""
+    notes: list = []
+    violations = check_perf.check_root(REPO, notes)
+    assert violations == []
+    # the gate saw the whole artifact set, not an empty glob
+    assert len(check_perf.discover(REPO)) >= 28
+    # pre-r11 artifacts pass via the EXPLICIT grandfather note, and at
+    # least one (the serve r09 capture) is recorded as fingerprint: null
+    assert any("BENCH_SERVE_CPU_r09" in n and "null" in n for n in notes)
+
+
+def test_every_root_artifact_matches_exactly_one_contract(check_perf):
+    for path in check_perf.discover(REPO):
+        assert check_perf.match_contract(path) is not None, path
+
+
+def test_unregistered_artifact_fails(check_perf, tmp_path):
+    """A BENCH_ file with no contract entry must fail the root gate —
+    new artifacts have to declare their claim to land."""
+    shutil.copy(os.path.join(REPO, "BENCH_SERVE_CPU_r09.json"),
+                tmp_path / "BENCH_SERVE_CPU_r09.json")
+    (tmp_path / "BENCH_MYSTERY_r99.json").write_text("{}")
+    violations = check_perf.check_root(str(tmp_path))
+    assert any("BENCH_MYSTERY_r99.json" in v and "no contract" in v
+               for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# tamper detection
+# ---------------------------------------------------------------------------
+
+def _check_one(check_perf, name: str, report: dict) -> list:
+    contract = check_perf.match_contract(name)
+    assert contract is not None
+    return check_perf.check_artifact(name, report, contract)
+
+
+def test_tampered_bound_fails(check_perf):
+    with open(os.path.join(REPO, "BENCH_SERVE_CPU_r09.json")) as f:
+        report = json.load(f)
+    name = "BENCH_SERVE_CPU_r09.json"
+    assert _check_one(check_perf, name, report) == []
+
+    bad = copy.deepcopy(report)
+    bad["latency_ms"]["p99"] = check_perf.P99_MS_MAX + 1
+    assert any("p99" in v for v in _check_one(check_perf, name, bad))
+    bad = copy.deepcopy(report)
+    bad["n_errors"] = 3
+    assert any("n_errors" in v for v in _check_one(check_perf, name, bad))
+
+
+def test_deleted_required_field_fails(check_perf):
+    # one representative per contract family with a committed bound
+    cases = [
+        ("BENCH_SERVE_CPU_r09.json", "breakdown"),
+        ("BENCH_SUITE_CPU_FULL_r04.json", "pairs"),
+        ("BENCH_TPU_HEADLINE_r05_default.json", "timing"),
+        ("BENCH_RECORDER_CPU_r08.json", "bound"),
+        ("BENCH_r03.json", "parsed"),
+    ]
+    for fname, field in cases:
+        with open(os.path.join(REPO, fname)) as f:
+            report = json.load(f)
+        assert _check_one(check_perf, fname, report) == [], fname
+        bad = copy.deepcopy(report)
+        del bad[field]
+        assert _check_one(check_perf, fname, bad) != [], (fname, field)
+
+
+def test_linearity_and_recorder_bounds_gate(check_perf):
+    """The non-serve bounds actually bite: a headline capture whose
+    linearity guard failed, and a recorder config over its committed
+    overhead bound, are both rejected."""
+    with open(os.path.join(REPO, "BENCH_TPU_HEADLINE_r05_default.json")) as f:
+        head = json.load(f)
+    bad = copy.deepcopy(head)
+    bad["timing"]["linearity"]["ok"] = False
+    assert any("linearity" in v for v in _check_one(
+        check_perf, "BENCH_TPU_HEADLINE_r05_default.json", bad))
+
+    with open(os.path.join(REPO, "BENCH_RECORDER_CPU_r08.json")) as f:
+        rec = json.load(f)
+    bad = copy.deepcopy(rec)
+    bad["configs"][0]["overhead"] = bad["bound"] + 0.01
+    assert any("overhead" in v for v in _check_one(
+        check_perf, "BENCH_RECORDER_CPU_r08.json", bad))
+
+
+# ---------------------------------------------------------------------------
+# fingerprint policy + cross-round regression
+# ---------------------------------------------------------------------------
+
+def _fp(knobs=None, backend="cpu"):
+    return {"backend": backend, "jax_version": "0.4.x",
+            "jaxlib_version": "0.4.x", "device_kind": "cpu",
+            "n_devices": 1, "threefry_partitionable": True, "x64": False,
+            "knobs": dict(knobs or {}), "dataset": {}}
+
+
+def _suite_report(value: float, fp=None) -> dict:
+    rep = {"metric": "suite", "value": value, "unit": "s",
+           "total_wall": value, "pairs": [{"task": "t", "method": "iid"}],
+           "per_method_s": {"iid": value}}
+    if fp is not None:
+        rep["fingerprint"] = fp
+    return rep
+
+
+def test_new_round_requires_fingerprint(check_perf):
+    """An r11+ artifact without the environment stamp fails; the same
+    artifact stamped passes."""
+    name = "BENCH_SUITE_CPU_SMOKE_r12.json"
+    vs = _check_one(check_perf, name, _suite_report(10.0))
+    assert any("fingerprint" in v for v in vs)
+    assert _check_one(check_perf, name, _suite_report(10.0, _fp())) == []
+
+
+def test_cross_round_regression_same_fingerprint(check_perf, tmp_path):
+    """Two suite captures with the SAME fingerprint (environment + knobs):
+    a newer round regressed past the explicit tolerance fails, within it
+    passes; a knob change (different workload) never compares."""
+    fp = _fp({"methods": "iid", "seeds": 2})
+    contract = check_perf.match_contract("BENCH_SUITE_X_r11.json")
+
+    def triples(new_value, new_fp):
+        return [
+            ("BENCH_SUITE_X_r11.json", _suite_report(100.0, fp), contract),
+            ("BENCH_SUITE_X_r12.json", _suite_report(new_value, new_fp),
+             contract),
+        ]
+
+    # lower-is-better metric: +50% wall regresses past the 25% tolerance
+    bad = check_perf.cross_round_violations(triples(150.0, fp))
+    assert any("regressed" in v and "r11" in v for v in bad)
+    # within tolerance: clean (and noted)
+    notes: list = []
+    assert check_perf.cross_round_violations(triples(110.0, fp),
+                                             notes) == []
+    assert any("within" in n for n in notes)
+    # different knobs -> different fingerprint key -> never compared
+    other = _fp({"methods": "coda", "seeds": 5})
+    assert check_perf.cross_round_violations(triples(900.0, other)) == []
+    # fingerprint-less artifacts never compare (grandfather semantics)
+    assert check_perf.cross_round_violations(
+        [("BENCH_SUITE_X_r11.json", _suite_report(100.0), contract),
+         ("BENCH_SUITE_X_r12.json", _suite_report(900.0, fp), contract)]
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# the evidence manifest format
+# ---------------------------------------------------------------------------
+
+def _component(report, status="ok"):
+    return {"status": status, "wall_s": 1.0, "report": report}
+
+
+def _manifest(check_perf, capture_evidence, tweak=None):
+    fp = _fp({"capture": "quick"})
+    serve = {"bench": "serve_loadgen", "n_errors": 0,
+             "latency_ms": {"p50": 10.0, "p99": 50.0},
+             "fingerprint": _fp({"sessions": 8})}
+    comps = {
+        "bench": _component({"value": 12.3,
+                             "fingerprint": _fp({"small": True})}),
+        "bench_suite": _component(_suite_report(9.0, _fp({"s": 2}))),
+        "serve_loadgen": _component(serve),
+        "multichip_replay": _component({"ok": True, "configs": []}),
+    }
+    man = capture_evidence.build_manifest("r99", fp, comps, quick=True)
+    if tweak:
+        tweak(man)
+    return man
+
+
+def test_capture_manifest_passes_the_evidence_contract(check_perf):
+    capture_evidence = _load("capture_evidence")
+    man = _manifest(check_perf, capture_evidence)
+    name = "EVIDENCE_cpu_r99.json"
+    assert _check_one(check_perf, name, man) == []
+    # every own-stamped component was fingerprint-verified against the
+    # manifest environment; the dryrun (no own stamp) inherits, recorded
+    arts = man["artifacts"]
+    assert arts["bench"]["fingerprint_match"] is True
+    assert arts["multichip_replay"]["fingerprint_inherited"] is True
+
+    # a failed component fails the manifest
+    bad = _manifest(check_perf, capture_evidence, lambda m: m["artifacts"][
+        "bench_suite"].update(status="failed:rc=1"))
+    assert any("bench_suite" in v for v in _check_one(check_perf, name,
+                                                      bad))
+    # a component captured in a different environment fails it
+    def cross_env(m):
+        m["artifacts"]["bench"]["report"]["fingerprint"]["backend"] = "tpu"
+        m["artifacts"]["bench"]["fingerprint_match"] = \
+            capture_evidence.fingerprint_match(
+                m["fingerprint"],
+                m["artifacts"]["bench"]["report"]["fingerprint"])
+    bad = _manifest(check_perf, capture_evidence, cross_env)
+    assert any("different environment" in v
+               for v in _check_one(check_perf, name, bad))
+    # serve errors fail it
+    bad = _manifest(check_perf, capture_evidence, lambda m: m["artifacts"][
+        "serve_loadgen"]["report"].update(n_errors=3))
+    assert any("n_errors" in v for v in _check_one(check_perf, name, bad))
+
+
+def test_committed_evidence_manifest_gated(check_perf):
+    """The committed EVIDENCE_* capture(s) pass their contract — and the
+    gate refuses an unstamped one."""
+    import glob
+
+    paths = glob.glob(os.path.join(REPO, "EVIDENCE_*.json"))
+    assert paths, "no committed evidence manifest at the repo root"
+    for path in paths:
+        with open(path) as f:
+            man = json.load(f)
+        assert _check_one(check_perf, os.path.basename(path), man) == [], \
+            path
+        bad = copy.deepcopy(man)
+        bad.pop("fingerprint")
+        assert _check_one(check_perf, os.path.basename(path), bad) != []
+
+
+def test_check_perf_cli_gates_root():
+    """The standalone invocation the docs cite exits 0 on the committed
+    tree."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_perf.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "perf gate clean" in proc.stdout
+
+
+@pytest.mark.slow
+def test_capture_evidence_quick_end_to_end(tmp_path):
+    """The acceptance run: one invocation of capture_evidence --quick on
+    the CPU container produces a schema-valid manifest that passes
+    check_perf. Slow (four subprocess captures) — excluded from tier-1;
+    the committed manifest keeps the fast gate honest."""
+    out = tmp_path / "EVIDENCE_cpu_r98.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "capture_evidence.py"),
+         "--quick", "--round", "r98", "--out", str(out),
+         "--platform", "cpu"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=3000)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    check_perf = _load("check_perf")
+    with open(out) as f:
+        man = json.load(f)
+    contract = check_perf.match_contract(str(out))
+    assert check_perf.check_artifact(str(out), man, contract) == []

@@ -947,3 +947,49 @@ def test_serve_pause_holds_even_full_batches(serve_task):
         assert snap["dispatches"] == 2  # max_batch split: 4 + 2
     finally:
         batcher.stop(drain=False, timeout=10.0)
+
+
+def test_warm_pool_cost_attribution_on_stats_and_metrics(serve_task):
+    """The performance-observatory acceptance surface: after warm-up,
+    /stats exposes per-bucket executable FLOPs / bytes / peak-HBM and a
+    roofline class for every warm-pool program, and /metrics carries the
+    executable_* gauge families — present-and-finite on CPU, no
+    hard-coded backend numbers."""
+    import math
+
+    from coda_tpu.serve import SelectorSpec, ServeApp
+    from coda_tpu.telemetry import lint_prometheus, render_prometheus
+
+    app = ServeApp(capacity=2, max_wait=0.001,
+                   spec=SelectorSpec.create("coda", n_parallel=2))
+    app.add_task("tiny", serve_task.preds)
+    app.start()
+    try:
+        stats = app.stats()
+        (bucket,) = stats["buckets"]
+        cost = bucket["cost"]
+        # every warm-pool program is attributed (coda has a pbest read;
+        # donation gives the slot writer)
+        assert {"step", "init", "pbest", "write_slot"} <= set(cost)
+        for program, entry in cost.items():
+            assert entry["flops"] > 0 and math.isfinite(entry["flops"]), \
+                program
+            assert entry["bytes_accessed"] > 0
+            assert entry["peak_hbm_bytes"] > 0
+            assert entry["roofline_class"] in ("compute-bound",
+                                               "memory-bound")
+            assert math.isfinite(entry["arithmetic_intensity"])
+            assert math.isfinite(entry["machine_balance"])
+        # the slab step dominates the tick: its working set and traffic
+        # must dwarf the one-slot programs' (the machine-read version of
+        # "99% of tick wall is one slab step")
+        assert cost["step"]["bytes_accessed"] > \
+            cost["pbest"]["bytes_accessed"]
+        text = render_prometheus(app.telemetry.registry,
+                                 serve_metrics=app.metrics)
+        assert 'coda_executable_flops{' in text
+        assert 'coda_executable_roofline{' in text
+        assert f'name="serve/tiny/coda/' in text
+        assert lint_prometheus(text) == []
+    finally:
+        app.drain(timeout=5.0)

@@ -91,7 +91,7 @@ def test_span_lane_busy_folds_overlaps():
 # ---------------------------------------------------------------------------
 
 _SAMPLE_RE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (-?[0-9.eE+]+|NaN)$")
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (-?[0-9.eE+]+|NaN|[+-]Inf)$")
 
 
 def _validate_exposition(text: str) -> dict:
@@ -501,3 +501,189 @@ def test_run_suite_telemetry_flushes_store(tmp_path):
     keys = {k for (k,) in rows}
     assert "suite_cold_dispatches_total" in keys
     store.close()
+
+
+# ---------------------------------------------------------------------------
+# performance observatory: cost attribution + exposition lint + RSS fallback
+# ---------------------------------------------------------------------------
+
+def test_prometheus_lint_clean_on_rendered_output():
+    """lint() accepts everything render() produces — including escaped
+    label values, NaN/±Inf formatting, and summary _count lines."""
+    from coda_tpu.telemetry import Registry, lint_prometheus, render_prometheus
+
+    reg = Registry()
+    reg.counter("events_total", 'help with "quotes"\nand newline').inc(2)
+    g = reg.gauge("weird_labels", "label-escape coverage")
+    g.set(1.5, path='a\\b', name='say "hi"\nthere')
+    reg.gauge("extremes", "non-finite values").set(float("nan"), kind="n")
+    reg.gauge("extremes").set(float("inf"), kind="p")
+    reg.gauge("extremes").set(float("-inf"), kind="m")
+    text = render_prometheus(reg)
+    assert lint_prometheus(text) == []
+    _validate_exposition(text)
+
+
+def test_prometheus_lint_catches_violations():
+    from coda_tpu.telemetry import lint_prometheus
+
+    # sample with no TYPE header
+    assert any("no TYPE" in v for v in lint_prometheus("orphan 1\n"))
+    # duplicate family (re-opened after another family interleaved)
+    dup = ("# TYPE a gauge\na 1\n# TYPE b gauge\nb 1\n"
+           "# TYPE a gauge\na 2\n")
+    out = lint_prometheus(dup)
+    assert any("duplicate TYPE" in v for v in out)
+    # HELP after TYPE is out of order
+    assert any("after its TYPE" in v for v in lint_prometheus(
+        "# TYPE c gauge\n# HELP c help\nc 1\n"))
+    # unescaped quote in a label value
+    assert any("labels" in v for v in lint_prometheus(
+        '# TYPE d gauge\nd{k="a"b"} 1\n'))
+    # a bad value
+    assert any("bad value" in v or "unparseable" in v
+               for v in lint_prometheus("# TYPE e gauge\ne nope\n"))
+    # lowercase "nan" is NOT the canonical spelling
+    assert lint_prometheus("# TYPE f gauge\nf NaN\n") == []
+    assert lint_prometheus("# TYPE f gauge\nf nan\n") != []
+
+
+def test_cost_harvest_roofline_and_metric_families():
+    """harvest_executable_cost on a real compiled program records FLOPs/
+    bytes/peak-HBM + a roofline class, feeds the executable_* gauge
+    families, and the rendered exposition (with the new families) lints
+    clean."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.telemetry import (
+        COSTS,
+        Registry,
+        harvest_executable_cost,
+        lint_prometheus,
+        render_prometheus,
+    )
+
+    reg = Registry()
+    compiled = jax.jit(lambda x: (x @ x.T).sum()).lower(
+        jnp.ones((32, 64))).compile()
+    entry = harvest_executable_cost(compiled, "test/matmul", site="engine",
+                                    registry=reg)
+    assert entry is not None
+    assert np.isfinite(entry["flops"]) and entry["flops"] > 0
+    assert np.isfinite(entry["bytes_accessed"]) and \
+        entry["bytes_accessed"] > 0
+    assert entry["peak_hbm_bytes"] > 0
+    assert entry["roofline_class"] in ("compute-bound", "memory-bound")
+    assert np.isfinite(entry["arithmetic_intensity"])
+    assert np.isfinite(entry["machine_balance"])
+    # an unknown device kind (CPU container) uses the documented default
+    # balance and says so — never a fabricated silicon peak
+    if entry["peak_source"] == "default_balance":
+        assert entry["peak_flops_per_sec"] is None
+    assert COSTS.get("test/matmul") == entry
+    text = render_prometheus(reg)
+    for fam in ("coda_executable_flops", "coda_executable_bytes_accessed",
+                "coda_executable_peak_hbm_bytes",
+                "coda_executable_roofline"):
+        assert fam in text, fam
+    assert lint_prometheus(text) == []
+
+
+def test_cost_tracked_matches_plain_jit_bitwise():
+    """The suite's CostTracked wrapper (AOT compile-and-reuse) returns
+    bitwise the plain jit path's results, records one cost entry per
+    argument signature, and degrades to plain jit when disabled."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.telemetry import COSTS, CostTracked, Registry
+    from coda_tpu.telemetry import costs as costs_mod
+
+    def f(x, y):
+        return jnp.sin(x) @ y + x.sum()
+
+    x = jnp.linspace(0, 1, 12 * 8).reshape(12, 8)
+    y = jnp.ones((8, 3))
+    ref = np.asarray(jax.jit(f)(x, y))
+    reg = Registry()
+    tracked = CostTracked(jax.jit(f), name="test/tracked", site="suite",
+                          registry=reg)
+    got = np.asarray(tracked(x, y))
+    assert got.tobytes() == ref.tobytes()
+    # second call reuses the compiled executable (still bitwise)
+    assert np.asarray(tracked(x, y)).tobytes() == ref.tobytes()
+    entries = {k: v for k, v in COSTS.snapshot(site="suite").items()
+               if k.startswith("test/tracked@")}
+    assert len(entries) == 1
+    (entry,) = entries.values()
+    assert entry["flops"] > 0 and entry["roofline_class"] in (
+        "compute-bound", "memory-bound")
+    # a new signature compiles (and records) separately
+    x2 = jnp.ones((5, 8))
+    assert np.asarray(tracked(x2, y)).tobytes() == np.asarray(
+        jax.jit(f)(x2, y)).tobytes()
+    assert len([k for k in COSTS.snapshot(site="suite")
+                if k.startswith("test/tracked@")]) == 2
+    # kill switch: no new entries, plain jit path
+    costs_mod.set_enabled(False)
+    try:
+        x3 = jnp.ones((7, 8))
+        assert np.asarray(tracked(x3, y)).tobytes() == np.asarray(
+            jax.jit(f)(x3, y)).tobytes()
+        assert len([k for k in COSTS.snapshot(site="suite")
+                    if k.startswith("test/tracked@")]) == 2
+    finally:
+        costs_mod.set_enabled(True)
+
+
+def test_suite_and_engine_cost_attribution_land_in_telemetry(tmp_path):
+    """The two non-serve compile sites: SuiteRunner's jitted programs and
+    the engine entry both land in the cost book, and telemetry.json
+    carries the costs section."""
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine.loop import run_seeds_compiled
+    from coda_tpu.engine.suite import SuiteRunner
+    from coda_tpu.selectors import SELECTOR_FACTORIES
+    from coda_tpu.telemetry import COSTS, Telemetry
+
+    task = make_synthetic_task(seed=3, H=4, N=48, C=4)
+    runner = SuiteRunner(iters=3, seeds=2)
+    runner.run_one("uncertainty", task)
+    suite_entries = [k for k in COSTS.snapshot(site="suite")
+                     if k.startswith("suite/uncertainty/")]
+    assert suite_entries, "suite compile site recorded nothing"
+
+    from coda_tpu.losses import LOSS_FNS
+
+    run_seeds_compiled(
+        lambda p: SELECTOR_FACTORIES["iid"](p, loss_fn=LOSS_FNS["acc"]),
+        task.preds, task.labels, iters=3, seeds=2, cost_label="iid")
+    assert any(k.startswith("engine/run_seeds/iid/4x48x4/")
+               for k in COSTS.snapshot(site="engine"))
+
+    tele = Telemetry(out_dir=str(tmp_path / "t"))
+    paths = tele.write()
+    snap = json.load(open(paths["telemetry"]))
+    assert "costs" in snap and suite_entries[0] in snap["costs"]
+    entry = snap["costs"][suite_entries[0]]
+    assert entry["flops"] > 0 and "roofline_class" in entry
+
+
+def test_rss_fallback_gauge_on_cpu():
+    """CPU backends report no device memory_stats; the sampler then
+    records the process-RSS gauge labeled source="rss" — memory evidence
+    that stays distinct from the device_* families."""
+    from coda_tpu.telemetry import Registry, sample_device_memory
+
+    reg = Registry()
+    out = sample_device_memory(reg)
+    assert out == {}  # device sample contract unchanged
+    assert reg.gauge("device_peak_bytes").samples() == []
+    samples = reg.gauge("process_rss_bytes").samples()
+    assert len(samples) == 1
+    labels, value = samples[0]
+    assert labels == {"source": "rss"}
+    assert value > 0
+    peak = reg.gauge("process_peak_rss_bytes").samples()
+    assert peak and peak[0][0] == {"source": "rss"}
