@@ -154,7 +154,8 @@ def _flops_of(compiled) -> float:
 def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
                          mode: str = "auto",
                          eig_cache_dtype: str = "float32",
-                         pi_update: str = "auto") -> tuple:
+                         pi_update: str = "auto",
+                         posterior: str = "dense") -> tuple:
     """(flops_per_step, resolved_mode, resolved_pi_update) from the
     kernels' documented shapes.
 
@@ -181,11 +182,13 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
     from coda_tpu.selectors.coda import resolve_eig_mode, resolve_pi_update
 
     # resolve with the SAME hyperparams the benched selector uses — the
-    # cache dtype changes the auto budget, so omitting it here could
+    # cache dtype AND the posterior representation change the auto budget
+    # (the dense (H, C, C) carry is charged; sparse:K is what keeps large-C
+    # shapes inside the incremental tier), so omitting either here could
     # report a different tier than the one that ran
     hp = CODAHyperparams(eig_mode=mode, num_points=G,
                          eig_cache_dtype=eig_cache_dtype,
-                         pi_update=pi_update)
+                         pi_update=pi_update, posterior=posterior)
     mode = resolve_eig_mode(hp, H, N, C)
     pi_res = resolve_pi_update(hp, N)
     if mode == "incremental":
@@ -198,7 +201,8 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
 def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
                          cache_bytes: int = 4,
                          pi_update: str, backend: str = "jnp",
-                         eig_refresh: str = "precomputed") -> float:
+                         eig_refresh: str = "precomputed",
+                         posterior: str = "dense") -> float:
     """Analytic HBM traffic per round (bytes), for the bandwidth roofline.
 
     ``mode`` and ``pi_update`` must be the ALREADY-RESOLVED tier and
@@ -224,6 +228,18 @@ def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
         cache = float(cache_bytes) * N * C * H
         pi_bytes = (4.0 * H * N if pi_update.startswith("delta")
                     else 4.0 * H * N * C)
+        # posterior stream: the dense per-round Beta extraction reduces
+        # the full (H, C, C) tensor (2 GB/round at ImageNet scale — the
+        # term the sparse tier removes); sparse:K reads one compact row
+        # (values + indices) and scatters it back. Negligible at the
+        # C=10 headline, dominant at C=1000 — priced so the imagenet
+        # config's MBU describes the kernel that actually runs.
+        from coda_tpu.ops.sparse_rows import parse_posterior
+
+        k = parse_posterior(posterior)
+        post_bytes = (4.0 * H * C * C if k is None
+                      else 16.0 * H * min(k, C))
+        cache += post_bytes
         if backend == "pallas" and eig_refresh == "fused":
             # fused-COMPUTE refresh: the replacement row is computed
             # in-kernel from O(H·G) tables, so the (N, H) hyp_t round
@@ -277,7 +293,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     eig_opts = {**{k: defaults[k] for k in
                    ("eig_mode", "eig_backend", "eig_precision",
                     "eig_cache_dtype", "eig_refresh", "eig_entropy",
-                    "pi_update")},
+                    "posterior", "eig_pbest", "pi_update")},
                 **(eig_opts or {})}
     # _mad of a single rep is 0, which would floor the noise at 1e-12 and
     # let any positive wall-clock delta pass linear_ok; the guard only
@@ -308,7 +324,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     flops_per_step, mode, pi_res = _analytic_step_flops(
         H, N, C, mode=eig_opts["eig_mode"],
         eig_cache_dtype=eig_opts["eig_cache_dtype"],
-        pi_update=eig_opts["pi_update"])
+        pi_update=eig_opts["pi_update"],
+        posterior=eig_opts["posterior"])
     # resolve the scoring backend with the SAME function make_coda uses
     # (and the same hyperparams _build_fn constructed) so the reported
     # metadata names the kernel that actually ran
@@ -338,7 +355,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         H, N, C, mode=mode,
         cache_bytes=np.dtype(eig_opts["eig_cache_dtype"]).itemsize,
         pi_update=pi_res, backend=backend_res,
-        eig_refresh=eig_opts["eig_refresh"])
+        eig_refresh=eig_opts["eig_refresh"],
+        posterior=eig_opts["posterior"])
     achieved = (flops_per_step / marginal_step_s
                 if linear_ok and marginal_step_s > 0 else 0.0)
     achieved_bps = (bytes_per_step / marginal_step_s
@@ -366,6 +384,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         "eig_cache_dtype": eig_opts["eig_cache_dtype"],
         "eig_refresh": eig_opts["eig_refresh"],
         "eig_entropy": eig_opts["eig_entropy"],
+        "posterior": eig_opts["posterior"],
+        "eig_pbest": eig_opts["eig_pbest"],
         "pi_update": pi_res,
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
@@ -509,8 +529,31 @@ def _probe_devices(timeout_s: float = 90.0):
     return (f"probe crashed (exit {r.returncode}): " + " | ".join(tail))
 
 
+# named shape presets: (H, N, C, iters, chunk). "imagenet" reproduces the
+# IMAGENET_VIRTUAL_r05.json pool shape (C=1000, H=500, N scaled to one
+# host) so the large-C capture is one flag; "imagenet_smoke" is its
+# scaled-down-C stand-in for the quick evidence run (same tier/kernels,
+# container-sized init cost). Both are INIT-DOMINATED: the one-time
+# incremental cache build dwarfs the rounds, so the linearity guard
+# reports instead of failing there (the committed round-time evidence for
+# the shape lives in IMAGENET_SPARSE_*.json, measured with a 50-round
+# delta by scripts/imagenet_sparse.py).
+BENCH_CONFIGS = {
+    "headline": (1000, 50_000, 10, 50, 2048),
+    "small": (32, 2000, 10, 10, 1000),
+    "imagenet": (500, 256, 1000, 10, 64),
+    "imagenet_smoke": (50, 256, 100, 10, 64),
+}
+_GUARD_SOFT_CONFIGS = ("small", "imagenet", "imagenet_smoke")
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None, choices=sorted(BENCH_CONFIGS),
+                    help="named shape preset (default: headline; "
+                         "'imagenet' = the C=1000/H=500 pool of "
+                         "IMAGENET_VIRTUAL_r05.json, 'imagenet_smoke' = "
+                         "its scaled-down-C quick-evidence stand-in)")
     ap.add_argument("--small", action="store_true",
                     help="small smoke config instead of the headline M=1k,N=50k")
     ap.add_argument("--iters", type=int, default=None,
@@ -553,6 +596,18 @@ def main():
                     help="override the scoring-pass block size (0 = the "
                          "config default; the tuning knob for the "
                          "cache-stream pass)")
+    ap.add_argument("--posterior", default="dense",
+                    metavar="dense|sparse:K",
+                    help="Dirichlet posterior representation: sparse:K "
+                         "carries top-K class rows + residual instead of "
+                         "the dense (H, C, C) tensor (the large-C rung; "
+                         "see --posterior on the main CLI)")
+    ap.add_argument("--eig-pbest", default="quad",
+                    choices=["quad", "amortized"],
+                    help="row-refresh P(best) integral: quad (reference "
+                         "Beta quadrature) | amortized (closed-form "
+                         "logistic-normal tables where the concentration "
+                         "gate holds the 2.34e-4 contract)")
     ap.add_argument("--pi-update", default="auto",
                     choices=["auto", "delta", "exact"],
                     help="incremental pi-hat refresh: auto (default) = "
@@ -588,10 +643,9 @@ def main():
         # so the whole protocol stays within a plausible driver timeout
         args.reps = min(args.reps, 3)
 
-    if args.small:
-        H, N, C, iters, chunk = 32, 2000, 10, 10, 1000
-    else:
-        H, N, C, iters, chunk = 1000, 50_000, 10, 50, 2048
+    config = args.config or ("small" if args.small else "headline")
+    guard_soft = config in _GUARD_SOFT_CONFIGS
+    H, N, C, iters, chunk = BENCH_CONFIGS[config]
     if args.eig_chunk:
         chunk = args.eig_chunk
 
@@ -605,18 +659,24 @@ def main():
                 "eig_cache_dtype": args.eig_cache_dtype,
                 "eig_refresh": args.eig_refresh,
                 "eig_entropy": args.eig_entropy,
+                "posterior": args.posterior,
+                "eig_pbest": args.eig_pbest,
                 "pi_update": args.pi_update}
     for attempt in range(2):
         ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
                           reps=args.reps, eig_opts=eig_opts)
-        if ours["linearity"]["ok"] or args.small:
+        if ours["linearity"]["ok"] or guard_soft:
             break
         print("[bench] linearity guard tripped on attempt "
               f"{attempt + 1}; " + ("re-measuring" if attempt == 0 else
                                     "giving up — reporting invalid"),
               file=sys.stderr)
 
-    base = reference_baseline(C, skip=args.skip_reference)
+    # the torch reference has no business at the imagenet presets (its
+    # extrapolated round time there is hours; the r05 artifact is the
+    # committed baseline for that shape)
+    base = reference_baseline(C, skip=args.skip_reference
+                              or config.startswith("imagenet"))
     # environment fingerprint (telemetry/recorder.py): the provenance
     # block that makes this capture attributable and cross-round
     # comparable — scripts/check_perf.py keys same-fingerprint regression
@@ -624,10 +684,11 @@ def main():
     from coda_tpu.telemetry.recorder import environment_fingerprint
 
     fingerprint = environment_fingerprint(
-        knobs=dict(eig_opts, iters=args.iters or iters, small=args.small,
-                   eig_chunk=chunk))
+        knobs=dict(eig_opts, iters=args.iters or iters, config=config,
+                   small=config == "small", eig_chunk=chunk))
     out = {
         "metric": f"coda-selection-steps/sec (M={H}, N={N}, C={C})",
+        "config": config,
         "value": round(ours["steps_per_sec"], 4),
         "unit": "steps/sec",
         "vs_baseline": 0.0,
@@ -644,7 +705,7 @@ def main():
         "compute": {k: ours[k] for k in
                     ("eig_mode", "eig_backend", "eig_precision",
                      "eig_cache_dtype", "eig_refresh", "eig_entropy",
-                     "pi_update",
+                     "posterior", "eig_pbest", "pi_update",
                      "flops_per_step_analytic", "flop_accounting",
                      "flops_xla_scan_body_once", "achieved_flops_per_sec",
                      "peak_flops_per_sec", "mfu",
@@ -695,9 +756,13 @@ def main():
             "per-step compute is not resolvable against the fixed "
             "per-invocation overhead"
         )
-        if args.small:
-            # the smoke config's per-step work is micro-seconds; only warn
-            print(msg + " (expected for --small)", file=sys.stderr)
+        if guard_soft:
+            # the smoke config's per-step work is micro-seconds (and the
+            # imagenet presets are init-dominated); only warn — their
+            # committed round-time evidence uses the 50-round delta of
+            # scripts/imagenet_sparse.py instead
+            print(msg + f" (expected for --config {config})",
+                  file=sys.stderr)
         else:
             print(msg + " — timing INVALID at headline scale; refusing to "
                   "report this as real", file=sys.stderr)

@@ -120,6 +120,27 @@ def parse_args(argv=None):
                         "(max |Dscore| <= 1e-4 — cuts the N*C*H "
                         "transcendental tail that caps the bf16 "
                         "headline; opt-in numerics like --eig-precision)")
+    p.add_argument("--posterior", default="dense", metavar="dense|sparse:K",
+                   help="Dirichlet posterior representation: dense = the "
+                        "reference (H, C, C) tensor; sparse:K keeps each "
+                        "class row as diagonal + top-K off-diagonal "
+                        "entries + one residual mass (~(2K+2)/C of the "
+                        "dense state; label updates touch one row with a "
+                        "sparse scatter, the per-round Beta extraction "
+                        "reads O(H*K) not O(H*C^2)) — the large-C rung of "
+                        "the numerics ladder (incremental tier only; "
+                        "sparse:K>=C is bitwise-equal to dense, K<C holds "
+                        "the documented 2.34e-4 score contract)")
+    p.add_argument("--eig-pbest", default="quad",
+                   choices=["quad", "amortized"],
+                   help="hypothetical P(best) row-refresh integral: quad "
+                        "= the reference G-point Beta quadrature; "
+                        "amortized = closed-form logistic-normal (Laplace "
+                        "bridge, arXiv 1905.12194) tables, engaged per "
+                        "round only where the labeled row's concentration "
+                        "provably holds the 2.34e-4 score contract "
+                        "(below the committed gate the quadrature runs "
+                        "unchanged; opt-in numerics like --eig-entropy)")
     p.add_argument("--pi-update", default="auto",
                    choices=["auto", "delta", "exact"],
                    help="incremental pi-hat refresh: auto (default) = exact "
@@ -246,6 +267,8 @@ def build_selector_factory(args, task_name: str):
             eig_cache_dtype=getattr(args, "eig_cache_dtype", "float32"),
             eig_refresh=getattr(args, "eig_refresh", "precomputed"),
             eig_entropy=getattr(args, "eig_entropy", "exact"),
+            posterior=getattr(args, "posterior", "dense"),
+            eig_pbest=getattr(args, "eig_pbest", "quad"),
             pi_update=getattr(args, "pi_update", "auto"),
             # a --mesh run declares its sharding so the pallas fast path
             # can shard_map the kernels over the data axis (make_coda

@@ -263,6 +263,16 @@ def compare_records(a: RunRecord, b: RunRecord,
         "backend_a": a.meta.get("fingerprint", {}).get("backend"),
         "backend_b": b.meta.get("fingerprint", {}).get("backend"),
     })
+    # name the knobs the two sides disagree on (e.g. posterior=dense vs
+    # sparse:32) — the reason the auto tolerance dropped to the score
+    # contract, surfaced instead of leaving the reader to diff fingerprints
+    knobs_a = a.meta.get("fingerprint", {}).get("knobs", {}) or {}
+    knobs_b = b.meta.get("fingerprint", {}).get("knobs", {}) or {}
+    diff = {key: [knobs_a.get(key), knobs_b.get(key)]
+            for key in sorted(set(knobs_a) | set(knobs_b))
+            if knobs_a.get(key) != knobs_b.get(key)}
+    if diff:
+        report.meta["knob_diff"] = diff
     k = min(int(a.meta.get("trace_k", 8)), int(b.meta.get("trace_k", 8)))
     if a.meta.get("trace_k") != b.meta.get("trace_k"):
         report.meta["trace_k_compared"] = k
@@ -324,6 +334,11 @@ def format_triage(report: ReplayReport) -> str:
         lines.append(f"  note: records carry different top-k widths; "
                      f"compared the common top-"
                      f"{report.meta['trace_k_compared']} prefix")
+    if report.meta.get("knob_diff"):
+        pairs = ", ".join(f"{k}: {va!r} vs {vb!r}" for k, (va, vb)
+                          in report.meta["knob_diff"].items())
+        lines.append(f"  knobs differ ({pairs}) — compared under the "
+                     "documented score contract, not bitwise")
     for s in report.seeds:
         if s.parity:
             lines.append(f"  seed {s.seed}: PARITY "

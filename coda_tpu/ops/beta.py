@@ -42,6 +42,75 @@ def beta_log_pdf(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def sparse_rows_to_beta(
+    diag: jnp.ndarray, vals: jnp.ndarray, resid: jnp.ndarray,
+    *, includes_diag: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Diagonal Beta marginals straight from COMPACT class rows.
+
+    The sparse posterior tier (``ops/sparse_rows.py``) stores each
+    Dirichlet row as its diagonal, top-K off-diagonal values, and one
+    residual mass. The Beta reduction only needs the diagonal and the
+    row's total off-diagonal mass, so the compact form feeds the G-point
+    quadrature directly — temps scale with K, not C (the dense
+    :func:`dirichlet_to_beta` reduces the full ``(..., C, C)`` tensor,
+    a 2 GB read per round at ImageNet scale).
+
+    Args:
+      diag:  ``(..., C)`` exact diagonal concentrations.
+      vals:  ``(..., C, K)`` tracked off-diagonal values — or, in the
+        K=C parity layout (``includes_diag=True``), the full dense rows
+        with the diagonal at its column position.
+      resid: ``(..., C)`` untracked off-diagonal mass (zero in the
+        parity layout).
+    Returns:
+      ``(alpha_cc, beta_cc)`` each ``(..., C)``.
+    """
+    if includes_diag:
+        return diag, vals.sum(axis=-1) - diag
+    return diag, vals.sum(axis=-1) + resid
+
+
+# -- amortized predictive-uncertainty approximation (arXiv 1905.12194) -----
+#
+# The Laplace-bridge / logistic-normal moment matching of 1905.12194 maps
+# a Dirichlet to a Gaussian in softmax basis; its two-class reduction maps
+# Beta(a, b) to logit(X) ~ N(digamma(a) - digamma(b),
+# polygamma(1, a) + polygamma(1, b)). pdf and cdf of X then have CLOSED
+# forms (Gaussian phi / log-ndtr of the logit) — no lgamma grids and no
+# cumulative-trapezoid CDF construction, which is what lets the
+# ``eig_pbest='amortized'`` rung replace the Beta quadrature tables.
+
+def beta_logit_normal_params(
+    a: jnp.ndarray, b: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Logistic-normal (Laplace-bridge) parameters of Beta(a, b):
+    ``(mu, sigma)`` of the matched Gaussian in logit space."""
+    from jax.scipy.special import digamma, polygamma
+
+    mu = digamma(a) - digamma(b)
+    var = polygamma(1, a) + polygamma(1, b)
+    return mu, jnp.sqrt(var)
+
+
+def logit_normal_log_pdf(x: jnp.ndarray, mu: jnp.ndarray,
+                         sigma: jnp.ndarray) -> jnp.ndarray:
+    """log pdf at x in (0, 1) of the logistic-normal; broadcasts."""
+    z = (jnp.log(x) - jnp.log1p(-x) - mu) / sigma
+    return (-0.5 * z * z - 0.5 * jnp.log(2.0 * jnp.pi) - jnp.log(sigma)
+            - jnp.log(x) - jnp.log1p(-x))
+
+
+def logit_normal_log_cdf(x: jnp.ndarray, mu: jnp.ndarray,
+                         sigma: jnp.ndarray) -> jnp.ndarray:
+    """log cdf at x in (0, 1) of the logistic-normal — closed form
+    (``log_ndtr``), replacing the quadrature's cumtrapz+log chain."""
+    from jax.scipy.special import log_ndtr
+
+    z = (jnp.log(x) - jnp.log1p(-x) - mu) / sigma
+    return log_ndtr(z)
+
+
 def cumtrapz_uniform(y: jnp.ndarray, dx, axis: int = -1) -> jnp.ndarray:
     """Cumulative trapezoid integral over a uniform grid, zero-initialized.
 

@@ -1,0 +1,235 @@
+"""Sparse top-K class-row Dirichlet posteriors — the large-C state tier.
+
+The dense CODA posterior is ``(H, C, C)`` — 2 GB at ImageNet scale
+(H=500, C=1000 fp32), carried through every ``lax.scan`` round even
+though a labeling round touches ONE class row per model and real
+confusion mass concentrates on a few classes per row
+(``IMAGENET_VIRTUAL_r05.json``: the dense state and its scan copies, not
+the EIG tables, dominate the 736-1207 s rounds). Here each class row
+keeps
+
+  * its **diagonal** entry exactly (``diag``, (H, C)) — the parameter the
+    Beta/EIG quadrature actually consumes,
+  * its **top-K off-diagonal** entries as values + int32 column indices
+    (``vals``/``idx``, (H, C, K)),
+  * one shared **residual** mass for the untracked remainder
+    (``resid``, (H, C)), spread uniformly over the ``C-1-K`` untracked
+    columns when a dense row must be reconstructed.
+
+Total: ``(2K+2)/C`` of the dense state (K=32, C=1000 -> ~15x smaller).
+Because every update conserves row mass exactly (tracked adds are exact;
+an untracked add moves its uniform share out of the residual and either
+evicts the smallest tracked entry back into it or returns the whole
+increment), the diagonal AND the row's total off-diagonal mass — the two
+numbers ``dirichlet_to_beta`` reduces a row to — stay exact up to float
+summation order. The EIG quadrature therefore sees the same Betas as the
+dense path; only consumers of off-diagonal *structure* (the exact pi-hat
+column einsum, which :func:`densify_row` serves with the share-spread
+reconstruction) are approximated. With the default bandwidth-lean
+``pi_update='delta'`` path (which never reads the posterior) the sparse
+tier tracks dense to summation-order ulps — far inside the documented
+2.34e-4 score contract.
+
+**Parity layout** (``K >= C``): ``vals`` stores the full dense rows
+(diagonal included at its column position), ``idx`` is the identity and
+``resid`` is zero. Updates then apply the same float ops to the same
+values as the dense ``.at[:, c, :].add`` path, so ``sparse:K=C`` is
+bitwise-equal to dense — the tier-1 parity rung, not a compression.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from coda_tpu.ops.beta import sparse_rows_to_beta
+
+
+class SparseRows(NamedTuple):
+    """Sparse class-row posterior state (a pytree; scan-carry friendly:
+    a labeling round DUSes one row of each leaf)."""
+
+    diag: jnp.ndarray   # (H, C) f32 — exact diagonal concentrations
+    vals: jnp.ndarray   # (H, C, K) f32 — top-K off-diag (K=C: full rows)
+    idx: jnp.ndarray    # (H, C, K) int32 — their column indices
+    resid: jnp.ndarray  # (H, C) f32 — untracked off-diag mass (K=C: zero)
+
+    @property
+    def n_classes(self) -> int:
+        return self.diag.shape[-1]
+
+    @property
+    def k(self) -> int:
+        return self.vals.shape[-1]
+
+    @property
+    def full(self) -> bool:
+        """The K=C parity layout (vals = dense rows, diagonal included)."""
+        return self.k == self.n_classes
+
+
+def parse_posterior(spec: str) -> Optional[int]:
+    """``'dense'`` -> None; ``'sparse:K'`` -> K (>= 1). Fails loudly on
+    anything else — the CLI forwards the string verbatim."""
+    if spec == "dense":
+        return None
+    if spec.startswith("sparse:"):
+        try:
+            k = int(spec.split(":", 1)[1])
+        except ValueError:
+            k = 0
+        if k >= 1:
+            return k
+    raise ValueError(
+        f"unknown posterior {spec!r} (use 'dense' or 'sparse:K' with "
+        "integer K >= 1, e.g. 'sparse:32')")
+
+
+def posterior_nbytes(H: int, C: int, k: Optional[int]) -> int:
+    """Resident bytes of the posterior representation (the term the auto
+    eig_mode budget charges — dense is the (H, C, C) fp32 tensor, sparse
+    is diag + resid + K (value, index) pairs per row)."""
+    if k is None:
+        return 4 * H * C * C
+    k_eff = min(k, C)
+    return H * C * (8 + 8 * k_eff)
+
+
+def sparsify(dirichlets: jnp.ndarray, k: int) -> SparseRows:
+    """Compress a dense ``(H, C, C)`` posterior into :class:`SparseRows`.
+
+    ``k >= C`` selects the parity layout (no truncation). Otherwise the
+    top-``k`` off-diagonal entries per row are kept exactly and the
+    remainder is folded into the residual, so row totals are preserved.
+    """
+    H, C, _ = dirichlets.shape
+    if k >= C:
+        return SparseRows(
+            diag=jnp.diagonal(dirichlets, axis1=-2, axis2=-1),
+            vals=dirichlets,
+            idx=jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32),
+                                 (H, C, C)),
+            resid=jnp.zeros((H, C), dirichlets.dtype),
+        )
+    k = min(k, C - 1)
+    diag = jnp.diagonal(dirichlets, axis1=-2, axis2=-1)        # (H, C)
+    eye = jnp.eye(C, dtype=bool)
+    offdiag = jnp.where(eye, -jnp.inf, dirichlets)
+    vals, idx = jax.lax.top_k(offdiag, k)                      # (H, C, k)
+    resid = dirichlets.sum(-1) - diag - vals.sum(-1)
+    return SparseRows(diag=diag, vals=vals, idx=idx.astype(jnp.int32),
+                      resid=resid)
+
+
+def to_beta(s: SparseRows) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``(a_cc, b_cc)`` each (H, C) — the compact-row analog of
+    ``ops.beta.dirichlet_to_beta``, reading O(H*C*K) instead of the dense
+    O(H*C*C)."""
+    return sparse_rows_to_beta(s.diag, s.vals, s.resid,
+                               includes_diag=s.full)
+
+
+def row_beta(s: SparseRows, c: jnp.ndarray
+             ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``(a_t, b_t)`` each (H,) for class row ``c`` — the per-round Beta
+    extraction, O(H*K) bytes instead of the dense path's full (H, C, C)
+    reduction (the dominant posterior read at large C)."""
+    a_t = jnp.take(s.diag, c, axis=1)                          # (H,)
+    rv = jnp.take(s.vals, c, axis=1)                           # (H, K)
+    if s.full:
+        return a_t, rv.sum(-1) - a_t
+    return a_t, rv.sum(-1) + jnp.take(s.resid, c, axis=1)
+
+
+def scatter_row(s: SparseRows, true_class: jnp.ndarray,
+                pred_classes: jnp.ndarray, lr: float) -> SparseRows:
+    """One labeling round: add ``lr`` at ``(h, true_class, pred_classes[h])``
+    for every model h — the sparse analog of the dense
+    ``dirichlets.at[:, true_class, :].add(lr * onehot)``.
+
+    Tracked columns (and the diagonal) take the increment exactly. An
+    untracked column takes its uniform residual share out, adds ``lr``,
+    and is inserted by EVICTING the smallest tracked entry back into the
+    residual — unless it still would not rank, in which case the whole
+    increment is absorbed by the residual. Row mass is conserved by every
+    branch, so the row's Beta reduction stays exact (see module doc).
+    """
+    H, C = s.diag.shape
+    K = s.k
+    rv = jnp.take(s.vals, true_class, axis=1)                  # (H, K)
+    dcol = jnp.take(s.diag, true_class, axis=1)                # (H,)
+    is_diag = pred_classes == true_class                       # (H,)
+
+    if s.full:
+        # parity layout: the same float add at the same position the
+        # dense one-hot path performs (adding lr*0.0 elsewhere is a
+        # bitwise no-op on positive concentrations)
+        onehot = jax.nn.one_hot(pred_classes, C, dtype=rv.dtype)
+        rv1 = rv + lr * onehot
+        diag1 = dcol + lr * jnp.take(onehot, true_class, axis=1)
+        return s._replace(vals=s.vals.at[:, true_class, :].set(rv1),
+                          diag=s.diag.at[:, true_class].set(diag1))
+
+    ri = jnp.take(s.idx, true_class, axis=1)                   # (H, K)
+    r = jnp.take(s.resid, true_class, axis=1)                  # (H,)
+    hit = ri == pred_classes[:, None]                          # (H, K)
+    tracked = hit & (~is_diag)[:, None]
+    rv1 = rv + lr * tracked.astype(rv.dtype)
+    hit_any = hit.any(-1)
+
+    n_untracked = C - 1 - K                                    # static
+    share = r / max(n_untracked, 1)
+    v_new = share + lr
+    m_pos = jnp.argmin(rv, axis=-1)                            # (H,)
+    m_val = jnp.take_along_axis(rv, m_pos[:, None], axis=-1)[:, 0]
+    miss = (~is_diag) & (~hit_any) if n_untracked > 0 else jnp.zeros(
+        (H,), bool)
+    insert = miss & (v_new > m_val)
+    sel = insert[:, None] & (jnp.arange(K) == m_pos[:, None])  # (H, K)
+    rv2 = jnp.where(sel, v_new[:, None], rv1)
+    ri2 = jnp.where(sel, pred_classes[:, None], ri)
+    # residual: evicted entry in, departed share out; or absorb the whole
+    # increment when the new entry would not rank
+    r2 = r + jnp.where(insert, m_val - share,
+                       jnp.where(miss, lr, 0.0))
+    diag1 = dcol + lr * is_diag.astype(dcol.dtype)
+    return SparseRows(
+        diag=s.diag.at[:, true_class].set(diag1),
+        vals=s.vals.at[:, true_class, :].set(rv2),
+        idx=s.idx.at[:, true_class, :].set(ri2),
+        resid=s.resid.at[:, true_class].set(r2),
+    )
+
+
+def densify_row(s: SparseRows, c: jnp.ndarray) -> jnp.ndarray:
+    """Dense ``(H, C)`` reconstruction of class row ``c`` — tracked
+    entries exact, untracked columns at the uniform residual share (the
+    input the exact pi-hat column refresh consumes in sparse mode)."""
+    H, C = s.diag.shape
+    rv = jnp.take(s.vals, c, axis=1)                           # (H, K)
+    if s.full:
+        return rv
+    ri = jnp.take(s.idx, c, axis=1)
+    r = jnp.take(s.resid, c, axis=1)
+    share = r / max(C - 1 - s.k, 1)
+    row = jnp.broadcast_to(share[:, None], (H, C))
+    row = jax.vmap(lambda rr, vv, ii: rr.at[ii].set(vv))(row, rv, ri)
+    cols = jnp.arange(C)
+    return jnp.where(cols[None, :] == c, jnp.take(s.diag, c, axis=1)[:, None],
+                     row)
+
+
+def densify(s: SparseRows) -> jnp.ndarray:
+    """Full dense ``(H, C, C)`` reconstruction (tests/debugging only —
+    defeats the point in production)."""
+    C = s.n_classes
+    rows = [densify_row(s, jnp.asarray(c)) for c in range(C)]
+    return jnp.stack(rows, axis=1)
+
+
+def state_nbytes(s: SparseRows) -> int:
+    """Actual resident bytes of a concrete sparse state."""
+    return sum(int(np_leaf.size) * np_leaf.dtype.itemsize
+               for np_leaf in jax.tree_util.tree_leaves(s))

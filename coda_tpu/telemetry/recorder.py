@@ -104,7 +104,8 @@ KNOB_FIELDS = (
     "method", "loss", "iters", "seeds", "alpha", "learning_rate",
     "multiplier", "prefilter_n", "no_diag_prior", "q", "epsilon",
     "eig_chunk", "eig_mode", "eig_backend", "eig_precision",
-    "eig_cache_dtype", "eig_refresh", "eig_entropy", "pi_update", "mesh",
+    "eig_cache_dtype", "eig_refresh", "eig_entropy", "posterior",
+    "eig_pbest", "pi_update", "mesh",
 )
 
 

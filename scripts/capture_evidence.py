@@ -145,6 +145,14 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                 [py, "scripts/dryrun_multichip.py", "2", "--skip-shard-map",
                  "--out", os.path.join(tmpdir, "multichip.json")],
                 os.path.join(tmpdir, "multichip.json"), 900),
+            # the large-C rung at its scaled-down-C stand-in (same tier
+            # and kernels; the full C=1000 shape is the non-quick config
+            # and the committed IMAGENET_SPARSE_* capture)
+            "bench_imagenet": (
+                [py, "bench.py", "--config", "imagenet_smoke",
+                 "--posterior", "sparse:16", "--skip-reference",
+                 "--reps", "2"] + plat,
+                None, 900),
         }
     return {
         # the r09 evidence set the ROADMAP asks for, in one run
@@ -166,6 +174,11 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
             [py, "scripts/dryrun_multichip.py", "8",
              "--out", os.path.join(tmpdir, "multichip.json")],
             os.path.join(tmpdir, "multichip.json"), 3600),
+        # the large-C rung at the real IMAGENET_VIRTUAL_r05 pool shape
+        "bench_imagenet": (
+            [py, "bench.py", "--config", "imagenet",
+             "--posterior", "sparse:32", "--skip-reference"] + plat,
+            None, 3600),
     }
 
 

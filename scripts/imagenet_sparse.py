@@ -1,0 +1,285 @@
+"""ImageNet-scale sparse-posterior capture (IMAGENET_SPARSE_*.json).
+
+Same container + synthetic-pool methodology as
+``scripts/imagenet_virtual.py`` / ``IMAGENET_VIRTUAL_r05.json`` — the
+real C=1000 x H=500 pool shape (N scaled to one host, same task seed) —
+running the tier that artifact showed the framework NEEDS at this scale:
+the incremental EIG with the ``sparse:K`` posterior representation,
+where a labeling round touches one compact class row per model instead
+of carrying the dense 2 GB ``(H, C, C)`` tensor through the scan.
+
+One deliberate methodology difference, recorded in the artifact: this
+capture executes on ONE host device, because the ROADMAP's claim for
+this shape is the one-chip interactivity target ("<1 s/round at C=1000
+on one chip"). r05's 8-virtual-device mesh existed to verify the dense
+tiers' temp-memory scaling and is itself the committed round-time
+baseline at this shape; replicating the 2 GB dense prior across 8
+virtual devices on one host (16+ GB of replicated init work for a
+representation whose point is to delete that tensor) measures the
+emulation, not the tier.
+
+Protocol:
+
+  * the sparse config runs the SAME compiled recording program at 1 and
+    at ``1 + ROUNDS`` scan steps; the wall-clock DIFFERENCE isolates the
+    marginal per-round cost from the one-time init (cache build + first
+    dispatch) — the same two-length protocol bench.py uses, sized at 50
+    rounds so the delta clears container noise;
+  * a dense-posterior run of the SAME incremental tier is recorded at the
+    long length, and the two flight-recorder records are compared through
+    the REAL ``cli replay --against`` path: the auto tolerance keys off
+    the fingerprinted ``posterior`` knob (dense-vs-sparse compares under
+    the documented 2.34e-4 score contract, not a fake bitwise bar), and
+    any first divergence arrives classified by the triage;
+  * posterior state bytes are reported analytically
+    (``ops.sparse_rows.posterior_nbytes``) next to XLA's compiled
+    argument/temp memory analysis of both programs.
+
+The committed claims (gated by ``scripts/check_perf.py``): round time
+>= 20x below the r05 dense capture's best tier at the same shape,
+posterior state bytes >= 10x below dense, max |Δscore| within the
+2.34e-4 contract.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/imagenet_sparse.py --out IMAGENET_SPARSE_CPU_r12.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+# the committed baseline this capture improves on: the best (rowscan)
+# tier of IMAGENET_VIRTUAL_r05.json at the same shape and mesh
+R05_BASELINE = {"artifact": "IMAGENET_VIRTUAL_r05.json",
+                "eig_mode": "rowscan", "round_s": 736.36}
+ROUNDS = 50          # marginal-measurement delta (iters 1 -> 51)
+TRACE_K = 8
+
+
+def _build(task, posterior: str, iters: int, chunk: int):
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.engine.loop import make_batched_experiment_fn
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    hp = CODAHyperparams(eig_mode="incremental", eig_chunk=chunk,
+                         posterior=posterior)
+    fn = jax.jit(make_batched_experiment_fn(
+        lambda p: make_coda(p, hp), iters=iters, trace_k=TRACE_K))
+    keys = jnp.stack([jax.random.PRNGKey(0)])
+    return fn, (task.preds, task.labels, keys)
+
+
+def run_config(task, posterior: str, iters: int, chunk: int) -> dict:
+    """Compile + execute one recorded config; returns timing, memory
+    analysis, and the (result, aux) pair for record building."""
+    import jax
+
+    fn, args = _build(task, posterior, iters, chunk)
+    label = f"{posterior}/i{iters}"
+    print(f"[{label}] lowering+compiling...", flush=True)
+    t0 = time.perf_counter()
+    compiled = fn.lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+    ma = compiled.memory_analysis()
+    print(f"[{label}] compiled in {compile_s:.1f}s; executing...",
+          flush=True)
+    t0 = time.perf_counter()
+    res, aux = compiled(*args)
+    res, aux = jax.tree.map(np.asarray, (res, aux))  # host-materialized
+    wall_s = time.perf_counter() - t0
+    print(f"[{label}] ran in {wall_s:.1f}s", flush=True)
+    return {
+        "posterior": posterior, "iters": iters,
+        "compile_s": round(compile_s, 2), "wall_s": round(wall_s, 2),
+        "xla_temp_bytes_per_device": ma.temp_size_in_bytes if ma else None,
+        "xla_argument_bytes_per_device": (
+            ma.argument_size_in_bytes if ma else None),
+        "regret_final": float(np.asarray(res.regret)[0, -1]),
+        "finite": bool(np.isfinite(np.asarray(res.regret)).all()),
+        "_res": res, "_aux": aux,
+    }
+
+
+def _record_of(entry: dict, task, knobs: dict):
+    from coda_tpu.telemetry.recorder import (
+        RunRecord,
+        environment_fingerprint,
+    )
+
+    fp = environment_fingerprint(dataset=task, knobs=knobs)
+    return RunRecord.from_result(
+        entry["_res"], entry["_aux"], fp,
+        run={"task": task.name, "iters": entry["iters"], "seeds": 1,
+             "synthetic": True})
+
+
+def _max_score_delta(rec_a, rec_b) -> float:
+    """max |Δ| over the recorded score quantities (rank-aligned top-k
+    scores + the chosen score), the number the contract bounds."""
+    worst = 0.0
+    for q in ("topk_score", "chosen_score"):
+        a, b = np.asarray(rec_a.arrays[q]), np.asarray(rec_b.arrays[q])
+        finite = np.isfinite(a) & np.isfinite(b)
+        if finite.any():
+            worst = max(worst, float(np.max(np.abs(a[finite] - b[finite]))))
+    return worst
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--small", action="store_true",
+                    help="smoke-test shape (CI), not the artifact config")
+    ap.add_argument("--sparse-k", type=int, default=32)
+    ap.add_argument("--record-root", default=None,
+                    help="where the two flight-recorder records land "
+                         "(default: <out>.records/ or a temp dir)")
+    args = ap.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform("cpu")  # the site hook force-registers the axon TPU
+    import jax
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine.replay import replay_main
+    from coda_tpu.ops.sparse_rows import posterior_nbytes
+    from coda_tpu.telemetry.recorder import CROSS_BACKEND_SCORE_TOL
+
+    if args.small:
+        H, N, C, chunk, k = 20, 256, 40, 64, 8
+    else:
+        # the r05 pool dims; N scaled exactly as that artifact records
+        H, N, C, chunk, k = 500, 256, 1000, 64, args.sparse_k
+    sparse_spec = f"sparse:{k}"
+    task = make_synthetic_task(seed=5, H=H, N=N, C=C,
+                               name="imagenet_sparse")
+
+    iters_long = 1 + ROUNDS
+    sparse_short = run_config(task, sparse_spec, 1, chunk)
+    sparse_long = run_config(task, sparse_spec, iters_long, chunk)
+    dense_short = run_config(task, "dense", 1, chunk)
+    dense_long = run_config(task, "dense", iters_long, chunk)
+
+    round_s = (sparse_long["wall_s"] - sparse_short["wall_s"]) / ROUNDS
+    dense_round_s = (dense_long["wall_s"] - dense_short["wall_s"]) / ROUNDS
+    base_knobs = {"method": "coda", "eig_mode": "incremental",
+                  "eig_chunk": chunk, "iters": iters_long, "seeds": 1}
+    rec_sparse = _record_of(sparse_long, task,
+                            dict(base_knobs, posterior=sparse_spec))
+    rec_dense = _record_of(dense_long, task,
+                           dict(base_knobs, posterior="dense"))
+
+    root = args.record_root or ((args.out or "IMAGENET_SPARSE")
+                                + ".records")
+    dir_sparse = os.path.join(root, "sparse")
+    dir_dense = os.path.join(root, "dense")
+    rec_sparse.save(dir_sparse)
+    rec_dense.save(dir_dense)
+
+    # the REAL replay CLI path: auto tolerance keys off the fingerprinted
+    # posterior knob (dense-vs-sparse -> the documented score contract)
+    report_path = os.path.join(root, "replay_report.json")
+    rc = replay_main([dir_sparse, "--against", dir_dense,
+                      "--score-tol", "auto", "--out", report_path])
+    with open(report_path) as f:
+        triage = json.load(f)
+    max_dscore = _max_score_delta(rec_sparse, rec_dense)
+
+    post_dense = posterior_nbytes(H, C, None)
+    post_sparse = posterior_nbytes(H, C, k)
+    first = (triage["seeds"][0] if triage.get("seeds") else {})
+    divergence_ok = bool(triage.get("parity")) or (
+        first.get("classification") == "tie-break-flip")
+
+    out = {
+        "config": "IMAGENET_VIRTUAL_r05.json pool shape (C=%d, H=%d, "
+                  "N=%d), incremental tier, posterior=%s"
+                  % (C, H, N, sparse_spec),
+        "devices": len(jax.devices()),
+        "mesh": "single host device (the ROADMAP one-chip interactivity "
+                "target; r05's data=8 virtual mesh verified dense-tier "
+                "temp scaling and is the round-time baseline here)",
+        "shape": {"H": H, "N": N, "C": C, "chunk": chunk,
+                  "rounds_measured": ROUNDS},
+        "baseline": dict(R05_BASELINE),
+        "sparse": {
+            k2: v for k2, v in sparse_long.items()
+            if not k2.startswith("_")},
+        "sparse_short": {
+            k2: v for k2, v in sparse_short.items()
+            if not k2.startswith("_")},
+        "dense_ref": {
+            k2: v for k2, v in dense_long.items()
+            if not k2.startswith("_")},
+        "dense_ref_short": {
+            k2: v for k2, v in dense_short.items()
+            if not k2.startswith("_")},
+        "round_s_marginal": round(round_s, 4),
+        # the same-setup comparison: dense INCREMENTAL on the same single
+        # device (the strongest dense config, much faster than r05's
+        # forced factored/rowscan tiers) vs sparse
+        "dense_round_s_marginal": round(dense_round_s, 4),
+        "round_time_reduction_vs_dense_ref": round(
+            dense_round_s / max(round_s, 1e-9), 2),
+        "round_time_reduction_vs_r05": round(
+            R05_BASELINE["round_s"] / max(round_s, 1e-9), 2),
+        "state": {
+            "dense_posterior_bytes": post_dense,
+            "sparse_posterior_bytes": post_sparse,
+            "bytes_ratio": round(post_dense / post_sparse, 2),
+        },
+        "replay": {
+            "cli": "cli replay %s --against %s --score-tol auto"
+                   % (dir_sparse, dir_dense),
+            "score_tol": (triage.get("score_tol")
+                          if triage.get("score_tol") is not None
+                          else CROSS_BACKEND_SCORE_TOL),
+            "parity": bool(triage.get("parity")),
+            "rc": rc,
+            "max_abs_dscore": max_dscore,
+            "first_divergence": ({
+                "round": first.get("first_divergent_round"),
+                "quantity": first.get("quantity"),
+                "classification": first.get("classification")}
+                if not triage.get("parity") else None),
+            "knob_diff": (triage.get("meta") or {}).get("knob_diff"),
+        },
+    }
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    out["fingerprint"] = environment_fingerprint(
+        dataset=task, knobs={"capture": "imagenet_sparse",
+                             "posterior": sparse_spec, "small": args.small,
+                             "rounds": ROUNDS, "chunk": chunk})
+    out["ok"] = bool(
+        sparse_long["finite"] and dense_long["finite"]
+        and max_dscore <= CROSS_BACKEND_SCORE_TOL
+        and divergence_ok
+        # the byte-ratio and round-time contracts are claims about the
+        # artifact shape; the CI smoke shape only proves the pipeline
+        and (args.small or (out["state"]["bytes_ratio"] >= 10.0
+                            and out["round_time_reduction_vs_r05"]
+                            >= 20.0)))
+    print(json.dumps({k2: v for k2, v in out.items()}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {args.out}", file=sys.stderr)
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,15 +98,23 @@ def test_reference_baseline_skip_without_cache(tmp_path, monkeypatch):
 
 def test_analytic_step_bytes_matches_documented_traffic():
     """The bytes model feeds the reported MBU; pin it to the documented
-    per-round traffic per tier: incremental = cache stream + delta pi-hat
-    gather + row write+read (the pi_update='delta' path), factored = hyp
-    stream + full preds stream + row."""
+    per-round traffic per tier: incremental = cache stream + dense
+    posterior Beta reduction + delta pi-hat gather + row write+read (the
+    pi_update='delta' path), factored = hyp stream + full preds stream +
+    row. A sparse:K posterior replaces the dense (H, C, C) reduction with
+    the compact row read."""
     from bench import _analytic_step_bytes
 
     H, N, C = 1000, 50_000, 10
-    expected = 4.0 * N * C * H + 4.0 * H * N + 8.0 * N * H
+    post = 4.0 * H * C * C
+    expected = 4.0 * N * C * H + post + 4.0 * H * N + 8.0 * N * H
     assert _analytic_step_bytes(
         H, N, C, mode="incremental", pi_update="delta") == expected
+    # sparse:K swaps the dense posterior stream for the O(H*K) row slices
+    k = 4
+    assert _analytic_step_bytes(
+        H, N, C, mode="incremental", pi_update="delta",
+        posterior=f"sparse:{k}") == expected - post + 16.0 * H * k
     expected_fac = 4.0 * N * C * H + 4.0 * H * N * C + 8.0 * N * H
     assert _analytic_step_bytes(
         H, N, C, mode="factored", pi_update="delta") == expected_fac
@@ -143,7 +151,7 @@ def test_analytic_bytes_prices_fused_pallas_backend():
     jnp_b = _analytic_step_bytes(H, N, C, "incremental", pi_update="exact")
     pal_b = _analytic_step_bytes(H, N, C, "incremental", pi_update="exact",
                                  backend="pallas")
-    cache = 4.0 * N * C * H
+    cache = 4.0 * N * C * H + 4.0 * H * C * C  # + dense posterior stream
     assert pal_b == cache + 4.0 * H * N * C + 16.0 * N * H
     # vs the jnp path: the kernel adds the (N, H) fp32 row roundtrip but
     # saves the defensive copy XLA inserts around the DUS (not priced —

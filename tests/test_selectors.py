@@ -578,14 +578,16 @@ def test_auto_eig_mode_accounts_for_vmapped_replicas():
 
     H, C = 1000, 10
     # the delta pi-hat default carries TWO preds-sized tensors (cache +
-    # transposed layout), so one replica is budgeted at 2 copies; size the
-    # cache just under budget/2
-    N = _INCR_CACHE_MAX_BYTES // (2 * 4 * C * H) - 1
+    # transposed layout) plus the dense (H, C, C) posterior itself, so one
+    # replica is budgeted at 2 copies + the posterior; size the cache just
+    # under (budget - posterior)/2
+    budget = _INCR_CACHE_MAX_BYTES - 4 * H * C * C
+    N = budget // (2 * 4 * C * H) - 1
     assert resolve_eig_mode(CODAHyperparams(), H, N, C) == "incremental"
     assert resolve_eig_mode(
         CODAHyperparams(n_parallel=5), H, N, C) == "factored"
     # pi_update='exact' keeps only the cache resident: twice the N fits
-    N2 = _INCR_CACHE_MAX_BYTES // (4 * C * H) - 1
+    N2 = budget // (4 * C * H) - 1
     assert resolve_eig_mode(
         CODAHyperparams(pi_update="exact"), H, N2, C) == "incremental"
     assert resolve_eig_mode(CODAHyperparams(), H, N2, C) == "factored"
@@ -675,9 +677,10 @@ def test_bf16_cache_scores_and_budget(task):
     assert int(s32.argmax()) in np.argsort(s16)[-5:]
 
     # budget: with the exact pi path (no delta layout), a bf16 cache fits
-    # at TWICE the N the fp32 cache does
+    # at TWICE the N the fp32 cache does (net of the dense posterior's own
+    # resident charge)
     H, C = 1000, 10
-    n_fp32 = _INCR_CACHE_MAX_BYTES // (4 * C * H) - 1
+    n_fp32 = (_INCR_CACHE_MAX_BYTES - 4 * H * C * C) // (4 * C * H) - 1
     assert resolve_eig_mode(CODAHyperparams(
         pi_update="exact"), H, 2 * n_fp32, C) == "factored"
     assert resolve_eig_mode(CODAHyperparams(

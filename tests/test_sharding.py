@@ -257,10 +257,14 @@ def test_auto_resolver_large_c_shapes():
     from coda_tpu.selectors import CODAHyperparams
     from coda_tpu.selectors.coda import resolve_eig_mode
 
-    # H=128, N=4096, C=1000: the (N, C, H) cache is 2.0 GiB -> up to two
-    # replicas fit incremental, four do not (and their 2.0 GiB of tables
-    # still fit -> factored)
-    assert resolve_eig_mode(CODAHyperparams(), 128, 4096, 1000) == "incremental"
+    # H=128, N=4096, C=1000: cache + delta layout is 3.9 GiB and the
+    # DENSE (H, C, C) posterior the budget now charges adds 0.5 GiB —
+    # past the 4 GiB budget, so the dense representation resolves
+    # factored even for one replica; the sparse:32 posterior (34 MB) is
+    # exactly what keeps this shape on the incremental tier
+    assert resolve_eig_mode(CODAHyperparams(), 128, 4096, 1000) == "factored"
+    assert resolve_eig_mode(CODAHyperparams(posterior="sparse:32"),
+                            128, 4096, 1000) == "incremental"
     assert resolve_eig_mode(
         CODAHyperparams(n_parallel=4), 128, 4096, 1000) == "factored"
     # ImageNet-scale reference config: 93 GiB cache is out, 1.9 GiB of

@@ -259,9 +259,11 @@ def test_suite_width_divergent_eig_tiers(monkeypatch):
     task = Dataset(preds=preds, labels=labels, name="ties")
     H, N, C = task.preds.shape
 
-    # budget: one (N, C, H) cache fits, four do not
+    # budget: one (N, C, H) cache fits (plus the tiny dense-posterior
+    # charge the budget now includes), four do not
     one_cache = 4 * N * C * H
-    monkeypatch.setattr(coda_mod, "_INCR_CACHE_MAX_BYTES", 2 * one_cache)
+    monkeypatch.setattr(coda_mod, "_INCR_CACHE_MAX_BYTES",
+                        2 * one_cache + 4 * H * C * C)
     assert resolve_eig_mode(
         CODAHyperparams(n_parallel=1), H, N, C) == "incremental"
     assert resolve_eig_mode(
