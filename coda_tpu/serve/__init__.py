@@ -10,9 +10,14 @@ front door with admission control and a warm-pool readiness gate
 queue-wait/dispatch/step attribution triplet
 (:mod:`~coda_tpu.serve.metrics`), fault tolerance — session
 checkpoint/restore + migration, bucket self-healing from recorder
-streams, crash restore (:mod:`~coda_tpu.serve.recovery`) — and a
+streams, crash restore (:mod:`~coda_tpu.serve.recovery`) — a
 deterministic fault-injection harness that exercises every recovery path
-(:mod:`~coda_tpu.serve.faults`). See ARCHITECTURE.md §"Serving".
+(:mod:`~coda_tpu.serve.faults`), and tiered posterior state: hot
+sessions on the slab, warm sessions as host-RAM export payloads, cold
+sessions hibernated to disk, with idle/watermark demotion and
+transparent wake-on-label, so open sessions are bounded by RAM+disk
+instead of slab capacity (:mod:`~coda_tpu.serve.tiering`). See
+ARCHITECTURE.md §"Serving".
 """
 
 from coda_tpu.serve.batcher import Batcher, Ticket
@@ -33,6 +38,7 @@ from coda_tpu.serve.server import (
     build_app,
     make_server,
 )
+from coda_tpu.serve.tiering import TierManager
 from coda_tpu.serve.state import (
     Bucket,
     BucketQuarantined,
@@ -65,6 +71,7 @@ __all__ = [
     "SlotRequest",
     "SlotResult",
     "Ticket",
+    "TierManager",
     "UnknownSession",
     "build_app",
     "export_session",

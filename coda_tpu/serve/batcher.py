@@ -67,13 +67,34 @@ class Ticket:
     result: Optional[dict] = None
     error: Optional[BaseException] = None
     cancelled: bool = False
+    # resolution hook: runs EXACTLY once, whoever resolves the ticket
+    # (complete/fail/cancel), outside the ticket lock. The serving layer
+    # hands a session's demotion pin to its ticket through this — the pin
+    # is released the instant the ticket is resolved, never twice.
+    on_resolve: Optional[object] = None
     _lock: threading.Lock = field(default_factory=threading.Lock)
     _async_waiters: list = field(default_factory=list)  # (loop, future)
 
     # -- resolution (exactly once) ----------------------------------------
-    def _fire(self) -> None:
+    def _resolve_locked(self):
+        """Caller holds ``_lock`` and has set result/error: mark done and
+        hand back (waiters, hook) for POST-lock delivery — the hook takes
+        other locks (the session store's, for the demotion pin), so it
+        must never run inside the ticket lock."""
         self.done.set()
         waiters, self._async_waiters = self._async_waiters, []
+        cb, self.on_resolve = self.on_resolve, None
+        return waiters, cb
+
+    @staticmethod
+    def _run_hook(cb) -> None:
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass  # a hook failure must never mask the resolution
+
+    def _deliver(self, waiters) -> None:
         for loop, fut in waiters:
             try:
                 loop.call_soon_threadsafe(self._resolve_future, fut)
@@ -100,13 +121,13 @@ class Ticket:
             if self.done.is_set():
                 return False
             self.result = result
-            if collector is None:
-                self._fire()
-                return True
-            self.done.set()
-            waiters, self._async_waiters = self._async_waiters, []
-        for loop, fut in waiters:
-            collector.setdefault(loop, []).append((self, fut))
+            waiters, cb = self._resolve_locked()
+        self._run_hook(cb)
+        if collector is None:
+            self._deliver(waiters)
+        else:
+            for loop, fut in waiters:
+                collector.setdefault(loop, []).append((self, fut))
         return True
 
     def fail(self, error: BaseException) -> bool:
@@ -114,8 +135,10 @@ class Ticket:
             if self.done.is_set():
                 return False
             self.error = error
-            self._fire()
-            return True
+            waiters, cb = self._resolve_locked()
+        self._run_hook(cb)
+        self._deliver(waiters)
+        return True
 
     def cancel(self, reason: str = "timeout") -> bool:
         """Mark the ticket dead-on-arrival for the dispatcher. Wins only if
@@ -126,8 +149,10 @@ class Ticket:
                 return False
             self.cancelled = True
             self.error = RuntimeError(f"request cancelled ({reason})")
-            self._fire()
-            return True
+            waiters, cb = self._resolve_locked()
+        self._run_hook(cb)
+        self._deliver(waiters)
+        return True
 
     # -- waiting -----------------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> dict:
@@ -487,6 +512,7 @@ class Batcher:
             for slot, t in slots.items():
                 r = results[slot]
                 t.session.last = r
+                t.session.last_used = time.monotonic()  # the tiers' LRU axis
                 if t.do_update:
                     t.session.n_labeled += 1
                 if t.request_id is not None:

@@ -30,7 +30,12 @@ Names (each is one injection point):
                         window);
   * ``crash_before_tick`` / ``crash_after_tick`` — ``os._exit(17)``
                         around a batcher tick (crash-restore path: rebuild
-                        sessions from their JSONL streams).
+                        sessions from their JSONL streams);
+  * ``demote_during_label`` — a tier demotion is attempted at the exact
+                        moment a label arrives for the session
+                        (demotion-vs-ticket race: either the label wakes
+                        the freshly-demoted session or the demotion loses
+                        cleanly to the in-flight pin).
 
 Triggers (deterministic — a spec plus a request history replays exactly):
 
@@ -64,6 +69,11 @@ FAULT_SITES = {
     "slow_step": "step_pre",        # before the step, inside the lock
     "crash_before_tick": "tick_pre",
     "crash_after_tick": "tick_post",
+    # inject a tier demotion at the exact moment a label arrives for the
+    # session (serve/tiering.py): either the demotion wins and the label
+    # transparently wakes the session back, or it loses cleanly to an
+    # in-flight pin — the matrix fails on any lost/double-applied label
+    "demote_during_label": "label_pre",
 }
 
 _CRASH_EXIT_CODE = 17  # distinguishable from python tracebacks (1) in tests

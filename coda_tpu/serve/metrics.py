@@ -57,6 +57,18 @@ class ServeMetrics:
         self.warm_misses = 0         # dispatches that fell back to lazy jit
         self.warm_pool_size = 0      # precompiled executables in the pool
         self.warm_pool_seconds = None  # warm-up wall time (None = no warm)
+        # tiered posterior state (serve/tiering.py): paging events and the
+        # hot/warm/cold occupancy gauges, plus a wake-latency ring — the
+        # "wake-from-warm p99 under one batcher tick" claim's evidence
+        self.demotions = 0           # hot -> warm (slab slot freed)
+        self.hibernates = 0          # warm -> cold (payload spilled to disk)
+        self.wakes = 0               # warm/cold -> hot (transparent restore)
+        self.wakes_from_warm = 0
+        self.wakes_from_cold = 0
+        self.wakes_via_replay = 0    # digest mismatch -> stream replay path
+        self.wake_failures = 0       # wakes that raised (payload re-parked)
+        self.tier_occupancy = {"hot": 0, "warm": 0, "cold": 0}
+        self._wake_s = collections.deque(maxlen=_RING)
         # fault tolerance: checkpoint/restore + bucket self-healing events
         self.recovery = {
             "exported": 0,     # sessions serialized for migration
@@ -107,6 +119,35 @@ class ServeMetrics:
         with self._lock:
             self.warm_pool_size = int(size)
             self.warm_pool_seconds = float(seconds)
+
+    def record_tier(self, event: str, src: str = None,
+                    seconds: float = None, via: str = None) -> None:
+        """One tiering event: ``demote`` | ``hibernate`` | ``wake`` (with
+        its source tier, wall seconds, and restore path) | ``wake_failed``."""
+        with self._lock:
+            if event == "demote":
+                self.demotions += 1
+            elif event == "hibernate":
+                self.hibernates += 1
+            elif event == "wake":
+                self.wakes += 1
+                if src == "warm":
+                    self.wakes_from_warm += 1
+                elif src == "cold":
+                    self.wakes_from_cold += 1
+                if via == "replay":
+                    self.wakes_via_replay += 1
+                if seconds is not None:
+                    self._wake_s.append(seconds)
+            elif event == "wake_failed":
+                self.wake_failures += 1
+            else:
+                raise ValueError(f"unknown tier event {event!r}")
+
+    def set_tier_occupancy(self, hot: int, warm: int, cold: int) -> None:
+        with self._lock:
+            self.tier_occupancy = {"hot": int(hot), "warm": int(warm),
+                                   "cold": int(cold)}
 
     def record_recovery(self, event: str) -> None:
         """One fault-tolerance event (see the ``recovery`` counter keys)."""
@@ -161,6 +202,17 @@ class ServeMetrics:
                     "misses": self.warm_misses,
                 },
                 "recovery": dict(self.recovery),
+                # tiered-state evidence: occupancy per tier, paging
+                # counters, and the wake-latency ring percentiles
+                "tiers": dict(self.tier_occupancy),
+                "demotions": self.demotions,
+                "hibernates": self.hibernates,
+                "wakes": self.wakes,
+                "wakes_from_warm": self.wakes_from_warm,
+                "wakes_from_cold": self.wakes_from_cold,
+                "wakes_via_replay": self.wakes_via_replay,
+                "wake_failures": self.wake_failures,
+                "wake_latency": _percentiles(self._wake_s),
                 # ring fill: how much recent-window evidence backs the
                 # percentiles above (fill == capacity -> the ring has
                 # wrapped and older events have been evicted)
@@ -172,6 +224,7 @@ class ServeMetrics:
                     "request_latency": len(self._request_s),
                     "queue_wait": len(self._queue_wait_s),
                     "step_latency": len(self._step_s),
+                    "wake_latency": len(self._wake_s),
                 },
             }
         return snap

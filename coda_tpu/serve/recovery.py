@@ -258,22 +258,14 @@ def snapshot_fingerprint(bucket) -> dict:
     }
 
 
-def export_session(app, sid: str) -> dict:
-    """Serialize one live session as a self-contained, versioned payload.
-
-    Always carries the recorder stream (the portable, replayable session
-    log — ``n_labeled``/``last`` are derived from it, the single source of
-    truth). When the slab is readable, also a fingerprint-guarded snapshot
-    of the slot's carries for the import fast path; a quarantined bucket
-    exports stream-only (the stream IS the session). Leaves the session
-    live — the drain flow closes it separately once the peer confirms the
-    import."""
-    sess = app.store.get(sid)
-    if sess.restoring:
-        # mid-restore the slot and the recorder history are half-built;
-        # an export now would serialize an empty stream as the session
-        raise BucketQuarantined(
-            f"session {sid} is being restored; retry shortly")
+def build_export_payload(app, sess, snapshot=None) -> dict:
+    """The serialization shared by ``POST /session/{id}/export`` and the
+    warm-tier demotion (serve/tiering.py — a demoted session IS its export
+    payload, minus the HTTP hop). Caller has resolved ``sess`` and
+    guaranteed it stays resident for the duration (a pin, or the export
+    verb's own lookup). ``snapshot`` injects a pre-taken
+    ``(leaves, key)`` (the sweeper's batched ``snapshot_slots``) instead
+    of reading the slab here."""
     bucket = sess.bucket
     payload = {
         "v": SESSION_EXPORT_VERSION,
@@ -295,15 +287,36 @@ def export_session(app, sid: str) -> dict:
     # the import-side digest check fails, and restore falls back to the
     # replay path — never a torn state
     try:
-        leaves, key = bucket.snapshot_slot(sess.slot)
+        leaves, key = (snapshot if snapshot is not None
+                       else bucket.snapshot_slot(sess.slot))
         payload["carries"] = [_pack(x) for x in leaves]
         payload["key"] = _pack(key)
     except (BucketQuarantined, RuntimeError):
         pass  # slab lost: the stream-only export is still complete
-    rows = data_rows(app.recorder.history(sid))
+    rows = data_rows(app.recorder.history(sess.sid))
     payload["rows"] = rows
     payload["n_labeled"] = sum(1 for r in rows if r.get("do_update"))
     payload["last"] = dict(rows[-1]) if rows else None
+    return payload
+
+
+def export_session(app, sid: str) -> dict:
+    """Serialize one live session as a self-contained, versioned payload.
+
+    Always carries the recorder stream (the portable, replayable session
+    log — ``n_labeled``/``last`` are derived from it, the single source of
+    truth). When the slab is readable, also a fingerprint-guarded snapshot
+    of the slot's carries for the import fast path; a quarantined bucket
+    exports stream-only (the stream IS the session). Leaves the session
+    live — the drain flow closes it separately once the peer confirms the
+    import."""
+    sess = app.store.get(sid)
+    if sess.restoring:
+        # mid-restore the slot and the recorder history are half-built;
+        # an export now would serialize an empty stream as the session
+        raise BucketQuarantined(
+            f"session {sid} is being restored; retry shortly")
+    payload = build_export_payload(app, sess)
     app.metrics.record_recovery("exported")
     _counter("serve_sessions_exported_total",
              "Sessions serialized for checkpoint/migration").inc()
@@ -311,9 +324,10 @@ def export_session(app, sid: str) -> dict:
 
 
 def export_all(app) -> list[dict]:
-    """Export every live session (the drain/migrate sweep). A session
-    closed by its client between the listing and its export is skipped —
-    a finished session needs no migration."""
+    """Export every open session — resident AND parked (the drain/migrate
+    sweep; a rolling restart must carry all three tiers). A session closed
+    by its client between the listing and its export is skipped — a
+    finished session needs no migration."""
     from coda_tpu.serve.state import UnknownSession
 
     with app.store.lock:
@@ -324,6 +338,11 @@ def export_all(app) -> list[dict]:
             out.append(export_session(app, sid))
         except UnknownSession:
             pass
+    tiers = getattr(app, "tiers", None)
+    if tiers is not None:
+        seen = {p["session"] for p in out}
+        out += [p for p in tiers.export_parked()
+                if p["session"] not in seen]
     return out
 
 
@@ -359,7 +378,7 @@ def _finalize_restored(sess, rows) -> None:
                                         "pbest_max", "pbest_entropy")}
 
 
-def import_session(app, payload: dict) -> dict:
+def import_session(app, payload: dict, count: bool = True) -> dict:
     """Restore an exported session into this server; returns
     ``{restored_via, session, n_labeled, rounds}``.
 
@@ -369,6 +388,10 @@ def import_session(app, payload: dict) -> dict:
     (2) replay path — re-drive the stream through the bucket's compiled
     step from the session's init, every round verified bitwise. A session
     that fails both is rejected whole (attributable), never half-admitted.
+
+    ``count=False`` skips the open/imported metrics — the tier wake path
+    (serve/tiering.py) restores through here but counts its own events
+    (a wake is a page-in, not a new session).
     """
     if payload.get("v") != SESSION_EXPORT_VERSION:
         raise ImportRejected(
@@ -410,16 +433,19 @@ def import_session(app, payload: dict) -> dict:
         restored_via = None
         if payload.get("carries") is not None and _fingerprint_compatible(
                 payload.get("fingerprint") or {}, bucket):
-            bucket.restore_slot(sess.slot,
-                                [_unpack(d) for d in payload["carries"]],
-                                _unpack(payload["key"]))
+            # verify FIRST, on the imported host leaves — no slab access,
+            # no bucket lock, so a wake/import never waits out an
+            # in-flight dispatch just to check a payload — then stage the
+            # slot write only for a payload that verified
+            leaves = [_unpack(d) for d in payload["carries"]]
             want = last_digest(rows)
             if want is not None:
-                with bucket.lock:
-                    got = bucket.digest(sess.slot)
+                got = bucket.digest_leaves(leaves)
                 if got is not None and \
                         _f32_bits_equal(got[0], want[0]) and \
                         _f32_bits_equal(got[1], want[1]):
+                    bucket.restore_slot(sess.slot, leaves,
+                                        _unpack(payload["key"]))
                     restored_via = "snapshot"
             # no digest on either side -> the snapshot is UNVERIFIABLE;
             # fall through to the replay path, which verifies every round
@@ -443,10 +469,12 @@ def import_session(app, payload: dict) -> dict:
         _close_quietly(app.store, sess.sid)
         raise
     sess.restoring = False  # fully rebuilt: labels flow again
-    app.metrics.record_session("open")  # pairs with close_session's 'close'
-    app.metrics.record_recovery("imported")
-    _counter("serve_sessions_imported_total",
-             "Sessions restored from checkpoint/migration payloads").inc()
+    if count:
+        app.metrics.record_session("open")  # pairs with close's 'close'
+        app.metrics.record_recovery("imported")
+        _counter("serve_sessions_imported_total",
+                 "Sessions restored from checkpoint/migration "
+                 "payloads").inc()
     return {"restored_via": restored_via, "session": sess.sid,
             "n_labeled": sess.n_labeled, "rounds": len(rows)}
 
@@ -500,13 +528,21 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
     """Restore every un-closed session stream found in ``record_dir``
     (default: the app's own recorder directory) — the crash-restart path.
 
-    Two phases: every restorable stream is first admitted GATED
+    Each wave: every restorable stream is first admitted GATED
     (``Session.restoring`` — the sid resolves, labels answer retryable
     503), then all sessions sharing a bucket are replayed COALESCED —
     one masked slab dispatch serves every restoring slot per round, the
     same choreography :func:`heal_bucket` uses. A serial
     per-session replay would run ``capacity`` times more full-slab steps
     at exactly the moment (crash under full load) this path exists for.
+
+    With tiering enabled (``app.tiers``), MORE streams than slab capacity
+    restore in waves: each restored wave is demoted to the warm tier
+    before the next wave admits, so a crash of a beyond-capacity server
+    restarts with its whole open-session population intact (hot set
+    re-forms on demand via wake-on-label). Hibernated sessions carry a
+    close marker and are correctly skipped — their spill files are the
+    authority and the TierManager re-indexes them at startup.
 
     Per-session failures are collected, not raised: one corrupt stream
     must not brick the whole restart. Returns
@@ -515,8 +551,8 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
     report = {"restored": [], "skipped_closed": 0, "failed": {}}
     if not d or not os.path.isdir(d):
         return report
-    # phase 1: admit gated (no replay yet); collect per-stream failures
-    staged: list = []          # (sess, rows, meta)
+    # phase 1: validate every stream (no admission yet)
+    pending: list = []         # (sid, meta, rows)
     for sid, path in iter_session_streams(d):
         try:
             meta, rows, closed = load_session_stream(path)
@@ -567,61 +603,92 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
                     f"selector config mismatch: stream ran "
                     f"{meta['method']}{want_kw}, this server serves "
                     f"{app.spec.method}{have_kw}")
-            sess = app.store.open(task, app.spec,
-                                  seed=int(meta.get("seed", 0)),
-                                  sid=sid, restoring=True)
-            sess.bucket.stage_fresh(sess.slot, sess.seed)
         except Exception as e:
             report["failed"][sid] = repr(e)
             continue
-        staged.append((sess, rows, meta))
-    # phase 2: coalesced bitwise-verified replay, one dispatch per round
-    # per bucket; a diverging stream fails ONLY its session
-    by_bucket: dict = {}
-    for sess, rows, meta in staged:
-        by_bucket.setdefault(id(sess.bucket), (sess.bucket, []))[1].append(
-            (sess, rows, meta))
-    for bucket, items in by_bucket.values():
-        live = {sess.slot: (sess.sid, rows) for sess, rows, _ in items}
-
-        def locked_dispatch(reqs, _bucket=bucket):
-            with _bucket.lock:
-                return _bucket.dispatch(reqs)
-
-        def on_fail(sid, e):
-            if isinstance(e, ReplayMismatch):
-                report["failed"][sid] = repr(ImportRejected(
-                    f"stream failed replay verification: {e}"))
-            else:
-                report["failed"][sid] = f"restore dispatch failed: {e!r}"
-            _close_quietly(app.store, sid)
-
-        # per-session isolation: a diverging stream fails ONLY its session
-        # (restoring sessions are close-gated, so no `alive` check needed)
-        replay_live_coalesced(bucket, live, dispatch=locked_dispatch,
-                              on_fail=on_fail)
-        for sess, rows, meta in items:
-            if sess.slot not in live:
+        pending.append((sid, meta, rows))
+    # phase 2: admit + replay in slab-sized waves (one wave = the whole
+    # set when everything fits; beyond-capacity restarts need app.tiers)
+    tiers = getattr(app, "tiers", None)
+    wave_size = max(1, int(app.store.capacity))
+    while pending:
+        wave, pending = pending[:wave_size], pending[wave_size:]
+        staged: list = []      # (sess, rows, meta)
+        for sid, meta, rows in wave:
+            try:
+                sess = app.store.open(meta.get("task"), app.spec,
+                                      seed=int(meta.get("seed", 0)),
+                                      sid=sid, restoring=True)
+                sess.bucket.stage_fresh(sess.slot, sess.seed)
+            except Exception as e:
+                report["failed"][sid] = repr(e)
                 continue
-            _finalize_restored(sess, rows)
-            app.recorder.import_history(
-                sess.sid, meta={"task": sess.task,
-                                "method": meta.get("method")
-                                or app.spec.method,
-                                "spec_kwargs": meta.get("spec_kwargs")
-                                or [list(kv) for kv in app.spec.kwargs],
-                                "seed": sess.seed,
-                                "shape": meta.get("shape"),
-                                "digest": meta.get("digest"),
-                                "imported_via": "replay"},
-                rows=rows)
-            sess.restoring = False
-            report["restored"].append(sess.sid)
-            app.metrics.record_session("open")
-            app.metrics.record_recovery("restored")
-            _counter("serve_sessions_restored_total",
-                     "Sessions rebuilt from their JSONL streams after a "
-                     "crash").inc()
+            staged.append((sess, rows, meta))
+        # coalesced bitwise-verified replay, one dispatch per round per
+        # bucket; a diverging stream fails ONLY its session
+        by_bucket: dict = {}
+        for sess, rows, meta in staged:
+            by_bucket.setdefault(
+                id(sess.bucket), (sess.bucket, []))[1].append(
+                    (sess, rows, meta))
+        for bucket, items in by_bucket.values():
+            live = {sess.slot: (sess.sid, rows) for sess, rows, _ in items}
+
+            def locked_dispatch(reqs, _bucket=bucket):
+                with _bucket.lock:
+                    return _bucket.dispatch(reqs)
+
+            def on_fail(sid, e):
+                if isinstance(e, ReplayMismatch):
+                    report["failed"][sid] = repr(ImportRejected(
+                        f"stream failed replay verification: {e}"))
+                else:
+                    report["failed"][sid] = f"restore dispatch failed: {e!r}"
+                _close_quietly(app.store, sid)
+
+            # per-session isolation: a diverging stream fails ONLY its
+            # session (restoring sessions are close-gated, so no `alive`
+            # check needed)
+            replay_live_coalesced(bucket, live, dispatch=locked_dispatch,
+                                  on_fail=on_fail)
+            for sess, rows, meta in items:
+                if sess.slot not in live:
+                    continue
+                _finalize_restored(sess, rows)
+                app.recorder.import_history(
+                    sess.sid, meta={"task": sess.task,
+                                    "method": meta.get("method")
+                                    or app.spec.method,
+                                    "spec_kwargs": meta.get("spec_kwargs")
+                                    or [list(kv) for kv in app.spec.kwargs],
+                                    "seed": sess.seed,
+                                    "shape": meta.get("shape"),
+                                    "digest": meta.get("digest"),
+                                    "imported_via": "replay"},
+                    rows=rows)
+                sess.restoring = False
+                report["restored"].append(sess.sid)
+                app.metrics.record_session("open")
+                app.metrics.record_recovery("restored")
+                _counter("serve_sessions_restored_total",
+                         "Sessions rebuilt from their JSONL streams after "
+                         "a crash").inc()
+        if pending and tiers is not None:
+            # make room for the next wave: page this one out to warm (it
+            # just replayed, so its payload is a verified snapshot); the
+            # hot set re-forms on demand via wake-on-label. Batched per
+            # bucket — one slab snapshot demotes the whole wave — and
+            # unstarted sessions (a stream with zero data rows) demote
+            # too, or their slots would starve every later wave.
+            demote_by_bucket: dict = {}
+            for sess, rows, meta in staged:
+                if app.store.alive(sess.sid):
+                    demote_by_bucket.setdefault(
+                        id(sess.bucket), (sess.bucket, []))[1].append(
+                            sess.sid)
+            for bucket, wave_sids in demote_by_bucket.values():
+                tiers.demote_batch(bucket, wave_sids,
+                                   allow_unstarted=True)
     return report
 
 

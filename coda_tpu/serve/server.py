@@ -29,8 +29,13 @@ run on the default executor so the loop never stalls behind them.
                                                           (ok|degraded|
                                                           unready)
 
-Admission control: a full slab answers 503 (the client's retry signal), as
-does a draining server. ``ServeApp.drain()`` stops admitting, finishes the
+Admission control: with tiering (the default, ``serve/tiering.py``) a
+full slab demotes its coldest idle session to the warm tier and admits —
+open sessions are bounded by host RAM + spill disk, not slab capacity —
+and a label/best/trace for a demoted session transparently wakes it.
+503 remains the backpressure signal when nothing is demotable (every
+slot pinned by an in-flight request), with ``--no-tiering``, and on a
+draining server. ``ServeApp.drain()`` stops admitting, finishes the
 queued work, and flushes metrics — the graceful-shutdown half of the
 contract.
 
@@ -84,9 +89,14 @@ class ServeApp:
                  spec: Optional[SelectorSpec] = None,
                  step_impl: Optional[str] = None, donate: bool = True,
                  telemetry=None, recorder=None,
-                 fault_spec: Optional[str] = None):
+                 fault_spec: Optional[str] = None,
+                 tiering: bool = True,
+                 tier_spill_dir: Optional[str] = None,
+                 idle_warm_s: float = 30.0, idle_cold_s: float = 120.0,
+                 max_warm: int = 8192, tier_free_fraction: float = 0.0):
         from coda_tpu.serve.faults import FaultInjector
         from coda_tpu.serve.recovery import BucketHealer
+        from coda_tpu.serve.tiering import TierManager
         from coda_tpu.telemetry import SessionRecorder, Telemetry
 
         # deterministic fault injection (--fault-spec); inert when unset —
@@ -124,6 +134,15 @@ class ServeApp:
         self.healer = BucketHealer(self.store, self.recorder,
                                    metrics=self.metrics)
         self.batcher.on_bucket_failure = self.healer.schedule
+        # tiered posterior state (serve/tiering.py): hot sessions on the
+        # slab, warm sessions as host-RAM export payloads, cold sessions
+        # hibernated to tier_spill_dir; admission past capacity demotes
+        # the coldest instead of 503, a label/best/trace for a
+        # non-resident session transparently wakes it
+        self.tiers = TierManager(
+            self, spill_dir=tier_spill_dir, idle_warm_s=idle_warm_s,
+            idle_cold_s=idle_cold_s, max_warm=max_warm,
+            free_fraction=tier_free_fraction) if tiering else None
         self.spec = spec or SelectorSpec.create("coda", n_parallel=capacity)
         self.default_task = default_task
         self.draining = False
@@ -216,6 +235,8 @@ class ServeApp:
               warm_async: bool = False) -> "ServeApp":
         self._warm_requested = warm
         self.batcher.start()
+        if self.tiers is not None:
+            self.tiers.start()
         if not warm:
             self.ready.set()
         elif warm_async:
@@ -243,6 +264,8 @@ class ServeApp:
         clients, who keep finishing and closing sessions while the queue
         waits to go quiet)."""
         self.draining = True
+        if self.tiers is not None:
+            self.tiers.stop()  # no demotions mid-migration sweep
         self.batcher.stop(drain=not hard, timeout=timeout)
 
     def drain(self, timeout: float = 30.0) -> None:
@@ -257,10 +280,60 @@ class ServeApp:
             self._next_seed += 1
             return s
 
+    # -- tiering glue: wake-through lookup + demote-then-admit -------------
+    def _resolve_pinned(self, sid: str, wake: bool = True):
+        """Session lookup that pages a non-resident session back in: a
+        label/best/trace for a warm or cold sid wakes it through the
+        import fast path instead of 404-ing. Returns the session PINNED
+        (undemotable) — every caller unpins on every exit path.
+        ``wake=False`` skips paging (the event-loop fast path, which
+        must never run a wake's disk/replay work inline)."""
+        misses = 0
+        for _ in range(8):
+            try:
+                return self.store.get_pinned(sid)
+            except UnknownSession:
+                if self.tiers is None or not wake:
+                    raise
+                if self.tiers.wake_if_parked(sid):
+                    continue
+                # in no tier map — either truly unknown, or inside a
+                # demotion's unpublish→publish window (store pop, slot
+                # release, stream park all precede the warm-map insert):
+                # wait that window out before answering 404
+                misses += 1
+                if misses > 3:
+                    raise
+                time.sleep(0.002)
+        return self.store.get_pinned(sid)
+
+    def _admit(self, task: str, seed: int, sid: Optional[str] = None,
+               restoring: bool = False):
+        """``store.open`` with tiering admission: past slab capacity the
+        coldest resident session is demoted and the open retried —
+        ``SlabFull`` (503) only when nothing is demotable (every slot
+        pinned by an in-flight verb), which is genuine backpressure."""
+        attempts = 16 if self.tiers is not None else 1
+        for i in range(attempts):
+            try:
+                return self.store.open(task, self.spec, seed=seed, sid=sid,
+                                       restoring=restoring)
+            except SlabFull:
+                if self.tiers is None or i == attempts - 1:
+                    raise
+                if not self.tiers.make_room_for(task, self.spec):
+                    # transient: every candidate is pinned by a concurrent
+                    # verb or another demoter mid-sweep — wait a beat for
+                    # the herd to clear instead of bouncing a 503 the
+                    # client would only retry anyway
+                    time.sleep(0.002)
+
     # -- the session verbs (shared by the front door and in-process
     #    callers; *_begin/_abort split out so the asyncio path can run the
     #    blocking host half on an executor and await only the ticket) ------
     def _open_begin(self, task: Optional[str], seed: Optional[int]):
+        from coda_tpu.serve.batcher import Ticket
+
         if self.draining:
             self.metrics.record_session("reject")
             raise Draining()
@@ -268,9 +341,8 @@ class ServeApp:
         if task is None:
             raise KeyError("no task registered")
         try:
-            sess = self.store.open(task, self.spec,
-                                   seed=self._auto_seed() if seed is None
-                                   else int(seed))
+            sess = self._admit(task, self._auto_seed() if seed is None
+                               else int(seed))
         except SlabFull:
             self.metrics.record_session("reject")
             raise
@@ -285,7 +357,14 @@ class ServeApp:
             "spec_kwargs": [list(kv) for kv in self.spec.kwargs],
             "seed": sess.seed, "shape": tm.get("shape"),
             "digest": tm.get("digest")})
-        return sess, self.batcher.submit_start(sess)
+        # the start ticket carries a demotion pin (set BEFORE submit so a
+        # racing sweep can never page out a session whose first dispatch
+        # is still in flight); resolution — result, error, or timeout
+        # cancel — releases it exactly once
+        self.store.pin(sess)
+        ticket = Ticket(session=sess, do_update=False)
+        ticket.on_resolve = lambda: self.store.unpin(sess)
+        return sess, self.batcher.submit(ticket)
 
     def _open_abort(self, sess) -> None:
         # first item + prior best come from the session's first dispatch;
@@ -336,69 +415,90 @@ class ServeApp:
         return self._payload(sess, res)
 
     def _label_begin(self, sid: str, label: int, idx: Optional[int],
-                     request_id: Optional[str] = None):
+                     request_id: Optional[str] = None, wake: bool = True):
         from coda_tpu.serve.batcher import Ticket
 
-        sess = self.store.get(sid)
-        if sess.restoring:
-            # import/restore is mid-replay: the posterior and the dedupe
-            # cache are not rebuilt yet, so a label now could double-apply
-            # — retryable 503, same contract as the quarantine heal
-            raise BucketQuarantined(
-                f"session {sid} is being restored; retry shortly")
-        # idempotent retries: a request_id the session has already applied
-        # (or has in flight) is answered from the committed result / the
-        # live ticket — the oracle answer is applied to the posterior
-        # EXACTLY once no matter how many times the client retries. Checked
-        # BEFORE the stale-idx guard: a retry of an applied label is stale
-        # by definition, and that staleness is precisely what it means to
-        # have already been applied. Restore/import repopulate the cache
-        # from the recorder stream, so dedupe survives migration too.
-        if request_id is not None:
-            with self.store.lock:
-                done = sess.recent.get(request_id)
-                inflight = None if done is not None else \
-                    sess.pending.get(request_id)
-                if inflight is not None and inflight.done.is_set() \
-                        and inflight.error is not None:
-                    inflight = None  # dead ticket: let the retry resubmit
-            if done is not None:
-                t = Ticket(session=sess, do_update=True,
-                           request_id=request_id)
-                t.complete(dict(done))
-                return sess, t
-            if inflight is not None:
-                return sess, inflight
-        cur = sess.last
-        if not cur:
-            raise UnknownSession(sid)  # start dispatch never completed
-        if idx is not None and int(idx) != cur["next_idx"]:
-            raise StaleItem(
-                f"session {sid} proposed item {cur['next_idx']}, "
-                f"got a label for {idx}")
-        label = int(label)
-        if not 0 <= label < sess.bucket.n_classes:
-            raise ValueError(f"label {label} out of range "
-                             f"[0, {sess.bucket.n_classes})")
-        ticket = Ticket(session=sess, do_update=True, idx=cur["next_idx"],
-                        label=label, prob=cur["next_prob"],
-                        request_id=request_id)
-        if request_id is not None:
-            # registration is atomic with a re-check, so two concurrent
-            # retries of the same request_id can never BOTH submit
-            with self.store.lock:
-                done = sess.recent.get(request_id)
-                if done is None:
-                    existing = sess.pending.get(request_id)
-                    if existing is not None and not (
-                            existing.done.is_set()
-                            and existing.error is not None):
-                        return sess, existing
-                    sess.pending[request_id] = ticket
-            if done is not None:
-                ticket.complete(dict(done))
-                return sess, ticket
-        return sess, self.batcher.submit(ticket)
+        if self.faults is not None and self.tiers is not None and \
+                "demote_during_label" in self.faults.fire("label_pre"):
+            # injected demotion at the exact moment a label arrives: it
+            # either wins (and the lookup below transparently wakes the
+            # session) or loses cleanly to an in-flight pin — never both
+            self.tiers.try_demote(sid)
+        # wake-through lookup, PINNED: the session cannot be demoted
+        # between here and the ticket's resolution (the pin is handed to
+        # the ticket below; every non-ticket exit unpins in `finally`)
+        sess = self._resolve_pinned(sid, wake=wake)
+        handoff = False
+        try:
+            if sess.restoring:
+                # import/restore is mid-replay: the posterior and the
+                # dedupe cache are not rebuilt yet, so a label now could
+                # double-apply — retryable 503, same contract as the
+                # quarantine heal
+                raise BucketQuarantined(
+                    f"session {sid} is being restored; retry shortly")
+            # idempotent retries: a request_id the session has already
+            # applied (or has in flight) is answered from the committed
+            # result / the live ticket — the oracle answer is applied to
+            # the posterior EXACTLY once no matter how many times the
+            # client retries. Checked BEFORE the stale-idx guard: a retry
+            # of an applied label is stale by definition, and that
+            # staleness is precisely what it means to have already been
+            # applied. Restore/import repopulate the cache from the
+            # recorder stream, so dedupe survives migration too.
+            if request_id is not None:
+                with self.store.lock:
+                    done = sess.recent.get(request_id)
+                    inflight = None if done is not None else \
+                        sess.pending.get(request_id)
+                    if inflight is not None and inflight.done.is_set() \
+                            and inflight.error is not None:
+                        inflight = None  # dead ticket: retry resubmits
+                if done is not None:
+                    t = Ticket(session=sess, do_update=True,
+                               request_id=request_id)
+                    t.complete(dict(done))
+                    return sess, t
+                if inflight is not None:
+                    return sess, inflight
+            cur = sess.last
+            if not cur:
+                raise UnknownSession(sid)  # start dispatch never completed
+            if idx is not None and int(idx) != cur["next_idx"]:
+                raise StaleItem(
+                    f"session {sid} proposed item {cur['next_idx']}, "
+                    f"got a label for {idx}")
+            label = int(label)
+            if not 0 <= label < sess.bucket.n_classes:
+                raise ValueError(f"label {label} out of range "
+                                 f"[0, {sess.bucket.n_classes})")
+            ticket = Ticket(session=sess, do_update=True,
+                            idx=cur["next_idx"],
+                            label=label, prob=cur["next_prob"],
+                            request_id=request_id)
+            if request_id is not None:
+                # registration is atomic with a re-check, so two
+                # concurrent retries of the same request_id can never
+                # BOTH submit
+                with self.store.lock:
+                    done = sess.recent.get(request_id)
+                    if done is None:
+                        existing = sess.pending.get(request_id)
+                        if existing is not None and not (
+                                existing.done.is_set()
+                                and existing.error is not None):
+                            return sess, existing
+                        sess.pending[request_id] = ticket
+                if done is not None:
+                    ticket.complete(dict(done))
+                    return sess, ticket
+            # the ticket inherits our pin; resolution releases it
+            ticket.on_resolve = lambda: self.store.unpin(sess)
+            handoff = True
+            return sess, self.batcher.submit(ticket)
+        finally:
+            if not handoff:
+                self.store.unpin(sess)
 
     def label(self, sid: str, label: int, idx: Optional[int] = None,
               request_id: Optional[str] = None) -> dict:
@@ -408,28 +508,58 @@ class ServeApp:
     async def label_async(self, sid: str, label: int,
                           idx: Optional[int] = None,
                           request_id: Optional[str] = None) -> dict:
-        # no executor hop: _label_begin is pure host-dict work (session
-        # lookup, bounds checks, queue.put) — microseconds on the loop
-        sess, ticket = self._label_begin(sid, label, idx, request_id)
+        try:
+            # inline fast path with waking DISABLED: for a resident
+            # session _label_begin is pure host-dict work (lookup, bounds
+            # checks, queue.put) — microseconds on the loop. wake=False
+            # (not a pre-check) closes the race where a demotion lands
+            # between an aliveness probe and the lookup: the wake's disk
+            # read / stream replay must never run on the event loop.
+            sess, ticket = self._label_begin(sid, label, idx, request_id,
+                                             wake=False)
+        except UnknownSession:
+            if self.tiers is None:
+                raise
+            # non-resident (or mid-demotion): the full wake-through path
+            # on the executor — it retries through the demotion window
+            # and re-raises UnknownSession only for truly dead sids
+            loop = asyncio.get_running_loop()
+            sess, ticket = await loop.run_in_executor(
+                self._executor, self._label_begin, sid, label, idx,
+                request_id)
         return self._payload(sess, await ticket.wait_async(REQUEST_TIMEOUT_S))
 
     def best(self, sid: str) -> dict:
-        sess = self.store.get(sid)
-        if sess.restoring:
-            # the slot holds a partially-replayed posterior and n_labeled
-            # is still 0 — answering now would serve a wrong best-model
-            # estimate with a 200; same retryable contract as label
-            raise BucketQuarantined(
-                f"session {sid} is being restored; retry shortly")
-        out = self._payload(sess, sess.last or None)
-        with sess.bucket.lock:
-            pbest = sess.bucket.pbest(sess.slot)
-        if pbest is not None:
-            out["pbest"] = pbest.tolist()
-        return out
+        sess = self._resolve_pinned(sid)  # wakes a parked session
+        try:
+            if sess.restoring:
+                # the slot holds a partially-replayed posterior and
+                # n_labeled is still 0 — answering now would serve a wrong
+                # best-model estimate with a 200; same retryable contract
+                # as label
+                raise BucketQuarantined(
+                    f"session {sid} is being restored; retry shortly")
+            out = self._payload(sess, sess.last or None)
+            with sess.bucket.lock:
+                pbest = sess.bucket.pbest(sess.slot)
+            if pbest is not None:
+                out["pbest"] = pbest.tolist()
+            return out
+        finally:
+            self.store.unpin(sess)
 
     def close_session(self, sid: str) -> dict:
-        if self.store.get(sid).restoring:
+        try:
+            restoring = self.store.get(sid).restoring
+        except UnknownSession:
+            # a parked session closes without waking: drop the payload /
+            # hibernate file and seal the stream (close marker)
+            if self.tiers is not None and self.tiers.discard(sid):
+                self.recorder.seal(sid)
+                self.metrics.record_session("close")
+                return {"closed": sid}
+            raise
+        if restoring:
             # freeing the slot mid-replay would let a new admission take
             # it while the restore keeps dispatching recorded rounds into
             # it — corrupting whichever session lands there
@@ -437,6 +567,8 @@ class ServeApp:
                 f"session {sid} is being restored; retry shortly")
         self.store.close(sid)
         self.recorder.close(sid)
+        if self.tiers is not None:
+            self.tiers.discard(sid)  # clear any stale cold-index entry
         self.metrics.record_session("close")
         return {"closed": sid}
 
@@ -445,24 +577,53 @@ class ServeApp:
         (the flight recorder's interactive face: every dispatch this
         session rode, with the proposed item, best-model answer, and the
         label that was applied)."""
-        sess = self.store.get(sid)   # raises UnknownSession for dead ids
-        if sess.restoring:
-            # import_history lands only after the replay verifies; a trace
-            # served now would be empty/partial, not the session's history
-            raise BucketQuarantined(
-                f"session {sid} is being restored; retry shortly")
-        rounds = self.recorder.history(sid) or []
-        return {"session": sid, "task": sess.task,
-                "n_labeled": sess.n_labeled, "rounds": rounds}
+        sess = self._resolve_pinned(sid)  # wakes a parked session
+        try:
+            if sess.restoring:
+                # import_history lands only after the replay verifies; a
+                # trace served now would be empty/partial, not the
+                # session's history
+                raise BucketQuarantined(
+                    f"session {sid} is being restored; retry shortly")
+            rounds = self.recorder.history(sid) or []
+            return {"session": sid, "task": sess.task,
+                    "n_labeled": sess.n_labeled, "rounds": rounds}
+        finally:
+            self.store.unpin(sess)
 
     def export_session(self, sid: str, close: bool = False) -> dict:
         """The migration verb behind ``POST /session/{id}/export``: a
         self-contained payload (recorder stream + fingerprint-guarded
         carries snapshot) any same-task server can import. ``close`` frees
-        the slot once the payload is built — the drain handoff."""
-        from coda_tpu.serve import recovery
+        the slot once the payload is built — the drain handoff.
 
-        payload = recovery.export_session(self, sid)
+        A PARKED session exports without waking — its warm/cold payload
+        IS the export (a demotion is an export minus the HTTP hop). The
+        export pin means a demotion either completed before this verb
+        (payload served from the tier) or cleanly aborts against it —
+        the client always gets a consistent snapshot."""
+        from coda_tpu.serve import recovery
+        from coda_tpu.serve.recovery import _counter
+
+        try:
+            sess = self.store.get_pinned(sid)
+        except UnknownSession:
+            payload = (self.tiers.parked_payload(sid)
+                       if self.tiers is not None else None)
+            if payload is None:
+                raise
+            if close:
+                self.tiers.discard(sid)
+                self.recorder.seal(sid)
+                self.metrics.record_session("close")
+            self.metrics.record_recovery("exported")
+            _counter("serve_sessions_exported_total",
+                     "Sessions serialized for checkpoint/migration").inc()
+            return payload
+        try:
+            payload = recovery.export_session(self, sid)
+        finally:
+            self.store.unpin(sess)
         if close:
             self.close_session(sid)
         return payload
@@ -479,7 +640,15 @@ class ServeApp:
             self.metrics.record_session("reject")
             raise Draining()
         try:
-            info = recovery.import_session(self, payload)
+            try:
+                info = recovery.import_session(self, payload)
+            except SlabFull:
+                # tiering admission: an import past slab capacity demotes
+                # the coldest resident session instead of 503
+                if self.tiers is None or not self.tiers.make_room_for(
+                        payload.get("task"), self.spec):
+                    raise
+                info = recovery.import_session(self, payload)
         except BaseException:
             # a restore replay dispatch that consumed donated carries
             # quarantines its bucket WITHOUT passing through the batcher's
@@ -534,8 +703,20 @@ class ServeApp:
                 "problems": problems}
 
     def stats(self) -> dict:
+        # refresh the tier occupancy FIRST so the snapshot below carries
+        # current gauges even between sweeper passes
+        if self.tiers is not None:
+            tiers = self.tiers.counts()
+            self.metrics.set_tier_occupancy(**tiers)
+        else:
+            tiers = {"hot": self.store.live_sessions(), "warm": 0,
+                     "cold": 0}
         snap = self.metrics.snapshot()
-        snap["live_sessions"] = self.store.live_sessions()
+        # open sessions vs slab occupancy are DISTINCT the moment a
+        # session can live off-slab: open = every addressable session
+        # across all three tiers, occupancy = live device slab slots
+        snap["open_sessions"] = tiers["hot"] + tiers["warm"] + tiers["cold"]
+        snap["slab_occupancy"] = self.store.slab_occupancy()
         snap["draining"] = self.draining
         snap["ready"] = self.ready.is_set()
         # flight-recorder evidence, in distinct units: run RECORDS written
@@ -853,8 +1034,36 @@ def parse_args(argv=None):
                    help="selector behind every session "
                         "{coda, iid, uncertainty, model_picker, ...}")
     p.add_argument("--capacity", type=int, default=64,
-                   help="slab slots per bucket = max concurrent sessions "
-                        "per (task, config); admission past it answers 503")
+                   help="slab slots per bucket = max HOT (resident) "
+                        "sessions per (task, config); admission past it "
+                        "demotes the coldest session to the warm tier "
+                        "(503 only with --no-tiering or when nothing is "
+                        "demotable)")
+    p.add_argument("--no-tiering", action="store_true",
+                   help="disable hot/warm/cold session paging: sessions "
+                        "exist only while they hold a slab slot and "
+                        "admission past capacity answers 503 (the "
+                        "pre-tiering behavior)")
+    p.add_argument("--tier-spill-dir", default=None,
+                   help="enable the COLD tier: idle warm payloads "
+                        "hibernate to hibernated_<sid>.json files here "
+                        "(scanned at startup, so cold sessions survive "
+                        "restarts); without it paging is warm-only")
+    p.add_argument("--idle-warm-s", type=float, default=30.0,
+                   help="demote a hot session to the warm tier after this "
+                        "many seconds without a request")
+    p.add_argument("--idle-cold-s", type=float, default=120.0,
+                   help="hibernate a warm session to the cold tier after "
+                        "this many further idle seconds (needs "
+                        "--tier-spill-dir)")
+    p.add_argument("--max-warm", type=int, default=8192,
+                   help="bound on host-RAM warm payloads; LRU overflow "
+                        "hibernates to the cold tier (the RSS lever)")
+    p.add_argument("--tier-free-frac", type=float, default=0.0,
+                   help="sweeper keeps this fraction of each slab free by "
+                        "demoting LRU-idle sessions ahead of admission "
+                        "bursts (0 = demote only under admission "
+                        "pressure)")
     p.add_argument("--bucket-n", type=int, default=1,
                    help="pad task N up to this quantum so near-shaped tasks "
                         "share one compiled program (1 = exact shapes)")
@@ -951,6 +1160,12 @@ def build_app(args) -> ServeApp:
         donate=not getattr(args, "no_donate", False),
         telemetry=telemetry, recorder=recorder,
         fault_spec=getattr(args, "fault_spec", None),
+        tiering=not getattr(args, "no_tiering", False),
+        tier_spill_dir=getattr(args, "tier_spill_dir", None),
+        idle_warm_s=getattr(args, "idle_warm_s", 30.0),
+        idle_cold_s=getattr(args, "idle_cold_s", 120.0),
+        max_warm=getattr(args, "max_warm", 8192),
+        tier_free_fraction=getattr(args, "tier_free_frac", 0.0),
     )
     if args.task or args.synthetic:
         ds = load_dataset(args)

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import secrets
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, NamedTuple, Optional
 
@@ -427,6 +428,12 @@ class Bucket:
                 pbest_exec = self._get_pbest.lower(
                     self.slot_state(0)).compile()
                 n += 1
+                # the standalone digest read too: it is the wake-from-warm
+                # fast path's verification (serve/tiering.py), and a lazy
+                # first-use compile there would land inside some user's
+                # first wake instead of the warm-up
+                self.digest(0)
+                n += 1
             if self._write_slot is not None:
                 write_exec = self._write_slot.lower(
                     self.states, self.keys, jnp.int32(0),
@@ -694,17 +701,10 @@ class Bucket:
         return np.asarray(fn(self.slot_state(slot)))
 
     # -- checkpoint / heal support (serve/recovery.py drives these) --------
-    def digest(self, slot: int):
-        """(pbest_max, pbest_entropy) of one slot's CURRENT state, or None
-        when the method exposes no posterior — the same two float32 words
-        the slab step emits per round, read standalone so an imported
-        snapshot verifies against its stream's last recorded digest
-        without spending a dispatch. Caller holds ``lock``."""
+    def _ensure_digest_fn(self):
         import jax
         import jax.numpy as jnp
 
-        if self._get_pbest is None:
-            return None
         if self._digest_fn is None:
             from coda_tpu.ops.masked import entropy2
 
@@ -715,7 +715,28 @@ class Bucket:
                 return pb.max(), entropy2(pb)
 
             self._digest_fn = jax.jit(_digest)
-        m, e = self._digest_fn(self.slot_state(slot))
+        return self._digest_fn
+
+    def digest(self, slot: int):
+        """(pbest_max, pbest_entropy) of one slot's CURRENT state, or None
+        when the method exposes no posterior — the same two float32 words
+        the slab step emits per round, read standalone so an imported
+        snapshot verifies against its stream's last recorded digest
+        without spending a dispatch. Caller holds ``lock``."""
+        if self._get_pbest is None:
+            return None
+        m, e = self._ensure_digest_fn()(self.slot_state(slot))
+        return float(np.asarray(m)), float(np.asarray(e))
+
+    def digest_leaves(self, leaves):
+        """The same posterior digest computed on IMPORTED host leaves,
+        without touching the slab — no bucket lock, so the wake fast path
+        (serve/tiering.py) never waits out an in-flight dispatch just to
+        verify a payload. None when the method exposes no posterior."""
+        if self._get_pbest is None:
+            return None
+        state = self._state_from_leaves(leaves)
+        m, e = self._ensure_digest_fn()(state)
         return float(np.asarray(m)), float(np.asarray(e))
 
     def snapshot_slot(self, slot: int):
@@ -735,12 +756,10 @@ class Bucket:
             key = np.asarray(self.keys[slot])
         return leaves, key
 
-    def restore_slot(self, slot: int, leaves, key) -> None:
-        """Overwrite a slot's carries with imported host leaves (staged
-        like an admission write; the slot must already be allocated). The
-        leaf list is order/shape/dtype-checked against this bucket's own
-        state structure — the structural half of the import fingerprint
-        guard."""
+    def _state_from_leaves(self, leaves):
+        """Validated state pytree from imported host leaves: the list is
+        order/shape/dtype-checked against this bucket's own state
+        structure — the structural half of the import fingerprint guard."""
         import jax
         import jax.numpy as jnp
 
@@ -758,7 +777,38 @@ class Bucket:
                     f"snapshot leaf {arr.dtype}{arr.shape} != bucket "
                     f"state leaf {want.dtype}{want.shape}")
             cast.append(jnp.asarray(arr))
-        state = jax.tree.unflatten(treedef, cast)
+        return jax.tree.unflatten(treedef, cast)
+
+    def snapshot_slots(self, slots) -> dict:
+        """Host-materialized ``(state leaves, key)`` for MANY slots under
+        ONE lock acquisition: the whole slab transfers once per leaf and
+        the per-slot rows are sliced on the host. The tier sweeper's
+        batched demotion path (serve/tiering.py) — per-slot snapshots
+        would serialize every demotion behind an in-flight dispatch, and
+        paging out a 100k-session backlog needs hundreds of demotions per
+        second, not one per tick gap. Returns ``{slot: (leaves, key)}``."""
+        import jax
+
+        slots = list(slots)
+        if not slots:
+            return {}
+        with self.lock:
+            self._check_available()
+            self._apply_staged()
+            host_leaves = [np.asarray(x)
+                           for x in jax.tree.leaves(self.states)]
+            host_keys = np.asarray(self.keys)
+        return {
+            slot: ([x[slot] for x in host_leaves], host_keys[slot])
+            for slot in slots
+        }
+
+    def restore_slot(self, slot: int, leaves, key) -> None:
+        """Overwrite a slot's carries with imported host leaves (staged
+        like an admission write; the slot must already be allocated)."""
+        import jax.numpy as jnp
+
+        state = self._state_from_leaves(leaves)
         with self._host_lock:
             self._staged.append(
                 (slot, state, jnp.asarray(np.asarray(key), jnp.uint32)))
@@ -810,6 +860,13 @@ class Session:
     # cache are not rebuilt yet — label dispatches answer retryable 503
     # instead of 404-ing or double-applying (cleared when restore completes)
     restoring: bool = False
+    # tiering bookkeeping (serve/tiering.py): ``pins`` counts in-flight
+    # verbs/tickets holding the session resident — demotion requires the
+    # count to be exactly its own pin, so it cleanly loses every race
+    # against live traffic; ``last_used`` is the LRU axis idle-driven and
+    # watermark demotion order on. Both mutate only under the store lock.
+    pins: int = 0
+    last_used: float = field(default_factory=time.monotonic)
 
 
 def _round_up(n: int, quantum: int) -> int:
@@ -902,7 +959,13 @@ class SessionStore:
             H, N, C = preds.shape
             key = (task, spec, (H, _round_up(N, self.bucket_n), C))
             b = self._buckets.get(key)
-        return b is not None and b._init_state is not None
+        if b is None or b._init_state is None:
+            return False
+        # a full slab disqualifies the inline path too: admission would
+        # then demote the coldest session (snapshot work — serve/tiering),
+        # which must never run on the event loop
+        with b._host_lock:
+            return len(b._free) > 0
 
     def _bucket_for(self, task: str, spec: SelectorSpec) -> Bucket:
         with self.lock:
@@ -966,6 +1029,32 @@ class SessionStore:
             if sess is None:
                 raise UnknownSession(sid)
             return sess
+
+    # -- pinning (the tiering race protocol; see serve/tiering.py) ---------
+    def get_pinned(self, sid: str) -> Session:
+        """Atomic lookup + pin: the session cannot be demoted off-slab
+        while the pin is held. Callers unpin on every exit path (a label
+        verb hands its pin to the ticket, which unpins on resolution)."""
+        with self.lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                raise UnknownSession(sid)
+            sess.pins += 1
+            sess.last_used = time.monotonic()
+            return sess
+
+    def pin(self, sess: Session) -> None:
+        with self.lock:
+            sess.pins += 1
+
+    def unpin(self, sess: Session) -> None:
+        with self.lock:
+            sess.pins = max(0, sess.pins - 1)
+
+    def slab_occupancy(self) -> int:
+        """Live slab slots across buckets — distinct from open sessions
+        the moment a session can live off-slab (warm/cold tiers)."""
+        return sum(b.live for b in self.buckets())
 
     def alive(self, sid: str) -> bool:
         with self.lock:

@@ -94,6 +94,19 @@ _SERVE_SCALARS = [
      "Requests refused (draining / unknown session / stale item)"),
     ("max_occupancy", "serve_max_occupancy", "gauge",
      "Most requests ever served by one dispatch"),
+    # tiered posterior state (serve/tiering.py)
+    ("demotions", "serve_demotions_total", "counter",
+     "Sessions demoted hot -> warm (slab slot freed, payload in host RAM)"),
+    ("hibernates", "serve_hibernates_total", "counter",
+     "Sessions hibernated warm -> cold (payload spilled to disk)"),
+    ("wakes", "serve_wakes_total", "counter",
+     "Non-resident sessions transparently woken back onto the slab"),
+    ("wakes_from_warm", "serve_wakes_from_warm_total", "counter",
+     "Wakes served from the host-RAM warm tier"),
+    ("wakes_from_cold", "serve_wakes_from_cold_total", "counter",
+     "Wakes served from the on-disk cold tier"),
+    ("wake_failures", "serve_wake_failures_total", "counter",
+     "Wakes that raised (payload re-parked, session still reachable)"),
     ("mean_occupancy", "serve_mean_occupancy", "gauge",
      "Mean requests per dispatch over the recent ring"),
     ("mean_queue_depth", "serve_mean_queue_depth", "gauge",
@@ -111,6 +124,8 @@ _SERVE_SUMMARIES = [
      "Submit-to-tick-start queue wait seconds over the recent ring"),
     ("step_latency", "serve_step_latency_seconds", "dispatches",
      "Compiled slab-step execution seconds over the recent ring"),
+    ("wake_latency", "serve_wake_latency_seconds", "wakes",
+     "Non-resident session wake seconds over the recent ring"),
 ]
 
 # warm-pool evidence: (warm_pool snapshot key, metric suffix, kind, help)
@@ -259,6 +274,12 @@ def _render_serve(out: list, snap: dict, prefix: str) -> None:
         v = warm.get(key)
         if v is not None:
             _family(out, _name(prefix, suffix), kind, help, [({}, v)])
+    tiers = snap.get("tiers") or {}
+    for tier in ("hot", "warm", "cold"):
+        if tier in tiers:
+            _family(out, _name(prefix, f"serve_sessions_{tier}"), "gauge",
+                    f"Open sessions currently in the {tier} tier",
+                    [({}, tiers[tier])])
     fills = snap.get("ring_fill") or {}
     if fills:
         _family(out, _name(prefix, "serve_ring_fill"), "gauge",

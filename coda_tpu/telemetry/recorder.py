@@ -488,6 +488,48 @@ class SessionRecorder:
             hist = self._history.get(sid)
             return list(hist) if hist is not None else None
 
+    def park(self, sid: str) -> None:
+        """Release a live stream's host resources WITHOUT ending it — the
+        warm-tier demotion hook (serve/tiering.py): the fd closes (100k
+        parked sessions must not hold 100k file handles) and the
+        in-memory history drops (the demotion payload carries the rows),
+        but NO close marker is written — the stream is still a live
+        session's record, and crash restore must rebuild it. Wake resumes
+        the file through :meth:`import_history`'s append path."""
+        with self._lock:
+            self._history.pop(sid, None)
+            self._task_of.pop(sid, None)
+            f = self._files.pop(sid, None)
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def seal(self, sid: str) -> None:
+        """End a PARKED session's stream: append the ``session_close``
+        marker to its file (hibernate/discard of a non-resident session —
+        from there the hibernate payload, or nothing, is the authority
+        and crash restore must skip the stream). A still-live stream is
+        closed normally instead."""
+        with self._lock:
+            live = sid in self._files or sid in self._history
+        if live:
+            self.close(sid)
+            return
+        if not self.out_dir:
+            return
+        path = os.path.join(self.out_dir, f"session_{sid}.jsonl")
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(
+                    {"v": SESSION_SCHEMA_VERSION, "kind": "session_close",
+                     "session": sid}) + "\n")
+        except OSError:
+            pass
+
     def close(self, sid: str) -> None:
         with self._lock:
             self._history.pop(sid, None)

@@ -141,6 +141,19 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                  "--sessions", "8", "--workers", "8", "--labels", "4",
                  "--out", os.path.join(tmpdir, "serve.json")] + plat,
                 os.path.join(tmpdir, "serve.json"), 900),
+            # the tiered store at smoke scale: sessions >> capacity, Zipf
+            # traffic, wakes exercised (the 100k claim is the committed
+            # BENCH_TIERED_* capture; this proves the machinery in-run)
+            "serve_tiered": (
+                [py, "scripts/serve_loadgen.py", "--synthetic", "4,48,4",
+                 "--zipf", "1.3", "--sessions", "96", "--workers", "8",
+                 "--labels", "0", "--requests", "192", "--capacity", "16",
+                 "--retries", "8", "--tier-free-frac", "0.25",
+                 "--idle-warm-s", "2", "--idle-cold-s", "4",
+                 "--max-warm", "32",
+                 "--tier-spill-dir", os.path.join(tmpdir, "spill"),
+                 "--out", os.path.join(tmpdir, "tiered.json")] + plat,
+                os.path.join(tmpdir, "tiered.json"), 900),
             "multichip_replay": (
                 [py, "scripts/dryrun_multichip.py", "2", "--skip-shard-map",
                  "--out", os.path.join(tmpdir, "multichip.json")],
@@ -170,6 +183,18 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
              "--max-wait-ms", "15", "--max-linger-ms", "250",
              "--out", os.path.join(tmpdir, "serve.json")] + plat,
             os.path.join(tmpdir, "serve.json"), 3600),
+        # the full ≥100k-open-sessions tiered capture (the BENCH_TIERED_*
+        # configuration)
+        "serve_tiered": (
+            [py, "scripts/serve_loadgen.py", "--synthetic", "4,48,4",
+             "--zipf", "1.5", "--sessions", "100000", "--workers", "64",
+             "--labels", "0", "--requests", "10000", "--capacity", "128",
+             "--retries", "8", "--tier-free-frac", "0.5",
+             "--idle-warm-s", "5", "--idle-cold-s", "10",
+             "--max-warm", "2048", "--think-ms", "1",
+             "--tier-spill-dir", os.path.join(tmpdir, "spill"),
+             "--out", os.path.join(tmpdir, "tiered.json")] + plat,
+            os.path.join(tmpdir, "tiered.json"), 3600),
         "multichip_replay": (
             [py, "scripts/dryrun_multichip.py", "8",
              "--out", os.path.join(tmpdir, "multichip.json")],

@@ -17,14 +17,16 @@ in-process server under retrying closed-loop traffic — and fails on:
 
 Scenarios (fault → expected recovery → verification):
 
-  ==================  ==============================  ====================
-  step_raise          bucket quarantine → slab heal   streams replay clean
-  step_nan            none (corruption is recorded)   replay MUST diverge
-  record_eio          stream degrades to memory-only  session completes
-  slow_step           none needed                     0 errors, all served
-  crash_before_tick   restart + restore from streams  all sessions rebuilt
-  crash_after_tick    restart + restore from streams  all sessions rebuilt
-  ==================  ==============================  ====================
+  ===================  =============================  ====================
+  step_raise           bucket quarantine → slab heal  streams replay clean
+  step_nan             none (corruption is recorded)  replay MUST diverge
+  record_eio           stream degrades to memory-only session completes
+  slow_step            none needed                    0 errors, all served
+  demote_during_label  demotion wins → wake-on-label, streams replay clean,
+                       or loses cleanly to the pin    exact label counts
+  crash_before_tick    restart + restore from streams all sessions rebuilt
+  crash_after_tick     restart + restore from streams all sessions rebuilt
+  ===================  =============================  ====================
 
 The two crash scenarios spawn a child process that kills itself at the
 injected tick boundary (exit 17); ``--skip-crash`` omits them (the tier-1
@@ -227,6 +229,35 @@ def scenario_slow_step() -> list[str]:
         app.drain(timeout=10)
 
 
+def scenario_demote_during_label() -> list[str]:
+    """A tier demotion injected at the exact moment a label arrives
+    (serve/tiering.py): when the session is quiescent the demotion WINS
+    and the label transparently wakes it back; when a ticket is in flight
+    the demotion LOSES cleanly to the pin. Either way: no lost label, no
+    double-apply, every stream still replays bitwise."""
+    app, _ = _make_app("demote_during_label:every=2,times=12")
+    try:
+        sids, errors = _drive(app)
+        out = _common_checks(app, sids, errors, "demote_during_label")
+        fired = sum(f["fired"] for f in app.faults.snapshot()
+                    if f["name"] == "demote_during_label")
+        if fired < 1:
+            out.append("demote_during_label: fault never fired")
+        if app.metrics.demotions < 1:
+            out.append("demote_during_label: no injected demotion ever "
+                       "won (the wake-on-label path went unexercised)")
+        if app.metrics.wakes < 1:
+            out.append("demote_during_label: demotions won but no label "
+                       "ever woke its session")
+        for sid, verdict in _verify_streams(app, filter(None, sids)).items():
+            if verdict is not None:
+                out.append(f"demote_during_label: session {sid} failed "
+                           f"replay verification after paging — {verdict}")
+        return out
+    finally:
+        app.drain(timeout=10)
+
+
 _CRASH_CHILD = r"""
 import sys
 from scripts.check_fault_matrix import _make_app, _drive
@@ -285,6 +316,7 @@ SCENARIOS = {
     "step_nan": scenario_step_nan,
     "record_eio": scenario_record_eio,
     "slow_step": scenario_slow_step,
+    "demote_during_label": scenario_demote_during_label,
     "crash_before_tick": lambda: scenario_crash("crash_before_tick"),
     "crash_after_tick": lambda: scenario_crash("crash_after_tick"),
 }

@@ -168,6 +168,70 @@ def serve_check_report(report: dict) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# the tiered-store contract (ISSUE 11 acceptance: BENCH_TIERED_* holds the
+# ≥100k-open-sessions / bounded-RSS / wake-under-one-tick claim)
+# ---------------------------------------------------------------------------
+
+TIERED_MIN_OPEN_SESSIONS = 100_000
+TIERED_MAX_RSS_BYTES = 2 * 1024 ** 3    # bounded RSS on the container
+TIERED_MIN_HOT_HIT_RATE = 0.5           # Zipf hot set stays resident
+
+_TIERED_REQUIRED_TIERING = (
+    "open_sessions", "slab_occupancy", "tiers", "demotions", "wakes",
+    "hibernates", "wake_latency", "hot_hit_rate", "tick_ms",
+    "peak_rss_bytes",
+)
+
+
+def tiered_check_report(report: dict) -> list[str]:
+    """Violations of one tiered-serve capture (empty = clean): the zipf
+    workload shape, zero errors, the session floor, the RSS bound, the
+    hot-set residency claim, wake-from-warm p99 under one batcher tick,
+    and no 503 ever surfacing for a wakeable session."""
+    out: list[str] = []
+    if report.get("mode") != "zipf":
+        out.append(f"mode {report.get('mode')!r} != 'zipf' (the tiering "
+                   "claim needs the Zipf-arrival workload)")
+    t = report.get("tiering")
+    if not isinstance(t, dict):
+        return out + ["tiering section missing"]
+    for key in _TIERED_REQUIRED_TIERING:
+        if t.get(key) is None:
+            out.append(f"tiering.{key} missing/null")
+    if out:
+        return out
+    if report.get("n_errors") != 0:
+        out.append(f"n_errors {report.get('n_errors')} != 0")
+    if t["open_sessions"] < TIERED_MIN_OPEN_SESSIONS:
+        out.append(f"tiering.open_sessions {t['open_sessions']} < "
+                   f"{TIERED_MIN_OPEN_SESSIONS}")
+    if t["open_sessions"] <= t["slab_occupancy"]:
+        out.append("open_sessions <= slab_occupancy: nothing ever lived "
+                   "off-slab — the tiered store was not exercised")
+    if t["peak_rss_bytes"] > TIERED_MAX_RSS_BYTES:
+        out.append(f"tiering.peak_rss_bytes {t['peak_rss_bytes']:.0f} > "
+                   f"the committed {TIERED_MAX_RSS_BYTES} bound")
+    if t["hot_hit_rate"] < TIERED_MIN_HOT_HIT_RATE:
+        out.append(f"tiering.hot_hit_rate {t['hot_hit_rate']:.3f} < "
+                   f"{TIERED_MIN_HOT_HIT_RATE} (the hot set did not stay "
+                   "resident under Zipf arrivals)")
+    wake_p99 = (t.get("wake_latency") or {}).get("p99_ms")
+    tick = t.get("tick_ms")
+    if wake_p99 is None or tick is None:
+        out.append("tiering.wake_latency.p99_ms / tick_ms missing")
+    elif wake_p99 > tick:
+        out.append(f"wake p99 {wake_p99:.1f} ms > one batcher tick "
+                   f"({tick:.1f} ms)")
+    if t.get("wake_failures"):
+        out.append(f"tiering.wake_failures {t['wake_failures']} != 0 "
+                   "(a wakeable session surfaced an error/503)")
+    if not t.get("wakes"):
+        out.append("tiering.wakes == 0 (no wake ever happened — the "
+                   "claim is unexercised)")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # per-family checkers
 # ---------------------------------------------------------------------------
 
@@ -228,7 +292,7 @@ EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
 # components newer manifests carry; checked when present (r11 predates
 # them, and an absent optional component is a capture-config choice the
 # manifest's own "skipped" list records)
-EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet",)
+EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -257,6 +321,14 @@ def _evidence_check(report: dict) -> list[str]:
     if rep and rep.get("n_errors") != 0:
         out.append(f"serve_loadgen.report.n_errors {rep.get('n_errors')} "
                    "!= 0")
+    rep = (arts.get("serve_tiered") or {}).get("report") or {}
+    if rep:
+        if rep.get("n_errors") != 0:
+            out.append(f"serve_tiered.report.n_errors "
+                       f"{rep.get('n_errors')} != 0")
+        if not ((rep.get("tiering") or {}).get("wakes")):
+            out.append("serve_tiered.report.tiering.wakes is 0/missing "
+                       "(the paged store went unexercised)")
     rep = (arts.get("bench") or {}).get("report") or {}
     if rep and not (isinstance(rep.get("value"), (int, float))
                     and rep["value"] > 0):
@@ -290,6 +362,16 @@ CONTRACTS: tuple = (
         pattern="BENCH_SERVE_*.json", kind="serve_loadgen",
         checker=serve_check_report,
         group="serve", regress=("latency_ms.p99", "lower", 0.25)),
+    # -- tiered posterior state (hot/warm/cold paging) --
+    Contract(
+        pattern="BENCH_TIERED_*.json", kind="serve_tiered",
+        required=("bench", "mode", "sessions", "wall_s", "n_errors",
+                  "latency_ms", "server", "config", "tiering"),
+        checker=tiered_check_report, fingerprint="required",
+        group="tiered",
+        regress=("tiering.wake_latency.p99_ms", "lower", 0.5),
+        note="≥100k open sessions via hot/warm/cold paging: RSS bound, "
+             "hot-set residency, wake-from-warm p99 under one tick"),
     # -- suite sweeps --
     Contract(
         pattern="BENCH_SUITE_*.json", kind="bench_suite",

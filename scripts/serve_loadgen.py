@@ -381,6 +381,102 @@ def _mux(app, http_port, n_classes, concurrency, sessions,
     asyncio.run(main())
 
 
+def _zipf_run(app, n_classes, workers, sessions, zipf_s, think_ms,
+              requests, latencies, errors, retries=0, backoff_s=0.05,
+              retried=None):
+    """Zipf-arrival open-loop driver — the tiering workload.
+
+    Two phases, both worker-pool coroutines on one event loop (100k+
+    session counts must not mean 100k coroutine objects at once):
+
+      * **populate** — open every session (admission past slab capacity
+        demotes the coldest, never 503s), tracking each session's last
+        proposed item client-side;
+      * **traffic** — ``requests`` label requests whose target session is
+        drawn from a Zipf(``zipf_s``) distribution over session ranks,
+        with exponential think times (mean ``think_ms``). The skewed hot
+        set stays slab-resident; the long tail pages out, and a request
+        for a paged-out session transparently wakes it — the residency
+        hit rate and wake counts come from the server's tier counters.
+
+    Returns ``{wakes_populate, wakes_traffic, requests_traffic}`` for the
+    report's hit-rate math."""
+    rng = np.random.default_rng(0)
+    last: dict = {}    # rank -> last proposed idx (client-side handle)
+    sids: dict = {}    # rank -> session id
+
+    async def _aretry(thunk):
+        attempt = 0
+        while True:
+            try:
+                return await thunk()
+            except Exception as e:
+                if attempt >= retries or not _retryable(e):
+                    raise
+                if retried is not None:
+                    retried.append(repr(e))
+                await asyncio.sleep(backoff_s * (2 ** attempt))
+                attempt += 1
+
+    async def _pool(n_items, worker):
+        cursor = {"next": 0}
+
+        async def one_worker():
+            while True:
+                i = cursor["next"]
+                if i >= n_items:
+                    return
+                cursor["next"] = i + 1
+                await worker(i)
+
+        await asyncio.gather(*(one_worker() for _ in range(workers)))
+
+    async def open_one(rank):
+        try:
+            t0 = time.perf_counter()
+            out = await _aretry(lambda: app.open_session_async(seed=rank))
+            latencies.append(time.perf_counter() - t0)
+            sids[rank] = out["session"]
+            last[rank] = int(out["idx"])
+        except Exception as e:
+            errors.append(f"open rank {rank}: {e!r}")
+
+    # Zipf pmf over ranks 1..sessions (rank 0 hottest), sampled by
+    # inverse CDF — precomputed draws keep the traffic deterministic
+    pmf = (1.0 / np.arange(1, sessions + 1) ** float(zipf_s))
+    cdf = np.cumsum(pmf / pmf.sum())
+    draws = np.searchsorted(cdf, rng.random(requests))
+
+    async def label_one(i):
+        rank = int(draws[i])
+        sid = sids.get(rank)
+        if sid is None:
+            return  # its open failed; already counted
+        try:
+            t0 = time.perf_counter()
+            lab = last.get(rank, 0) % n_classes
+            out = await _aretry(lambda: app.label_async(sid, lab))
+            latencies.append(time.perf_counter() - t0)
+            last[rank] = int(out["idx"])
+        except Exception as e:
+            errors.append(f"label rank {rank}: {e!r}")
+        if think_ms > 0:
+            await asyncio.sleep(rng.exponential(think_ms / 1e3))
+
+    info: dict = {}
+
+    async def main():
+        await _pool(sessions, open_one)
+        info["wakes_populate"] = app.metrics.wakes
+        # optional warm-up labels per session are folded into traffic
+        await _pool(requests, label_one)
+        info["wakes_traffic"] = app.metrics.wakes - info["wakes_populate"]
+        info["requests_traffic"] = requests
+
+    asyncio.run(main())
+    return info
+
+
 def _lockstep(app, client, n_classes, workers, labels_per_session,
               latencies, errors):
     """Deterministic occupancy: open W sessions, then label all W in
@@ -488,8 +584,15 @@ def _rolling_restart(client, args, migration: dict, errors: list) -> None:
             verified += 1
         via: dict = {}
         reclosed = 0
+
+        def still_open(sid):
+            # parked (warm/cold) sessions are open sessions too — a
+            # rolling restart migrates all three tiers
+            return old.store.alive(sid) or (
+                old.tiers is not None and old.tiers.parked(sid))
+
         for p in payloads:
-            if not old.store.alive(p["session"]):
+            if not still_open(p["session"]):
                 # closed on the OLD app after export_all captured it (the
                 # worker's final label landed just before the cut): the
                 # client is done with this session — importing it would
@@ -504,7 +607,7 @@ def _rolling_restart(client, args, migration: dict, errors: list) -> None:
         # session to the new server
         for p in payloads:
             sid = p["session"]
-            if not old.store.alive(sid) and new.store.alive(sid):
+            if not still_open(sid) and new.store.alive(sid):
                 new.close_session(sid)
                 reclosed += 1
         migration.update(
@@ -571,6 +674,7 @@ def run_loadgen(args) -> dict:
             args=(client, args, migration, errors),
             daemon=True, name="loadgen-migrate").start()
     t_start = time.perf_counter()
+    zipf_info: dict = {}
     if args.lockstep:
         if app is None:
             raise SystemExit("--lockstep needs an in-process app (no --url)")
@@ -578,6 +682,20 @@ def run_loadgen(args) -> dict:
         _lockstep(app, client, n_classes, args.workers, args.labels,
                   latencies, errors)
         mode = "lockstep"
+    elif getattr(args, "zipf", None) is not None:
+        if app is None:
+            raise SystemExit("--zipf needs an in-process app (no --url)")
+        if app.tiers is None:
+            raise SystemExit("--zipf exercises the tiered store; drop "
+                             "--no-tiering")
+        n_sessions = args.sessions
+        n_requests_target = (args.requests if args.requests is not None
+                             else args.sessions * args.labels)
+        zipf_info = _zipf_run(
+            app, n_classes, args.workers, args.sessions, args.zipf,
+            args.think_ms, n_requests_target, latencies, errors,
+            retries=args.retries, backoff_s=backoff_s, retried=retried)
+        mode = "zipf"
     elif args.mux:
         if app is None:
             raise SystemExit("--mux needs an in-process app (no --url)")
@@ -600,6 +718,51 @@ def run_loadgen(args) -> dict:
         app = client.app   # stats/drain target the post-migration server
     stats = client.stats() if app is None else app.stats()
     spans = _span_breakdown(app)
+    # tiered-store evidence (the --zipf workload's whole point): open
+    # sessions across all three tiers vs slab occupancy, paging counters,
+    # residency hit rate, wake latency vs one batcher tick, and the peak
+    # RSS the >=100k-session memory claim is gated on
+    tiering = None
+    if mode == "zipf" and app is not None:
+        from coda_tpu.telemetry.registry import sample_process_rss
+
+        sample_process_rss(app.telemetry.registry)
+        try:
+            samples = app.telemetry.registry.gauge(
+                "process_peak_rss_bytes").samples()
+            peak_rss = max(v for _, v in samples) if samples else None
+        except Exception:
+            peak_rss = None
+        wl = stats.get("wake_latency") or {}
+        req_t = zipf_info.get("requests_traffic") or 0
+        wakes_t = zipf_info.get("wakes_traffic") or 0
+        tick_ms = (stats.get("dispatch_latency") or {}).get("p99_ms")
+        wake_p99 = wl.get("p99_ms")
+        tiering = {
+            "open_sessions": stats.get("open_sessions"),
+            "slab_occupancy": stats.get("slab_occupancy"),
+            "tiers": stats.get("tiers"),
+            "demotions": stats.get("demotions"),
+            "hibernates": stats.get("hibernates"),
+            "wakes": stats.get("wakes"),
+            "wakes_from_warm": stats.get("wakes_from_warm"),
+            "wakes_from_cold": stats.get("wakes_from_cold"),
+            "wakes_via_replay": stats.get("wakes_via_replay"),
+            "wake_failures": stats.get("wake_failures"),
+            "wake_latency": wl,
+            # 503s for wakeable sessions are forbidden by the tiering
+            # contract: admission demotes instead of refusing
+            "admission_rejects": stats.get("sessions_rejected"),
+            "requests_traffic": req_t,
+            "wakes_traffic": wakes_t,
+            "hot_hit_rate": (1.0 - wakes_t / req_t) if req_t else None,
+            "tick_ms": tick_ms,
+            "wake_p99_vs_tick": (wake_p99 / tick_ms
+                                 if wake_p99 and tick_ms else None),
+            "peak_rss_bytes": peak_rss,
+            "zipf_s": getattr(args, "zipf", None),
+            "think_ms": getattr(args, "think_ms", 0.0),
+        }
     if srv is not None:
         srv.shutdown()
         srv.server_close()
@@ -624,6 +787,9 @@ def run_loadgen(args) -> dict:
         "mode": mode,
         "transport": "http" if (args.url or args.http) else "inproc",
         "ramp_s": args.ramp_s,
+        "zipf": getattr(args, "zipf", None),
+        "think_ms": getattr(args, "think_ms", 0.0),
+        "requests": getattr(args, "requests", None),
         "task": args.task or args.synthetic or "default"})
     # per-bucket executable cost attribution (warm-pool harvest): which
     # side of the roofline the slab step sits on, machine-read
@@ -660,6 +826,10 @@ def run_loadgen(args) -> dict:
         # ran): exported == imported == replay_verified means zero dropped
         # sessions and every migrated stream bitwise-verified
         "migration": migration or None,
+        # tiered-store evidence (--zipf mode): open sessions vs slab
+        # occupancy, paging counters, hot-set residency hit rate, wake
+        # latency vs one tick, and peak RSS
+        "tiering": tiering,
         "server": {
             "dispatches": stats.get("dispatches"),
             "requests": stats.get("requests"),
@@ -737,6 +907,20 @@ def parse_args(argv=None):
     p.add_argument("--ramp-s", type=float, default=0.0,
                    help="mux: spread session arrivals over this many "
                         "seconds instead of a thundering herd at t=0")
+    p.add_argument("--zipf", type=float, default=None, metavar="S",
+                   help="Zipf-arrival mode (the tiering workload): open "
+                        "--sessions sessions (admission demotes past slab "
+                        "capacity, never 503s), then drive --requests "
+                        "labels whose target session is Zipf(S)-skewed — "
+                        "the hot set stays resident, the tail pages out "
+                        "and wakes on touch; reports residency hit rate, "
+                        "wake counts/latency, and peak RSS (in-process "
+                        "only)")
+    p.add_argument("--think-ms", type=float, default=0.0,
+                   help="zipf: mean per-request exponential think time")
+    p.add_argument("--requests", type=int, default=None,
+                   help="zipf: total label requests in the traffic phase "
+                        "(default sessions * labels)")
     p.add_argument("--retries", type=int, default=0,
                    help="client-side retries per request on transient "
                         "failures (503/504/500/conn-drop), exponential "

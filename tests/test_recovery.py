@@ -1077,7 +1077,7 @@ def test_fault_matrix_tier1_gate():
     import scripts.check_fault_matrix as m
 
     results = m.run_matrix(skip_crash=True)
-    assert sorted(results) == ["record_eio", "slow_step", "step_nan",
-                               "step_raise"]
+    assert sorted(results) == ["demote_during_label", "record_eio",
+                               "slow_step", "step_nan", "step_raise"]
     violations = [v for vs in results.values() for v in vs]
     assert violations == [], violations

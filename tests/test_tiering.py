@@ -1,0 +1,431 @@
+"""Tiered posterior state tests (``coda_tpu/serve/tiering.py``).
+
+The load-bearing claims: (1) a session paged out to the warm or cold tier
+and woken by a later label/best/trace is BITWISE the session that never
+left the slab — trajectory rows and recorder streams both; (2) demotion
+cleanly LOSES every race against live traffic — an in-flight label
+ticket or a concurrent export pins the session and the demotion aborts
+with state untouched, never a lost or double-applied label; (3) admission
+past slab capacity demotes the coldest session instead of answering 503,
+so open sessions are bounded by RAM+disk, not slab slots; (4) crash
+restore holds across tiers — beyond-capacity record dirs restore in
+waves, hibernated sessions survive a restart through the spill dir.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+H, N, C = 4, 48, 4
+_ROW_KEYS = ("next_idx", "next_prob", "best", "pbest_max", "pbest_entropy")
+
+
+@pytest.fixture(scope="module")
+def task():
+    from coda_tpu.data import make_synthetic_task
+
+    return make_synthetic_task(seed=0, H=H, N=N, C=C)
+
+
+def _app(task, capacity=4, warm=True, tiering=True, spill_dir=None,
+         recorder=None, fault_spec=None, **kw):
+    from coda_tpu.serve import SelectorSpec, ServeApp
+
+    app = ServeApp(capacity=capacity, max_wait=0.001,
+                   spec=SelectorSpec.create("coda", n_parallel=capacity),
+                   tiering=tiering, tier_spill_dir=spill_dir,
+                   recorder=recorder, fault_spec=fault_spec, **kw)
+    app.add_task(task.name, task.preds)
+    app.start(warm=warm)
+    return app
+
+
+def _drive(app, seed, rounds):
+    out = app.open_session(seed=seed)
+    sid = out["session"]
+    for _ in range(rounds):
+        out = app.label(sid, int(out["idx"]) % C)
+    return sid
+
+
+def _last_row(app, sid):
+    return {k: app.store.get(sid).last[k] for k in _ROW_KEYS}
+
+
+def _assert_rows_bitwise(a, b, what=""):
+    for k in _ROW_KEYS:
+        va, vb = a[k], b[k]
+        if isinstance(va, float):
+            assert np.float32(va).tobytes() == np.float32(vb).tobytes(), \
+                (what, k, va, vb)
+        else:
+            assert va == vb, (what, k, va, vb)
+
+
+# ---------------------------------------------------------------------------
+# wake-from-warm / wake-from-cold: bitwise vs a never-demoted control
+# ---------------------------------------------------------------------------
+
+def test_wake_from_warm_bitwise_vs_control(task):
+    """Demote a session mid-trajectory, continue it with labels (each
+    transparently waking it), and pin the result bitwise — rows AND the
+    full recorder stream — against a control session that never left the
+    slab."""
+    app = _app(task)
+    try:
+        sid = _drive(app, seed=5, rounds=3)
+        assert app.tiers.try_demote(sid)
+        st = app.stats()
+        assert st["tiers"]["warm"] == 1
+        assert st["open_sessions"] == 1 and st["slab_occupancy"] == 0
+        # label the parked session: transparent wake through the snapshot
+        # fast path (no replay), then two more rounds
+        out = app.store  # noqa: F841  (documentation: sid not resident)
+        cur = app.best(sid)  # best() wakes too
+        assert app.metrics.wakes == 1
+        assert app.metrics.wakes_from_warm == 1
+        assert app.metrics.wakes_via_replay == 0
+        for _ in range(2):
+            cur = app.label(sid, int(cur["idx"]) % C)
+
+        control = _drive(app, seed=5, rounds=5)
+        _assert_rows_bitwise(_last_row(app, sid), _last_row(app, control),
+                             "warm-woken vs control")
+        rows_w = app.recorder.history(sid)
+        rows_c = app.recorder.history(control)
+        assert len(rows_w) == len(rows_c) == 6
+        for rw, rc in zip(rows_w, rows_c):
+            for k in _ROW_KEYS:
+                assert rw[k] == rc[k], k  # floats: exact dict equality
+    finally:
+        app.drain(timeout=10)
+
+
+def test_wake_from_cold_bitwise_vs_control(task, tmp_path):
+    """Same pin through the cold tier: demote -> hibernate (payload on
+    disk, recorder stream sealed) -> a label wakes it from the spill file
+    -> continue -> bitwise vs the uninterrupted control."""
+    app = _app(task, spill_dir=str(tmp_path / "spill"))
+    try:
+        sid = _drive(app, seed=9, rounds=3)
+        assert app.tiers.try_demote(sid)
+        assert app.tiers.hibernate(sid)
+        st = app.stats()
+        assert st["tiers"] == {"hot": 0, "warm": 0, "cold": 1}
+        assert st["open_sessions"] == 1
+        files = os.listdir(str(tmp_path / "spill"))
+        assert files == [f"hibernated_{sid}.json"]
+
+        cur = app.label(sid, int(_cold_payload(app, tmp_path, sid)))
+        assert app.metrics.wakes_from_cold == 1
+        assert not os.path.exists(
+            str(tmp_path / "spill" / f"hibernated_{sid}.json"))
+        cur = app.label(sid, int(cur["idx"]) % C)
+
+        control = _drive(app, seed=9, rounds=5)
+        _assert_rows_bitwise(_last_row(app, sid), _last_row(app, control),
+                             "cold-woken vs control")
+        rows_w = app.recorder.history(sid)
+        rows_c = app.recorder.history(control)
+        assert len(rows_w) == len(rows_c) == 6
+        for rw, rc in zip(rows_w, rows_c):
+            for k in _ROW_KEYS:
+                assert rw[k] == rc[k], k
+    finally:
+        app.drain(timeout=10)
+
+
+def _cold_payload(app, tmp_path, sid):
+    """The next label for a hibernated session, read from its payload
+    (the client's handle: last proposed idx mod C)."""
+    with open(str(tmp_path / "spill" / f"hibernated_{sid}.json")) as f:
+        payload = json.load(f)
+    return payload["last"]["next_idx"] % C
+
+
+# ---------------------------------------------------------------------------
+# demotion races: in-flight label tickets and exports pin the session
+# ---------------------------------------------------------------------------
+
+def test_demotion_loses_to_inflight_label_ticket(task):
+    """A label ticket in flight holds the session's pin: a concurrent
+    demotion must ABORT (state untouched, label applied exactly once);
+    after the ticket resolves the demotion succeeds."""
+    app = _app(task)
+    try:
+        out = app.open_session(seed=0)
+        sid = out["session"]
+        app.batcher.pause()
+        sess, ticket = app._label_begin(sid, int(out["idx"]) % C, None)
+        assert sess.pins == 1
+        # demotion races the queued ticket: it must cleanly lose
+        assert app.tiers.try_demote(sid) is False
+        assert app.store.alive(sid)
+        app.batcher.resume()
+        res = ticket.wait(30.0)
+        assert app.store.get(sid).n_labeled == 1  # applied exactly once
+        assert sess.pins == 0                     # pin released on resolve
+        assert app.metrics.demotions == 0
+        # quiescent now: the same demotion wins
+        assert app.tiers.try_demote(sid) is True
+        assert not app.store.alive(sid) and app.tiers.parked(sid)
+        # and the woken session continues from the post-label state
+        nxt = app.label(sid, int(res["next_idx"]) % C)
+        assert nxt["n_labeled"] == 2
+    finally:
+        app.drain(timeout=10)
+
+
+def test_demotion_races_export_without_loss(task):
+    """POST /export pins like any verb: a demotion racing it aborts; a
+    demotion that already won serves the export FROM the parked payload
+    (no wake), and close-on-export discards the parked copy."""
+    app = _app(task)
+    try:
+        sid = _drive(app, seed=2, rounds=2)
+        sess = app.store.get_pinned(sid)      # what the export verb holds
+        try:
+            assert app.tiers.try_demote(sid) is False
+        finally:
+            app.store.unpin(sess)
+        assert app.tiers.try_demote(sid) is True
+        # export of the parked session: the payload IS the export
+        payload = app.export_session(sid)
+        assert payload["session"] == sid
+        assert payload["n_labeled"] == 2
+        assert payload["carries"] is not None
+        assert app.tiers.parked(sid)          # served without waking
+        assert app.metrics.wakes == 0
+        # a second server imports the parked export; continuing there
+        # matches continuing the demoted session here
+        b = _app(task)
+        try:
+            info = b.import_session(payload)
+            assert info["restored_via"] == "snapshot"
+            cont_b = b.label(sid, int(payload["last"]["next_idx"]) % C)
+            cont_a = app.label(sid, int(payload["last"]["next_idx"]) % C)
+            assert cont_a["n_labeled"] == cont_b["n_labeled"] == 3
+            _assert_rows_bitwise(_last_row(app, sid), _last_row(b, sid),
+                                 "parked-export import vs wake")
+        finally:
+            b.drain(timeout=10)
+        # close-on-export of a parked session discards it
+        assert app.tiers.try_demote(sid)
+        app.export_session(sid, close=True)
+        assert not app.tiers.parked(sid)
+        from coda_tpu.serve import UnknownSession
+
+        with pytest.raises(UnknownSession):
+            app.store.get(sid)
+    finally:
+        app.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# admission past capacity: demote-then-admit, 503 only without tiering
+# ---------------------------------------------------------------------------
+
+def test_admission_past_capacity_demotes_instead_of_503(task):
+    app = _app(task, capacity=2)
+    try:
+        sids = [app.open_session(seed=s)["session"] for s in range(5)]
+        st = app.stats()
+        assert st["open_sessions"] == 5
+        assert st["slab_occupancy"] == 2
+        assert st["sessions_rejected"] == 0
+        assert st["demotions"] >= 3
+        # every session — resident or paged — still answers
+        for sid in sids:
+            assert app.best(sid)["session"] == sid
+    finally:
+        app.drain(timeout=10)
+
+
+def test_no_tiering_keeps_slabfull_backpressure(task):
+    """--no-tiering preserves the pre-tiering contract: sessions exist
+    only while they hold a slab slot and admission past capacity raises
+    SlabFull (the 503)."""
+    from coda_tpu.serve import SlabFull
+
+    app = _app(task, capacity=2, tiering=False)
+    try:
+        assert app.tiers is None
+        for s in range(2):
+            app.open_session(seed=s)
+        with pytest.raises(SlabFull):
+            app.open_session(seed=2)
+        assert app.metrics.sessions_rejected == 1
+    finally:
+        app.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# fault injection: demote_during_label
+# ---------------------------------------------------------------------------
+
+def test_fault_demote_during_label_exact_once(task):
+    """The injected demotion-at-label race (``demote_during_label``):
+    labels keep applying exactly once through forced paging, and the
+    woken streams still replay bitwise."""
+    from coda_tpu.serve import SessionStore
+    from coda_tpu.serve.recovery import verify_session_stream
+
+    app = _app(task, fault_spec="demote_during_label:every=2,times=8")
+    try:
+        out = app.open_session(seed=1)
+        sid = out["session"]
+        for _ in range(6):
+            out = app.label(sid, int(out["idx"]) % C)
+        assert app.store.get(sid).n_labeled == 6
+        assert app.metrics.demotions >= 1 and app.metrics.wakes >= 1
+        store = SessionStore(capacity=2)
+        store.register_task(app.default_task,
+                            app.store._tasks[app.default_task])
+        meta = {"task": app.default_task, "method": app.spec.method,
+                "spec_kwargs": [list(kv) for kv in app.spec.kwargs],
+                "seed": 1}
+        info = verify_session_stream(store, meta,
+                                     app.recorder.history(sid), sid=sid)
+        assert info["parity"] and info["rounds"] == 7
+    finally:
+        app.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# observability: tier gauges/counters/ring on /stats and /metrics
+# ---------------------------------------------------------------------------
+
+def test_tier_metrics_on_stats_and_prometheus(task):
+    from coda_tpu.telemetry import lint_prometheus, render_prometheus
+
+    app = _app(task, capacity=2)
+    try:
+        sids = [app.open_session(seed=s)["session"] for s in range(3)]
+        app.best(sids[0])     # wake (sids[0] was demoted by admission)
+        st = app.stats()
+        assert st["tiers"]["hot"] + st["tiers"]["warm"] \
+            + st["tiers"]["cold"] == st["open_sessions"] == 3
+        assert st["demotions"] >= 2 and st["wakes"] >= 1
+        assert st["wake_latency"]["p99_ms"] is not None
+        assert st["ring_fill"]["wake_latency"] >= 1
+        text = render_prometheus(app.telemetry.registry,
+                                 serve_metrics=app.metrics)
+        for family in ("coda_serve_sessions_hot", "coda_serve_sessions_warm",
+                       "coda_serve_sessions_cold",
+                       "coda_serve_demotions_total",
+                       "coda_serve_wakes_total",
+                       "coda_serve_hibernates_total",
+                       "coda_serve_wake_latency_seconds"):
+            assert family in text, family
+        assert lint_prometheus(text) == []
+    finally:
+        app.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# restore across tiers
+# ---------------------------------------------------------------------------
+
+def test_crash_restore_waves_beyond_capacity(task, tmp_path):
+    """A record dir holding MORE live streams than slab capacity restores
+    whole with tiering: waves of capacity-many sessions replay coalesced,
+    each wave pages out for the next — then every session answers (the
+    tail waking on touch)."""
+    from coda_tpu.serve.recovery import data_rows, load_session_stream
+    from coda_tpu.telemetry import SessionRecorder
+
+    d = str(tmp_path / "rec")
+    app = _app(task, capacity=2,
+               recorder=SessionRecorder(out_dir=d))
+    try:
+        sids = [_drive(app, seed=s, rounds=2) for s in range(5)]
+    finally:
+        # simulate sudden death: no drain, no close markers — just stop
+        # ticking (the files keep their flushed rows)
+        app.batcher.stop(drain=False, timeout=5)
+        if app.tiers is not None:
+            app.tiers.stop()
+    # the on-disk streams are the authority (several sessions were already
+    # paged warm by admission pressure on the first app — their streams
+    # are parked, not closed)
+    rows_before = {
+        sid: data_rows(load_session_stream(
+            os.path.join(d, f"session_{sid}.jsonl"))[1])
+        for sid in sids
+    }
+
+    app2 = _app(task, capacity=2, recorder=SessionRecorder(out_dir=d))
+    try:
+        report = app2.restore_sessions(d)
+        assert sorted(report["restored"]) == sorted(sids)
+        assert report["failed"] == {}
+        st = app2.stats()
+        assert st["open_sessions"] == 5
+        assert st["slab_occupancy"] <= 2
+        # every restored session continues bitwise where it left off
+        for sid in sids:
+            hist = app2.recorder.history(sid) or \
+                app2.tiers.parked_payload(sid)["rows"]
+            assert len(hist) == len(rows_before[sid])
+            out = app2.label(sid, int(hist[-1]["next_idx"]) % C)
+            assert out["n_labeled"] == 3
+    finally:
+        app2.drain(timeout=10)
+
+
+def test_hibernated_sessions_survive_restart(task, tmp_path):
+    """Cold sessions live in the spill dir, not the process: a fresh app
+    pointed at the same dir re-indexes them and a label wakes them."""
+    spill = str(tmp_path / "spill")
+    app = _app(task, spill_dir=spill)
+    try:
+        sid = _drive(app, seed=4, rounds=2)
+        nxt = int(app.store.get(sid).last["next_idx"]) % C
+        assert app.tiers.try_demote(sid) and app.tiers.hibernate(sid)
+    finally:
+        app.drain(timeout=10)
+
+    app2 = _app(task, spill_dir=spill)
+    try:
+        assert app2.tiers.parked(sid)
+        out = app2.label(sid, nxt)
+        assert out["n_labeled"] == 3
+        assert app2.metrics.wakes_from_cold == 1
+    finally:
+        app2.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# loadgen: zipf mode smoke (the tiering workload end to end, every PR)
+# ---------------------------------------------------------------------------
+
+def test_zipf_loadgen_smoke(tmp_path):
+    import scripts.serve_loadgen as lg
+
+    spill = str(tmp_path / "spill")
+    args = lg.parse_args([
+        "--synthetic", "4,48,4", "--method", "coda",
+        "--zipf", "1.3", "--sessions", "24", "--workers", "6",
+        "--labels", "2", "--capacity", "8", "--retries", "8",
+        "--tier-spill-dir", spill, "--idle-warm-s", "2",
+        "--idle-cold-s", "4", "--max-warm", "8",
+        "--tier-free-frac", "0.25",
+    ])
+    report = lg.run_loadgen(args)
+    assert report["n_errors"] == 0, report["errors"]
+    assert report["mode"] == "zipf"
+    t = report["tiering"]
+    assert t["open_sessions"] == 24
+    assert t["slab_occupancy"] <= 8
+    assert t["admission_rejects"] == 0
+    assert t["demotions"] >= 16
+    assert t["wakes"] >= 1
+    assert t["wake_failures"] == 0
+    assert t["hot_hit_rate"] is not None
+    assert t["peak_rss_bytes"] and t["peak_rss_bytes"] > 0
+    assert t["wake_latency"]["p99_ms"] is not None
+    assert t["tick_ms"] is not None
