@@ -86,15 +86,19 @@ def make_round_trace(selector: Selector, res, state_after, k,
     from coda_tpu.ops.masked import entropy2
 
     scores = res.scores
+    # batched acquisition (acq_batch > 1): idx/prob carry a (q,) axis; the
+    # round's "chosen" trace slot is the FIRST (unpenalized-argmax) pick
+    idx0 = res.idx if res.idx.ndim == 0 else res.idx[0]
+    prob0 = res.prob if res.prob.ndim == 0 else res.prob[0]
     if scores is None:
         topk_score = jnp.full((trace_k,), -jnp.inf,
-                              jnp.float32).at[0].set(res.prob)
-        topk_idx = jnp.full((trace_k,), -1, jnp.int32).at[0].set(res.idx)
-        chosen = res.prob.astype(jnp.float32)
+                              jnp.float32).at[0].set(prob0)
+        topk_idx = jnp.full((trace_k,), -1, jnp.int32).at[0].set(idx0)
+        chosen = prob0.astype(jnp.float32)
     else:
         topk_score, topk_idx = lax.top_k(scores.astype(jnp.float32), trace_k)
         topk_idx = topk_idx.astype(jnp.int32)
-        chosen = scores[res.idx].astype(jnp.float32)
+        chosen = scores[idx0].astype(jnp.float32)
     gap = (topk_score[0] - topk_score[1] if trace_k >= 2
            else jnp.asarray(0.0, jnp.float32))
     get_pbest = selector.extras.get("get_pbest")
@@ -121,6 +125,7 @@ def make_step_fn(
     labels: jnp.ndarray,
     model_losses: jnp.ndarray,
     trace_k: int = 0,
+    acq_batch: int = 1,
 ):
     """One labeling round as a pure scan step.
 
@@ -134,8 +139,43 @@ def make_step_fn(
     — the trace only *reads* values the step already computes — so a
     recorded run's decision trajectory is the unrecorded program's, pinned
     by ``tests/test_recorder.py``.
+
+    ``acq_batch = q > 1``: the round acquires q points in one scoring pass
+    (``selectors/batch.py`` — a selector's native ``select_q`` or the
+    generic greedy top-q) and applies all q oracle answers as ONE fused
+    update; ``idx``/``true_class``/``prob`` then carry a trailing ``(q,)``
+    axis and the cumulative-regret trace is LABEL-weighted (each round's
+    regret counts its q labels, so budgets align with q=1 runs). ``q = 1``
+    is this exact function's legacy body — same trace, bitwise.
     """
     best_loss = model_losses.min()
+
+    if acq_batch > 1:
+        from coda_tpu.selectors.batch import resolve_batch_fns
+
+        sel_q, upd_q = resolve_batch_fns(selector, acq_batch)
+
+        def step_q(carry, k):
+            state, cum = carry
+            k_sel, k_best = jax.random.split(k)
+            with jax.named_scope("select_q"):
+                res = sel_q(state, k_sel)
+            tcs = labels[res.idx]                      # (q,)
+            with jax.named_scope("update_q"):
+                state = upd_q(state, res.idx, tcs, res.prob)
+            with jax.named_scope("best"):
+                best, b_stoch = selector.best(state, k_best)
+            regret = model_losses[best] - best_loss
+            cum = cum + acq_batch * regret             # label-weighted
+            outs = (res.idx, tcs, best, regret, cum, res.prob,
+                    res.stochastic | b_stoch)
+            if trace_k:
+                with jax.named_scope("record"):
+                    outs = outs + (make_round_trace(selector, res, state,
+                                                    k, trace_k),)
+            return (state, cum), outs
+
+        return step_q
 
     # named_scope stamps the phase names into HLO metadata, so a
     # --profile-dir device trace carries the same select/update/best
@@ -164,28 +204,40 @@ def make_step_fn(
     return step
 
 
+def _validate_rounds(selector: Selector, N: int, iters: int,
+                     acq_batch: int) -> None:
+    """``iters`` labeling ROUNDS at ``acq_batch`` labels each must fit the
+    pool and any fixed label buffer."""
+    n_labels = iters * acq_batch
+    if n_labels > N:
+        raise ValueError(
+            f"iters={iters} x acq_batch={acq_batch} = {n_labels} labels "
+            f"exceeds the {N} labelable points; the unlabeled set would "
+            "be exhausted mid-run"
+        )
+    budget = selector.hyperparams.get("budget")
+    if budget is not None and n_labels > budget:
+        raise ValueError(
+            f"selector '{selector.name}' has a fixed label buffer of "
+            f"{budget} but iters={iters} x acq_batch={acq_batch} = "
+            f"{n_labels} labels; rebuild it with budget >= {n_labels}"
+        )
+
+
 def build_experiment_fn(
     selector: Selector,
     labels: jnp.ndarray,
     model_losses: jnp.ndarray,
     iters: int = 100,
+    acq_batch: int = 1,
 ) -> Callable[[jax.Array], ExperimentResult]:
     """Pure function key -> ExperimentResult for one seed."""
     best_loss = model_losses.min()
     N = labels.shape[0]
-    if iters > N:
-        raise ValueError(
-            f"iters={iters} exceeds the {N} labelable points; the unlabeled "
-            "set would be exhausted mid-run"
-        )
-    budget = selector.hyperparams.get("budget")
-    if budget is not None and iters > budget:
-        raise ValueError(
-            f"selector '{selector.name}' has a fixed label buffer of "
-            f"{budget} but iters={iters}; rebuild it with budget >= iters"
-        )
+    _validate_rounds(selector, N, iters, acq_batch)
 
-    step = make_step_fn(selector, labels, model_losses)
+    step = make_step_fn(selector, labels, model_losses,
+                        acq_batch=acq_batch)
 
     def experiment(key: jax.Array) -> ExperimentResult:
         k_init, k_prior, k_scan = jax.random.split(key, 3)
@@ -218,6 +270,7 @@ def build_recording_experiment_fn(
     model_losses: jnp.ndarray,
     iters: int = 100,
     trace_k: int = 8,
+    acq_batch: int = 1,
 ) -> Callable[[jax.Array], tuple]:
     """``key -> (ExperimentResult, RunTraceAux)`` — the flight-recorder
     variant of :func:`build_experiment_fn`.
@@ -228,13 +281,10 @@ def build_recording_experiment_fn(
     which the caller harvests once alongside the result."""
     best_loss = model_losses.min()
     N = labels.shape[0]
-    if iters > N:
-        raise ValueError(
-            f"iters={iters} exceeds the {N} labelable points; the unlabeled "
-            "set would be exhausted mid-run"
-        )
+    _validate_rounds(selector, N, iters, acq_batch)
     trace_k = max(1, min(int(trace_k), N))
-    step = make_step_fn(selector, labels, model_losses, trace_k=trace_k)
+    step = make_step_fn(selector, labels, model_losses, trace_k=trace_k,
+                        acq_batch=acq_batch)
 
     def experiment(key: jax.Array):
         k_init, k_prior, k_scan = jax.random.split(key, 3)
@@ -268,7 +318,8 @@ def build_recording_experiment_fn(
 
 def _engine_cost_name(preds, seeds: int, iters: int, factory,
                       label: Optional[str] = None,
-                      recorded: bool = False) -> str:
+                      recorded: bool = False,
+                      acq_batch: int = 1) -> str:
     # selector identity keeps two methods at the same (shape, seeds,
     # iters) from overwriting each other's cost-book entry; callers that
     # know the method name (cli) pass it, anonymous factories fall back
@@ -278,6 +329,7 @@ def _engine_cost_name(preds, seeds: int, iters: int, factory,
         label = getattr(factory, "__name__", None) or "anon"
     shape = "x".join(str(int(s)) for s in getattr(preds, "shape", ()))
     return (f"engine/run_seeds/{label}/{shape}/s{seeds}x{iters}"
+            + (f"/q{acq_batch}" if acq_batch > 1 else "")
             + ("/rec" if recorded else ""))
 
 
@@ -300,15 +352,17 @@ def run_seeds_recorded(
     loss_fn: Callable = accuracy_loss,
     trace_k: int = 8,
     cost_label: Optional[str] = None,
+    acq_batch: int = 1,
 ):
     """:func:`run_seeds_compiled` with the flight recorder on: returns
     ``(ExperimentResult, RunTraceAux)``, both with a leading seed axis."""
     fn = make_batched_experiment_fn(selector_factory, iters, loss_fn,
-                                    trace_k=trace_k)
+                                    trace_k=trace_k, acq_batch=acq_batch)
     keys = jnp.stack([jax.random.PRNGKey(s) for s in range(seeds)])
     return _aot(jax.jit(fn), (preds, labels, keys),
                 _engine_cost_name(preds, seeds, iters, selector_factory,
-                                  label=cost_label, recorded=True))
+                                  label=cost_label, recorded=True,
+                                  acq_batch=acq_batch))
 
 
 def run_experiment(
@@ -341,6 +395,7 @@ def run_seeds_compiled(
     seeds: int = 5,
     loss_fn: Callable = accuracy_loss,
     cost_label: Optional[str] = None,
+    acq_batch: int = 1,
 ) -> ExperimentResult:
     """All seeds, with the prediction tensor as a *traced jit argument*.
 
@@ -352,11 +407,12 @@ def run_seeds_compiled(
     ``preds`` argument, so the tensor stays a runtime parameter. This is the
     production entry point for the CLI and bench.
     """
-    fn = make_batched_experiment_fn(selector_factory, iters, loss_fn)
+    fn = make_batched_experiment_fn(selector_factory, iters, loss_fn,
+                                    acq_batch=acq_batch)
     keys = jnp.stack([jax.random.PRNGKey(s) for s in range(seeds)])
     return _aot(jax.jit(fn), (preds, labels, keys),
                 _engine_cost_name(preds, seeds, iters, selector_factory,
-                                  label=cost_label))
+                                  label=cost_label, acq_batch=acq_batch))
 
 
 def make_batched_experiment_fn(
@@ -364,6 +420,7 @@ def make_batched_experiment_fn(
     iters: int,
     loss_fn: Callable = accuracy_loss,
     trace_k: int = 0,
+    acq_batch: int = 1,
 ):
     """``(preds, labels, keys, *extra) -> ExperimentResult`` (seed axis
     leading).
@@ -383,9 +440,11 @@ def make_batched_experiment_fn(
         sel = selector_factory(preds, *extra)
         losses = compute_true_losses(preds, labels, loss_fn)
         exp = (build_recording_experiment_fn(sel, labels, losses, iters,
-                                             trace_k=trace_k)
+                                             trace_k=trace_k,
+                                             acq_batch=acq_batch)
                if trace_k else build_experiment_fn(sel, labels, losses,
-                                                   iters))
+                                                   iters,
+                                                   acq_batch=acq_batch))
         if keys.shape[0] == 1:
             # width-1 batches (the suite's seed-0 probe) skip the seed vmap:
             # under vmap both pallas kernels' custom_vmap rules fall back to

@@ -89,7 +89,10 @@ def replay_record(record: RunRecord, selector_factory, preds, labels,
     iters = int(run.get("iters", record.rounds))
     fn = make_batched_experiment_fn(
         selector_factory, iters, LOSS_FNS[loss],
-        trace_k=int(record.meta.get("trace_k", 8)))
+        trace_k=int(record.meta.get("trace_k", 8)),
+        # re-execute the identical q-wide program: a batched record
+        # replays through the same select_q/update_q trace it recorded
+        acq_batch=record.acq_batch)
     keys = jnp.asarray(record.arrays["root_key"], jnp.uint32)
     result, aux = jax.jit(fn)(preds, labels, keys)
     return {
@@ -244,6 +247,78 @@ def compare_seed(rec: dict, rep: dict, score_tol: float = 0.0,
                       quantities=info, note=note)
 
 
+def _label_aligned_cum(record: RunRecord, seed: int) -> np.ndarray:
+    """Label-indexed cumulative regret of one seed: entry L-1 is the
+    cumulative regret after L labels. For q > 1 records each round's
+    regret counts its q labels (the engine's label-weighted trace already
+    does; re-derive from ``regret`` so v1 and v2 align identically)."""
+    q = record.acq_batch
+    regret = np.asarray(record.arrays["regret"][seed], np.float64)
+    cum = np.cumsum(q * regret)
+    return np.repeat(cum, q)  # constant within a round's q labels
+
+
+def compare_records_batchq(a: RunRecord, b: RunRecord) -> ReplayReport:
+    """The q-vs-q' comparison (``--against`` across different acq_batch
+    knobs): the two records run DIFFERENT acquisition programs, so
+    per-round decision parity is not a meaningful contract — what is, is
+    the regret ENVELOPE at equal label budgets. Aligns both records'
+    label-weighted cumulative-regret curves on the common label prefix
+    and reports, per seed, the final gap/ratio and the worst aligned gap;
+    triage class ``acq-batch-envelope``. Parity is never claimed."""
+    report = ReplayReport(mode="records", score_tol=0.0, meta={
+        "a": a.meta.get("run", {}), "b": b.meta.get("run", {}),
+        "backend_a": a.meta.get("fingerprint", {}).get("backend"),
+        "backend_b": b.meta.get("fingerprint", {}).get("backend"),
+    })
+    knobs_a = a.meta.get("fingerprint", {}).get("knobs", {}) or {}
+    knobs_b = b.meta.get("fingerprint", {}).get("knobs", {}) or {}
+    diff = {key: [knobs_a.get(key), knobs_b.get(key)]
+            for key in sorted(set(knobs_a) | set(knobs_b))
+            if knobs_a.get(key) != knobs_b.get(key)}
+    diff.setdefault("acq_batch", [a.acq_batch, b.acq_batch])
+    report.meta["knob_diff"] = diff
+    n_seeds = min(a.seeds, b.seeds)
+    if a.seeds != b.seeds:
+        report.meta["seed_count_mismatch"] = {"a": a.seeds, "b": b.seeds,
+                                              "compared": n_seeds}
+    per_seed = []
+    for s in range(n_seeds):
+        ca = _label_aligned_cum(a, s)
+        cb = _label_aligned_cum(b, s)
+        L = min(ca.shape[0], cb.shape[0])
+        ca, cb = ca[:L], cb[:L]
+        gap = cb - ca
+        final_ratio = (float(cb[-1] / ca[-1]) if ca[-1] > 0
+                       else (1.0 if cb[-1] <= 0 else float("inf")))
+        info = {
+            "labels_compared": int(L),
+            "final_cum_a": float(ca[-1]), "final_cum_b": float(cb[-1]),
+            "final_gap": float(gap[-1]),
+            "max_aligned_gap": float(np.max(gap)),
+            "final_ratio_b_over_a": final_ratio,
+        }
+        per_seed.append(info)
+        report.seeds.append(SeedTriage(
+            seed=s, parity=False, first_divergent_round=0,
+            quantity="cumulative_regret",
+            classification="acq-batch-envelope",
+            quantities={"cumulative_regret": info},
+            note=(f"label-aligned regret envelope over {L} labels: "
+                  f"final {ca[-1]:.4f} (q={a.acq_batch}) vs "
+                  f"{cb[-1]:.4f} (q={b.acq_batch}), "
+                  f"ratio {final_ratio:.3f}, "
+                  f"max aligned gap {np.max(gap):.4f}")))
+    report.meta["batchq_envelope"] = {
+        "q_a": a.acq_batch, "q_b": b.acq_batch, "seeds": per_seed,
+        "max_final_ratio_b_over_a": max(
+            (i["final_ratio_b_over_a"] for i in per_seed), default=None),
+        "max_aligned_gap": max(
+            (i["max_aligned_gap"] for i in per_seed), default=None),
+    }
+    return report
+
+
 def compare_records(a: RunRecord, b: RunRecord,
                     score_tol: float = 0.0) -> ReplayReport:
     """Direct record-vs-record comparison (no re-execution): the shared
@@ -253,7 +328,13 @@ def compare_records(a: RunRecord, b: RunRecord,
     Records captured with different ``--record-topk`` compare on the
     common top-k prefix; a seed-count mismatch compares the common seeds
     and is surfaced in the report meta + triage text (never silently
-    called full parity)."""
+    called full parity). Records captured at different ``acq_batch``
+    widths route through the label-aligned regret-envelope comparison
+    (:func:`compare_records_batchq`) — the knob-diff path, like
+    dense-vs-sparse, but with budget alignment instead of a score
+    tolerance since the two acquisition programs genuinely differ."""
+    if a.acq_batch != b.acq_batch:
+        return compare_records_batchq(a, b)
     if a.rounds != b.rounds:
         raise ValueError(
             f"records disagree on round count ({a.rounds} vs {b.rounds}); "
@@ -337,8 +418,18 @@ def format_triage(report: ReplayReport) -> str:
     if report.meta.get("knob_diff"):
         pairs = ", ".join(f"{k}: {va!r} vs {vb!r}" for k, (va, vb)
                           in report.meta["knob_diff"].items())
-        lines.append(f"  knobs differ ({pairs}) — compared under the "
-                     "documented score contract, not bitwise")
+        contract = ("the label-aligned regret envelope"
+                    if report.meta.get("batchq_envelope")
+                    else "the documented score contract")
+        lines.append(f"  knobs differ ({pairs}) — compared under "
+                     f"{contract}, not bitwise")
+    env = report.meta.get("batchq_envelope")
+    if env:
+        lines.append(
+            f"  acq-batch envelope: q={env['q_a']} vs q={env['q_b']}, "
+            f"worst final cum-regret ratio "
+            f"{env['max_final_ratio_b_over_a']:.3f}, worst aligned gap "
+            f"{env['max_aligned_gap']:.4f}")
     for s in report.seeds:
         if s.parity:
             lines.append(f"  seed {s.seed}: PARITY "
@@ -352,6 +443,8 @@ def format_triage(report: ReplayReport) -> str:
         if s.note:
             lines.append(f"    {s.note}")
         for q, info in s.quantities.items():
+            if "first_divergent_round" not in info:
+                continue  # envelope entries carry their own note line
             d = info.get("max_abs_delta")
             lines.append(
                 f"    {q}: first at round {info['first_divergent_round']}"

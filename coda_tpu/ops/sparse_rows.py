@@ -143,37 +143,16 @@ def row_beta(s: SparseRows, c: jnp.ndarray
     return a_t, rv.sum(-1) + jnp.take(s.resid, c, axis=1)
 
 
-def scatter_row(s: SparseRows, true_class: jnp.ndarray,
-                pred_classes: jnp.ndarray, lr: float) -> SparseRows:
-    """One labeling round: add ``lr`` at ``(h, true_class, pred_classes[h])``
-    for every model h — the sparse analog of the dense
-    ``dirichlets.at[:, true_class, :].add(lr * onehot)``.
-
-    Tracked columns (and the diagonal) take the increment exactly. An
-    untracked column takes its uniform residual share out, adds ``lr``,
-    and is inserted by EVICTING the smallest tracked entry back into the
-    residual — unless it still would not rank, in which case the whole
-    increment is absorbed by the residual. Row mass is conserved by every
-    branch, so the row's Beta reduction stays exact (see module doc).
-    """
-    H, C = s.diag.shape
-    K = s.k
-    rv = jnp.take(s.vals, true_class, axis=1)                  # (H, K)
-    dcol = jnp.take(s.diag, true_class, axis=1)                # (H,)
+def _scatter_into_row(dcol, rv, ri, r, true_class, pred_classes, lr: float,
+                      C: int, K: int):
+    """The per-row scatter core on COMPACT row leaves: ``(dcol (H,),
+    rv (H, K), ri (H, K), r (H,))`` -> the same four, updated. Shared by
+    the single-row :func:`scatter_row` and the multi-row
+    :func:`scatter_rows` so the eviction/mass choreography can never
+    drift between them (the float ops are exactly the pre-refactor
+    single-row body's)."""
+    H = dcol.shape[0]
     is_diag = pred_classes == true_class                       # (H,)
-
-    if s.full:
-        # parity layout: the same float add at the same position the
-        # dense one-hot path performs (adding lr*0.0 elsewhere is a
-        # bitwise no-op on positive concentrations)
-        onehot = jax.nn.one_hot(pred_classes, C, dtype=rv.dtype)
-        rv1 = rv + lr * onehot
-        diag1 = dcol + lr * jnp.take(onehot, true_class, axis=1)
-        return s._replace(vals=s.vals.at[:, true_class, :].set(rv1),
-                          diag=s.diag.at[:, true_class].set(diag1))
-
-    ri = jnp.take(s.idx, true_class, axis=1)                   # (H, K)
-    r = jnp.take(s.resid, true_class, axis=1)                  # (H,)
     hit = ri == pred_classes[:, None]                          # (H, K)
     tracked = hit & (~is_diag)[:, None]
     rv1 = rv + lr * tracked.astype(rv.dtype)
@@ -195,12 +174,114 @@ def scatter_row(s: SparseRows, true_class: jnp.ndarray,
     r2 = r + jnp.where(insert, m_val - share,
                        jnp.where(miss, lr, 0.0))
     diag1 = dcol + lr * is_diag.astype(dcol.dtype)
+    return diag1, rv2, ri2, r2
+
+
+def scatter_row(s: SparseRows, true_class: jnp.ndarray,
+                pred_classes: jnp.ndarray, lr: float) -> SparseRows:
+    """One labeling round: add ``lr`` at ``(h, true_class, pred_classes[h])``
+    for every model h — the sparse analog of the dense
+    ``dirichlets.at[:, true_class, :].add(lr * onehot)``.
+
+    Tracked columns (and the diagonal) take the increment exactly. An
+    untracked column takes its uniform residual share out, adds ``lr``,
+    and is inserted by EVICTING the smallest tracked entry back into the
+    residual — unless it still would not rank, in which case the whole
+    increment is absorbed by the residual. Row mass is conserved by every
+    branch, so the row's Beta reduction stays exact (see module doc).
+    """
+    H, C = s.diag.shape
+    K = s.k
+    rv = jnp.take(s.vals, true_class, axis=1)                  # (H, K)
+    dcol = jnp.take(s.diag, true_class, axis=1)                # (H,)
+
+    if s.full:
+        # parity layout: the same float add at the same position the
+        # dense one-hot path performs (adding lr*0.0 elsewhere is a
+        # bitwise no-op on positive concentrations)
+        onehot = jax.nn.one_hot(pred_classes, C, dtype=rv.dtype)
+        rv1 = rv + lr * onehot
+        diag1 = dcol + lr * jnp.take(onehot, true_class, axis=1)
+        return s._replace(vals=s.vals.at[:, true_class, :].set(rv1),
+                          diag=s.diag.at[:, true_class].set(diag1))
+
+    ri = jnp.take(s.idx, true_class, axis=1)                   # (H, K)
+    r = jnp.take(s.resid, true_class, axis=1)                  # (H,)
+    diag1, rv2, ri2, r2 = _scatter_into_row(
+        dcol, rv, ri, r, true_class, pred_classes, lr, C, K)
     return SparseRows(
         diag=s.diag.at[:, true_class].set(diag1),
         vals=s.vals.at[:, true_class, :].set(rv2),
         idx=s.idx.at[:, true_class, :].set(ri2),
         resid=s.resid.at[:, true_class].set(r2),
     )
+
+
+def scatter_rows(s: SparseRows, true_classes: jnp.ndarray,
+                 pred_classes: jnp.ndarray, lr: float) -> SparseRows:
+    """One FUSED multi-row scatter: ``q`` oracle answers applied in a
+    single pass — ``true_classes`` (q,) int32, ``pred_classes`` (q, H)
+    int32 (each answer's per-model hard predictions). The batched analog
+    of calling :func:`scatter_row` q times, with ONE gather of the
+    touched rows' compact leaves up front; all chained row arithmetic
+    runs on those compact (q, H, K) copies, and only the final per-row
+    results are written back to the carry.
+
+    Within-batch collisions (two answers landing on the same class row)
+    are SEQUENCED: answer j's row update starts from the result of the
+    latest j' < j with the same ``true_class`` (chained on the compact
+    gathered copies — q is static and small, so the chain unrolls), and
+    the write-back keeps only each row's LAST result. Every chained step
+    runs the exact :func:`_scatter_into_row` core, so per-row mass
+    conservation — and therefore the Beta reduction the EIG quadrature
+    consumes — holds for the batch exactly as for q sequential rounds.
+    """
+    q = int(true_classes.shape[0])
+    if q == 1:
+        return scatter_row(s, true_classes[0], pred_classes[0], lr)
+    H, C = s.diag.shape
+    K = s.k
+
+    if s.full:
+        # parity layout: one scatter-add of all q one-hot increments
+        # (duplicate rows accumulate — addition is the whole update)
+        onehot = jax.nn.one_hot(pred_classes, C, dtype=s.vals.dtype)  # (q,H,C)
+        vals = s.vals.at[:, true_classes, :].add(
+            lr * jnp.transpose(onehot, (1, 0, 2)))
+        diag_inc = lr * (pred_classes == true_classes[:, None]).astype(
+            s.diag.dtype)                                      # (q, H)
+        diag = s.diag.at[:, true_classes].add(diag_inc.T)
+        return s._replace(vals=vals, diag=diag)
+
+    # one gather of the q touched rows' compact leaves
+    dcols = jnp.take(s.diag, true_classes, axis=1).T           # (q, H)
+    rvs = jnp.moveaxis(jnp.take(s.vals, true_classes, axis=1), 1, 0)
+    ris = jnp.moveaxis(jnp.take(s.idx, true_classes, axis=1), 1, 0)
+    rs = jnp.take(s.resid, true_classes, axis=1).T             # (q, H)
+    outs = []                                                  # per-answer
+    for j in range(q):
+        dcol, rv, ri, r = dcols[j], rvs[j], ris[j], rs[j]
+        # chain duplicates: start from the latest earlier answer that
+        # touched this row (same-row collision sequencing)
+        for j2 in range(j):
+            same = true_classes[j] == true_classes[j2]
+            d2, rv2_, ri2_, r2_ = outs[j2]
+            dcol = jnp.where(same, d2, dcol)
+            rv = jnp.where(same, rv2_, rv)
+            ri = jnp.where(same, ri2_, ri)
+            r = jnp.where(same, r2_, r)
+        outs.append(_scatter_into_row(dcol, rv, ri, r, true_classes[j],
+                                      pred_classes[j], lr, C, K))
+    # write-back, earliest first so a duplicated row keeps its LAST result
+    diag, vals, idx, resid = s.diag, s.vals, s.idx, s.resid
+    for j in range(q):
+        d1, rv1, ri1, r1 = outs[j]
+        tc = true_classes[j]
+        diag = diag.at[:, tc].set(d1)
+        vals = vals.at[:, tc, :].set(rv1)
+        idx = idx.at[:, tc, :].set(ri1)
+        resid = resid.at[:, tc].set(r1)
+    return SparseRows(diag=diag, vals=vals, idx=idx, resid=resid)
 
 
 def densify_row(s: SparseRows, c: jnp.ndarray) -> jnp.ndarray:

@@ -20,6 +20,7 @@ from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from coda_tpu.losses import accuracy_loss
 from coda_tpu.ops.masked import masked_argmin_tiebreak, masked_categorical
@@ -129,6 +130,54 @@ def make_activetesting(
             n_labeled=m + 1,
         )
 
+    def select_q(state, key, q: int) -> SelectResult:
+        """q sequential proportional draws WITHOUT replacement from the
+        static acquisition weights — each draw's recorded probability is
+        conditional on the picks before it (exactly the q_m the LURE
+        weights need: the batch is q single-draw rounds whose oracle
+        answers arrive together)."""
+        keys = jax.random.split(key, q)
+
+        def draw(carry, kt):
+            mask = carry
+            idx_t, prob_t = masked_categorical(kt, acquisition_scores, mask)
+            return mask.at[idx_t].set(False), (idx_t.astype(jnp.int32),
+                                               prob_t)
+
+        _, (idxs, probs) = lax.scan(draw, state.unlabeled, keys)
+        return SelectResult(
+            idx=idxs,
+            prob=probs.astype(jnp.float32),
+            stochastic=jnp.asarray(True),
+            scores=jnp.where(state.unlabeled, acquisition_scores,
+                             -jnp.inf),
+        )
+
+    def update_q(state, idxs, true_classes, probs):
+        """One fused update: the q loss vectors are computed in a single
+        (H, q) batch, then land as q unrolled column writes at slots
+        ``m..m+q-1`` — scalar-index ``.at`` scatters, whose out-of-bounds
+        writes DROP exactly like the q=1 path's (a ``dynamic_update_slice``
+        block write would instead CLAMP at the ring edge and overwrite
+        committed history when a serving session runs past the LURE
+        budget)."""
+        q = idxs.shape[0]
+        loss_blk = loss_fn(preds[:, idxs, :],
+                           jnp.broadcast_to(true_classes[None, :],
+                                            (H, q)))          # (H, q)
+        m = state.n_labeled
+        losses, qs = state.losses, state.qs
+        for j in range(q):
+            losses = losses.at[:, m + j].set(
+                loss_blk[:, j].astype(jnp.float32))
+            qs = qs.at[m + j].set(probs[j].astype(jnp.float32))
+        return LUREState(
+            unlabeled=state.unlabeled.at[idxs].set(False),
+            losses=losses,
+            qs=qs,
+            n_labeled=m + q,
+        )
+
     def best(state, key):
         risk = lure_risks(state.losses, state.qs, state.n_labeled, N)
         k_tie, k_rand = jax.random.split(key)
@@ -142,6 +191,7 @@ def make_activetesting(
 
     return Selector(
         name=name, init=init, select=select, update=update, best=best,
+        select_q=select_q, update_q=update_q,
         always_stochastic=True,
         hyperparams={"budget": budget},
         extras={

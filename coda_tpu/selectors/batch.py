@@ -1,0 +1,147 @@
+"""Batched acquisition: q oracle labels per round, one fused update.
+
+The paper's protocol acquires exactly ONE label per round, but production
+oracles (crowd annotators, labeling services) answer in parallel. This
+module is the generic half of the ``--acq-batch q`` machinery: given any
+:class:`~coda_tpu.selectors.protocol.Selector`, it resolves the pair of
+q-wide pure functions the engine's scan step (and the serving slab step)
+drive instead of ``select``/``update``:
+
+  * ``select_q(state, key) -> SelectResult`` with a leading ``(q,)`` axis
+    on ``idx``/``prob`` — q DISTINCT points from ONE scoring pass. A
+    selector that declares its own ``select_q`` (CODA's greedy EIG with
+    the information-overlap penalty, ModelPicker's argmin top-q,
+    ActiveTesting's sequential proportional draws) is used verbatim;
+    otherwise :func:`generic_select_q` derives a greedy top-q from the
+    ``(N,)`` score vector ``select`` already emits (pick 1 is the
+    method's OWN choice — same randomness class as q=1 — and picks 2..q
+    re-rank the same scores with picked points masked out, never
+    re-scoring).
+  * ``update_q(state, idxs, true_classes, probs) -> state`` — all q
+    oracle answers applied at once. A selector-provided ``update_q`` is
+    the FUSED path (multi-row posterior scatter + one batched refresh);
+    the fallback is a ``lax.scan`` of the single-label ``update``
+    (sequentially correct, not fused — e.g. the pallas scoring backends,
+    whose in-kernel refresh is single-row).
+
+``q == 1`` never routes through this module: the engine keeps the legacy
+single-label program bitwise unchanged (the tier-1 pin). The scorer seam
+stays pluggable — select_q consumes whatever score vector the selector's
+scoring rung produced (exact quadrature, the Laplace-bridge rung, or a
+future learned surrogate à la LINNA arXiv 2203.05583), so new rungs
+compose with batching for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from coda_tpu.ops.masked import masked_argmax_tiebreak
+from coda_tpu.selectors.protocol import Selector, SelectResult
+
+# the tie tolerance of the generic greedy re-rank picks (2..q) — the same
+# reference rule CODA's argmax uses (coda.py: isclose rtol=atol=1e-8)
+_TIE_RTOL = 1e-8
+_TIE_ATOL = 1e-8
+
+
+def generic_select_q(selector: Selector, q: int) -> Callable:
+    """Greedy top-q over the selector's own score vector, one scoring pass.
+
+    Pick 1 is the method's own ``select`` (same key, same tie-break /
+    sampling semantics as the q=1 round). Picks 2..q are masked argmaxes
+    over the SAME scores with already-picked points removed — a cached
+    re-rank, not q scoring passes. When the finite-score candidate set
+    runs dry mid-batch (e.g. a disagreement prefilter smaller than q),
+    later picks fall back to the ``unlabeled`` mask every selector state
+    carries (protocol convention), scored at -inf — distinctness is the
+    invariant, not score order.
+    """
+    if q < 2:
+        raise ValueError("generic_select_q is the q >= 2 path")
+
+    def select_q(state, key) -> SelectResult:
+        res = selector.select(state, key)
+        scores = res.scores
+        if scores is None:
+            raise ValueError(
+                f"selector {selector.name!r} emits no score vector; "
+                "--acq-batch > 1 needs one (SelectResult.scores) for the "
+                "greedy top-q re-rank")
+        N = scores.shape[0]
+        picked0 = jnp.zeros((N,), bool).at[res.idx].set(True)
+        keys = jax.random.split(jax.random.fold_in(key, 0x6ba7c9), q - 1)
+
+        def pick(carry, kt):
+            picked, any_tie = carry
+            avail = jnp.isfinite(scores) & ~picked
+            fallback = state.unlabeled & ~picked
+            cand = jnp.where(avail.any(), avail, fallback)
+            idx_t, n_ties = masked_argmax_tiebreak(
+                kt, jnp.where(avail, scores, -jnp.inf), cand,
+                rtol=_TIE_RTOL, atol=_TIE_ATOL)
+            return ((picked.at[idx_t].set(True), any_tie | (n_ties > 1)),
+                    (idx_t.astype(jnp.int32), scores[idx_t]))
+
+        (_, any_tie), (idxs, probs) = lax.scan(
+            pick, (picked0, jnp.asarray(False)), keys)
+        return SelectResult(
+            idx=jnp.concatenate([res.idx.astype(jnp.int32)[None], idxs]),
+            prob=jnp.concatenate([res.prob.astype(jnp.float32)[None],
+                                  probs.astype(jnp.float32)]),
+            stochastic=res.stochastic | any_tie,
+            scores=scores,
+        )
+
+    return select_q
+
+
+def generic_update_q(selector: Selector) -> Callable:
+    """Sequential fallback: a ``lax.scan`` of the single-label ``update``
+    — correct for any selector, but q refresh passes instead of one
+    (selectors on the hot path provide a fused ``update_q`` instead)."""
+
+    def update_q(state, idxs, true_classes, probs):
+        def body(st, xs):
+            i, t, p = xs
+            return selector.update(st, i, t, p), None
+
+        st, _ = lax.scan(body, state, (idxs, true_classes, probs))
+        return st
+
+    return update_q
+
+
+def resolve_batch_fns(selector: Selector, q: int):
+    """The concrete ``(select_q(state, key), update_q(state, idxs, tcs,
+    probs))`` pair for a static batch width ``q >= 2`` — selector-native
+    implementations when declared, generic derivations otherwise."""
+    if q < 2:
+        raise ValueError(f"acq_batch={q}: the batched pair is the q >= 2 "
+                         "path (q == 1 runs the legacy program)")
+    if selector.select_q is not None:
+        def sel_q(state, key, _f=selector.select_q):
+            return _f(state, key, q)
+    else:
+        sel_q = generic_select_q(selector, q)
+    upd_q = (selector.update_q if selector.update_q is not None
+             else generic_update_q(selector))
+    return sel_q, upd_q
+
+
+def make_batched_selector(selector: Selector, q: int) -> Selector:
+    """A :class:`Selector` whose ``select``/``update`` ARE the q-wide pair
+    — the adapter the serving slab step drives, so a ``(task, spec,
+    acq_batch=q)`` bucket's one compiled program batches labels without
+    the slab machinery knowing about q at all (shapes just carry a
+    trailing ``(q,)``)."""
+    sel_q, upd_q = resolve_batch_fns(selector, q)
+    return dataclasses.replace(
+        selector, select=sel_q, update=upd_q,
+        select_q=None, update_q=None,
+        hyperparams=dict(selector.hyperparams, acq_batch=q))

@@ -1463,6 +1463,226 @@ def make_coda(
             scores=jnp.where(cand, scores, -jnp.inf),
         )
 
+    def _greedy_overlap_topq(state: CODAState, scores, cand, k_tie,
+                             q: int) -> SelectResult:
+        """Greedy top-q EIG with an information-overlap penalty, as a
+        cached re-rank of ONE scoring pass.
+
+        After each pick, remaining candidates are discounted by how much
+        their hypothetical-label effect concentrates on the same class
+        rows / P(best) mass as the points already taken: each candidate
+        n carries two unit feature vectors — its class-row hit
+        distribution ``pi_hat_xi[n]`` (which Dirichlet rows its label
+        would touch, in expectation) and, on the incremental tier, its
+        expected |ΔP(best)| profile over models read straight from the
+        carried ``pbest_hyp`` cache — and the penalty is the running max
+        cosine overlap with the picked set. Scores multiply by
+        ``(1 - penalty)``, so a fully-redundant point re-ranks toward
+        zero while independent points keep their raw EIG. The re-rank
+        runs on the top-M score pool (M = max(32, 8q)) — the greedy
+        argmax only ever reaches deep into the pool when most of it is
+        redundant, and M bounds that reach statically.
+        """
+        M = min(N, max(32, 8 * q))
+        # the pool: candidates by score; unlabeled non-candidates at a
+        # huge-but-finite sentinel so a candidate set smaller than q
+        # falls back to unlabeled points, never to labeled ones
+        pool_scores = jnp.where(
+            cand, scores,
+            jnp.where(state.unlabeled, -1e30, -jnp.inf))
+        top_scores, pool = lax.top_k(pool_scores, M)       # (M,)
+        valid = top_scores > -1e29                          # real candidates
+        pi_xi_p = state.pi_hat_xi[pool]                     # (M, C)
+        U = pi_xi_p / jnp.clip(
+            jnp.linalg.norm(pi_xi_p, axis=1, keepdims=True), 1e-12, None)
+        feats = [U]
+        if incremental:
+            # expected P(best)-mass displacement per model, off the cache:
+            # E[n, h] ≈ Σ_c pi_xi[n, c] · pi_hat[c] · |hyp[c, n, h] − rows[c, h]|
+            # restricted to each candidate's top-kc likeliest labels —
+            # the weight pi_xi[n, c]·pi_hat[c] concentrates the expected
+            # displacement there, and the restriction turns an O(C·M·H)
+            # read of the cache (84 ms/round at the C=1000 preset,
+            # measured — it alone would eat the batching win) into an
+            # O(kc·M·H) gather
+            kc = min(8, C)
+            w_full = pi_xi_p * state.pi_hat[None, :]        # (M, C)
+            wv, ci = lax.top_k(w_full, kc)                  # (M, kc)
+            hyp_sel = state.pbest_hyp[ci, pool[:, None], :].astype(
+                jnp.float32)                                # (M, kc, H)
+            rows_sel = state.pbest_rows[ci]                 # (M, kc, H)
+            E = jnp.einsum("mk,mkh->mh", wv,
+                           jnp.abs(hyp_sel - rows_sel))     # (M, H)
+            feats.append(E / jnp.clip(
+                jnp.linalg.norm(E, axis=1, keepdims=True), 1e-12, None))
+        F = jnp.concatenate(feats, axis=1) / jnp.sqrt(float(len(feats)))
+        # (M, C[+H]); <F_i, F_j> = mean of the per-feature cosines
+
+        keys = jax.random.split(k_tie, q)
+
+        def pick(carry, kt):
+            pen, taken = carry
+            eff = top_scores * (1.0 - pen)
+            avail = valid & ~taken
+            fb = state.unlabeled[pool] & ~taken
+            use = jnp.where(avail.any(), avail, fb)
+            loc, n_ties = masked_argmax_tiebreak(
+                kt, jnp.where(avail, eff, -jnp.inf), use,
+                rtol=_TIE_RTOL, atol=_TIE_ATOL)
+            overlap = jnp.clip(F @ F[loc], 0.0, 1.0)        # (M,)
+            return ((jnp.maximum(pen, overlap), taken.at[loc].set(True)),
+                    (loc, n_ties > 1))
+
+        (_, _), (locs, ties) = lax.scan(
+            pick, (jnp.zeros((M,)), jnp.zeros((M,), bool)), keys)
+        return SelectResult(
+            idx=pool[locs].astype(jnp.int32),
+            prob=jnp.where(valid[locs], top_scores[locs],
+                           -jnp.inf).astype(jnp.float32),
+            stochastic=ties.any(),
+            scores=jnp.where(cand, scores, -jnp.inf),
+        )
+
+    def select_q(state: CODAState, key, q: int) -> SelectResult:
+        """q-wide acquisition for the full-pool EIG: the one scoring pass
+        the round already paid (score-ahead on the incremental tier),
+        then the greedy overlap-penalized re-rank. Key choreography
+        mirrors ``select`` (split; the sub key is unused here, exactly as
+        in the unprefiltered q=1 path)."""
+        k_sub, k_tie = jax.random.split(key)
+        del k_sub
+        cand, _ = _candidates(state)
+        if incremental:
+            scores = state.eig_scores_cached
+        else:
+            with jax.named_scope("eig/scores"):
+                scores = eig_fn(
+                    state.dirichlets, state.pi_hat, state.pi_hat_xi,
+                    hard_preds, num_points=hp.num_points,
+                    chunk=hp.eig_chunk, **eig_kwargs,
+                )
+        return _greedy_overlap_topq(state, scores, cand, k_tie, q)
+
+    def update_q(state: CODAState, idxs, true_classes, probs) -> CODAState:
+        """All q oracle answers as ONE fused update: a single multi-row
+        posterior scatter (``ops.sparse_rows.scatter_rows`` / one dense
+        scatter-add), ONE batched pi-hat column refresh, ONE batched
+        multi-row EIG-cache refresh from the FINAL posterior (duplicate
+        class rows recompute identical values — the row refresh depends
+        only on the end state), and one scoring pass — per-round cost
+        approaches 1 scoring pass + 1 update instead of q of each."""
+        del probs
+        preds_at = hard_preds[idxs]                     # (q, H)
+        if sparse_k is not None:
+            from coda_tpu.ops.sparse_rows import (
+                densify_row,
+                row_beta,
+                scatter_rows,
+            )
+
+            sparse = scatter_rows(state.sparse, true_classes, preds_at,
+                                  update_strength)
+            dirichlets = None
+        else:
+            sparse = None
+            onehot = jax.nn.one_hot(preds_at, C, dtype=preds.dtype)
+            # q scalar-index row adds, NOT one fancy-index scatter: a
+            # dynamic-index DUS updates the scan-carried (H, C, C) tensor
+            # in place, while an index-ARRAY scatter makes XLA copy the
+            # whole posterior every round (the 512 MB cache copy below,
+            # same story). Sequential adds also sequence duplicate rows
+            # exactly.
+            dirichlets = state.dirichlets
+            for j in range(preds_at.shape[0]):
+                dirichlets = dirichlets.at[:, true_classes[j], :].add(
+                    update_strength * onehot[j])
+        if incremental:
+            if pi_update.startswith("delta"):
+                if pi_gather is None:
+                    from coda_tpu.ops.pallas_gather import (
+                        gather_rows_sum_xla as _gfn,
+                    )
+                else:
+                    _gfn = pi_gather
+                deltas = update_strength * jax.vmap(
+                    _gfn, in_axes=(None, 0))(preds_by_class, preds_at)
+                unnorm = state.pi_xi_unnorm.at[:, true_classes].add(
+                    deltas.T)
+                pi_xi, pi = _normalize_pi(unnorm)
+            else:
+                # exact column refresh from the FINAL posterior rows:
+                # duplicates produce identical columns, so the scatter's
+                # winner is immaterial
+                if sparse_k is not None:
+                    rows_d = jax.vmap(
+                        lambda c: densify_row(sparse, c))(true_classes)
+                else:
+                    rows_d = jnp.moveaxis(
+                        jnp.take(dirichlets, true_classes, axis=1), 1, 0)
+                cols = jnp.einsum("qhs,hns->qn", rows_d, preds,
+                                  precision=_pi_precision(preds))
+                unnorm = state.pi_xi_unnorm.at[:, true_classes].set(cols.T)
+                pi_xi, pi = _normalize_pi(unnorm)
+            # ONE batched multi-row cache refresh (the q=1 path's
+            # update_eig_cache_parts, vmapped over the touched rows)
+            if sparse_k is not None:
+                a_t, b_t = jax.vmap(
+                    lambda c: row_beta(sparse, c))(true_classes)  # (q, H)
+            else:
+                a_cc, b_cc = dirichlet_to_beta(dirichlets)
+                a_t = a_cc.T[true_classes]                  # (q, H)
+                b_t = b_cc.T[true_classes]
+            eq = hard_preds[None, :, :] == true_classes[:, None, None]
+
+            def _hyp_row(a_r, b_r, eq_r):
+                if hp.eig_pbest == "amortized":
+                    # under vmap the cond lowers to a select (both
+                    # branches run) — the gate still decides the VALUE,
+                    # so the score contract holds; batched rounds pay
+                    # both table flavors for the touched rows
+                    return lax.cond(
+                        jnp.min(a_r + b_r) >= _AMORTIZED_MIN_CONC,
+                        lambda: _pbest_hyp_row_amortized(
+                            a_r, b_r, eq_r, 1.0, hp.num_points,
+                            eig_precision),
+                        lambda: _pbest_hyp_row(
+                            a_r, b_r, eq_r, 1.0, hp.num_points,
+                            eig_precision),
+                    )
+                return _pbest_hyp_row(a_r, b_r, eq_r, 1.0, hp.num_points,
+                                      eig_precision)
+
+            hyp_ts = jax.vmap(_hyp_row)(a_t, b_t, eq)       # (q, N, H)
+            row_ts = compute_pbest(a_t, b_t,
+                                   num_points=hp.num_points)  # (q, H)
+            # write back as q scalar-index DUSes (in-place on the scan
+            # carry), NOT one `.at[index_array].set` scatter — the
+            # scatter lowering copies the whole (C, N, H) cache (512 MB
+            # at the imagenet preset, ~half the batched round's wall
+            # when measured). Duplicate rows: later writes win, and
+            # their values are identical (refreshed from the same final
+            # posterior).
+            rows, hyp = state.pbest_rows, state.pbest_hyp
+            for j in range(row_ts.shape[0]):
+                rows = rows.at[true_classes[j]].set(row_ts[j])
+                hyp = hyp.at[true_classes[j]].set(
+                    hyp_ts[j].astype(hyp.dtype))
+            scores = _score_cache(rows, hyp, pi, pi_xi)
+        else:
+            pi_xi, pi = update_pi_hat(dirichlets, preds)
+            unnorm = rows = hyp = scores = None
+        return CODAState(
+            dirichlets=dirichlets,
+            pi_hat_xi=pi_xi,
+            pi_hat=pi,
+            unlabeled=state.unlabeled.at[idxs].set(False),
+            pbest_rows=rows,
+            pbest_hyp=hyp,
+            pi_xi_unnorm=unnorm,
+            eig_scores_cached=scores,
+            sparse=sparse,
+        )
+
     def update(state: CODAState, idx, true_class, prob) -> CODAState:
         del prob
         pred_at = hard_preds[idx]                       # (H,) int32
@@ -1595,6 +1815,16 @@ def make_coda(
         select=select,
         update=update,
         best=best,
+        # batched acquisition (--acq-batch q): the native greedy-EIG
+        # overlap re-rank covers the full-pool EIG; prefilter/ablation
+        # acquisitions derive a generic greedy top-q from their score
+        # vector (selectors/batch.py). The fused multi-row update_q is a
+        # jnp-path program — the pallas backends' in-kernel refresh is
+        # single-row, so they fall back to batch.py's sequential scan
+        # (select stays one pass either way).
+        select_q=(select_q if hp.q == "eig" and not use_prefilter
+                  else None),
+        update_q=(None if eig_backend == "pallas" else update_q),
         always_stochastic=False,
         hyperparams=dict(hp._asdict()),
         hyperparam_defaults=dict(CODAHyperparams()._asdict()),

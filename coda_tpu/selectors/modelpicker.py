@@ -244,6 +244,59 @@ def make_modelpicker(
             n_labeled=state.n_labeled + 1,
         )
 
+    def select_q(state, key, q: int) -> SelectResult:
+        """Argmin top-q: the q lowest-expected-entropy candidates from the
+        ONE closed-form scoring pass (no re-scoring between picks — the
+        multiplicative posterior only moves once the batch of answers
+        lands), each pick breaking its ties with its own key like the
+        q=1 argmin."""
+        if static_cand is not None:
+            ent_sub = expected_entropies(hard_sub, state.posterior, gamma, C)
+            h_agree = entropy2(state.posterior)
+            ent = jnp.full((N,), h_agree).at[static_cand].set(ent_sub)
+        else:
+            ent = expected_entropies(hard_preds, state.posterior, gamma, C)
+        cand = disagree & state.unlabeled
+        cand = jnp.where(cand.any(), cand, state.unlabeled)
+        prob = 1.0 / state.unlabeled.sum().astype(jnp.float32)
+        keys = jax.random.split(key, q)
+
+        def pick(carry, kt):
+            taken = carry
+            avail = cand & ~taken
+            # a candidate set smaller than q falls back to any unlabeled
+            use = jnp.where(avail.any(), avail,
+                            state.unlabeled & ~taken)
+            idx_t, _ = masked_argmin_tiebreak(kt, ent, use)
+            return taken.at[idx_t].set(True), idx_t.astype(jnp.int32)
+
+        _, idxs = lax.scan(pick, jnp.zeros((N,), bool), keys)
+        return SelectResult(
+            idx=idxs,
+            prob=jnp.full((q,), prob, jnp.float32),
+            stochastic=jnp.asarray(True),
+            scores=jnp.where(cand, -ent, -jnp.inf),
+        )
+
+    def update_q(state, idxs, true_classes, probs):
+        """One fused multiplicative update: the posterior moves by
+        ``γ^(Σ_j agreement_j)`` with a single normalization (same
+        posterior as q sequential updates up to float order — each
+        sequential step's normalizer cancels in the product)."""
+        del probs
+        q = idxs.shape[0]
+        pred_q = hard_preds[idxs]                     # (q, H)
+        agree = (pred_q == true_classes[:, None]).astype(jnp.float32)
+        a_sum = agree.sum(axis=0)                     # (H,)
+        post = state.posterior * jnp.power(gamma, a_sum)
+        post = post / post.sum()
+        return ModelPickerState(
+            unlabeled=state.unlabeled.at[idxs].set(False),
+            posterior=post,
+            correct_counts=state.correct_counts + a_sum.astype(jnp.int32),
+            n_labeled=state.n_labeled + q,
+        )
+
     def best(state, key):
         k_tie, k_rand = jax.random.split(key)
         idx, n_ties = masked_argmin_tiebreak(
@@ -257,6 +310,7 @@ def make_modelpicker(
 
     return Selector(
         name=name, init=init, select=select, update=update, best=best,
+        select_q=select_q, update_q=update_q,
         always_stochastic=True,
         hyperparams={"epsilon": None if traced_eps else epsilon},
         # the multiplicative-weights posterior IS this method's P(best)

@@ -29,7 +29,7 @@ mutable 3-method API on top of the pure functions.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +57,21 @@ class Selector:
     select: Callable[[Any, jax.Array], SelectResult]
     update: Callable[[Any, jnp.ndarray, jnp.ndarray, jnp.ndarray], Any]
     best: Callable[[Any, jax.Array], jnp.ndarray]
+    # -- batched acquisition (the --acq-batch q protocol) ------------------
+    # select_q(state, key, q): pick q DISTINCT points in one scoring pass,
+    # returning a SelectResult whose idx/prob carry a leading (q,) axis
+    # (q is a static Python int). None = the method has no native batched
+    # acquisition; `selectors/batch.py` then derives a generic greedy
+    # top-q from the (N,) score vector `select` already emits.
+    # update_q(state, idxs, true_classes, probs) with (q,) arrays: apply
+    # all q oracle answers as ONE fused update (multi-row posterior
+    # scatter + a single batched refresh) instead of q sequential steps.
+    # None = batch.py falls back to a lax.scan of `update` (correct, not
+    # fused). q == 1 never routes through either: the legacy single-label
+    # program runs unchanged (bitwise pin).
+    select_q: Optional[Callable[[Any, jax.Array, int], SelectResult]] = None
+    update_q: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray,
+                                 jnp.ndarray], Any]] = None
     # True when the method is stochastic by construction (e.g. IID sampling);
     # deterministic methods let the driver skip redundant seeds, mirroring the
     # reference's `stochastic` early-stop (reference main.py:128-130).

@@ -514,7 +514,12 @@ class Batcher:
                 t.session.last = r
                 t.session.last_used = time.monotonic()  # the tiers' LRU axis
                 if t.do_update:
-                    t.session.n_labeled += 1
+                    # batch-label tickets carry a q-wide list: every one
+                    # of its oracle answers counts (the loadgen's
+                    # double-apply sentinel reads this)
+                    t.session.n_labeled += (len(t.label)
+                                            if isinstance(t.label, list)
+                                            else 1)
                 if t.request_id is not None:
                     # idempotency: the result is committed BEFORE the
                     # ticket resolves, so a client retry racing the

@@ -89,6 +89,23 @@ def _schema_version() -> int:
     return SESSION_SCHEMA_VERSION
 
 
+def _stream_version_error(meta: dict) -> Optional[str]:
+    """The schema-gate verdict for one session stream's meta, or None
+    when it is replayable by this build: the current version always; the
+    previous (pre-batching) version too, whose rows are a strict subset
+    at acq_batch=1 — rejecting it would discard every in-flight session
+    across a deploy. A v2 stream's missing ``acq_batch`` reads as 1; the
+    q-mismatch against a batch server is caught by the acq_batch check,
+    not mislabeled a schema problem."""
+    from coda_tpu.telemetry.recorder import SUPPORTED_SESSION_VERSIONS
+
+    v = meta.get("v")
+    if v is not None and v not in SUPPORTED_SESSION_VERSIONS:
+        return (f"stream schema v{v}; this build replays "
+                f"v{list(SUPPORTED_SESSION_VERSIONS)}")
+    return None
+
+
 # ---------------------------------------------------------------------------
 # array <-> JSON-safe codec for snapshot carries
 # ---------------------------------------------------------------------------
@@ -121,8 +138,15 @@ def check_row(recorded: dict, replayed: dict, round_i: int,
     through the identical compiled step admits nothing less — and a NaN
     poisoned into the recorded stream can never silently verify against a
     finite replay)."""
+    def _as_list(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v]
+
     for q in _INT_QUANTITIES:
-        if int(recorded[q]) != int(replayed[q]):
+        # batch-label rows carry q-wide lists (next_idx of a q>1 bucket);
+        # compare element-exact either way
+        rec_l, rep_l = _as_list(recorded[q]), _as_list(replayed[q])
+        if len(rec_l) != len(rep_l) or any(
+                int(x) != int(y) for x, y in zip(rec_l, rep_l)):
             raise ReplayMismatch(
                 f"session {sid} round {round_i}: {q} recorded "
                 f"{recorded[q]} != replayed {replayed[q]}")
@@ -135,7 +159,9 @@ def check_row(recorded: dict, replayed: dict, round_i: int,
             raise ReplayMismatch(
                 f"session {sid} round {round_i}: {q} present on only one "
                 f"side (recorded {rec!r}, replayed {rep!r})")
-        if not _f32_bits_equal(rec, rep):
+        rec_l, rep_l = _as_list(rec), _as_list(rep)
+        if len(rec_l) != len(rep_l) or any(
+                not _f32_bits_equal(x, y) for x, y in zip(rec_l, rep_l)):
             raise ReplayMismatch(
                 f"session {sid} round {round_i}: {q} recorded {rec!r} != "
                 f"replayed {rep!r} (bitwise)")
@@ -155,10 +181,27 @@ def last_digest(rows) -> Optional[tuple]:
     return (rows[-1]["pbest_max"], rows[-1].get("pbest_entropy"))
 
 
+def _row_label_count(row: dict) -> int:
+    """Oracle answers a stream row committed: q for a batch-label row
+    (list-valued ``label``), else 1."""
+    if not row.get("do_update"):
+        return 0
+    lab = row.get("label")
+    return len(lab) if isinstance(lab, (list, tuple)) else 1
+
+
 def _request_from_row(row: dict) -> dict:
     if row.get("do_update"):
+        lab = row["label"]
+        if isinstance(lab, (list, tuple)):
+            # batch-label row (acq_batch > 1): the whole q-wide answer
+            # set replays through one dispatch, like it was applied
+            return {"do_update": True,
+                    "idx": [int(v) for v in row["labeled_idx"]],
+                    "label": [int(v) for v in lab],
+                    "prob": [float(v) for v in row["prob"]]}
         return {"do_update": True, "idx": int(row["labeled_idx"]),
-                "label": int(row["label"]), "prob": float(row["prob"])}
+                "label": int(lab), "prob": float(row["prob"])}
     return {"do_update": False}
 
 
@@ -252,6 +295,7 @@ def snapshot_fingerprint(bucket) -> dict:
         "jax_version": jax.__version__,
         "method": bucket.spec.method,
         "spec_kwargs": [list(kv) for kv in bucket.spec.kwargs],
+        "acq_batch": bucket.acq_batch,
         "shape": list(bucket.shape),
         "n_valid": bucket.n_valid,
         "step_impl": bucket.step_impl,
@@ -274,6 +318,7 @@ def build_export_payload(app, sess, snapshot=None) -> dict:
         "task": sess.task,
         "method": bucket.spec.method,
         "spec_kwargs": [list(kv) for kv in bucket.spec.kwargs],
+        "acq_batch": bucket.acq_batch,
         "seed": sess.seed,
         "dataset": {k: app.store.task_meta(sess.task).get(k)
                     for k in ("shape", "digest")},
@@ -295,7 +340,7 @@ def build_export_payload(app, sess, snapshot=None) -> dict:
         pass  # slab lost: the stream-only export is still complete
     rows = data_rows(app.recorder.history(sess.sid))
     payload["rows"] = rows
-    payload["n_labeled"] = sum(1 for r in rows if r.get("do_update"))
+    payload["n_labeled"] = sum(_row_label_count(r) for r in rows)
     payload["last"] = dict(rows[-1]) if rows else None
     return payload
 
@@ -367,7 +412,7 @@ def _finalize_restored(sess, rows) -> None:
     """Rebuild a restored session's host bookkeeping from its rows:
     label count, last result row, and the idempotency cache — a label the
     client retries across the migration must dedupe on the new server."""
-    sess.n_labeled = sum(1 for r in rows if r.get("do_update"))
+    sess.n_labeled = sum(_row_label_count(r) for r in rows)
     sess.last = dict(rows[-1]) if rows else {}
     for row in rows:
         rid = row.get("request_id")
@@ -414,6 +459,13 @@ def import_session(app, payload: dict, count: bool = True) -> dict:
             f"selector config mismatch: session ran "
             f"{payload['method']}{payload['spec_kwargs']}, this server "
             f"serves {app.spec.method}{[list(k) for k in app.spec.kwargs]}")
+    want_q = int(payload.get("acq_batch", 1))
+    if want_q != app.spec.acq_batch:
+        # a q-mismatched import would replay q-wide rows through a
+        # differently-shaped compiled step — reject with the real reason
+        raise ImportRejected(
+            f"acq_batch mismatch: session batches {want_q} label(s) per "
+            f"round, this server serves acq_batch={app.spec.acq_batch}")
     sid = payload.get("session")
     if not isinstance(sid, str) or not _SID_RE.match(sid):
         # an unchecked id would flow into a recorder file path AND create
@@ -457,6 +509,7 @@ def import_session(app, payload: dict, count: bool = True) -> dict:
         app.recorder.import_history(
             sess.sid, meta={"task": task, "method": payload["method"],
                             "spec_kwargs": payload["spec_kwargs"],
+                            "acq_batch": want_q,
                             "seed": sess.seed,
                             "shape": meta.get("shape"),
                             "digest": meta.get("digest"),
@@ -559,13 +612,12 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
         except Exception as e:
             report["failed"][sid] = f"unreadable stream: {e}"
             continue
-        v, want_v = meta.get("v"), _schema_version()
-        if v is not None and v != want_v:
-            # a pre-upgrade stream lacks the per-round digest fields; its
-            # replay would misreport them as divergence — name the real
-            # incompatibility instead
-            report["failed"][sid] = (f"stream schema v{v}; this build "
-                                     f"replays v{want_v}")
+        v_err = _stream_version_error(meta)
+        if v_err is not None:
+            # an unsupported stream version would replay with missing/
+            # mis-shaped fields and misreport them as divergence — name
+            # the real incompatibility instead
+            report["failed"][sid] = v_err
             continue
         if closed:
             report["skipped_closed"] += 1
@@ -603,6 +655,14 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
                     f"selector config mismatch: stream ran "
                     f"{meta['method']}{want_kw}, this server serves "
                     f"{app.spec.method}{have_kw}")
+            # a v2 (pre-batching) stream carries no acq_batch: it is an
+            # acq_batch=1 stream by construction
+            want_q = int(meta.get("acq_batch", 1))
+            if want_q != app.spec.acq_batch:
+                raise ImportRejected(
+                    f"acq_batch mismatch: stream batches {want_q} "
+                    f"label(s) per round, this server serves "
+                    f"acq_batch={app.spec.acq_batch}")
         except Exception as e:
             report["failed"][sid] = repr(e)
             continue
@@ -661,6 +721,7 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
                                     or app.spec.method,
                                     "spec_kwargs": meta.get("spec_kwargs")
                                     or [list(kv) for kv in app.spec.kwargs],
+                                    "acq_batch": app.spec.acq_batch,
                                     "seed": sess.seed,
                                     "shape": meta.get("shape"),
                                     "digest": meta.get("digest"),
@@ -834,10 +895,9 @@ def verify_session_stream(store, meta: dict, rows, sid: str = "?") -> dict:
 
     Returns ``{parity, rounds}``; raises :class:`ReplayMismatch` (or
     ValueError for a structurally unusable stream) otherwise."""
-    v, want_v = meta.get("v"), _schema_version()
-    if v is not None and v != want_v:
-        raise ValueError(f"stream schema v{v}; this build replays "
-                         f"v{want_v}")
+    v_err = _stream_version_error(meta)
+    if v_err is not None:
+        raise ValueError(v_err)
     task = meta.get("task")
     if task not in store.tasks():
         raise ValueError(f"stream's task {task!r} not loaded")
@@ -848,7 +908,9 @@ def verify_session_stream(store, meta: dict, rows, sid: str = "?") -> dict:
             f"dataset digest mismatch: stream recorded {want}, loaded "
             f"data hashes to {have}")
     kwargs = {k: v for k, v in (meta.get("spec_kwargs") or [])}
-    spec = SelectorSpec.create(meta.get("method", "coda"), **kwargs)
+    spec = SelectorSpec.create(meta.get("method", "coda"),
+                               acq_batch=int(meta.get("acq_batch", 1)),
+                               **kwargs)
     sess = store.open(task, spec, seed=int(meta.get("seed", 0)))
     try:
         rows = data_rows(rows)

@@ -355,6 +355,7 @@ class ServeApp:
         self.recorder.open(sess.sid, meta={
             "task": sess.task, "method": self.spec.method,
             "spec_kwargs": [list(kv) for kv in self.spec.kwargs],
+            "acq_batch": self.spec.acq_batch,
             "seed": sess.seed, "shape": tm.get("shape"),
             "digest": tm.get("digest")})
         # the start ticket carries a demotion pin (set BEFORE submit so a
@@ -464,14 +465,49 @@ class ServeApp:
             cur = sess.last
             if not cur:
                 raise UnknownSession(sid)  # start dispatch never completed
-            if idx is not None and int(idx) != cur["next_idx"]:
-                raise StaleItem(
-                    f"session {sid} proposed item {cur['next_idx']}, "
-                    f"got a label for {idx}")
-            label = int(label)
-            if not 0 <= label < sess.bucket.n_classes:
-                raise ValueError(f"label {label} out of range "
-                                 f"[0, {sess.bucket.n_classes})")
+            # batch-label sessions (acq_batch q > 1): the session proposes
+            # q items per round and ``label`` arrives as a length-q list —
+            # all q oracle answers resolve through this ONE ticket/dispatch
+            q = sess.bucket.acq_batch
+            if q > 1:
+                if not isinstance(label, (list, tuple)):
+                    raise ValueError(
+                        f"session {sid} batches {q} labels per round; "
+                        "POST /session/{id}/labels with a 'labels' list")
+                if len(label) != q:
+                    raise ValueError(
+                        f"session {sid} expects exactly {q} labels per "
+                        f"round, got {len(label)}")
+                if idx is not None:
+                    if (not isinstance(idx, (list, tuple))
+                            or [int(i) for i in idx]
+                            != [int(i) for i in cur["next_idx"]]):
+                        raise StaleItem(
+                            f"session {sid} proposed items "
+                            f"{cur['next_idx']}, got labels for {idx}")
+                label = [int(v) for v in label]
+                for v in label:
+                    if not 0 <= v < sess.bucket.n_classes:
+                        raise ValueError(
+                            f"label {v} out of range "
+                            f"[0, {sess.bucket.n_classes})")
+            else:
+                if isinstance(label, (list, tuple)):
+                    if len(label) != 1:
+                        raise ValueError(
+                            f"session {sid} labels one item per round, "
+                            f"got {len(label)} labels")
+                    label = label[0]
+                    if isinstance(idx, (list, tuple)):
+                        idx = idx[0] if idx else None
+                if idx is not None and int(idx) != cur["next_idx"]:
+                    raise StaleItem(
+                        f"session {sid} proposed item {cur['next_idx']}, "
+                        f"got a label for {idx}")
+                label = int(label)
+                if not 0 <= label < sess.bucket.n_classes:
+                    raise ValueError(f"label {label} out of range "
+                                     f"[0, {sess.bucket.n_classes})")
             ticket = Ticket(session=sess, do_update=True,
                             idx=cur["next_idx"],
                             label=label, prob=cur["next_prob"],
@@ -528,6 +564,28 @@ class ServeApp:
                 self._executor, self._label_begin, sid, label, idx,
                 request_id)
         return self._payload(sess, await ticket.wait_async(REQUEST_TIMEOUT_S))
+
+    def labels(self, sid: str, labels, idx=None,
+               request_id: Optional[str] = None) -> dict:
+        """The batch-label verb behind ``POST /session/{id}/labels``: all
+        q oracle answers of one round, resolved through ONE ticket and
+        ONE fused dispatch (the q-wide bucket's compiled step applies
+        them as a single multi-row posterior update and proposes the next
+        q items). Idempotent per ``request_id`` exactly like ``label`` —
+        the batch commits to the posterior at most once no matter how
+        many times the client retries. On an acq_batch=1 session a
+        single-element list degrades to the plain label path.
+
+        ``_label_begin`` is list-aware, so both verbs ARE the label
+        verbs with a list payload — no second copy of the pin/dedupe/
+        wake choreography to keep in lockstep."""
+        return self.label(sid, list(labels), idx=idx,
+                          request_id=request_id)
+
+    async def labels_async(self, sid: str, labels, idx=None,
+                           request_id: Optional[str] = None) -> dict:
+        return await self.label_async(sid, list(labels), idx=idx,
+                                      request_id=request_id)
 
     def best(self, sid: str) -> dict:
         sess = self._resolve_pinned(sid)  # wakes a parked session
@@ -777,7 +835,7 @@ class StaleItem(ValueError):
 
 
 _SESSION_RE = re.compile(
-    r"^/session/([0-9a-f]+)(/(label|best|trace|export))?$")
+    r"^/session/([0-9a-f]+)(/(label|labels|best|trace|export))?$")
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             409: "Conflict", 500: "Internal Server Error",
@@ -996,6 +1054,14 @@ class AsyncHTTPServer:
             return await app.label_async(m.group(1), req["label"],
                                          idx=req.get("idx"),
                                          request_id=req.get("request_id"))
+        if m and method == "POST" and m.group(3) == "labels":
+            # batch of oracle answers, one dispatch (see ServeApp.labels)
+            req = json.loads(raw or b"{}")
+            if not isinstance(req.get("labels"), list) or not req["labels"]:
+                raise ValueError("missing non-empty 'labels' list")
+            return await app.labels_async(m.group(1), req["labels"],
+                                          idx=req.get("idx"),
+                                          request_id=req.get("request_id"))
         if m and method == "POST" and m.group(3) == "export":
             req = json.loads(raw or b"{}")
             return await loop.run_in_executor(
@@ -1033,6 +1099,13 @@ def parse_args(argv=None):
     p.add_argument("--method", default="coda",
                    help="selector behind every session "
                         "{coda, iid, uncertainty, model_picker, ...}")
+    p.add_argument("--acq-batch", type=int, default=1, metavar="Q",
+                   help="labels per round per session (default 1). Q > 1 "
+                        "sessions propose Q items per round and accept "
+                        "all Q oracle answers through ONE "
+                        "POST /session/{id}/labels dispatch (fused "
+                        "multi-row posterior update) — the serving face "
+                        "of --acq-batch")
     p.add_argument("--capacity", type=int, default=64,
                    help="slab slots per bucket = max HOT (resident) "
                         "sessions per (task, config); admission past it "
@@ -1155,7 +1228,9 @@ def build_app(args) -> ServeApp:
         capacity=args.capacity, bucket_n=args.bucket_n,
         max_batch=args.max_batch, max_wait=args.max_wait_ms / 1e3,
         max_linger=(None if max_linger_ms is None else max_linger_ms / 1e3),
-        spec=SelectorSpec.create(args.method, **spec_kwargs),
+        spec=SelectorSpec.create(args.method,
+                                 acq_batch=getattr(args, "acq_batch", 1),
+                                 **spec_kwargs),
         step_impl=getattr(args, "step_impl", None),
         donate=not getattr(args, "no_donate", False),
         telemetry=telemetry, recorder=recorder,

@@ -66,14 +66,26 @@ class SelectorSpec:
 
     ``kwargs`` is a sorted tuple of (name, value) pairs so equal configs
     compare equal (dicts don't hash); use :meth:`create` to build one.
+
+    ``acq_batch`` is the labels-per-round width of the bucket's compiled
+    step (the serving face of ``--acq-batch``): a q > 1 bucket's slab
+    step applies q oracle answers per slot through one fused update and
+    proposes the next q points per round, so sessions at different q
+    never share an executable. Part of the spec (not ``kwargs``) because
+    it is an ENGINE knob, not a selector hyperparameter.
     """
 
     method: str = "coda"
     kwargs: tuple = ()
+    acq_batch: int = 1
 
     @classmethod
-    def create(cls, method: str = "coda", **kwargs) -> "SelectorSpec":
-        return cls(method=method, kwargs=tuple(sorted(kwargs.items())))
+    def create(cls, method: str = "coda", acq_batch: int = 1,
+               **kwargs) -> "SelectorSpec":
+        if int(acq_batch) < 1:
+            raise ValueError(f"acq_batch must be >= 1, got {acq_batch}")
+        return cls(method=method, kwargs=tuple(sorted(kwargs.items())),
+                   acq_batch=int(acq_batch))
 
     def factory(self):
         """``preds -> Selector`` (the cli.build_selector_factory contract,
@@ -279,7 +291,20 @@ class Bucket:
         self.shape = (H, N, C)
         self.n_valid = N if n_valid is None else int(n_valid)
         self.n_classes = C
-        self.selector = spec.factory()(self.preds)
+        # batch-label buckets (spec.acq_batch > 1) compile the q-wide
+        # selector: select proposes (q,) points per round, update applies
+        # (q,) answers as one fused multi-row posterior update — the slab
+        # step and every downstream read are shape-generic, so nothing
+        # else here knows about q beyond the request/result marshaling
+        self.acq_batch = max(1, int(getattr(spec, "acq_batch", 1)))
+        base_selector = spec.factory()(self.preds)
+        if self.acq_batch > 1:
+            from coda_tpu.selectors.batch import make_batched_selector
+
+            self.selector = make_batched_selector(base_selector,
+                                                  self.acq_batch)
+        else:
+            self.selector = base_selector
         self._init = jax.jit(self.selector.init)
         # donated slab buffers: the step's (states, keys) carry is updated
         # in place instead of allocating a fresh slab copy per tick (the
@@ -412,10 +437,12 @@ class Bucket:
                         "seconds": self.warm_s or 0.0}
             t0 = _time.perf_counter()
             S = self.capacity
+            lane = (S,) if self.acq_batch == 1 else (S, self.acq_batch)
             req = SlotRequest(
                 pending=jnp.zeros(S, bool), do_update=jnp.zeros(S, bool),
-                idx=jnp.zeros(S, jnp.int32), label=jnp.zeros(S, jnp.int32),
-                prob=jnp.zeros(S, jnp.float32))
+                idx=jnp.zeros(lane, jnp.int32),
+                label=jnp.zeros(lane, jnp.int32),
+                prob=jnp.zeros(lane, jnp.float32))
             # NOTE: after lower().compile(), dispatch must call the
             # RETURNED executable — calling the jit-wrapped function again
             # would trace and compile a second, separate program
@@ -608,17 +635,27 @@ class Bucket:
         t0 = _time.perf_counter()
         self._apply_staged()  # admissions since the last slab access
         S = self.capacity
+        q = self.acq_batch
+        lane = (S,) if q == 1 else (S, q)
         pending = np.zeros(S, bool)
         do_update = np.zeros(S, bool)
-        idx = np.zeros(S, np.int32)
-        label = np.zeros(S, np.int32)
-        prob = np.zeros(S, np.float32)
+        idx = np.zeros(lane, np.int32)
+        label = np.zeros(lane, np.int32)
+        prob = np.zeros(lane, np.float32)
         for slot, r in requests.items():
             pending[slot] = True
             do_update[slot] = bool(r.get("do_update", False))
-            idx[slot] = r.get("idx", 0)
-            label[slot] = r.get("label", 0)
-            prob[slot] = r.get("prob", 0.0)
+            # q > 1 buckets carry q-wide label batches per request (the
+            # batch-label verb); values arrive as length-q lists
+            idx[slot] = r.get("idx", 0) if q == 1 else np.asarray(
+                r.get("idx") if r.get("idx") is not None else [0] * q,
+                np.int32)
+            label[slot] = r.get("label", 0) if q == 1 else np.asarray(
+                r.get("label") if r.get("label") is not None else [0] * q,
+                np.int32)
+            prob[slot] = r.get("prob", 0.0) if q == 1 else np.asarray(
+                r.get("prob") if r.get("prob") is not None else [0.0] * q,
+                np.float32)
         req = SlotRequest(
             pending=jnp.asarray(pending), do_update=jnp.asarray(do_update),
             idx=jnp.asarray(idx), label=jnp.asarray(label),
@@ -669,10 +706,20 @@ class Bucket:
         t2 = _time.perf_counter()
         self.last_timing = {"build_s": t1 - t0, "step_s": t2 - t1}
         has_digest = self._get_pbest is not None
+
+        def _next(arr, slot):
+            # q-wide buckets propose (q,) next points per round; the host
+            # row carries them as plain lists (JSON/recorder-safe)
+            if q == 1:
+                return (int(arr[slot]) if arr.dtype.kind in "iu"
+                        else float(arr[slot]))
+            return [int(v) for v in arr[slot]] if arr.dtype.kind in "iu" \
+                else [float(v) for v in arr[slot]]
+
         return {
             slot: {
-                "next_idx": int(out.next_idx[slot]),
-                "next_prob": float(out.next_prob[slot]),
+                "next_idx": _next(out.next_idx, slot),
+                "next_prob": _next(out.next_prob, slot),
                 "best": int(out.best[slot]),
                 "stochastic": bool(out.stochastic[slot]),
                 "pbest_max": (float(out.pbest_max[slot]) if has_digest

@@ -59,12 +59,28 @@ import numpy as np
 # Batch records (record.json + rounds.npz) and serving-session JSONL
 # streams version INDEPENDENTLY — a stream-only field change must not
 # invalidate every previously captured batch record.
-RECORD_SCHEMA_VERSION = 1
+# v2: batched acquisition (--acq-batch q): meta gained ``acq_batch`` and,
+# for q > 1, the per-round decision arrays chosen_idx / true_class /
+# select_prob carry a trailing (q,) axis (one entry per oracle answer of
+# the round). q = 1 records are v1's arrays exactly — v1 records load as
+# acq_batch=1 (the committed r12 captures stay replayable).
+RECORD_SCHEMA_VERSION = 2
+SUPPORTED_RECORD_VERSIONS = (1, 2)
 # v2: session-stream rows gained request_id + pbest_max/pbest_entropy
 # (the in-step posterior digest) and the session_close marker kind — a v1
 # stream replayed by this build would misreport the absent digests as a
-# divergence, so the version gate rejects it with the real reason instead
-SESSION_SCHEMA_VERSION = 2
+# divergence, so the version gate rejects it with the real reason instead.
+# v3: batch-label sessions (POST /session/{id}/labels): rows'
+# labeled_idx/label/prob and next_idx/next_prob may be q-wide LISTS, and
+# the session meta carries ``acq_batch`` — a v2 reader would replay a
+# batch row as a single mis-shaped label, so v3 streams gate out old
+# readers. The other direction is SAFE at q=1: v3 only ADDS fields there,
+# so a v2 stream replays bitwise on an acq_batch=1 server — restore
+# accepts it (a deploy must not discard every in-flight session) and
+# treats its missing ``acq_batch`` meta as 1; a v2 stream on a q>1
+# server is rejected with the real acq_batch-mismatch reason.
+SESSION_SCHEMA_VERSION = 3
+SUPPORTED_SESSION_VERSIONS = (2, 3)
 
 # the documented cross-backend score contract: pallas kernels vs the XLA
 # lowering agree on EIG scores to the MEASURED 2.34e-4 at the headline shape
@@ -72,8 +88,10 @@ SESSION_SCHEMA_VERSION = 2
 # across backends/knobs use this bound, same-backend replays demand bitwise
 CROSS_BACKEND_SCORE_TOL = 2.34e-4
 
-# every array a v1 rounds.npz must carry: name -> (dtype kind, ndim with the
-# leading seed axis). trace_k (the k of the top-k columns) lives in meta.
+# every array a rounds.npz must carry: name -> (dtype kind, ndim with the
+# leading seed axis) at acq_batch = 1. trace_k (the k of the top-k
+# columns) lives in meta; :func:`required_arrays` adjusts the ranks for
+# q-wide (acq_batch > 1) records.
 REQUIRED_ARRAYS = {
     "chosen_idx": ("i", 2),        # (S, T)
     "true_class": ("i", 2),        # (S, T)
@@ -98,6 +116,22 @@ REQUIRED_ARRAYS = {
 REQUIRED_META = ("schema_version", "fingerprint", "run", "trace_k",
                  "seeds", "rounds")
 
+# the per-round decision arrays that grow a trailing (q,) axis under
+# batched acquisition
+_BATCH_ARRAYS = ("chosen_idx", "true_class", "select_prob")
+
+
+def required_arrays(acq_batch: int = 1) -> dict:
+    """The REQUIRED_ARRAYS spec for a record's ``acq_batch``: at q > 1
+    the decision arrays are (S, T, q) instead of (S, T)."""
+    if acq_batch <= 1:
+        return dict(REQUIRED_ARRAYS)
+    out = dict(REQUIRED_ARRAYS)
+    for name in _BATCH_ARRAYS:
+        kind, ndim = out[name]
+        out[name] = (kind, ndim + 1)
+    return out
+
 # the knob subset of an argparse namespace worth fingerprinting: every flag
 # that can change the decision trace (numerics, acquisition, RNG layout)
 KNOB_FIELDS = (
@@ -105,7 +139,7 @@ KNOB_FIELDS = (
     "multiplier", "prefilter_n", "no_diag_prior", "q", "epsilon",
     "eig_chunk", "eig_mode", "eig_backend", "eig_precision",
     "eig_cache_dtype", "eig_refresh", "eig_entropy", "posterior",
-    "eig_pbest", "pi_update", "mesh",
+    "eig_pbest", "pi_update", "mesh", "acq_batch",
 )
 
 
@@ -224,7 +258,11 @@ class RunRecord:
             "init_key": np.asarray(aux.init_key, np.uint32).reshape(-1, 2),
             "prior_key": np.asarray(aux.prior_key, np.uint32).reshape(-1, 2),
         }
-        seeds, rounds = arrays["chosen_idx"].shape
+        # batched acquisition: (S, T, q) decision arrays carry their q in
+        # meta so readers never infer it from ranks alone
+        ci_shape = arrays["chosen_idx"].shape
+        seeds, rounds = ci_shape[0], ci_shape[1]
+        acq_batch = int(ci_shape[2]) if arrays["chosen_idx"].ndim == 3 else 1
         meta = {
             "schema_version": RECORD_SCHEMA_VERSION,
             "fingerprint": fingerprint,
@@ -232,6 +270,7 @@ class RunRecord:
             "trace_k": int(arrays["topk_idx"].shape[-1]),
             "seeds": int(seeds),
             "rounds": int(rounds),
+            "acq_batch": acq_batch,
         }
         if extra_meta:
             meta.update(extra_meta)
@@ -267,10 +306,10 @@ class RunRecord:
         with open(os.path.join(in_dir, "record.json")) as f:
             meta = json.load(f)
         v = meta.get("schema_version")
-        if v != RECORD_SCHEMA_VERSION:
+        if v not in SUPPORTED_RECORD_VERSIONS:
             raise ValueError(
                 f"record at {in_dir!r} has schema_version={v!r}; this build "
-                f"reads v{RECORD_SCHEMA_VERSION} — re-record or use a "
+                f"reads v{SUPPORTED_RECORD_VERSIONS} — re-record or use a "
                 "matching checkout")
         with np.load(os.path.join(in_dir, "rounds.npz")) as z:
             arrays = {k: z[k] for k in z.files}
@@ -284,6 +323,11 @@ class RunRecord:
     @property
     def rounds(self) -> int:
         return int(self.meta["rounds"])
+
+    @property
+    def acq_batch(self) -> int:
+        """Labels per round (1 for v1 records, which predate batching)."""
+        return int(self.meta.get("acq_batch", 1))
 
     def seed_arrays(self, s: int) -> dict:
         """The per-round arrays of one seed (no leading axis)."""

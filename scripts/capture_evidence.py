@@ -166,6 +166,15 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                  "--posterior", "sparse:16", "--skip-reference",
                  "--reps", "2"] + plat,
                 None, 900),
+            # batched acquisition at smoke scale: digits q=4 envelope +
+            # the smoke-shape throughput probe (the committed floors live
+            # in the full BENCH_BATCHQ_* capture)
+            "bench_batchq": (
+                [py, "scripts/bench_batchq.py", "--quick",
+                 "--out", os.path.join(tmpdir, "batchq.json"),
+                 "--records-dir", os.path.join(tmpdir, "batchq_records")]
+                + plat,
+                os.path.join(tmpdir, "batchq.json"), 900),
         }
     return {
         # the r09 evidence set the ROADMAP asks for, in one run
@@ -204,6 +213,14 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
             [py, "bench.py", "--config", "imagenet",
              "--posterior", "sparse:32", "--skip-reference"] + plat,
             None, 3600),
+        # batched acquisition in full: digits q ∈ {4, 8} regret envelope
+        # + the q=8 imagenet-preset labels/s floor, replay-triaged
+        "bench_batchq": (
+            [py, "scripts/bench_batchq.py",
+             "--out", os.path.join(tmpdir, "batchq.json"),
+             "--records-dir", os.path.join(tmpdir, "batchq_records")]
+            + plat,
+            os.path.join(tmpdir, "batchq.json"), 3600),
     }
 
 

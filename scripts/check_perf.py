@@ -28,9 +28,12 @@ Runnable standalone (no args = gate the whole repo root)::
 
     python scripts/check_perf.py
     python scripts/check_perf.py EVIDENCE_cpu_r11.json   # one artifact
+    python scripts/check_perf.py --family serve          # one family only
 
-``scripts/check_serve_bench.py`` remains as a thin shim over the serve
-contract here (its documented standalone invocation still works).
+``--family`` filters to one contract group (``serve``, ``batchq``, ...)
+— the old ``check_serve_bench.py`` shim's standalone invocation is now
+``--family serve``; the serve thresholds still live here under the same
+names.
 """
 
 from __future__ import annotations
@@ -286,13 +289,85 @@ def _imagenet_sparse_check(report: dict) -> list[str]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# the batched-acquisition contract (ISSUE 12 acceptance: BENCH_BATCHQ_*
+# holds the regret-parity envelope + the labels/s speedup floor)
+# ---------------------------------------------------------------------------
+
+# labels/s speedup at the artifact's headline q must clear frac * q
+BATCHQ_SPEEDUP_FRAC = 0.6
+# the declared real-digits regret envelope (label-weighted final
+# cumulative regret at q vs q=1): ratio + absolute slack, matching the
+# generator's declaration (scripts/bench_batchq.py)
+BATCHQ_ENVELOPE_RATIO = 1.5
+BATCHQ_ENVELOPE_ABS = 1.0
+
+
+def batchq_check_report(report: dict) -> list[str]:
+    """Violations of one batchq capture (empty = clean): the speedup
+    floor at the headline q, the regret envelope held per batched q with
+    every divergence replay-triaged through the ``--against`` path, and
+    bitwise self-replay of every recorded q-wide program."""
+    out: list[str] = []
+    if report.get("quick"):
+        return ["quick batchq captures must not be committed at the repo "
+                "root (no committed floors were checked)"]
+    im = report.get("imagenet") or {}
+    q = im.get("q")
+    speedup = report.get("labels_per_s_speedup")
+    if not isinstance(q, int) or q < 8:
+        out.append(f"imagenet.q {q!r} < 8 (the committed floor is "
+                   "measured at q=8)")
+    if not isinstance(speedup, (int, float)):
+        out.append("labels_per_s_speedup missing")
+    elif isinstance(q, int) and speedup < BATCHQ_SPEEDUP_FRAC * q:
+        out.append(f"labels_per_s_speedup {speedup:.2f} < "
+                   f"{BATCHQ_SPEEDUP_FRAC} * q = "
+                   f"{BATCHQ_SPEEDUP_FRAC * q:.2f}")
+    dig = report.get("digits") or {}
+    per_q = dig.get("per_q") or {}
+    if "1" not in per_q or len(per_q) < 2:
+        out.append("digits.per_q must carry q=1 and at least one "
+                   "batched q")
+        return out
+    base = (per_q.get("1") or {}).get("final_cum_regret_mean")
+    for key, row in per_q.items():
+        rep = row.get("replay") or {}
+        if rep.get("parity") is not True:
+            out.append(f"digits.per_q[{key}].replay.parity is not true "
+                       "(every recorded q-wide program must self-replay "
+                       "bitwise)")
+        if key == "1":
+            continue
+        against = row.get("against_q1") or {}
+        if against.get("classification") != "acq-batch-envelope":
+            out.append(
+                f"digits.per_q[{key}].against_q1.classification "
+                f"{against.get('classification')!r} — the q-vs-1 "
+                "divergence must be triaged through the replay "
+                "--against knob-diff path")
+        mean = row.get("final_cum_regret_mean")
+        if not isinstance(mean, (int, float)) or \
+                not isinstance(base, (int, float)):
+            out.append(f"digits.per_q[{key}].final_cum_regret_mean "
+                       "missing")
+        elif mean > BATCHQ_ENVELOPE_RATIO * base + BATCHQ_ENVELOPE_ABS:
+            out.append(
+                f"digits.per_q[{key}] final cum regret {mean:.4f} "
+                f"outside the committed envelope "
+                f"({BATCHQ_ENVELOPE_RATIO} * {base:.4f} + "
+                f"{BATCHQ_ENVELOPE_ABS})")
+    return out
+
+
 EVIDENCE_SCHEMA_VERSION = 1
 EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
                        "multichip_replay")
 # components newer manifests carry; checked when present (r11 predates
 # them, and an absent optional component is a capture-config choice the
 # manifest's own "skipped" list records)
-EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered")
+EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered",
+                                "bench_batchq")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -329,6 +404,14 @@ def _evidence_check(report: dict) -> list[str]:
         if not ((rep.get("tiering") or {}).get("wakes")):
             out.append("serve_tiered.report.tiering.wakes is 0/missing "
                        "(the paged store went unexercised)")
+    rep = (arts.get("bench_batchq") or {}).get("report") or {}
+    if rep:
+        if rep.get("ok") is not True:
+            out.append("bench_batchq.report.ok is not true (regret "
+                       "envelope / replay verification / speedup floor "
+                       "broke in-capture)")
+        if rep.get("replays_verified") is not True:
+            out.append("bench_batchq.report.replays_verified is not true")
     rep = (arts.get("bench") or {}).get("report") or {}
     if rep and not (isinstance(rep.get("value"), (int, float))
                     and rep["value"] > 0):
@@ -399,6 +482,25 @@ CONTRACTS: tuple = (
         bounds=(("value", ">", 0), ("matched_linearity_ok", "==", True),
                 ("vs_baseline", ">=", 1.0)),
         note="same-hardware CPU comparison vs the PyTorch reference"),
+    # -- batched top-q acquisition --
+    Contract(
+        pattern="BENCH_BATCHQ_*.json", kind="batchq",
+        required=("bench", "wall_s", "config", "digits.label_budget",
+                  "digits.per_q", "digits.envelope.ok",
+                  "imagenet.q1.round_s_marginal",
+                  "imagenet.labels_per_s_speedup",
+                  "labels_per_s_speedup", "regret_envelope_ok",
+                  "replays_verified", "divergences_triaged", "ok"),
+        bounds=(("ok", "==", True),
+                ("regret_envelope_ok", "==", True),
+                ("replays_verified", "==", True),
+                ("divergences_triaged", "==", True)),
+        checker=batchq_check_report, fingerprint="required",
+        group="batchq",
+        regress=("labels_per_s_speedup", "higher", 0.25),
+        note="q oracle labels per round: labels/s speedup >= 0.6*q at "
+             "q=8 on the imagenet preset, real-digits regret within the "
+             "declared envelope of q=1, divergences replay-triaged"),
     # -- recorder overhead --
     Contract(
         pattern="BENCH_RECORDER_*.json", kind="recorder_overhead",
@@ -611,19 +713,25 @@ def discover(root: str) -> list[str]:
     return sorted(paths)
 
 
-def check_root(root: str, notes: Optional[list] = None) -> list[str]:
+def check_root(root: str, notes: Optional[list] = None,
+               family: Optional[str] = None) -> list[str]:
     """Gate every committed artifact at ``root``: per-artifact contracts,
     contract coverage (an unregistered BENCH_/EVIDENCE_ file fails), and
-    the cross-round regression comparison."""
+    the cross-round regression comparison. ``family`` restricts to one
+    contract group (coverage of other files is then not checked)."""
     out: list[str] = []
     triples = []
     for path in discover(root):
         base = os.path.basename(path)
         contract = match_contract(path)
         if contract is None:
-            out.append(f"{base}: no contract entry in "
-                       "scripts/check_perf.py (new artifacts must declare "
-                       "their claim — add a Contract for this file)")
+            if family is None:
+                out.append(f"{base}: no contract entry in "
+                           "scripts/check_perf.py (new artifacts must "
+                           "declare their claim — add a Contract for "
+                           "this file)")
+            continue
+        if family is not None and contract.group != family:
             continue
         try:
             with open(path) as f:
@@ -639,8 +747,22 @@ def check_root(root: str, notes: Optional[list] = None) -> list[str]:
 
 
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    family = None
+    if "--family" in argv:
+        i = argv.index("--family")
+        try:
+            family = argv[i + 1]
+        except IndexError:
+            print("--family needs a contract group name (serve, batchq, "
+                  "suite, ...)")
+            return 64
+        del argv[i:i + 2]
+        groups = {c.group for c in CONTRACTS if c.group}
+        if family not in groups:
+            print(f"unknown family {family!r}; known: {sorted(groups)}")
+            return 64
     notes: list = []
     if argv:
         bad = 0
@@ -648,6 +770,11 @@ def main(argv=None) -> int:
             contract = match_contract(path)
             if contract is None:
                 print(f"{path}: no contract entry matches this filename")
+                bad += 1
+                continue
+            if family is not None and contract.group != family:
+                print(f"{path}: contract group {contract.group!r} != "
+                      f"requested family {family!r}")
                 bad += 1
                 continue
             try:
@@ -667,18 +794,19 @@ def main(argv=None) -> int:
             return 1
         print(f"perf gate clean: {len(argv)} artifact(s)")
         return 0
-    violations = check_root(repo, notes)
+    violations = check_root(repo, notes, family=family)
     for n in notes:
         print(f"note: {n}")
     for v in violations:
         print(v)
+    scope = f" ({family} family)" if family else ""
     n_artifacts = len(discover(repo))
     if violations:
-        print(f"perf gate FAILED: {len(violations)} violation(s) across "
-              f"{n_artifacts} artifact(s)")
+        print(f"perf gate FAILED: {len(violations)} violation(s)"
+              f"{scope}")
         return 1
-    print(f"perf gate clean: {n_artifacts} committed artifact(s), every "
-          "claim declared and within bounds")
+    print(f"perf gate clean{scope}: {n_artifacts} committed artifact(s) "
+          "discovered, every gated claim declared and within bounds")
     return 0
 
 

@@ -5,7 +5,9 @@ evidence: a record that silently drifted from the schema — missing version
 stamp, renamed array, wrong dtype/rank, seed/round counts that disagree
 between meta and arrays — would make ``cli replay`` triage garbage instead
 of failing loudly. This checker walks a directory tree and validates every
-artifact it finds against the versioned v1 schema:
+artifact it finds against the versioned schema (record v1/v2 — v2 adds the
+``acq_batch`` stamp and q-wide decision arrays; session streams at the
+current version only):
 
   * ``record.json`` + ``rounds.npz`` pairs (batch/suite records): version
     stamp, required meta fields, every REQUIRED_ARRAYS entry present with
@@ -42,9 +44,9 @@ def check_record(dir_path: str) -> list[str]:
     import numpy as np
 
     from coda_tpu.telemetry.recorder import (
-        RECORD_SCHEMA_VERSION,
-        REQUIRED_ARRAYS,
         REQUIRED_META,
+        SUPPORTED_RECORD_VERSIONS,
+        required_arrays,
     )
 
     out: list[str] = []
@@ -58,9 +60,15 @@ def check_record(dir_path: str) -> list[str]:
     v = meta.get("schema_version")
     if v is None:
         out.append("record.json has no schema_version stamp")
-    elif v != RECORD_SCHEMA_VERSION:
-        out.append(f"schema_version {v!r} != supported "
-                   f"{RECORD_SCHEMA_VERSION}")
+    elif v not in SUPPORTED_RECORD_VERSIONS:
+        out.append(f"schema_version {v!r} not in supported "
+                   f"{list(SUPPORTED_RECORD_VERSIONS)}")
+    # v2 must stamp acq_batch; v1 predates batching and reads as q=1
+    q = meta.get("acq_batch", 1)
+    if v == 2 and not isinstance(meta.get("acq_batch"), int):
+        out.append("v2 record.json missing integer 'acq_batch'")
+        q = 1
+    REQUIRED_ARRAYS = required_arrays(q if isinstance(q, int) else 1)
     for key in REQUIRED_META:
         if key not in meta:
             out.append(f"record.json missing required field {key!r}")
@@ -98,6 +106,11 @@ def check_record(dir_path: str) -> list[str]:
                 and a.ndim == 3 and a.shape[2] != k:
             out.append(f"{name}: top-k extent {a.shape[2]} != "
                        f"meta trace_k {k}")
+        if name in ("chosen_idx", "true_class", "select_prob") \
+                and isinstance(q, int) and q > 1 and a.ndim == 3 \
+                and a.shape[2] != q:
+            out.append(f"{name}: label-batch extent {a.shape[2]} != "
+                       f"meta acq_batch {q}")
     extra = set(arrays) - set(REQUIRED_ARRAYS)
     if extra:
         out.append(f"unversioned field drift: unexpected arrays "
@@ -107,7 +120,7 @@ def check_record(dir_path: str) -> list[str]:
 
 def check_session_stream(fp: str) -> list[str]:
     """Violations of one serving-session JSONL stream."""
-    from coda_tpu.telemetry.recorder import SESSION_SCHEMA_VERSION
+    from coda_tpu.telemetry.recorder import SUPPORTED_SESSION_VERSIONS
 
     out: list[str] = []
     try:
@@ -126,9 +139,9 @@ def check_session_stream(fp: str) -> list[str]:
         v = row.get("v")
         if v is None:
             out.append(f"line {i}: no 'v' version stamp")
-        elif v != SESSION_SCHEMA_VERSION:
-            out.append(f"line {i}: v={v!r} != supported "
-                       f"{SESSION_SCHEMA_VERSION}")
+        elif v not in SUPPORTED_SESSION_VERSIONS:
+            out.append(f"line {i}: v={v!r} not in supported "
+                       f"{list(SUPPORTED_SESSION_VERSIONS)}")
         kind = row.get("kind")
         if kind is not None:
             # marker lines: the open header and the clean-close marker

@@ -79,6 +79,9 @@ class InprocClient:
     def label(self, sid, label, request_id=None):
         return self.app.label(sid, label, request_id=request_id)
 
+    def labels(self, sid, labels, request_id=None):
+        return self.app.labels(sid, labels, request_id=request_id)
+
     def close(self, sid):
         app = self.app
         out = app.close_session(sid)
@@ -120,6 +123,12 @@ class HttpClient:
         if request_id is not None:
             body["request_id"] = request_id
         return self._req("POST", f"/session/{sid}/label", body)
+
+    def labels(self, sid, labels, request_id=None):
+        body = {"labels": list(labels)}
+        if request_id is not None:
+            body["request_id"] = request_id
+        return self._req("POST", f"/session/{sid}/labels", body)
 
     def close(self, sid):
         return self._req("DELETE", f"/session/{sid}")
@@ -264,6 +273,71 @@ def _free_run(client, n_classes, workers, sessions, labels_per_session,
                 if sid is not None:
                     # free the slot: capacity == workers, so one leaked
                     # session would starve every later open into SlabFull
+                    try:
+                        client.close(sid)
+                    except Exception:
+                        pass
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _batch_run(client, n_classes, workers, sessions, rounds, q,
+               latencies, label_latencies, errors, retries=0,
+               backoff_s=0.05, retried=None):
+    """``--labels-per-round q`` mode: the free-run arrival model driving
+    the batch-label verb — each session answers all q proposed items of a
+    round through ONE ``POST /session/{id}/labels``, ``rounds`` times.
+    Per-request latencies land in ``latencies`` (the existing rings);
+    each request also contributes q amortized per-label samples
+    (request latency / q) to ``label_latencies`` — the effective
+    time-per-oracle-answer the batching exists to shrink."""
+    counter = {"next": 0}
+    lock = threading.Lock()
+
+    def take():
+        with lock:
+            s = counter["next"]
+            if s >= sessions:
+                return None
+            counter["next"] = s + 1
+            return s
+
+    def worker():
+        while True:
+            seed = take()
+            if seed is None:
+                return
+            sid = None
+            try:
+                t0 = time.perf_counter()
+                out = with_retries(lambda: client.open(seed),
+                                   retries, backoff_s, retried)
+                sid = out["session"]
+                latencies.append(time.perf_counter() - t0)
+                for _ in range(rounds):
+                    t0 = time.perf_counter()
+                    ans = [int(i) % n_classes for i in out["idx"]]
+                    rid = uuid.uuid4().hex
+                    out = with_retries(
+                        lambda: client.labels(sid, ans, request_id=rid),
+                        retries, backoff_s, retried)
+                    dt = time.perf_counter() - t0
+                    latencies.append(dt)
+                    label_latencies.extend([dt / q] * q)
+                n = out.get("n_labeled")
+                if n is not None and n != rounds * q:
+                    errors.append(
+                        f"session {sid}: server applied {n} labels, "
+                        f"client issued {rounds * q}")
+                client.close(sid)
+                sid = None
+            except Exception as e:
+                errors.append(repr(e))
+                if sid is not None:
                     try:
                         client.close(sid)
                     except Exception:
@@ -635,6 +709,18 @@ def run_loadgen(args) -> dict:
 
     app = srv = None
     warm_s = None
+    lpr = getattr(args, "labels_per_round", None)
+    if lpr is not None and lpr > 1:
+        if args.lockstep or args.mux or getattr(args, "zipf", None) \
+                is not None:
+            # those arrival models drive the single-label verb, which a
+            # q-wide session refuses — reject the combination instead of
+            # producing a 100%-error report
+            raise SystemExit("--labels-per-round has its own arrival "
+                             "model; drop --lockstep/--mux/--zipf")
+        # the batch-label workload needs batch-label sessions: the served
+        # spec's acq_batch IS the per-round width (build_app reads it)
+        args.acq_batch = lpr
     if args.url:
         client = HttpClient(args.url)
         n_classes = args.classes
@@ -705,6 +791,14 @@ def run_loadgen(args) -> dict:
              latencies, errors, ramp_s=args.ramp_s,
              retries=args.retries, backoff_s=backoff_s, retried=retried)
         mode = "mux"
+    elif lpr is not None and lpr > 1:
+        n_sessions = args.sessions
+        label_latencies: list = []
+        _batch_run(client, n_classes, args.workers, args.sessions,
+                   args.labels, lpr, latencies, label_latencies, errors,
+                   retries=args.retries, backoff_s=backoff_s,
+                   retried=retried)
+        mode = "batch"
     else:
         n_sessions = args.sessions
         _free_run(client, n_classes, args.workers, args.sessions,
@@ -790,6 +884,7 @@ def run_loadgen(args) -> dict:
         "zipf": getattr(args, "zipf", None),
         "think_ms": getattr(args, "think_ms", 0.0),
         "requests": getattr(args, "requests", None),
+        "labels_per_round": lpr,
         "task": args.task or args.synthetic or "default"})
     # per-bucket executable cost attribution (warm-pool harvest): which
     # side of the roofline the slab step sits on, machine-read
@@ -830,6 +925,24 @@ def run_loadgen(args) -> dict:
         # occupancy, paging counters, hot-set residency hit rate, wake
         # latency vs one tick, and peak RSS
         "tiering": tiering,
+        # batch-label evidence (--labels-per-round q): oracle-answer
+        # throughput and the amortized per-label latency distribution,
+        # alongside the per-request rings above
+        "batch": None if mode != "batch" else {
+            "labels_per_round": lpr,
+            "labels_total": n_sessions * args.labels * lpr,
+            "labels_per_s": n_sessions * args.labels * lpr / wall,
+            "per_label_latency_ms": {
+                "p50": float(np.percentile(
+                    np.asarray(label_latencies) * 1e3, 50))
+                if label_latencies else None,
+                "p99": float(np.percentile(
+                    np.asarray(label_latencies) * 1e3, 99))
+                if label_latencies else None,
+                "mean": float(np.mean(label_latencies) * 1e3)
+                if label_latencies else None,
+            },
+        },
         "server": {
             "dispatches": stats.get("dispatches"),
             "requests": stats.get("requests"),
@@ -896,7 +1009,17 @@ def parse_args(argv=None):
     p.add_argument("--sessions", type=int, default=64,
                    help="total sessions to run (free-run / mux modes)")
     p.add_argument("--labels", type=int, default=8,
-                   help="labels per session")
+                   help="labels per session (with --labels-per-round: "
+                        "ROUNDS per session, each carrying q labels)")
+    p.add_argument("--labels-per-round", type=int, default=None,
+                   metavar="Q",
+                   help="batch-label mode: serve acq_batch=Q sessions and "
+                        "answer each round's Q proposed items through ONE "
+                        "POST /session/{id}/labels (the fused multi-row "
+                        "update); reports labels/s and the amortized "
+                        "per-label latency next to the per-request rings. "
+                        "With --url the remote server must already run "
+                        "--acq-batch Q")
     p.add_argument("--lockstep", action="store_true",
                    help="barrier arrivals: every round of W labels rides "
                         "one dispatch (deterministic occupancy)")

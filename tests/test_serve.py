@@ -888,47 +888,55 @@ def test_serve_loadgen_mux_smoke(tmp_path):
     assert report["breakdown"]["spans"]["n_tick_spans"] >= 1
 
 
-def _load_check_serve_bench():
+def _load_check_perf():
     import importlib.util
     import os
+    import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     spec = importlib.util.spec_from_file_location(
-        "check_serve_bench",
-        os.path.join(repo, "scripts", "check_serve_bench.py"))
+        "check_perf_serve_test",
+        os.path.join(repo, "scripts", "check_perf.py"))
     mod = importlib.util.module_from_spec(spec)
+    # registered BEFORE exec: the module defines dataclasses, whose field
+    # resolution looks itself up through sys.modules
+    sys.modules["check_perf_serve_test"] = mod
     spec.loader.exec_module(mod)
     return mod
 
 
-def test_check_serve_bench_gates_committed_artifact():
-    """Tier-1 wiring of scripts/check_serve_bench.py: the committed
-    BENCH_SERVE_CPU_r09.json satisfies the schema and the committed
-    latency bounds (>= 256 sessions, 0 errors, p99 within the 10x-vs-r06
-    contract), and a regressed/degraded report is rejected."""
+def test_check_perf_serve_contract_gates_committed_artifact():
+    """Tier-1 wiring of the serve contract in the check_perf.py registry
+    (``check_serve_bench.py``'s shim was folded into ``--family serve``):
+    the committed BENCH_SERVE_CPU_r09.json satisfies the schema and the
+    committed latency bounds (>= 256 sessions, 0 errors, p99 within the
+    10x-vs-r06 contract), and a regressed/degraded report is rejected."""
     import copy
     import os
 
-    mod = _load_check_serve_bench()
+    mod = _load_check_perf()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(repo, "BENCH_SERVE_CPU_r09.json")
     with open(path) as f:
         report = json.load(f)
-    assert mod.check_report(report) == []
+    assert mod.serve_check_report(report) == []
 
     bad = copy.deepcopy(report)
     bad["latency_ms"]["p99"] = mod.P99_MS_MAX + 1
-    assert any("p99" in v for v in mod.check_report(bad))
+    assert any("p99" in v for v in mod.serve_check_report(bad))
     bad = copy.deepcopy(report)
     bad["n_errors"] = 3
-    assert any("n_errors" in v for v in mod.check_report(bad))
+    assert any("n_errors" in v for v in mod.serve_check_report(bad))
     bad = copy.deepcopy(report)
     del bad["breakdown"]
-    assert any("breakdown" in v for v in mod.check_report(bad))
+    assert any("breakdown" in v for v in mod.serve_check_report(bad))
     bad = copy.deepcopy(report)
     bad["warm_pool"]["misses"] = 2
-    assert any("misses" in v for v in mod.check_report(bad))
-    assert mod.main([path]) == 0
+    assert any("misses" in v for v in mod.serve_check_report(bad))
+    # the folded CLI: the old check_serve_bench default invocation is now
+    # `check_perf.py --family serve` (no args = the committed serve set)
+    assert mod.main(["--family", "serve"]) == 0
+    assert mod.main(["--family", "serve", path]) == 0
 
 
 def test_serve_pause_holds_even_full_batches(serve_task):
