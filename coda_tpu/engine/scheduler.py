@@ -124,6 +124,99 @@ def estimate_cost(family: str, method: str, n_tasks: int,
     return max(rate * w_m * n_tasks, 1e-9)
 
 
+def plan_fleet_schedule(costs: Sequence[float],
+                        host_weights: Sequence[float],
+                        schedule: str = "lpt"):
+    """Host-level half of two-level fleet placement: assign chunks to
+    HOSTS by weighted least-normalized-load greedy.
+
+    ``host_weights`` is each host's relative capacity — its local device
+    count for a homogeneous fleet, or a measured throughput ratio for a
+    mixed one (a v5e-8 host takes ~8x the work of a 1-chip host). LPT
+    order + argmin of ``load[h] / weight[h]`` generalizes
+    :func:`plan_schedule`'s makespan heuristic to unequal hosts; with
+    all weights 1 it reduces to it exactly. Returns
+    ``(order, host_assignment, loads)`` with loads UN-normalized (the
+    estimated work per host a cross-host dispatcher ships).
+    """
+    if schedule not in ("lpt", "fifo"):
+        raise ValueError(f"unknown schedule {schedule!r}; use 'lpt'|'fifo'")
+    weights = [float(w) for w in host_weights]
+    if not weights or any(w <= 0 for w in weights):
+        raise ValueError(f"host weights must be positive, got {weights}")
+    idx = list(range(len(costs)))
+    if schedule == "lpt":
+        idx.sort(key=lambda i: (-costs[i], i))
+    loads = [0.0] * len(weights)
+    assignment = [0] * len(costs)
+    for i in idx:
+        h = min(range(len(weights)),
+                key=lambda j: (loads[j] / weights[j], j))
+        assignment[i] = h
+        loads[h] += costs[i]
+    return idx, assignment, loads
+
+
+def partition_hosts(n_devices: int, hosts) -> list[list[int]]:
+    """Device-index groups for a ``hosts`` spec: an int splits the local
+    devices into that many near-equal contiguous groups (the in-process
+    stand-in for N fleet hosts — the virtual-device tests and the
+    container demo); a sequence of sequences names explicit per-host
+    device index sets (the multi-process fleet shape, where each entry
+    is one host's local devices)."""
+    if isinstance(hosts, int):
+        if not 1 <= hosts <= n_devices:
+            raise ValueError(f"hosts={hosts} but only {n_devices} devices")
+        base, rem = divmod(n_devices, hosts)
+        groups, i = [], 0
+        for h in range(hosts):
+            n = base + (1 if h < rem else 0)
+            groups.append(list(range(i, i + n)))
+            i += n
+        return groups
+    groups = [list(g) for g in hosts]
+    flat = [d for g in groups for d in g]
+    if not groups or any(not g for g in groups):
+        raise ValueError("every host needs at least one device")
+    if len(set(flat)) != len(flat) or any(
+            not 0 <= d < n_devices for d in flat):
+        raise ValueError(f"host device groups {groups} must be disjoint "
+                         f"indices into the {n_devices} local devices")
+    if len(flat) != n_devices:
+        # the flattened plan indexes loads/est_device_load by absolute
+        # device position — a non-covering spec would crash mid-run;
+        # shrink `devices=` instead to use fewer
+        raise ValueError(f"host device groups {groups} must cover all "
+                         f"{n_devices} devices exactly")
+    return groups
+
+
+def plan_two_level(costs: Sequence[float], host_groups: Sequence[Sequence],
+                   schedule: str = "lpt"):
+    """Fleet placement composed down to flat device assignment: chunks go
+    to hosts by :func:`plan_fleet_schedule` (weight = device count), then
+    within each host to its devices by :func:`plan_schedule`. Returns the
+    same ``(order, assignment, loads)`` shape as :func:`plan_schedule`
+    over the GLOBAL device list, so the executing loop is placement-
+    policy agnostic."""
+    weights = [len(g) for g in host_groups]
+    order, h_assign, _ = plan_fleet_schedule(costs, weights, schedule)
+    n_dev = sum(weights)
+    assignment = [0] * len(costs)
+    loads = [0.0] * n_dev
+    for hi, group in enumerate(host_groups):
+        mine = [i for i in order if h_assign[i] == hi]
+        if not mine:
+            continue
+        _, sub_assign, _ = plan_schedule([costs[i] for i in mine],
+                                         len(group), schedule)
+        for j, i in enumerate(mine):
+            d = group[sub_assign[j]]
+            assignment[i] = d
+            loads[d] += costs[i]
+    return order, assignment, loads
+
+
 def plan_schedule(costs: Sequence[float], n_devices: int,
                   schedule: str = "lpt"):
     """Dispatch order + device assignment for chunk ``costs``.
@@ -194,7 +287,7 @@ def _all_ready(pend, jax) -> bool:
 def run_scheduled(runner, groups, methods, *, store=None, force_rerun=False,
                   method_args=None, batch_caps=None, progress=print,
                   devices="auto", schedule="lpt", cost_profile=None,
-                  max_inflight=2) -> dict:
+                  max_inflight=2, hosts=None) -> dict:
     """``SuiteRunner.run_batched`` with task-parallel device placement.
 
     Same contract as the serial path (chunking, resume, result layout,
@@ -204,6 +297,17 @@ def run_scheduled(runner, groups, methods, *, store=None, force_rerun=False,
     holds every group (device memory still only holds in-flight chunks);
     callers for whom that is too much should fall back to the serial
     path's one-group-at-a-time streaming.
+
+    ``hosts`` opts into two-level FLEET placement: chunks are first
+    assigned to hosts by weighted LPT (:func:`plan_fleet_schedule`,
+    weight = the host's device count), then within each host to its
+    devices. An int partitions the local devices into that many host
+    groups (the in-process stand-in — on a multi-process fleet each
+    process's local devices are one group, and the host-level plan is
+    what a cross-host dispatcher ships to each serve replica's suite
+    endpoint); a sequence of device-index sequences names the groups
+    explicitly. Placement stays a pure copy either way — results remain
+    bitwise identical to the serial path.
     """
     jax = runner._jax
     devs = resolve_devices(devices, jax)
@@ -247,8 +351,14 @@ def run_scheduled(runner, groups, methods, *, store=None, force_rerun=False,
     for ch in chunks:
         ch.cost = estimate_cost(ch.family, ch.method, len(ch.todo),
                                 cost_profile, fam_counts)
-    order, assignment, est_loads = plan_schedule(
-        [c.cost for c in chunks], len(devs), schedule)
+    host_groups = None
+    if hosts is not None:
+        host_groups = partition_hosts(len(devs), hosts)
+        order, assignment, est_loads = plan_two_level(
+            [c.cost for c in chunks], host_groups, schedule)
+    else:
+        order, assignment, est_loads = plan_schedule(
+            [c.cost for c in chunks], len(devs), schedule)
 
     # ---- compute phase: throttled async dispatch + deferred harvest
     pending: dict = {i: [] for i in range(len(devs))}
@@ -334,6 +444,11 @@ def run_scheduled(runner, groups, methods, *, store=None, force_rerun=False,
         "est_device_load": {devs[i].id: round(est_loads[i], 4)
                             for i in range(len(devs))},
     }
+    if host_groups is not None:
+        runner.last_stats["hosts"] = [
+            [devs[d].id for d in g] for g in host_groups]
+        runner.last_stats["host_load"] = [
+            round(sum(est_loads[d] for d in g), 4) for g in host_groups]
     progress(f"suite[scheduled x{len(devs)}]: {len(results)} task-method "
              f"pairs in {total:.2f}s (compute wall {compute_wall:.2f}s, "
              f"device-seconds {compute_device_s:.2f}s, data load "

@@ -478,6 +478,7 @@ class SuiteRunner:
         schedule: str = "lpt",
         cost_profile: Optional[dict] = None,
         max_inflight: int = 2,
+        hosts=None,
     ) -> dict:
         """The sweep with same-shape tasks BATCHED into one program.
 
@@ -529,6 +530,10 @@ class SuiteRunner:
         bitwise identical to ``devices=None`` (same executables, same
         seed keys — pinned by ``tests/test_scheduler.py``).
         ``devices=None`` (default) is the serial path.
+        ``hosts`` (with ``devices``) opts into two-level FLEET placement:
+        chunks go to host groups by weighted LPT, then to devices within
+        each group (``engine/scheduler.plan_fleet_schedule``) — still
+        bitwise identical; see ``run_scheduled``.
         """
         if devices is not None:
             from coda_tpu.engine.scheduler import run_scheduled
@@ -537,7 +542,8 @@ class SuiteRunner:
                 self, groups, methods, store=store, force_rerun=force_rerun,
                 method_args=method_args, batch_caps=batch_caps,
                 progress=progress, devices=devices, schedule=schedule,
-                cost_profile=cost_profile, max_inflight=max_inflight)
+                cost_profile=cost_profile, max_inflight=max_inflight,
+                hosts=hosts)
         results: dict = {}
         t_start = time.perf_counter()
         t_load = 0.0

@@ -22,6 +22,14 @@ ARCHITECTURE.md §"Serving".
 
 from coda_tpu.serve.batcher import Batcher, Ticket
 from coda_tpu.serve.faults import FaultInjected, FaultInjector
+from coda_tpu.serve.fleet import Fleet, build_fleet
+from coda_tpu.serve.router import (
+    HttpReplica,
+    InprocReplica,
+    SessionRouter,
+    rendezvous_owner,
+    rendezvous_rank,
+)
 from coda_tpu.serve.metrics import ServeMetrics
 from coda_tpu.serve.recovery import (
     BucketHealer,
@@ -38,6 +46,7 @@ from coda_tpu.serve.server import (
     build_app,
     make_server,
 )
+from coda_tpu.serve.spill import SpillStore
 from coda_tpu.serve.tiering import TierManager
 from coda_tpu.serve.state import (
     Bucket,
@@ -60,24 +69,32 @@ __all__ = [
     "BucketQuarantined",
     "FaultInjected",
     "FaultInjector",
+    "Fleet",
+    "HttpReplica",
     "ImportRejected",
+    "InprocReplica",
     "ReplayMismatch",
     "SelectorSpec",
     "ServeApp",
     "ServeMetrics",
     "Session",
+    "SessionRouter",
     "SessionStore",
     "SlabFull",
+    "SpillStore",
     "SlotRequest",
     "SlotResult",
     "Ticket",
     "TierManager",
     "UnknownSession",
     "build_app",
+    "build_fleet",
     "export_session",
     "heal_bucket",
     "import_session",
     "make_server",
     "make_slab_step",
+    "rendezvous_owner",
+    "rendezvous_rank",
     "restore_app_sessions",
 ]

@@ -1,0 +1,244 @@
+"""Replicated serve fleet: lifecycle, rolling restarts, peer paging.
+
+``serve/router.py`` owns addressing (rendezvous sharding, health-driven
+routing, per-session migration mechanics); this module owns the
+replicas themselves:
+
+  * **Spawn** — :class:`Fleet` builds N replicas from an ``app_factory``
+    (each a full :class:`~coda_tpu.serve.ServeApp`: own slab, batcher,
+    tier manager, recorder), registers them with a
+    :class:`~coda_tpu.serve.router.SessionRouter`, and wires the
+    fleet-level hooks.
+  * **Rolling restart** — :meth:`rolling_restart` cycles every replica
+    in sequence: evict from routing → drain-and-migrate its sessions to
+    their new owners (each digest-verified on the PR 7 export/import
+    path) → stop the old process state → stand up a fresh replica from
+    the factory → wait for its warm pool (the ``/healthz`` readiness
+    gate) → rejoin → minimal rebalance pulls its key range back. Zero
+    dropped sessions and zero double-applied labels through the whole
+    cycle is the committed ``BENCH_FLEET_*`` claim.
+  * **Demotion-aware peer paging** — each replica's
+    :class:`~coda_tpu.serve.tiering.TierManager` gets a ``page_out``
+    hook: a watermark- or age-pressured warm session is offered to the
+    least-loaded OTHER routable replica (imported there digest-verified,
+    router re-pointed) before it is spilled to local disk. Fleet RAM
+    becomes one pool instead of N silos.
+
+The container demo (``scripts/serve_loadgen.py --fleet N``) runs the
+whole fleet in one process with :class:`~coda_tpu.serve.router.
+InprocReplica` handles; a real deployment points the same router at
+``HttpReplica`` URLs — the router and this lifecycle logic are
+handle-type agnostic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from coda_tpu.serve.router import InprocReplica, SessionRouter
+
+
+class Fleet:
+    """N serve replicas + one session router, managed together.
+
+    ``app_factory(replica_id)`` returns an UNSTARTED ServeApp for that
+    replica (the same factory serves initial spawn and rolling-restart
+    respawn, so a restarted replica is configured identically)."""
+
+    def __init__(self, app_factory: Callable, n_replicas: int = 3,
+                 replica_ids: Optional[list] = None, telemetry=None,
+                 peer_paging: bool = True, auto_rebalance: bool = True):
+        self.app_factory = app_factory
+        self.replica_ids = list(replica_ids or
+                                [f"r{i}" for i in range(n_replicas)])
+        self.apps: dict[str, object] = {}
+        self.router = SessionRouter(telemetry=telemetry,
+                                    auto_rebalance=auto_rebalance)
+        self.peer_paging = peer_paging
+        for rid in self.replica_ids:
+            self._spawn(rid)
+
+    @property
+    def peer_pages(self) -> int:
+        """Fleet-wide successful peer pages (the router's counter is the
+        single source of truth — one event, one counter)."""
+        return self.router.counters["peer_pages"]
+
+    # -- lifecycle ---------------------------------------------------------
+    def _spawn(self, rid: str):
+        app = self.app_factory(rid)
+        self.apps[rid] = app
+        if self.peer_paging and getattr(app, "tiers", None) is not None:
+            app.tiers.page_out = self._make_pager(rid)
+        self.router.add_replica(rid, InprocReplica(rid, app),
+                                rebalance=False)
+        return app
+
+    def start(self, warm: bool = True, poll_s: float = 0.25) -> "Fleet":
+        for app in self.apps.values():
+            app.start(warm=warm)
+        self.router.start(poll_s=poll_s)
+        return self
+
+    def drain(self, timeout: float = 30.0) -> None:
+        self.router.drain()
+        for app in self.apps.values():
+            app.drain(timeout=timeout)
+
+    # -- peer paging -------------------------------------------------------
+    def _make_pager(self, src_rid: str):
+        def _page_out(sid: str, payload: dict) -> bool:
+            dst_rid = self._least_loaded(exclude={src_rid})
+            if dst_rid is None:
+                return False  # no routable peer: fall back to disk
+            handle = self.router.replicas.get(dst_rid)
+            if handle is None:
+                return False
+            # the move rides the router's migration gate like any other
+            # migration: the tier manager already popped the warm entry,
+            # so until the peer's import lands the session exists only
+            # in this thread's hands — a verb arriving now must wait the
+            # gate out, not 404
+            gate = threading.Event()
+            with self.router._lock:
+                if self.router._migrating.get(sid) is not None:
+                    return False  # a real migration owns the sid: yield
+                self.router._migrating[sid] = gate
+            try:
+                try:
+                    handle.import_payload(payload)
+                except Exception:
+                    return False
+                with self.router._lock:
+                    self.router._placed[sid] = dst_rid
+                    self.router.counters["peer_pages"] += 1
+                return True
+            finally:
+                with self.router._lock:
+                    self.router._migrating.pop(sid, None)
+                gate.set()
+
+        return _page_out
+
+    def _least_loaded(self, exclude=()) -> Optional[str]:
+        best, best_n = None, None
+        for rid in self.router.routable():
+            if rid in exclude:
+                continue
+            handle = self.router.replicas.get(rid)
+            if handle is None:
+                continue
+            try:
+                n = handle.open_count()
+            except Exception:
+                continue
+            if best_n is None or n < best_n:
+                best, best_n = rid, n
+        return best
+
+    # -- rolling restart ---------------------------------------------------
+    def restart_replica(self, rid: str, warm: bool = True,
+                        ready_timeout: float = 120.0) -> dict:
+        """One replica's zero-drop restart cycle (see module docstring).
+        Returns the migration accounting for the gate's evidence."""
+        t0 = time.perf_counter()
+        # cordoned eviction: the health poller must not re-admit a
+        # replica we are deliberately draining (its /healthz answers ok
+        # until the old app actually stops); rejoin() lifts the cordon
+        self.router.evict(rid, cordon=True)
+        # drain-and-migrate: ONLY this replica's sessions move (their
+        # owner over the remaining set), each export/import
+        # digest-verified; the other replicas' sessions never move
+        out_report = self.router._migrate_all_off(rid)
+        if out_report.get("failed"):
+            # a failed migration restored its payload to THIS replica —
+            # draining now would discard it. One more pass (transient
+            # peer pressure usually clears), then ABORT the restart:
+            # the replica rejoins with its sessions intact, and the
+            # restart fails attributably instead of dropping anyone.
+            retry = self.router._migrate_all_off(rid)
+            out_report = {
+                "migrated": out_report.get("migrated", 0)
+                + retry.get("migrated", 0),
+                "failed": retry.get("failed", 0),
+                "errors": retry.get("errors"),
+            }
+            if out_report["failed"]:
+                self.router.rejoin(rid)
+                raise RuntimeError(
+                    f"replica {rid} restart aborted: "
+                    f"{out_report['failed']} session(s) could not be "
+                    f"migrated off ({out_report.get('errors')}); the "
+                    "replica rejoined with its sessions intact")
+        old = self.apps[rid]
+        old.drain(timeout=30.0)
+        new_app = self.app_factory(rid)
+        if self.peer_paging and getattr(new_app, "tiers", None) is not None:
+            new_app.tiers.page_out = self._make_pager(rid)
+        new_app.start(warm=warm)
+        if not new_app.ready.wait(ready_timeout):
+            raise TimeoutError(f"replica {rid} warm pool not ready after "
+                               f"{ready_timeout}s")
+        self.apps[rid] = new_app
+        with self.router._lock:
+            self.router.replicas[rid] = InprocReplica(rid, new_app)
+        self.router.rejoin(rid)
+        # minimal rebalance: exactly the sids whose HRW owner is the
+        # rejoined replica come home
+        back_report = self.router.rebalance()
+        out = {"replica": rid,
+               "migrated_out": out_report.get("migrated", 0),
+               "migrated_back": back_report.get("moved", 0),
+               "failed": out_report.get("failed", 0)
+               + back_report.get("failed", 0),
+               "seconds": round(time.perf_counter() - t0, 3)}
+        errors = (out_report.get("errors") or []) + \
+            (back_report.get("errors") or [])
+        if errors:
+            out["errors"] = errors
+        return out
+
+    def rolling_restart(self, warm: bool = True) -> dict:
+        """Restart EVERY replica in sequence — the fleet's zero-downtime
+        deploy. The router keeps serving throughout; each replica's
+        sessions ride two digest-verified migrations (out, then home)."""
+        rounds = []
+        for rid in list(self.replica_ids):
+            rounds.append(self.restart_replica(rid, warm=warm))
+        c = self.router.counters
+        return {
+            "replicas_restarted": len(rounds),
+            "rounds": rounds,
+            "migrations": c["migrations"],
+            "migration_failures": c["migration_failures"],
+            "sessions_dropped": c["sessions_dropped"],
+            "migrations_via": dict(self.router.migrations_via),
+        }
+
+    # -- reads -------------------------------------------------------------
+    def stats(self) -> dict:
+        return self.router.stats()
+
+
+def build_fleet(args, n_replicas: int, record_dir: Optional[str] = None
+                ) -> Fleet:
+    """A fleet from serve CLI args (the loadgen/demo entry): each replica
+    is ``build_app(args)`` with its own spill/record sub-directories so
+    replicas never share mutable disk state."""
+    import copy
+    import os
+
+    from coda_tpu.serve.server import build_app
+
+    def factory(rid: str):
+        a = copy.copy(args)
+        if getattr(args, "tier_spill_dir", None):
+            a.tier_spill_dir = os.path.join(args.tier_spill_dir, rid)
+        base_record = record_dir or getattr(args, "record_dir", None)
+        if base_record:
+            a.record_dir = os.path.join(base_record, rid)
+        return build_app(a)
+
+    return Fleet(factory, n_replicas=n_replicas)

@@ -62,6 +62,7 @@ class ServeMetrics:
         # "wake-from-warm p99 under one batcher tick" claim's evidence
         self.demotions = 0           # hot -> warm (slab slot freed)
         self.hibernates = 0          # warm -> cold (payload spilled to disk)
+        self.peer_pages = 0          # warm -> a less-loaded peer replica
         self.wakes = 0               # warm/cold -> hot (transparent restore)
         self.wakes_from_warm = 0
         self.wakes_from_cold = 0
@@ -129,6 +130,8 @@ class ServeMetrics:
                 self.demotions += 1
             elif event == "hibernate":
                 self.hibernates += 1
+            elif event == "peer_page":
+                self.peer_pages += 1
             elif event == "wake":
                 self.wakes += 1
                 if src == "warm":
@@ -207,6 +210,7 @@ class ServeMetrics:
                 "tiers": dict(self.tier_occupancy),
                 "demotions": self.demotions,
                 "hibernates": self.hibernates,
+                "peer_pages": self.peer_pages,
                 "wakes": self.wakes,
                 "wakes_from_warm": self.wakes_from_warm,
                 "wakes_from_cold": self.wakes_from_cold,

@@ -1,0 +1,875 @@
+"""Fleet session router: rendezvous sharding, health-driven re-routing.
+
+Every serve headline number before this module was a single-process
+ceiling: one slab, one batcher thread, one front door. The router is the
+fleet's front door — it speaks the exact same HTTP/JSON surface as one
+replica (``make_server(router, port)`` reuses ``AsyncHTTPServer``
+unchanged), and shards sessions across N serve replicas:
+
+  * **Placement is rendezvous (HRW) hashing** on the session id:
+    ``owner(sid) = argmax_r blake2b(sid, r)`` over the routable replica
+    set. Deterministic across processes (keyed hash, never Python's
+    salted ``hash``), and minimal under topology change — adding or
+    removing one of N replicas re-owns only ~1/N of the id space, which
+    is exactly the set of sessions a rebalance has to move.
+  * **Health drives the routing set**: each replica's ``/healthz``
+    (ok | degraded | unready, PR 6/7) is polled; an unready or
+    unreachable replica is evicted from routing (its verbs re-route),
+    a recovered one rejoins — each transition triggering a minimal
+    rebalance.
+  * **Rebalancing is drain-and-migrate on the PR 7 export/import path**:
+    a session moves by being quiesced (the tiering demotion protocol —
+    an in-flight label ticket pins the session and the demotion loses
+    cleanly, so the payload always contains every committed label),
+    exported, and imported on its new owner, where the snapshot fast
+    path verifies the posterior digest bitwise against the stream's
+    last recorded digest (or falls back to bitwise stream replay) —
+    EVERY migration is digest-verified by construction. The router
+    holds a per-sid migration gate while a session is in flight;
+    requests for it wait out the move and then land on the new owner,
+    and a label retried across the move is absorbed by the replica's
+    idempotent request-id dedupe.
+  * **Added latency is attributed span-by-span**: every routed verb
+    records a ``route/<verb>`` span on the ``host:router`` lane nesting
+    a ``dispatch/<replica>`` span for the replica call — router overhead
+    is the outer minus the inner, mechanically, in the same trace.json
+    vocabulary as the batcher's tick/step spans.
+
+Observability does not regress to per-replica curl loops: the router's
+``/stats`` merges every replica's snapshot (plus aggregate sums and the
+router's own counters), and ``/metrics`` renders the serve gauge
+families once each with a ``replica`` label per sample (lint-clean
+under ``telemetry/prometheus.lint``).
+
+``serve/fleet.py`` owns replica lifecycle (spawn, rolling restart, peer
+paging); this module owns addressing, health, and migration mechanics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from typing import Optional, Sequence
+
+from coda_tpu.serve.state import BucketQuarantined, SlabFull, UnknownSession
+
+#: how long a verb waits out an in-flight migration of its session
+MIGRATION_WAIT_S = 30.0
+
+
+# ---------------------------------------------------------------------------
+# rendezvous (highest-random-weight) hashing
+# ---------------------------------------------------------------------------
+
+def rendezvous_score(sid: str, replica_id: str) -> int:
+    """The HRW weight of (session, replica): a keyed 64-bit digest.
+
+    ``blake2b`` (not Python's ``hash``, which is salted per process):
+    owners must agree across the router, every replica, and any offline
+    tool that recomputes the shard map."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(sid.encode())
+    h.update(b"\x00")
+    h.update(replica_id.encode())
+    return int.from_bytes(h.digest(), "big")
+
+
+def rendezvous_rank(sid: str, replica_ids: Sequence[str]) -> list[str]:
+    """Replicas by descending HRW score (ties broken by id — total order
+    so every process ranks identically). ``[0]`` is the owner; the rest
+    is the failover order."""
+    return sorted(replica_ids,
+                  key=lambda rid: (-rendezvous_score(sid, rid), rid))
+
+
+def rendezvous_owner(sid: str, replica_ids: Sequence[str]) -> str:
+    if not replica_ids:
+        raise SlabFull("no routable replicas")
+    best = None
+    best_key = None
+    for rid in replica_ids:
+        key = (-rendezvous_score(sid, rid), rid)
+        if best_key is None or key < best_key:
+            best, best_key = rid, key
+    return best
+
+
+# ---------------------------------------------------------------------------
+# replica handles: in-process and HTTP
+# ---------------------------------------------------------------------------
+
+class InprocReplica:
+    """One fleet member served by a ServeApp in this process (the
+    container demo; also what the tests drive)."""
+
+    def __init__(self, replica_id: str, app):
+        self.replica_id = replica_id
+        self.app = app
+
+    # -- verbs (the router forwards these; exceptions flow through) --------
+    def open(self, task=None, seed=None, sid=None):
+        return self.app.open_session(task=task, seed=seed, sid=sid)
+
+    def label(self, sid, label, idx=None, request_id=None):
+        return self.app.label(sid, label, idx=idx, request_id=request_id)
+
+    def labels(self, sid, labels, idx=None, request_id=None):
+        return self.app.labels(sid, labels, idx=idx, request_id=request_id)
+
+    def best(self, sid):
+        return self.app.best(sid)
+
+    def trace(self, sid):
+        return self.app.trace(sid)
+
+    def close(self, sid):
+        return self.app.close_session(sid)
+
+    def export(self, sid, close=False):
+        return self.app.export_session(sid, close=close)
+
+    def import_payload(self, payload):
+        return self.app.import_session(payload)
+
+    def stats(self):
+        return self.app.stats()
+
+    def healthz(self):
+        return self.app.healthz()
+
+    # -- fleet bookkeeping -------------------------------------------------
+    def has_session(self, sid) -> bool:
+        return self.app.store.alive(sid) or (
+            self.app.tiers is not None and self.app.tiers.parked(sid))
+
+    def open_sids(self) -> list[str]:
+        return self.app.list_sessions()["sessions"]
+
+    def open_count(self) -> int:
+        n = self.app.store.live_sessions()
+        if self.app.tiers is not None:
+            c = self.app.tiers.counts()
+            n = c["hot"] + c["warm"] + c["cold"]
+        return n
+
+    def export_for_migration(self, sid) -> dict:
+        """Quiesce-then-export: ride the tiering demotion protocol (it
+        loses cleanly to any in-flight label ticket and wins once the
+        ticket resolves) so the payload always carries every committed
+        label; the export's ``close=True`` is the drain handoff — the
+        source forgets the session the moment the payload exists."""
+        app = self.app
+        if app.tiers is not None:
+            for _ in range(500):
+                if not app.store.alive(sid):
+                    break  # already parked (or closed) — export serves it
+                if app.tiers.try_demote(sid):
+                    break
+                time.sleep(0.002)
+        return app.export_session(sid, close=True)
+
+
+class HttpReplica:
+    """One fleet member behind a base URL (a real multi-process fleet).
+
+    Maps the HTTP error envelope back onto the exceptions the in-process
+    verbs raise, so the router's own front door re-encodes them
+    identically no matter which handle type served the request."""
+
+    def __init__(self, replica_id: str, url: str, timeout: float = 60.0):
+        self.replica_id = replica_id
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _req(self, method, path, body=None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        data = None if body is None else _json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = _json.loads(e.read()).get("error", "")
+            except Exception:
+                msg = str(e)
+            if e.code == 404:
+                raise UnknownSession(msg or path)
+            if e.code == 503:
+                raise BucketQuarantined(msg) if "healing" in msg \
+                    else SlabFull(msg)
+            if e.code == 409:
+                from coda_tpu.serve.recovery import ImportRejected
+
+                raise ImportRejected(msg)
+            if e.code == 504:
+                raise TimeoutError(msg)
+            raise RuntimeError(f"{e.code}: {msg}")
+
+    def open(self, task=None, seed=None, sid=None):
+        body = {}
+        if task is not None:
+            body["task"] = task
+        if seed is not None:
+            body["seed"] = seed
+        if sid is not None:
+            body["session"] = sid
+        return self._req("POST", "/session", body)
+
+    def label(self, sid, label, idx=None, request_id=None):
+        body = {"label": label}
+        if idx is not None:
+            body["idx"] = idx
+        if request_id is not None:
+            body["request_id"] = request_id
+        return self._req("POST", f"/session/{sid}/label", body)
+
+    def labels(self, sid, labels, idx=None, request_id=None):
+        body = {"labels": list(labels)}
+        if idx is not None:
+            body["idx"] = idx
+        if request_id is not None:
+            body["request_id"] = request_id
+        return self._req("POST", f"/session/{sid}/labels", body)
+
+    def best(self, sid):
+        return self._req("GET", f"/session/{sid}/best")
+
+    def trace(self, sid):
+        return self._req("GET", f"/session/{sid}/trace")
+
+    def close(self, sid):
+        return self._req("DELETE", f"/session/{sid}")
+
+    def export(self, sid, close=False):
+        return self._req("POST", f"/session/{sid}/export",
+                         {"close": bool(close)})
+
+    def import_payload(self, payload):
+        return self._req("POST", "/session/import", payload)
+
+    def stats(self):
+        return self._req("GET", "/stats")
+
+    def healthz(self):
+        try:
+            return self._req("GET", "/healthz")
+        except SlabFull:
+            # a 503 here is the replica saying "unready" — report it as
+            # the healthz body would
+            return {"ok": False, "ready": False, "status": "unready",
+                    "draining": False, "problems": ["unready"]}
+
+    def has_session(self, sid) -> bool:
+        try:
+            self.best(sid)
+            return True
+        except UnknownSession:
+            return False
+        except (SlabFull, BucketQuarantined):
+            return True  # restoring/healing: it exists
+
+    def open_sids(self) -> list[str]:
+        return list((self._req("GET", "/sessions") or {})
+                    .get("sessions", []))
+
+    def open_count(self) -> int:
+        st = self.stats()
+        return int(st.get("open_sessions") or 0)
+
+    def export_for_migration(self, sid) -> dict:
+        return self.export(sid, close=True)
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class SessionRouter:
+    """The fleet's front door (duck-types ServeApp's verb surface, so
+    ``make_server(router, port)`` serves it over the same asyncio HTTP
+    stack a single replica uses).
+
+    Construction takes ``{replica_id: handle}``; :meth:`start` begins
+    health polling. Topology changes (eviction, rejoin,
+    :meth:`add_replica` / :meth:`remove_replica`) trigger
+    :meth:`rebalance` — drain-and-migrate of exactly the minimal re-owned
+    key range."""
+
+    def __init__(self, replicas: Optional[dict] = None, telemetry=None,
+                 auto_rebalance: bool = True):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from coda_tpu.serve.metrics import ServeMetrics
+        from coda_tpu.telemetry import Telemetry
+
+        self._lock = threading.RLock()
+        self.replicas: dict[str, object] = dict(replicas or {})
+        self._routable: set[str] = set(self.replicas)
+        self._health: dict[str, str] = {rid: "ok" for rid in self.replicas}
+        # deliberate off-owner placements (peer paging, mid-rebalance):
+        # sid -> replica id; consulted before the HRW owner
+        self._placed: dict[str, str] = {}
+        # operator-evicted replicas the health poller must NOT re-admit
+        # (a draining replica's /healthz still answers ok until it
+        # stops; rejoin() lifts the cordon explicitly)
+        self._cordoned: set[str] = set()
+        # per-sid migration gates: verbs wait these out, then re-locate
+        self._migrating: dict[str, threading.Event] = {}
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.metrics = ServeMetrics()   # router-level request accounting
+        self.draining = False
+        self.auto_rebalance = auto_rebalance
+        self.counters = {
+            "requests_routed": 0, "reroutes": 0, "migrations": 0,
+            "migration_failures": 0, "evictions": 0, "rejoins": 0,
+            "rebalances": 0, "peer_pages": 0, "sessions_dropped": 0,
+        }
+        self.migrations_via: dict[str, int] = {}   # snapshot vs replay
+        self.routed_to: dict[str, int] = {rid: 0 for rid in self.replicas}
+        self._executor = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="router-verb")
+        self._running = False
+        self._poll_thread: Optional[threading.Thread] = None
+        self._wakeup = threading.Event()
+        self.ready = threading.Event()
+        if self.replicas:
+            self.ready.set()
+        # the span vocabulary the trace-based attribution keys on
+        self._spans = self.telemetry.spans
+
+    # -- topology ----------------------------------------------------------
+    def add_replica(self, replica_id: str, handle, rebalance: bool = True
+                    ) -> None:
+        with self._lock:
+            self.replicas[replica_id] = handle
+            self._routable.add(replica_id)
+            self._health[replica_id] = "ok"
+            self.routed_to.setdefault(replica_id, 0)
+            self.ready.set()
+        if rebalance:
+            self.rebalance()
+
+    def remove_replica(self, replica_id: str, migrate: bool = True) -> dict:
+        """Drain one replica out of the fleet: evict it from routing,
+        migrate every session it holds to the sessions' new HRW owners
+        (each digest-verified), then forget the handle. Returns the
+        migration report."""
+        with self._lock:
+            if replica_id not in self.replicas:
+                return {"migrated": 0}
+            self._routable.discard(replica_id)
+        report = (self._migrate_all_off(replica_id) if migrate
+                  else {"migrated": 0})
+        with self._lock:
+            self.replicas.pop(replica_id, None)
+            self._health.pop(replica_id, None)
+        return report
+
+    def evict(self, replica_id: str, cordon: bool = False) -> None:
+        """Take a replica out of routing without forgetting it (health
+        eviction: it may recover and rejoin). ``cordon`` additionally
+        bars the health poller from re-admitting it — the drain flow,
+        where the replica's /healthz keeps answering ok until it
+        actually stops."""
+        with self._lock:
+            if cordon:
+                self._cordoned.add(replica_id)
+            if replica_id in self._routable:
+                self._routable.discard(replica_id)
+                self.counters["evictions"] += 1
+
+    def rejoin(self, replica_id: str) -> None:
+        with self._lock:
+            self._cordoned.discard(replica_id)
+            if replica_id in self.replicas and \
+                    replica_id not in self._routable:
+                self._routable.add(replica_id)
+                self.counters["rejoins"] += 1
+
+    def routable(self) -> list[str]:
+        with self._lock:
+            return sorted(self._routable)
+
+    def owner_of(self, sid: str) -> str:
+        return rendezvous_owner(sid, self.routable())
+
+    # -- health ------------------------------------------------------------
+    def check_health(self) -> dict:
+        """One poll of every replica's /healthz: unreachable or unready
+        replicas leave the routing set, recovered ones rejoin. Returns
+        {replica: status}; topology changes trigger a rebalance when
+        ``auto_rebalance``."""
+        statuses: dict[str, str] = {}
+        with self._lock:
+            items = list(self.replicas.items())
+        changed = False
+        for rid, handle in items:
+            try:
+                hz = handle.healthz()
+                status = hz.get("status") or (
+                    "ok" if hz.get("ready") else "unready")
+                if hz.get("draining"):
+                    status = "draining"
+            except Exception:
+                status = "unreachable"
+            statuses[rid] = status
+            routable = status in ("ok", "degraded")
+            with self._lock:
+                was = rid in self._routable
+                cordoned = rid in self._cordoned
+                self._health[rid] = status
+            if routable and not was and not cordoned:
+                self.rejoin(rid)
+                changed = True
+            elif not routable and was:
+                self.evict(rid)
+                changed = True
+        if changed and self.auto_rebalance:
+            try:
+                self.rebalance()
+            except Exception:
+                pass  # the poller must survive a mid-rebalance hiccup
+        return statuses
+
+    def start(self, poll_s: float = 0.25) -> "SessionRouter":
+        if self._poll_thread is not None:
+            return self
+        self._running = True
+
+        def _loop():
+            while self._running:
+                try:
+                    self.check_health()
+                except Exception:
+                    pass
+                self._wakeup.wait(poll_s)
+                self._wakeup.clear()
+
+        self._poll_thread = threading.Thread(
+            target=_loop, daemon=True, name="router-health")
+        self._poll_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        self._wakeup.set()
+        t, self._poll_thread = self._poll_thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        self.draining = True
+        self.stop()
+        self._executor.shutdown(wait=False)
+
+    # -- location ----------------------------------------------------------
+    def _locate(self, sid: str) -> str:
+        gate = None
+        with self._lock:
+            gate = self._migrating.get(sid)
+        if gate is not None:
+            gate.wait(MIGRATION_WAIT_S)
+        with self._lock:
+            rid = self._placed.get(sid)
+            if rid is not None and rid in self.replicas:
+                # an off-owner placement on an evicted-but-known replica
+                # still resolves: it serves its sessions while draining
+                return rid
+            routable = sorted(self._routable)
+        return rendezvous_owner(sid, routable)
+
+    def _find(self, sid: str, exclude=()) -> Optional[str]:
+        """Search the fleet for a session that is not where the shard map
+        says (a topology change the rebalance has not caught up with).
+        ALL known replicas are probed — an evicted-but-draining replica
+        still serves its existing sessions until they migrate off it —
+        in rendezvous-rank order, the most likely ex-owners first."""
+        with self._lock:
+            candidates = [r for r in self.replicas if r not in exclude]
+        for rid in rendezvous_rank(sid, candidates):
+            try:
+                if self.replicas[rid].has_session(sid):
+                    return rid
+            except Exception:
+                continue
+        return None
+
+    def _forward(self, verb: str, sid: str, fn):
+        """Route one verb: locate -> dispatch (with the route span
+        nesting the replica dispatch span) -> on UnknownSession, search
+        the fleet and re-route once; on a dead replica, evict and
+        fail over."""
+        with self._spans.span(f"route/{verb}", lane="host:router"):
+            last_err: Optional[BaseException] = None
+            for attempt in range(3):
+                rid = self._locate(sid)
+                with self._lock:
+                    handle = self.replicas.get(rid)
+                if handle is None:
+                    continue
+                try:
+                    with self._spans.span(f"dispatch/{rid}",
+                                          lane="host:router"):
+                        out = fn(handle)
+                    with self._lock:
+                        self.counters["requests_routed"] += 1
+                        self.routed_to[rid] = \
+                            self.routed_to.get(rid, 0) + 1
+                    return out
+                except UnknownSession as e:
+                    last_err = e
+                    with self._lock:
+                        gate = self._migrating.get(sid)
+                    if gate is not None:
+                        # we located the source BEFORE its migration gate
+                        # went up and dispatched after the export-close:
+                        # mid-move the payload exists only in the
+                        # migrating thread's hands, so neither side
+                        # answers. Wait the move out, then re-locate —
+                        # never a 404 for a session that is merely in
+                        # transit.
+                        gate.wait(MIGRATION_WAIT_S)
+                        continue
+                    found = self._find(sid, exclude={rid})
+                    if found is None:
+                        if attempt < 2:
+                            # a migration's gate may have been popped
+                            # between our dispatch and the check above —
+                            # one short beat, then re-locate
+                            time.sleep(0.01)
+                            continue
+                        raise
+                    with self._lock:
+                        self._placed[sid] = found
+                        self.counters["reroutes"] += 1
+                except (ConnectionError, OSError) as e:
+                    # replica went away under us: evict, let health/
+                    # rebalance recover it, and fail over this request
+                    last_err = e
+                    self.evict(rid)
+            raise (last_err or SlabFull("no routable replica answered"))
+
+    # -- the front-door verb surface (ServeApp-compatible) -----------------
+    def open_session(self, task: Optional[str] = None,
+                     seed: Optional[int] = None) -> dict:
+        if self.draining:
+            from coda_tpu.serve.server import Draining
+
+            raise Draining()
+        # the router mints the sid so placement is HRW on the id BEFORE
+        # the replica admits it (the replica honors the pinned id)
+        sid = uuid.uuid4().hex
+        with self._spans.span("route/open", lane="host:router"):
+            last_err: Optional[BaseException] = None
+            for _ in range(3):
+                owner = rendezvous_owner(sid, self.routable())
+                with self._lock:
+                    handle = self.replicas.get(owner)
+                if handle is None:
+                    continue  # removed between routable() and lookup
+                try:
+                    with self._spans.span(f"dispatch/{owner}",
+                                          lane="host:router"):
+                        out = handle.open(task=task, seed=seed, sid=sid)
+                except (ConnectionError, OSError) as e:
+                    # dead owner inside the health-poll window: evict it
+                    # (like every _forward verb does) and re-own the sid
+                    # over the survivors instead of bouncing the client
+                    last_err = e
+                    self.evict(owner)
+                    continue
+                with self._lock:
+                    self.counters["requests_routed"] += 1
+                    self.routed_to[owner] = \
+                        self.routed_to.get(owner, 0) + 1
+                return out
+            raise (last_err or SlabFull("no routable replica answered"))
+
+    async def open_session_async(self, task=None, seed=None) -> dict:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, lambda: self.open_session(task, seed))
+
+    def label(self, sid: str, label, idx=None, request_id=None) -> dict:
+        return self._forward(
+            "label", sid,
+            lambda h: h.label(sid, label, idx=idx, request_id=request_id))
+
+    async def label_async(self, sid, label, idx=None,
+                          request_id=None) -> dict:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor,
+            lambda: self.label(sid, label, idx=idx, request_id=request_id))
+
+    def labels(self, sid: str, labels, idx=None, request_id=None) -> dict:
+        return self._forward(
+            "labels", sid,
+            lambda h: h.labels(sid, labels, idx=idx,
+                               request_id=request_id))
+
+    async def labels_async(self, sid, labels, idx=None,
+                           request_id=None) -> dict:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor,
+            lambda: self.labels(sid, labels, idx=idx,
+                                request_id=request_id))
+
+    def best(self, sid: str) -> dict:
+        return self._forward("best", sid, lambda h: h.best(sid))
+
+    def trace(self, sid: str) -> dict:
+        return self._forward("trace", sid, lambda h: h.trace(sid))
+
+    def close_session(self, sid: str) -> dict:
+        out = self._forward("close", sid, lambda h: h.close(sid))
+        with self._lock:
+            self._placed.pop(sid, None)
+        return out
+
+    def export_session(self, sid: str, close: bool = False) -> dict:
+        out = self._forward("export", sid,
+                            lambda h: h.export(sid, close=close))
+        if close:
+            with self._lock:
+                self._placed.pop(sid, None)
+        return out
+
+    def import_session(self, payload: dict) -> dict:
+        if self.draining:
+            from coda_tpu.serve.server import Draining
+
+            raise Draining()
+        sid = str(payload.get("session") or "")
+        owner = rendezvous_owner(sid, self.routable())
+        with self._spans.span("route/import", lane="host:router"):
+            with self._lock:
+                handle = self.replicas[owner]
+            with self._spans.span(f"dispatch/{owner}", lane="host:router"):
+                return handle.import_payload(payload)
+
+    # -- migration ---------------------------------------------------------
+    def migrate_session(self, sid: str, src_rid: str, dst_rid: str) -> dict:
+        """Move one session: gate its verbs, quiesce-export from the
+        source (drain handoff — the source forgets it), import on the
+        destination (digest-verified snapshot or bitwise stream replay),
+        un-gate. On an import failure the payload is restored to the
+        SOURCE so the session is never dropped."""
+        gate = threading.Event()
+        with self._lock:
+            if self._migrating.get(sid) is not None:
+                return {"skipped": "already migrating"}
+            self._migrating[sid] = gate
+            src = self.replicas.get(src_rid)
+            dst = self.replicas.get(dst_rid)
+        info: dict = {}
+        try:
+            if src is None or dst is None:
+                return {"skipped": "replica gone"}
+            try:
+                payload = src.export_for_migration(sid)
+            except UnknownSession:
+                return {"skipped": "closed"}
+            try:
+                res = None
+                for i in range(8):
+                    try:
+                        res = dst.import_payload(payload)
+                        break
+                    except SlabFull:
+                        # transient admission pressure on the peer
+                        # (every slot momentarily pinned): a migration
+                        # must out-wait it, not fail the move
+                        if i == 7:
+                            raise
+                        time.sleep(0.01 * (i + 1))
+                via = res.get("restored_via", "?")
+                with self._lock:
+                    # home placement needs no override; an off-owner
+                    # destination (peer paging) keeps one
+                    owner = rendezvous_owner(sid, sorted(self._routable))
+                    if dst_rid == owner:
+                        self._placed.pop(sid, None)
+                    else:
+                        self._placed[sid] = dst_rid
+                    self.counters["migrations"] += 1
+                    self.migrations_via[via] = \
+                        self.migrations_via.get(via, 0) + 1
+                info = {"migrated": sid, "from": src_rid, "to": dst_rid,
+                        "via": via}
+            except BaseException as e:
+                # put it back where it came from — a failed migration
+                # must degrade to "didn't move", never to "gone"
+                with self._lock:
+                    self.counters["migration_failures"] += 1
+                try:
+                    src.import_payload(payload)
+                    with self._lock:
+                        self._placed[sid] = src_rid
+                except BaseException:
+                    with self._lock:
+                        self.counters["sessions_dropped"] += 1
+                    raise
+                info = {"failed": sid, "error": repr(e)}
+            return info
+        finally:
+            with self._lock:
+                self._migrating.pop(sid, None)
+            gate.set()
+
+    def _migrate_all_off(self, src_rid: str) -> dict:
+        """Drain-and-migrate every session off one replica to the
+        sessions' HRW owners over the remaining routable set."""
+        with self._lock:
+            handle = self.replicas.get(src_rid)
+            routable = sorted(self._routable - {src_rid})
+        if handle is None or not routable:
+            return {"migrated": 0}
+        moved = failed = 0
+        fail_errors: list = []
+        for sid in handle.open_sids():
+            dst = rendezvous_owner(sid, routable)
+            info = self.migrate_session(sid, src_rid, dst)
+            if "migrated" in info:
+                moved += 1
+            elif "failed" in info:
+                failed += 1
+                fail_errors.append(info.get("error"))
+        out = {"migrated": moved, "failed": failed}
+        if fail_errors:
+            out["errors"] = fail_errors[:10]
+        return out
+
+    def rebalance(self, full: bool = False) -> dict:
+        """Move every session to its HRW owner over the CURRENT routable
+        set — after a topology change this is exactly the minimal
+        re-owned key range (sessions whose owner is unchanged never
+        move). ``full=True`` also re-homes deliberate off-owner
+        placements (peer-paged sessions); the default leaves them where
+        the pressure balancing put them."""
+        moved = failed = 0
+        fail_errors: list = []
+        with self._lock:
+            items = [(rid, self.replicas[rid])
+                     for rid in sorted(self._routable)]
+            routable = sorted(self._routable)
+            placed = dict(self._placed)
+        for rid, handle in items:
+            try:
+                sids = handle.open_sids()
+            except Exception:
+                continue
+            for sid in sids:
+                if not full and placed.get(sid) == rid:
+                    continue  # deliberately placed here (peer paging)
+                owner = rendezvous_owner(sid, routable)
+                if owner == rid:
+                    continue
+                info = self.migrate_session(sid, rid, owner)
+                if "migrated" in info:
+                    moved += 1
+                elif "failed" in info:
+                    failed += 1
+                    fail_errors.append(info.get("error"))
+        with self._lock:
+            self.counters["rebalances"] += 1
+        out = {"moved": moved, "failed": failed}
+        if fail_errors:
+            out["errors"] = fail_errors[:10]
+        return out
+
+    def list_sessions(self) -> dict:
+        """Union of every replica's addressable sessions (GET /sessions
+        on the router — the fleet-wide worklist)."""
+        out: list[str] = []
+        seen: set = set()
+        with self._lock:
+            items = list(self.replicas.items())
+        for rid, handle in items:
+            try:
+                fresh = [s for s in handle.open_sids() if s not in seen]
+            except Exception:
+                continue
+            out += fresh
+            seen.update(fresh)
+        return {"sessions": out}
+
+    # -- observability -----------------------------------------------------
+    def healthz(self) -> dict:
+        with self._lock:
+            health = dict(self._health)
+            routable = sorted(self._routable)
+            n_replicas = len(self.replicas)
+        ready = bool(routable) and not self.draining
+        problems = [f"replica_{rid}_{st}" for rid, st in sorted(
+            health.items()) if st not in ("ok",)]
+        status = ("unready" if not routable
+                  else "degraded" if len(routable) < n_replicas or problems
+                  else "ok")
+        return {"ok": ready, "ready": bool(routable),
+                "draining": self.draining, "status": status,
+                "role": "router", "replicas": health,
+                "routable": routable, "problems": problems}
+
+    def stats(self) -> dict:
+        """The merged fleet snapshot: per-replica /stats sections, the
+        aggregate sums a dashboard wants, and the router's own routing/
+        migration counters — one endpoint, not a per-replica curl loop."""
+        with self._lock:
+            items = list(self.replicas.items())
+            counters = dict(self.counters)
+            via = dict(self.migrations_via)
+            routed = dict(self.routed_to)
+            routable = sorted(self._routable)
+            health = dict(self._health)
+            placed = len(self._placed)
+        per_replica: dict[str, dict] = {}
+        for rid, handle in items:
+            try:
+                per_replica[rid] = handle.stats()
+            except Exception as e:
+                per_replica[rid] = {"error": repr(e)}
+        agg_keys = ("open_sessions", "slab_occupancy", "dispatches",
+                    "requests", "sessions_opened", "sessions_closed",
+                    "demotions", "wakes", "hibernates", "peer_pages")
+        aggregate = {k: sum(int(s.get(k) or 0) for s in per_replica.values()
+                            if "error" not in s) for k in agg_keys}
+        return {
+            "role": "router",
+            "replicas": per_replica,
+            "aggregate": aggregate,
+            "router": {
+                "routable": routable,
+                "health": health,
+                "counters": counters,
+                "migrations_via": via,
+                "requests_to": routed,
+                "placed_overrides": placed,
+                "migration_verified": sum(via.values()),
+            },
+        }
+
+    def render_metrics(self) -> str:
+        """The merged /metrics exposition: router registry families plus
+        every serve family rendered ONCE with per-replica labels."""
+        from coda_tpu.telemetry.prometheus import render_fleet
+
+        st = self.stats()
+        return render_fleet(st["replicas"],
+                            registry=self.telemetry.registry,
+                            router_stats=st["router"])

@@ -331,8 +331,10 @@ class ServeApp:
     # -- the session verbs (shared by the front door and in-process
     #    callers; *_begin/_abort split out so the asyncio path can run the
     #    blocking host half on an executor and await only the ticket) ------
-    def _open_begin(self, task: Optional[str], seed: Optional[int]):
+    def _open_begin(self, task: Optional[str], seed: Optional[int],
+                    sid: Optional[str] = None):
         from coda_tpu.serve.batcher import Ticket
+        from coda_tpu.serve.recovery import _SID_RE
 
         if self.draining:
             self.metrics.record_session("reject")
@@ -340,9 +342,24 @@ class ServeApp:
         task = task or self.default_task
         if task is None:
             raise KeyError("no task registered")
+        if sid is not None:
+            # a fleet router pins the session id at open so placement is
+            # rendezvous-on-id; it must still be the hex form the HTTP
+            # routes (and the recorder file layout) can address
+            if not _SID_RE.match(str(sid)):
+                raise ValueError(f"invalid session id {sid!r}: expected "
+                                 "lowercase hex")
+            if self.tiers is not None and self.tiers.parked(sid):
+                # the store only collides against LIVE sids; a parked
+                # session is still addressable, and re-opening its id
+                # would put two states under one identity (the stale
+                # parked copy would wake later under the new client's
+                # handle)
+                raise ValueError(f"session id {sid!r} already exists "
+                                 "(parked in the warm/cold tier)")
         try:
             sess = self._admit(task, self._auto_seed() if seed is None
-                               else int(seed))
+                               else int(seed), sid=sid)
         except SlabFull:
             self.metrics.record_session("reject")
             raise
@@ -377,8 +394,9 @@ class ServeApp:
         self.metrics.record_session("close")
 
     def open_session(self, task: Optional[str] = None,
-                     seed: Optional[int] = None) -> dict:
-        sess, ticket = self._open_begin(task, seed)
+                     seed: Optional[int] = None,
+                     sid: Optional[str] = None) -> dict:
+        sess, ticket = self._open_begin(task, seed, sid=sid)
         try:
             res = ticket.wait(REQUEST_TIMEOUT_S)
         except BaseException:
@@ -387,7 +405,8 @@ class ServeApp:
         return self._payload(sess, res)
 
     async def open_session_async(self, task: Optional[str] = None,
-                                 seed: Optional[int] = None) -> dict:
+                                 seed: Optional[int] = None,
+                                 sid: Optional[str] = None) -> dict:
         loop = asyncio.get_running_loop()
         if (self.recorder.out_dir is None
                 and self.store.has_fast_admission(
@@ -400,13 +419,13 @@ class ServeApp:
             # disqualifies the fast path: recorder.open() would do disk
             # I/O (and contend on the recorder lock with the batcher's
             # per-row flushes) on the event loop.
-            sess, ticket = self._open_begin(task, seed)
+            sess, ticket = self._open_begin(task, seed, sid=sid)
         else:
             # unseen (task, spec) or cold bucket: bucket construction /
             # per-admission init compute runs for real — never on the
             # event loop
             sess, ticket = await loop.run_in_executor(
-                self._executor, self._open_begin, task, seed)
+                self._executor, self._open_begin, task, seed, sid)
         try:
             res = await ticket.wait_async(REQUEST_TIMEOUT_S)
         except BaseException:
@@ -734,6 +753,19 @@ class ServeApp:
             if b.quarantined is not None:
                 self.healer.schedule(b)
 
+    def list_sessions(self) -> dict:
+        """Every addressable session id across all tiers (the fleet
+        router's rebalance worklist — ``GET /sessions``). Set-deduped:
+        this runs per replica per topology change at 100k+-session
+        scale."""
+        with self.store.lock:
+            sids = list(self.store._sessions)
+        if self.tiers is not None:
+            seen = set(sids)
+            sids += [s for s in self.tiers.parked_sids()
+                     if s not in seen]
+        return {"sessions": sids}
+
     def healthz(self) -> dict:
         ready = self.ready.is_set()
         # three-state readiness for the load balancer: "unready" (warm
@@ -997,9 +1029,18 @@ class AsyncHTTPServer:
             try:
                 from coda_tpu.telemetry import render_prometheus
 
+                # a fleet router merges every replica's families with
+                # per-replica labels (render_metrics); a single replica
+                # renders its own registry + serve snapshot
+                if hasattr(app, "render_metrics"):
+                    render = app.render_metrics
+                else:
+                    def render():
+                        return render_prometheus(
+                            app.telemetry.registry,
+                            serve_metrics=app.metrics)
                 text = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: render_prometheus(
-                        app.telemetry.registry, serve_metrics=app.metrics))
+                    None, render)
             except Exception as e:
                 return 500, {"error": f"internal: {e}"}, _JSON
             return 200, text, _PROM
@@ -1045,8 +1086,12 @@ class AsyncHTTPServer:
                                               app.import_session, req)
         if method == "POST" and path == "/session":
             req = json.loads(raw or b"{}")
+            kw = {}
+            if req.get("session") is not None:
+                # a fleet router pins the id (rendezvous placement)
+                kw["sid"] = str(req["session"])
             return await app.open_session_async(task=req.get("task"),
-                                                seed=req.get("seed"))
+                                                seed=req.get("seed"), **kw)
         if m and method == "POST" and m.group(3) == "label":
             req = json.loads(raw or b"{}")
             if "label" not in req:
@@ -1079,6 +1124,9 @@ class AsyncHTTPServer:
                                               app.close_session, m.group(1))
         if method == "GET" and path == "/stats":
             return await loop.run_in_executor(app._executor, app.stats)
+        if method == "GET" and path == "/sessions":
+            return await loop.run_in_executor(app._executor,
+                                              app.list_sessions)
         return None
 
 

@@ -1,0 +1,318 @@
+"""Cold-tier spill store v2: append-log + in-memory index + compression.
+
+The cold tier's first incarnation hibernated each session to its own
+``hibernated_<sid>.json`` file. That is transparent and crash-obvious, but
+it does not survive contact with the ROADMAP's literal million sessions:
+1M inodes, 1M ``open()`` syscalls to re-index at startup, and the
+uncompressed JSON payload (base64 carries + full row history) at ~10-40 KB
+per session puts tens of GB on disk for state that compresses 5-10x.
+
+This module replaces it with a single append-only log:
+
+  * **records** — one frame per hibernate: a JSON header line
+    ``{"sid", "n", "crc", ...}`` followed by exactly ``n`` bytes of
+    zlib-compressed JSON payload and a trailing newline. Appends are
+    O(payload) with one ``flush``; a process killed mid-append leaves a
+    torn FINAL frame, which the scan drops (the same contract as the
+    recorder's JSONL streams).
+  * **index** — an in-memory ``sid -> (offset, length)`` map rebuilt by
+    scanning the log at startup: last frame per sid wins, a tombstone
+    frame (``"tomb": true``) deletes. A million sessions index in one
+    sequential read of headers (the payloads are seeked over, not read).
+  * **compaction on startup** — when dead bytes (superseded frames,
+    tombstones) exceed half the log, the live set is rewritten to a fresh
+    log and atomically swapped in. Runtime appends never pay compaction.
+  * **legacy layout readable** — ``hibernated_<sid>.json`` files from the
+    v1 store are indexed at startup and served transparently; startup
+    compaction folds them into the log and removes the per-file copies,
+    so a v1 spill dir upgrades itself on first start.
+
+Thread safety: one lock around the index and the append fd. Reads seek on
+a separate fd so a ``get`` never blocks behind an in-flight append's
+flush.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import Iterator, Optional
+
+#: the v1 per-file layout (still readable; compaction folds it in)
+LEGACY_PREFIX = "hibernated_"
+#: the v2 append-log
+LOG_NAME = "spill.log"
+#: rewrite the log at startup when dead bytes exceed this fraction
+COMPACT_GARBAGE_FRAC = 0.5
+
+
+def _legacy_path(spill_dir: str, sid: str) -> str:
+    return os.path.join(spill_dir, f"{LEGACY_PREFIX}{sid}.json")
+
+
+class SpillStore:
+    """Append-log session hibernation store (see module docstring).
+
+    The public surface the tier manager needs: ``put``/``get``/``delete``/
+    ``sids``/``__contains__``/``__len__``. Payloads are JSON-able dicts
+    (the export payload); the store owns serialization + compression.
+    """
+
+    def __init__(self, spill_dir: str, compact: bool = True):
+        self.dir = spill_dir
+        self.log_path = os.path.join(spill_dir, LOG_NAME)
+        self._lock = threading.Lock()
+        # sid -> (offset, n_bytes) into the log, or the LEGACY marker
+        # (None, path) for a v1 per-file payload not yet folded in
+        self._index: dict[str, tuple] = {}
+        # dead bytes (superseded/tombstone frames) as measured by the
+        # startup scan — the compact-on-startup decision's input; runtime
+        # appends don't maintain it (compaction never runs at runtime)
+        self._dead_bytes = 0
+        # tombstones whose append failed (ENOSPC): retried before the
+        # next successful append so a deleted sid cannot silently
+        # resurrect at the next startup scan
+        self._tomb_retry: set[str] = set()
+        self.compactions = 0      # startup compactions run
+        self.put_errors = 0       # appends that failed (caller keeps warm)
+        os.makedirs(spill_dir, exist_ok=True)
+        self._scan()
+        if compact and self._wants_compaction():
+            self.compact()
+        self._append_fd = open(self.log_path, "ab")
+
+    # -- startup scan ------------------------------------------------------
+    def _scan(self) -> None:
+        """Rebuild the index: legacy files first (a log frame for the same
+        sid supersedes its per-file copy), then one sequential pass over
+        the log headers. A torn final frame is truncated away — the crash
+        the append path's single-flush contract allows."""
+        for fn in sorted(os.listdir(self.dir)):
+            if fn.startswith(LEGACY_PREFIX) and fn.endswith(".json"):
+                sid = fn[len(LEGACY_PREFIX):-len(".json")]
+                self._index[sid] = (None, os.path.join(self.dir, fn))
+        if not os.path.exists(self.log_path):
+            return
+        good_end = 0
+        extents: dict[str, tuple] = {}   # sid -> (head_off, frame_end)
+        with open(self.log_path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            while True:
+                head_off = f.tell()
+                line = f.readline()
+                if not line:
+                    break
+                try:
+                    head = json.loads(line)
+                    n = int(head["n"])
+                    sid = head["sid"]
+                except (ValueError, KeyError, TypeError):
+                    break  # torn/garbage frame: the log ends here
+                payload_off = f.tell()
+                if payload_off + n + 1 > size:
+                    break  # torn payload (crash mid-append)
+                f.seek(payload_off + n)
+                if f.read(1) != b"\n":
+                    break  # frame not terminated: torn
+                good_end = f.tell()
+                prev = extents.pop(sid, None)
+                if prev is not None:
+                    self._dead_bytes += prev[1] - prev[0]  # superseded
+                if head.get("tomb"):
+                    self._index.pop(sid, None)
+                    self._dead_bytes += good_end - head_off
+                else:
+                    # a log frame supersedes a legacy file too (the legacy
+                    # copy becomes garbage compaction removes)
+                    self._index[sid] = (payload_off, n)
+                    extents[sid] = (head_off, good_end)
+        if good_end < size:
+            # drop the torn tail so the next append starts on a frame
+            # boundary instead of gluing onto half a record
+            with open(self.log_path, "ab") as f:
+                f.truncate(good_end)
+
+    def _wants_compaction(self) -> bool:
+        try:
+            size = os.path.getsize(self.log_path)
+        except OSError:
+            size = 0
+        has_legacy = any(off is None for off, _ in self._index.values())
+        return has_legacy or (
+            size > 0 and self._dead_bytes > COMPACT_GARBAGE_FRAC * size)
+
+    # -- frame codec -------------------------------------------------------
+    @staticmethod
+    def _encode(payload: dict) -> bytes:
+        return zlib.compress(
+            json.dumps(payload, separators=(",", ":")).encode(), 6)
+
+    def _frame(self, sid: str, zbytes: Optional[bytes]) -> bytes:
+        head: dict = {"sid": sid, "n": len(zbytes or b"")}
+        if zbytes is None:
+            head = {"sid": sid, "n": 0, "tomb": True}
+            zbytes = b""
+        else:
+            head["crc"] = zlib.crc32(zbytes)
+        return json.dumps(head, separators=(",", ":")).encode() \
+            + b"\n" + zbytes + b"\n"
+
+    def _read_at(self, offset: int, n: int) -> dict:
+        with open(self.log_path, "rb") as f:
+            f.seek(offset)
+            zbytes = f.read(n)
+        return json.loads(zlib.decompress(zbytes))
+
+    def _append_locked(self, frame: bytes) -> Optional[int]:
+        """Append one frame under the lock; returns its start offset, or
+        None on failure — with the tail rewound, because a partial write
+        (ENOSPC mid-flush) would otherwise make the startup scan's
+        torn-tail truncation drop every valid frame appended after it."""
+        offset = self._append_fd.tell()
+        try:
+            self._append_fd.write(frame)
+            self._append_fd.flush()
+            return offset
+        except OSError:
+            try:
+                self._append_fd.seek(offset)
+                self._append_fd.truncate(offset)
+            except OSError:
+                pass  # scan-time truncation remains the backstop
+            self.put_errors += 1
+            return None
+
+    def _flush_tombstones_locked(self) -> None:
+        for sid in list(self._tomb_retry):
+            if self._append_locked(self._frame(sid, None)) is None:
+                return  # disk still unhappy; keep retrying later
+            self._tomb_retry.discard(sid)
+
+    # -- the store surface -------------------------------------------------
+    def put(self, sid: str, payload: dict) -> bool:
+        """Append one hibernate frame; False (counted) when the disk write
+        failed — the caller keeps the session warm, never lost."""
+        zbytes = self._encode(payload)
+        frame = self._frame(sid, zbytes)
+        with self._lock:
+            self._flush_tombstones_locked()  # deletes land before puts
+            offset = self._append_locked(frame)
+            if offset is None:
+                return False
+            payload_off = offset + frame.index(b"\n") + 1
+            self._index[sid] = (payload_off, len(zbytes))
+        # a log frame supersedes the legacy per-file copy
+        try:
+            os.remove(_legacy_path(self.dir, sid))
+        except OSError:
+            pass
+        return True
+
+    def get(self, sid: str) -> Optional[dict]:
+        with self._lock:
+            entry = self._index.get(sid)
+        if entry is None:
+            return None
+        offset, ref = entry
+        try:
+            if offset is None:          # legacy per-file payload
+                with open(ref) as f:
+                    return json.load(f)
+            return self._read_at(offset, ref)
+        except (OSError, ValueError, zlib.error):
+            return None
+
+    def delete(self, sid: str) -> bool:
+        """Tombstone one sid (and drop its legacy file, if any). A failed
+        tombstone append is queued and retried before the next append —
+        without that, a restart's scan would re-index the last live
+        frame and resurrect a session the server confirmed closed."""
+        with self._lock:
+            entry = self._index.pop(sid, None)
+            if entry is None:
+                return False
+            offset, ref = entry
+            if offset is not None:
+                if self._append_locked(self._frame(sid, None)) is None:
+                    self._tomb_retry.add(sid)
+        if offset is None:
+            try:
+                os.remove(ref)
+            except OSError:
+                pass
+        return True
+
+    def sids(self) -> list[str]:
+        with self._lock:
+            return list(self._index)
+
+    def __contains__(self, sid: str) -> bool:
+        with self._lock:
+            return sid in self._index
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def items(self) -> Iterator[tuple]:
+        """(sid, payload) over the live set (the export-parked sweep)."""
+        for sid in self.sids():
+            payload = self.get(sid)
+            if payload is not None:
+                yield sid, payload
+
+    # -- compaction --------------------------------------------------------
+    def compact(self) -> dict:
+        """Rewrite the log with only live frames (legacy files folded in
+        and removed), atomically swapped. Startup-only by construction —
+        the caller runs it before the append fd opens."""
+        tmp = self.log_path + ".tmp"
+        new_index: dict[str, tuple] = {}
+        legacy_done: list[str] = []
+        n_live = 0
+        with open(tmp, "wb") as out:
+            for sid in list(self._index):
+                entry = self._index.get(sid)
+                if entry is None:
+                    continue
+                offset, ref = entry
+                try:
+                    if offset is None:
+                        with open(ref) as f:
+                            zbytes = self._encode(json.load(f))
+                        legacy_done.append(ref)
+                    else:
+                        with open(self.log_path, "rb") as f:
+                            f.seek(offset)
+                            zbytes = f.read(ref)
+                        json.loads(zlib.decompress(zbytes))  # verify
+                except (OSError, ValueError, zlib.error):
+                    continue  # unreadable frame: dropped, not copied
+                frame = self._frame(sid, zbytes)
+                head_off = out.tell()
+                out.write(frame)
+                new_index[sid] = (head_off + frame.index(b"\n") + 1,
+                                  len(zbytes))
+                n_live += 1
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, self.log_path)
+        self._index = new_index
+        self._dead_bytes = 0
+        self.compactions += 1
+        for path in legacy_done:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        return {"live": n_live, "legacy_folded": len(legacy_done)}
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_tombstones_locked()  # last chance to persist
+            try:
+                self._append_fd.close()
+            except OSError:
+                pass

@@ -15,11 +15,19 @@ posterior estimation):
     is freed; the recorder stream is *parked* (fd closed, in-memory
     history dropped — the payload carries the rows) but NOT closed, so a
     crash still restores the session from its stream;
-  * **cold** — hibernated to disk: the payload lands in
-    ``<spill_dir>/hibernated_<sid>.json`` and the recorder stream gets its
-    close marker (the hibernate file is now the authority; ``--restore``
-    must not double-restore it). A restarted TierManager re-indexes the
-    spill dir, so cold sessions survive process death.
+  * **cold** — hibernated to disk: the payload lands in the spill dir's
+    append-log store (``serve/spill.py`` — zlib-compressed frames + an
+    in-memory index, compacted at startup; the v1 one-file-per-session
+    layout is still readable) and the recorder stream gets its close
+    marker (the spill store is now the authority; ``--restore`` must not
+    double-restore it). A restarted TierManager re-indexes the spill
+    log, so cold sessions survive process death.
+
+In a replica fleet (``serve/fleet.py``) the warm→cold transition gets a
+third option: when a ``page_out`` hook is installed, a watermark- or
+age-pressured warm session is offered to a less-loaded PEER replica
+first (the payload imports there, digest-verified, and the fleet router
+re-points the sid) and only hits the local disk when no peer takes it.
 
 A label, ``best``, or ``trace`` arriving for a non-resident session
 transparently **wakes** it through the import fast path — snapshot
@@ -56,21 +64,14 @@ hibernates_total`` counters, and a wake-latency ring (p50/p99) ride
 
 from __future__ import annotations
 
-import json
-import os
 import threading
 import time
 from collections import OrderedDict
 from typing import Optional
 
+from coda_tpu.serve.spill import LEGACY_PREFIX as _HIB_PREFIX  # noqa: F401
+from coda_tpu.serve.spill import SpillStore
 from coda_tpu.serve.state import SlabFull, UnknownSession
-
-#: hibernate filename prefix (the spill-dir scan contract)
-_HIB_PREFIX = "hibernated_"
-
-
-def _hib_path(spill_dir: str, sid: str) -> str:
-    return os.path.join(spill_dir, f"{_HIB_PREFIX}{sid}.json")
 
 
 class TierManager:
@@ -102,20 +103,26 @@ class TierManager:
         self.min_idle_s = float(min_idle_s)
         self.wake_attempts = int(wake_attempts)
         # tier maps: sid -> {payload, task, last_used} (warm, LRU-ordered)
-        # and sid -> hibernate path (cold). _waking holds one event per
-        # in-flight wake so a thundering herd of requests for one sid
-        # rides a single restore.
+        # and the cold append-log store (spill.py, its own sid index).
+        # _waking holds one event per in-flight wake so a thundering herd
+        # of requests for one sid rides a single restore.
         self._lock = threading.Lock()
         self._warm: "OrderedDict[str, dict]" = OrderedDict()
-        self._cold: dict[str, str] = {}
         self._waking: dict[str, threading.Event] = {}
         self.spill_errors = 0        # hibernate writes that failed (stayed warm)
+        # fleet hook (serve/fleet.py): page_out(sid, payload) -> bool
+        # offers a warm payload to a less-loaded peer replica before the
+        # disk; True = the peer imported it (digest-verified) and owns it
+        # (counted in ServeMetrics.peer_pages + the router's counter)
+        self.page_out = None
         self._running = False
         self._wakeup = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        if spill_dir:
-            os.makedirs(spill_dir, exist_ok=True)
-            self._scan_spill_dir()
+        # the cold tier: append-log + index + compression; re-indexes (and
+        # startup-compacts) a previous incarnation's log AND any v1
+        # hibernated_<sid>.json files, so cold sessions survive process
+        # death across both layouts
+        self._spill = SpillStore(spill_dir) if spill_dir else None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "TierManager":
@@ -134,26 +141,32 @@ class TierManager:
         if t is not None:
             t.join(timeout=5.0)
 
-    def _scan_spill_dir(self) -> None:
-        """Re-index hibernated sessions left by a previous incarnation —
-        cold sessions survive process death and stay addressable."""
-        for fn in sorted(os.listdir(self.spill_dir)):
-            if fn.startswith(_HIB_PREFIX) and fn.endswith(".json"):
-                sid = fn[len(_HIB_PREFIX):-len(".json")]
-                self._cold[sid] = os.path.join(self.spill_dir, fn)
-
     # -- reads -------------------------------------------------------------
     def counts(self) -> dict:
         with self._lock:
-            warm, cold = len(self._warm), len(self._cold)
+            warm = len(self._warm)
+        cold = len(self._spill) if self._spill is not None else 0
         return {"hot": self.app.store.live_sessions(), "warm": warm,
                 "cold": cold}
 
     def parked(self, sid: str) -> bool:
         """Whether the sid lives in a non-resident tier (or is mid-wake)."""
         with self._lock:
-            return (sid in self._warm or sid in self._cold
-                    or sid in self._waking)
+            if sid in self._warm or sid in self._waking:
+                return True
+        return self._spill is not None and sid in self._spill
+
+    def parked_sids(self) -> list[str]:
+        """Every non-resident session id, warm first then cold — the one
+        tier-union enumeration (``/sessions``, ``export_parked``, the
+        fleet worklist all read this, so the tier map layout has a
+        single reader)."""
+        with self._lock:
+            sids = list(self._warm)
+        if self._spill is not None:
+            seen = set(sids)
+            sids += [s for s in self._spill.sids() if s not in seen]
+        return sids
 
     def parked_payload(self, sid: str) -> Optional[dict]:
         """The export payload of a parked session, without waking it (the
@@ -164,22 +177,15 @@ class TierManager:
             entry = self._warm.get(sid)
             if entry is not None:
                 return entry["payload"]
-            path = self._cold.get(sid)
-        if path is None:
+        if self._spill is None:
             return None
-        try:
-            with open(path) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
+        return self._spill.get(sid)
 
     def export_parked(self) -> list:
         """Every parked session's payload (the drain/migrate sweep's
         off-slab half — rolling restarts must carry all three tiers)."""
-        with self._lock:
-            sids = list(self._warm) + list(self._cold)
         out = []
-        for sid in sids:
+        for sid in self.parked_sids():
             p = self.parked_payload(sid)
             if p is not None:
                 out.append(p)
@@ -300,31 +306,55 @@ class TierManager:
 
     # -- hibernation (warm -> cold) ----------------------------------------
     def hibernate(self, sid: str) -> bool:
-        """Move one warm payload to disk. Runs under the tier lock end to
-        end (the JSON is small) so the sid is never unreachable mid-move;
-        a failed disk write leaves the session warm, counted, never lost."""
-        if not self.spill_dir:
+        """Move one warm payload into the spill log. Runs under the tier
+        lock end to end (one compressed append) so the sid is never
+        unreachable mid-move; a failed disk write leaves the session
+        warm, counted, never lost."""
+        if self._spill is None:
             return False
         with self._lock:
             entry = self._warm.get(sid)
             if entry is None:
                 return False
-            path = _hib_path(self.spill_dir, sid)
-            tmp = path + ".tmp"
-            try:
-                with open(tmp, "w") as f:
-                    json.dump(entry["payload"], f)
-                os.replace(tmp, path)
-            except OSError:
+            if not self._spill.put(sid, entry["payload"]):
                 self.spill_errors += 1
                 return False
             del self._warm[sid]
-            self._cold[sid] = path
-        # the hibernate file is now the authority: seal the recorder
+        # the spilled frame is now the authority: seal the recorder
         # stream (close marker) so --restore skips it instead of
         # rebuilding a second live copy next to the cold one
         self.app.recorder.seal(sid)
         self.app.metrics.record_tier("hibernate")
+        self._publish_gauges()
+        return True
+
+    def page_to_peer(self, sid: str) -> bool:
+        """Offer one warm payload to a peer replica via the fleet's
+        ``page_out`` hook (demotion-aware peer paging): the entry leaves
+        the warm map FIRST (atomically — a concurrent wake then misses
+        locally and the router finds the session on the peer), the peer
+        imports it digest-verified, and on any failure the entry is
+        re-parked warm, never lost. The local stream gets its close
+        marker exactly like a migration away — the peer owns the session
+        now."""
+        hook = self.page_out
+        if hook is None:
+            return False
+        with self._lock:
+            entry = self._warm.pop(sid, None)
+        if entry is None:
+            return False
+        ok = False
+        try:
+            ok = bool(hook(sid, entry["payload"]))
+        except Exception:
+            ok = False
+        if not ok:
+            with self._lock:
+                self._warm[sid] = entry
+            return False
+        self.app.recorder.seal(sid)
+        self.app.metrics.record_tier("peer_page")
         self._publish_gauges()
         return True
 
@@ -339,7 +369,8 @@ class TierManager:
             if ev is not None:
                 mine = False
             else:
-                if sid not in self._warm and sid not in self._cold:
+                if sid not in self._warm and not (
+                        self._spill is not None and sid in self._spill):
                     return False
                 ev = self._waking[sid] = threading.Event()
                 mine = True
@@ -363,14 +394,12 @@ class TierManager:
         t0 = time.perf_counter()
         with self._lock:
             entry = self._warm.pop(sid, None)
-            path = None if entry is not None else self._cold.get(sid)
+        payload = None
         if entry is not None:
             src, payload = "warm", entry["payload"]
-        elif path is not None:
-            src = "cold"
-            with open(path) as f:
-                payload = json.load(f)
-        else:
+        elif self._spill is not None:
+            src, payload = "cold", self._spill.get(sid)
+        if payload is None:
             return  # discarded between the caller's check and ours
         try:
             info = None
@@ -400,12 +429,7 @@ class TierManager:
             self.app._heal_quarantined()
             raise
         if src == "cold":
-            with self._lock:
-                self._cold.pop(sid, None)
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+            self._spill.delete(sid)
         self.app.metrics.record_tier(
             "wake", src=src, seconds=time.perf_counter() - t0,
             via=(info or {}).get("restored_via"))
@@ -417,13 +441,8 @@ class TierManager:
         go away; the caller writes the stream's close marker."""
         with self._lock:
             had_warm = self._warm.pop(sid, None) is not None
-            path = self._cold.pop(sid, None)
-        if path is not None:
-            try:
-                os.remove(path)
-            except OSError:
-                pass
-        if had_warm or path is not None:
+        had_cold = (self._spill is not None and self._spill.delete(sid))
+        if had_warm or had_cold:
             self._publish_gauges()
             return True
         return False
@@ -462,7 +481,7 @@ class TierManager:
                            if now - s.last_used >= self.min_idle_s]
                     if lru:
                         n_demoted += self.demote_batch(bucket, lru)
-        if self.spill_dir:
+        if self._spill is not None or self.page_out is not None:
             with self._lock:
                 aged = [sid for sid, e in self._warm.items()
                         if now - e["last_used"] > self.idle_cold_s]
@@ -475,6 +494,12 @@ class TierManager:
                 else:
                     lru = []
             for sid in aged + lru:
+                # demotion-aware peer paging: a pressured replica offers
+                # the payload to a less-loaded peer first; disk is the
+                # fallback, not the only exit
+                if self.page_to_peer(sid):
+                    n_hibernated += 1
+                    continue
                 n_hibernated += self.hibernate(sid)
         self._publish_gauges()
         from coda_tpu.telemetry.registry import sample_process_rss

@@ -99,6 +99,8 @@ _SERVE_SCALARS = [
      "Sessions demoted hot -> warm (slab slot freed, payload in host RAM)"),
     ("hibernates", "serve_hibernates_total", "counter",
      "Sessions hibernated warm -> cold (payload spilled to disk)"),
+    ("peer_pages", "serve_peer_pages_total", "counter",
+     "Warm sessions paged to a less-loaded peer replica instead of disk"),
     ("wakes", "serve_wakes_total", "counter",
      "Non-resident sessions transparently woken back onto the slab"),
     ("wakes_from_warm", "serve_wakes_from_warm_total", "counter",
@@ -262,6 +264,93 @@ def lint(text: str) -> list[str]:
             except ValueError:
                 out.append(f"line {i}: bad value {val!r}")
     return out
+
+
+def render_fleet(replica_snaps: dict, registry: Optional[Registry] = None,
+                 router_stats: Optional[dict] = None,
+                 prefix: str = "coda") -> str:
+    """The fleet's merged exposition: each serve family rendered ONCE
+    with a ``replica`` label per sample (families stay contiguous, so
+    the output is :func:`lint`-clean), plus the router's own routing/
+    migration counters. This is what keeps fleet observability a single
+    scrape instead of a per-replica curl loop.
+
+    ``replica_snaps`` maps replica id -> its ``ServeMetrics.snapshot()``
+    dict (the ``/stats`` payload — handle-type agnostic, so HTTP and
+    in-process replicas merge identically)."""
+    out: list[str] = []
+    reg = registry if registry is not None else get_registry()
+    for m in reg.collect():
+        _family(out, _name(prefix, m.name), m.kind, m.help, m.samples())
+    if router_stats is not None:
+        rt = router_stats
+        counters = rt.get("counters") or {}
+        for key, help in (
+                ("requests_routed", "Requests the router forwarded"),
+                ("reroutes", "Requests re-routed after an off-owner find"),
+                ("migrations", "Sessions drain-and-migrated between "
+                               "replicas (each digest-verified)"),
+                ("migration_failures", "Migrations that failed and were "
+                                       "restored to their source"),
+                ("sessions_dropped", "Sessions lost in a failed migration "
+                                     "(must stay 0)"),
+                ("evictions", "Replicas evicted from routing by health"),
+                ("rejoins", "Replicas re-admitted to routing by health"),
+                ("rebalances", "Topology-change rebalance passes"),
+        ):
+            if key in counters:
+                _family(out, _name(prefix, f"router_{key}_total"),
+                        "counter", help, [({}, counters[key])])
+        routed = rt.get("requests_to") or {}
+        if routed:
+            _family(out, _name(prefix, "router_requests_to_replica_total"),
+                    "counter", "Requests forwarded per replica",
+                    [({"replica": rid}, n)
+                     for rid, n in sorted(routed.items())])
+        routable = rt.get("routable")
+        if routable is not None:
+            _family(out, _name(prefix, "router_routable_replicas"),
+                    "gauge", "Replicas currently in the routing set",
+                    [({}, len(routable))])
+    snaps = {rid: s for rid, s in sorted(replica_snaps.items())
+             if isinstance(s, dict) and "error" not in s}
+    for key, suffix, kind, help in _SERVE_SCALARS:
+        samples = [({"replica": rid}, s[key])
+                   for rid, s in snaps.items() if s.get(key) is not None]
+        if samples:
+            _family(out, _name(prefix, suffix), kind, help, samples)
+    for key, suffix, kind, help in _SERVE_WARM:
+        samples = [({"replica": rid}, (s.get("warm_pool") or {}).get(key))
+                   for rid, s in snaps.items()
+                   if (s.get("warm_pool") or {}).get(key) is not None]
+        if samples:
+            _family(out, _name(prefix, suffix), kind, help, samples)
+    for tier in ("hot", "warm", "cold"):
+        samples = [({"replica": rid}, (s.get("tiers") or {}).get(tier))
+                   for rid, s in snaps.items()
+                   if (s.get("tiers") or {}).get(tier) is not None]
+        if samples:
+            _family(out, _name(prefix, f"serve_sessions_{tier}"), "gauge",
+                    f"Open sessions currently in the {tier} tier",
+                    samples)
+    for key, suffix, count_key, help in _SERVE_SUMMARIES:
+        name = _name(prefix, suffix)
+        samples = []
+        counts = []
+        for rid, s in snaps.items():
+            q = s.get(key) or {}
+            for qk, quantile in (("p50_ms", "0.5"), ("p99_ms", "0.99")):
+                if q.get(qk) is not None:
+                    samples.append(({"quantile": quantile,
+                                     "replica": rid}, q[qk] / 1e3))
+            if q.get("p50_ms") is not None:
+                counts.append(({"replica": rid}, s.get(count_key, 0)))
+        if not samples:
+            continue
+        _family(out, name, "summary", help, samples)
+        for labels, n in counts:
+            out.append(_line(name + "_count", labels, n))
+    return "\n".join(out) + "\n"
 
 
 def _render_serve(out: list, snap: dict, prefix: str) -> None:

@@ -175,6 +175,19 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                  "--records-dir", os.path.join(tmpdir, "batchq_records")]
                 + plat,
                 os.path.join(tmpdir, "batchq.json"), 900),
+            # the replicated fleet at proof scale: 2 replicas behind the
+            # rendezvous router, rolling restart of both mid-load, every
+            # migration digest-verified (the committed 3-replica claim is
+            # BENCH_FLEET_*)
+            "serve_fleet": (
+                [py, "scripts/serve_loadgen.py", "--synthetic", "4,48,4",
+                 "--fleet", "2", "--sessions", "12", "--workers", "4",
+                 "--labels", "40", "--capacity", "8", "--retries", "8",
+                 "--rolling-restart-at", "0.3",
+                 "--compilation-cache-dir",
+                 os.path.join(tmpdir, "fleet_cache"),
+                 "--out", os.path.join(tmpdir, "fleet.json")] + plat,
+                os.path.join(tmpdir, "fleet.json"), 900),
         }
     return {
         # the r09 evidence set the ROADMAP asks for, in one run
@@ -221,6 +234,19 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
              "--records-dir", os.path.join(tmpdir, "batchq_records")]
             + plat,
             os.path.join(tmpdir, "batchq.json"), 3600),
+        # the full 3-replica fleet demo (the BENCH_FLEET_* configuration):
+        # rolling restart of every replica in sequence under live load,
+        # zero drops / zero double-applies, scaling vs the 1-replica
+        # baseline (--fleet-baseline)
+        "serve_fleet": (
+            [py, "scripts/serve_loadgen.py", "--synthetic", "8,256,10",
+             "--fleet", "3", "--fleet-baseline", "--sessions", "24",
+             "--workers", "8", "--labels", "60", "--capacity", "18",
+             "--retries", "10", "--rolling-restart-at", "0.3",
+             "--compilation-cache-dir",
+             os.path.join(tmpdir, "fleet_cache"),
+             "--out", os.path.join(tmpdir, "fleet.json")] + plat,
+            os.path.join(tmpdir, "fleet.json"), 3600),
     }
 
 

@@ -235,6 +235,114 @@ def tiered_check_report(report: dict) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# the replicated-fleet contract (ISSUE 13 acceptance: BENCH_FLEET_* holds
+# the near-linear-aggregate / zero-drop rolling-restart claim)
+# ---------------------------------------------------------------------------
+
+FLEET_MIN_REPLICAS = 3
+# multi-core capture: aggregate rps at N replicas >= 0.75 * N * baseline
+FLEET_MIN_EFFICIENCY = 0.75
+# 1-core container (every replica shares the core): parity with one
+# replica is the physically honest ceiling — the router must not cost it
+FLEET_MIN_PARITY = 0.7
+
+_FLEET_REQUIRED = (
+    "replicas", "host_cores", "single_core", "per_replica",
+    "request_share", "balance", "router", "rolling_restart",
+    "dropped_sessions", "double_applied_labels", "router_spans",
+    "scaling",
+)
+
+
+def fleet_check_report(report: dict) -> list[str]:
+    """Violations of one fleet capture (empty = clean): the rolling
+    restart of EVERY replica in sequence with zero dropped sessions and
+    zero double-applied labels, every migration digest-verified, the
+    request distribution actually spread, the router's added latency
+    span-attributed, and the scaling claim (efficiency on multi-core,
+    documented parity on the 1-core container)."""
+    out: list[str] = []
+    if report.get("mode") != "fleet":
+        out.append(f"mode {report.get('mode')!r} != 'fleet'")
+    f = report.get("fleet")
+    if not isinstance(f, dict):
+        return out + ["fleet section missing"]
+    for key in _FLEET_REQUIRED:
+        if f.get(key) is None:
+            out.append(f"fleet.{key} missing/null")
+    if out:
+        return out
+    if report.get("n_errors") != 0:
+        out.append(f"n_errors {report.get('n_errors')} != 0")
+    if f["replicas"] < FLEET_MIN_REPLICAS:
+        out.append(f"fleet.replicas {f['replicas']} < "
+                   f"{FLEET_MIN_REPLICAS}")
+    if f["dropped_sessions"] != 0:
+        out.append(f"fleet.dropped_sessions {f['dropped_sessions']} != 0")
+    if f["double_applied_labels"] != 0:
+        out.append(f"fleet.double_applied_labels "
+                   f"{f['double_applied_labels']} != 0")
+    rr = f.get("rolling_restart") or {}
+    if rr.get("replicas_restarted") != f["replicas"]:
+        out.append(f"rolling_restart.replicas_restarted "
+                   f"{rr.get('replicas_restarted')!r} != fleet.replicas "
+                   f"{f['replicas']} (every replica must cycle)")
+    if rr.get("sessions_dropped"):
+        out.append(f"rolling_restart.sessions_dropped "
+                   f"{rr['sessions_dropped']} != 0")
+    if rr.get("migration_failures"):
+        out.append(f"rolling_restart.migration_failures "
+                   f"{rr['migration_failures']} != 0")
+    router = f.get("router") or {}
+    migrations = (router.get("counters") or {}).get("migrations")
+    verified = router.get("migration_verified")
+    if not migrations:
+        out.append("router.counters.migrations is 0/missing — the "
+                   "restart cycled no live sessions, the zero-drop claim "
+                   "is unexercised")
+    elif verified != migrations:
+        out.append(f"router.migration_verified {verified!r} != migrations "
+                   f"{migrations} (every migration must restore via the "
+                   "digest-verified snapshot or bitwise-replay path)")
+    shares = f.get("request_share") or {}
+    if len([s for s in shares.values() if s > 0]) < f["replicas"]:
+        out.append("request_share: some replica served no requests — the "
+                   "rendezvous spread is unexercised")
+    spans = f.get("router_spans") or {}
+    if not spans.get("n_route_spans"):
+        out.append("router_spans.n_route_spans is 0/missing (added "
+                   "latency must be span-attributed)")
+    if spans.get("router_overhead_mean_ms") is None:
+        out.append("router_spans.router_overhead_mean_ms missing")
+    sc = f.get("scaling") or {}
+    eff, parity = sc.get("efficiency"), sc.get("parity_ratio")
+    if not isinstance(eff, (int, float)) or \
+            not isinstance(parity, (int, float)):
+        out.append("scaling.efficiency / parity_ratio missing (run the "
+                   "loadgen with --fleet-baseline)")
+    else:
+        # the efficiency ceiling is min(1, cores/replicas): N replicas
+        # cannot scale past the cores they share. The bound is 0.75 of
+        # that ceiling — on a >=N-core host that is the committed 0.75,
+        # on a core-limited host it is proportionally honest, and the
+        # artifact must STATE its regime (single_core/host_cores).
+        cores = f.get("host_cores") or 1
+        ceiling = min(1.0, cores / f["replicas"])
+        if eff < FLEET_MIN_EFFICIENCY * ceiling:
+            out.append(
+                f"scaling.efficiency {eff:.3f} < "
+                f"{FLEET_MIN_EFFICIENCY} * {ceiling:.2f} (the "
+                f"{cores}-core/{f['replicas']}-replica ceiling)")
+        if cores == 1 and parity < FLEET_MIN_PARITY:
+            # one core: aggregate parity with a single replica is the
+            # additional claim (the router must not eat the budget)
+            out.append(f"scaling.parity_ratio {parity:.3f} < "
+                       f"{FLEET_MIN_PARITY} on the 1-core container "
+                       "(the router cost more than the parity budget)")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # per-family checkers
 # ---------------------------------------------------------------------------
 
@@ -367,7 +475,7 @@ EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
 # them, and an absent optional component is a capture-config choice the
 # manifest's own "skipped" list records)
 EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered",
-                                "bench_batchq")
+                                "bench_batchq", "serve_fleet")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -412,6 +520,21 @@ def _evidence_check(report: dict) -> list[str]:
                        "broke in-capture)")
         if rep.get("replays_verified") is not True:
             out.append("bench_batchq.report.replays_verified is not true")
+    rep = (arts.get("serve_fleet") or {}).get("report") or {}
+    if rep:
+        fl = rep.get("fleet") or {}
+        if rep.get("n_errors") != 0:
+            out.append(f"serve_fleet.report.n_errors "
+                       f"{rep.get('n_errors')} != 0")
+        if fl.get("dropped_sessions"):
+            out.append("serve_fleet.report.fleet.dropped_sessions != 0")
+        if fl.get("double_applied_labels"):
+            out.append("serve_fleet.report.fleet.double_applied_labels "
+                       "!= 0")
+        rr = fl.get("rolling_restart") or {}
+        if rr.get("replicas_restarted") != fl.get("replicas"):
+            out.append("serve_fleet: rolling restart did not cycle every "
+                       "replica")
     rep = (arts.get("bench") or {}).get("report") or {}
     if rep and not (isinstance(rep.get("value"), (int, float))
                     and rep["value"] > 0):
@@ -445,6 +568,19 @@ CONTRACTS: tuple = (
         pattern="BENCH_SERVE_*.json", kind="serve_loadgen",
         checker=serve_check_report,
         group="serve", regress=("latency_ms.p99", "lower", 0.25)),
+    # -- replicated serve fleet (router + rolling restart) --
+    Contract(
+        pattern="BENCH_FLEET_*.json", kind="serve_fleet",
+        required=("bench", "mode", "sessions", "wall_s", "n_errors",
+                  "latency_ms", "requests_per_s", "fleet", "aggregate",
+                  "config"),
+        checker=fleet_check_report, fingerprint="required",
+        group="fleet",
+        regress=("requests_per_s", "higher", 0.25),
+        note="N serve replicas behind the rendezvous router: zero-drop "
+             "rolling restart of every replica, digest-verified "
+             "migrations, span-attributed router latency, near-linear "
+             "scaling (or documented 1-core parity)"),
     # -- tiered posterior state (hot/warm/cold paging) --
     Contract(
         pattern="BENCH_TIERED_*.json", kind="serve_tiered",

@@ -50,6 +50,13 @@ def main(argv=None):
                         "task-method dispatches across this many local "
                         "devices ('auto' = all) — the task-parallel "
                         "scheduler (engine/scheduler.py)")
+    p.add_argument("--suite-hosts", type=int, default=None, metavar="H",
+                   help="with --suite-devices: two-level FLEET placement — "
+                        "chunks go to H host groups by weighted LPT "
+                        "(weight = the group's device count), then to "
+                        "devices within each group. The in-process "
+                        "stand-in for placing dispatches across serve "
+                        "fleet hosts (engine/scheduler.plan_fleet_schedule)")
     p.add_argument("--schedule", default="lpt", choices=["lpt", "fifo"],
                    help="with --suite-devices: dispatch order (lpt = "
                         "longest-processing-time-first off the per-family "
@@ -139,7 +146,8 @@ def main(argv=None):
         results = runner.run_batched(
             list(groups.values()), args.methods.split(","), store=store,
             force_rerun=args.force_rerun, devices=args.suite_devices,
-            schedule=args.schedule, cost_profile=cost_profile)
+            schedule=args.schedule, cost_profile=cost_profile,
+            hosts=args.suite_hosts)
     else:
         results = runner.run(datasets, args.methods.split(","), store=store,
                              force_rerun=args.force_rerun)

@@ -1,0 +1,363 @@
+"""Replicated-fleet tests: rendezvous invariants, migration parity, peer
+paging, merged observability (``serve/router.py`` + ``serve/fleet.py``).
+
+The load-bearing claims: (1) rendezvous hashing is STABLE — adding one of
+N replicas re-owns only ~1/N of a 10k-id keyspace, and every moved id
+moves TO the new replica — and DETERMINISTIC across processes (keyed
+digest, never Python's salted ``hash``); (2) a session routed through the
+fleet and force-migrated mid-trajectory is BITWISE the session that ran
+on one replica the whole time (the migration rides the digest-verified
+export/import path); (3) a rolling restart of every replica in sequence
+drops nothing and double-applies nothing; (4) fleet observability is one
+endpoint — merged ``/stats`` and a lint-clean per-replica-labeled
+``/metrics`` — not a per-replica curl loop.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+H, N, C = 4, 48, 4
+_ROW_KEYS = ("next_idx", "next_prob", "best", "pbest_max", "pbest_entropy")
+
+
+@pytest.fixture(scope="module")
+def task():
+    from coda_tpu.data import make_synthetic_task
+
+    return make_synthetic_task(seed=0, H=H, N=N, C=C)
+
+
+def _factory(task, capacity=4, **kw):
+    from coda_tpu.serve import SelectorSpec, ServeApp
+
+    def make(rid):
+        app = ServeApp(capacity=capacity, max_wait=0.001,
+                       spec=SelectorSpec.create("coda",
+                                                n_parallel=capacity),
+                       **kw)
+        app.add_task(task.name, task.preds)
+        return app
+
+    return make
+
+
+def _fleet(task, n=2, warm=True, **kw):
+    from coda_tpu.serve import Fleet
+
+    return Fleet(_factory(task, **kw), n_replicas=n).start(warm=warm)
+
+
+def _assert_rows_bitwise(a, b, what=""):
+    for k in _ROW_KEYS:
+        va, vb = a[k], b[k]
+        if isinstance(va, float):
+            assert np.float32(va).tobytes() == np.float32(vb).tobytes(), \
+                (what, k, va, vb)
+        else:
+            assert va == vb, (what, k, va, vb)
+
+
+# ---------------------------------------------------------------------------
+# rendezvous hashing invariants
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_stability_10k_keyspace():
+    """Adding a replica to {r0,r1,r2} re-owns ~1/4 of 10k session ids,
+    and EVERY re-owned id moves to the new replica — the minimal-movement
+    property drain-and-migrate relies on."""
+    from coda_tpu.serve import rendezvous_owner
+
+    ids = [f"{i:032x}" for i in range(10_000)]
+    before = {sid: rendezvous_owner(sid, ["r0", "r1", "r2"]) for sid in ids}
+    after = {sid: rendezvous_owner(sid, ["r0", "r1", "r2", "r3"])
+             for sid in ids}
+    moved = [sid for sid in ids if before[sid] != after[sid]]
+    frac = len(moved) / len(ids)
+    assert 0.15 < frac < 0.35, frac          # ~1/4, not a reshuffle
+    assert all(after[sid] == "r3" for sid in moved)  # only TO the newcomer
+    # removal is the mirror image: dropping r3 sends its ids back to
+    # exactly where they were (everyone else never moved)
+    assert all(rendezvous_owner(sid, ["r0", "r1", "r2"]) == before[sid]
+               for sid in ids[:1000])
+    # and the spread over 3 replicas is roughly even (each within 2x)
+    from collections import Counter
+
+    counts = Counter(before.values())
+    assert len(counts) == 3
+    assert max(counts.values()) < 2 * min(counts.values()), counts
+
+
+def test_rendezvous_deterministic_across_processes():
+    """Owners must agree between processes: the hash is a keyed digest,
+    not Python's per-process-salted ``hash``. A subprocess computes the
+    same owners for the same ids."""
+    from coda_tpu.serve import rendezvous_owner, rendezvous_rank
+
+    ids = [f"{i:08x}" for i in range(200)]
+    replicas = ["alpha", "beta", "gamma"]
+    mine = {sid: rendezvous_owner(sid, replicas) for sid in ids}
+    code = (
+        "import json, sys\n"
+        "from coda_tpu.serve import rendezvous_owner\n"
+        f"ids = {ids!r}\n"
+        f"reps = {replicas!r}\n"
+        "print(json.dumps({s: rendezvous_owner(s, reps) for s in ids}))\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, check=True,
+                         env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:"
+                              "/bin:/usr/local/bin", "PYTHONPATH": "."})
+    theirs = json.loads(out.stdout.strip().splitlines()[-1])
+    assert theirs == mine
+    # rank order is total (ties broken by id): permutation-invariant
+    assert rendezvous_rank(ids[0], replicas) == \
+        rendezvous_rank(ids[0], list(reversed(replicas)))
+
+
+# ---------------------------------------------------------------------------
+# router-vs-direct bitwise trajectory parity through a forced migration
+# ---------------------------------------------------------------------------
+
+def test_router_migration_bitwise_vs_direct(task):
+    """A session driven through the router and FORCE-MIGRATED to the
+    other replica mid-trajectory finishes bitwise identical — rows and
+    recorder stream — to the same seed driven on a single direct app.
+    The migration must be digest-verified (snapshot or replay), and the
+    router must keep answering under the session's original id."""
+    fleet = _fleet(task, n=2)
+    r = fleet.router
+    direct_app = _factory(task)("direct")
+    direct_app.start(warm=True)
+    try:
+        out = r.open_session(seed=7)
+        sid = out["session"]
+        for _ in range(3):
+            out = r.label(sid, int(out["idx"]) % C)
+        src = r._locate(sid)
+        dst = [rid for rid in fleet.replica_ids if rid != src][0]
+        info = r.migrate_session(sid, src, dst)
+        assert info.get("migrated") == sid, info
+        assert info["via"] in ("snapshot", "replay")  # digest-verified
+        assert r.counters["migrations"] == 1
+        assert sum(r.migrations_via.values()) == 1
+        # the session now answers from the destination, same id
+        assert fleet.apps[dst].store.alive(sid) or \
+            fleet.apps[dst].tiers.parked(sid)
+        assert not fleet.apps[src].store.alive(sid)
+        for _ in range(3):
+            out = r.label(sid, int(out["idx"]) % C)
+        assert out["n_labeled"] == 6
+
+        ctrl = direct_app.open_session(seed=7)
+        for _ in range(6):
+            ctrl = direct_app.label(ctrl["session"], int(ctrl["idx"]) % C)
+        last = {k: fleet.apps[dst].store.get(sid).last[k]
+                for k in _ROW_KEYS}
+        ctrl_last = {k: direct_app.store.get(ctrl["session"]).last[k]
+                     for k in _ROW_KEYS}
+        _assert_rows_bitwise(last, ctrl_last, "migrated vs direct")
+        rows_m = fleet.apps[dst].recorder.history(sid)
+        rows_c = direct_app.recorder.history(ctrl["session"])
+        assert len(rows_m) == len(rows_c) == 7  # open + 6 labels
+        for rm, rc in zip(rows_m, rows_c):
+            for k in _ROW_KEYS:
+                assert rm[k] == rc[k], k
+    finally:
+        direct_app.drain(timeout=10)
+        fleet.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# rolling restart: every replica in sequence, zero drops
+# ---------------------------------------------------------------------------
+
+def test_rolling_restart_zero_drop(task):
+    """Open sessions across a 3-replica fleet, rolling-restart every
+    replica, keep labeling: no session is dropped, no label double-
+    applies (the n_labeled sentinel), every migration digest-verified."""
+    fleet = _fleet(task, n=3)
+    r = fleet.router
+    try:
+        outs = {}
+        for i in range(6):
+            out = r.open_session(seed=i)
+            outs[out["session"]] = out
+        for sid, out in outs.items():
+            outs[sid] = r.label(sid, int(out["idx"]) % C)
+        report = fleet.rolling_restart()
+        assert report["replicas_restarted"] == 3
+        assert report["sessions_dropped"] == 0
+        assert report["migration_failures"] == 0
+        assert report["migrations"] > 0
+        assert sum(report["migrations_via"].values()) == \
+            report["migrations"]
+        for sid, out in outs.items():
+            out = r.label(sid, int(out["idx"]) % C)
+            assert out["n_labeled"] == 2, (sid, out)  # exactly-once
+    finally:
+        fleet.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# health-driven eviction and rejoin
+# ---------------------------------------------------------------------------
+
+def test_health_eviction_and_rejoin(task):
+    """A replica whose /healthz stops reporting ready leaves the routing
+    set (new sessions route around it); recovery rejoins it."""
+    fleet = _fleet(task, n=2, warm=False)
+    r = fleet.router
+    try:
+        r.stop()  # drive health checks by hand, no poller races
+        app0 = fleet.apps["r0"]
+        app0.ready.clear()   # simulate a replica stuck compiling
+        statuses = r.check_health()
+        assert statuses["r0"] == "unready"
+        assert r.routable() == ["r1"]
+        hz = r.healthz()
+        assert hz["status"] == "degraded" and hz["ready"]
+        for i in range(4):   # everything routes to the survivor
+            out = r.open_session(seed=i)
+            assert fleet.apps["r1"].store.alive(out["session"])
+        app0.ready.set()
+        statuses = r.check_health()
+        assert statuses["r0"] in ("ok", "degraded")
+        assert r.routable() == ["r0", "r1"]
+        assert r.counters["evictions"] == 1
+        assert r.counters["rejoins"] == 1
+    finally:
+        fleet.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# demotion-aware peer paging
+# ---------------------------------------------------------------------------
+
+def test_peer_paging_moves_warm_session_to_peer(task):
+    """A warm session offered to the fleet pager lands on the less-loaded
+    peer (digest-verified import), the router re-points the sid, and a
+    later label serves from the peer — the trajectory unbroken."""
+    fleet = _fleet(task, n=2)
+    r = fleet.router
+    try:
+        out = r.open_session(seed=3)
+        sid = out["session"]
+        for _ in range(2):
+            out = r.label(sid, int(out["idx"]) % C)
+        src = r._locate(sid)
+        dst = [rid for rid in fleet.replica_ids if rid != src][0]
+        app_src = fleet.apps[src]
+        assert app_src.tiers.try_demote(sid)      # hot -> warm
+        assert app_src.tiers.page_to_peer(sid)    # warm -> the peer
+        assert app_src.metrics.peer_pages == 1
+        assert r._placed[sid] == dst
+        assert r.counters["peer_pages"] == 1
+        assert fleet.peer_pages == 1
+        assert not app_src.store.alive(sid)
+        assert not app_src.tiers.parked(sid)
+        out = r.label(sid, int(out["idx"]) % C)   # served by the peer
+        assert out["n_labeled"] == 3
+        assert fleet.apps[dst].store.alive(sid)
+    finally:
+        fleet.drain(timeout=10)
+
+
+def test_peer_paging_failure_reparks_warm(task):
+    """A pager that refuses (no peer / import failure) must leave the
+    session warm and reachable — paging can degrade, never lose."""
+    fleet = _fleet(task, n=2)
+    try:
+        out = fleet.router.open_session(seed=1)
+        sid = out["session"]
+        out = fleet.router.label(sid, int(out["idx"]) % C)
+        src = fleet.router._locate(sid)
+        app = fleet.apps[src]
+        assert app.tiers.try_demote(sid)
+        app.tiers.page_out = lambda s, p: False   # every peer refuses
+        assert app.tiers.page_to_peer(sid) is False
+        assert app.tiers.parked(sid)              # still warm, reachable
+        out = fleet.router.label(sid, int(out["idx"]) % C)  # wakes locally
+        assert out["n_labeled"] == 2
+    finally:
+        fleet.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# merged observability: one /stats, one lint-clean /metrics
+# ---------------------------------------------------------------------------
+
+def test_fleet_merged_stats_and_metrics(task):
+    from coda_tpu.telemetry.prometheus import lint
+
+    fleet = _fleet(task, n=2)
+    r = fleet.router
+    try:
+        sids = []
+        for i in range(4):
+            out = r.open_session(seed=i)
+            sids.append(out["session"])
+            r.label(out["session"], int(out["idx"]) % C)
+        st = r.stats()
+        assert set(st["replicas"]) == {"r0", "r1"}
+        assert st["aggregate"]["open_sessions"] == 4
+        assert st["aggregate"]["requests"] >= 8
+        assert st["router"]["counters"]["requests_routed"] >= 8
+        assert set(st["router"]["requests_to"]) == {"r0", "r1"}
+        text = r.render_metrics()
+        assert lint(text) == []
+        # per-replica labels on the serve families, each family ONCE
+        assert 'coda_serve_requests_total{replica="r0"}' in text
+        assert 'coda_serve_requests_total{replica="r1"}' in text
+        assert text.count("# TYPE coda_serve_requests_total counter") == 1
+        assert "coda_router_requests_routed_total" in text
+        assert "coda_router_requests_to_replica_total" in text
+    finally:
+        fleet.drain(timeout=10)
+
+
+def test_router_http_front_door(task):
+    """The router serves over the SAME AsyncHTTPServer as a replica:
+    open/label/close + merged /stats + /healthz + /metrics over real
+    HTTP, and the open lands on the rendezvous owner of the minted id."""
+    import threading
+    import urllib.request
+
+    from coda_tpu.serve import make_server
+
+    fleet = _fleet(task, n=2)
+    srv = make_server(fleet.router, 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def req(method, path, body=None):
+        data = None if body is None else json.dumps(body).encode()
+        rq = urllib.request.Request(base + path, data=data, method=method)
+        with urllib.request.urlopen(rq, timeout=30) as resp:
+            return resp.status, resp.read()
+
+    try:
+        code, body = req("POST", "/session", {"seed": 0})
+        out = json.loads(body)
+        sid = out["session"]
+        code, body = req("POST", f"/session/{sid}/label",
+                         {"label": int(out["idx"]) % C})
+        assert code == 200 and json.loads(body)["n_labeled"] == 1
+        code, body = req("GET", "/stats")
+        st = json.loads(body)
+        assert st["role"] == "router" and "aggregate" in st
+        code, body = req("GET", "/healthz")
+        assert code == 200 and json.loads(body)["role"] == "router"
+        code, body = req("GET", "/metrics")
+        assert b'replica="r0"' in body or b'replica="r1"' in body
+        code, body = req("DELETE", f"/session/{sid}")
+        assert code == 200
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.drain(timeout=10)

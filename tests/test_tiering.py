@@ -106,9 +106,9 @@ def test_wake_from_warm_bitwise_vs_control(task):
 
 
 def test_wake_from_cold_bitwise_vs_control(task, tmp_path):
-    """Same pin through the cold tier: demote -> hibernate (payload on
-    disk, recorder stream sealed) -> a label wakes it from the spill file
-    -> continue -> bitwise vs the uninterrupted control."""
+    """Same pin through the cold tier: demote -> hibernate (payload in
+    the spill log, recorder stream sealed) -> a label wakes it from the
+    spill store -> continue -> bitwise vs the uninterrupted control."""
     app = _app(task, spill_dir=str(tmp_path / "spill"))
     try:
         sid = _drive(app, seed=9, rounds=3)
@@ -117,13 +117,13 @@ def test_wake_from_cold_bitwise_vs_control(task, tmp_path):
         st = app.stats()
         assert st["tiers"] == {"hot": 0, "warm": 0, "cold": 1}
         assert st["open_sessions"] == 1
-        files = os.listdir(str(tmp_path / "spill"))
-        assert files == [f"hibernated_{sid}.json"]
+        # v2 spill layout: one append-log, not one file per session
+        assert os.path.exists(str(tmp_path / "spill" / "spill.log"))
+        assert app.tiers._spill.sids() == [sid]
 
-        cur = app.label(sid, int(_cold_payload(app, tmp_path, sid)))
+        cur = app.label(sid, int(_cold_payload(app, sid)))
         assert app.metrics.wakes_from_cold == 1
-        assert not os.path.exists(
-            str(tmp_path / "spill" / f"hibernated_{sid}.json"))
+        assert sid not in app.tiers._spill  # woken frame tombstoned
         cur = app.label(sid, int(cur["idx"]) % C)
 
         control = _drive(app, seed=9, rounds=5)
@@ -139,11 +139,10 @@ def test_wake_from_cold_bitwise_vs_control(task, tmp_path):
         app.drain(timeout=10)
 
 
-def _cold_payload(app, tmp_path, sid):
+def _cold_payload(app, sid):
     """The next label for a hibernated session, read from its payload
     (the client's handle: last proposed idx mod C)."""
-    with open(str(tmp_path / "spill" / f"hibernated_{sid}.json")) as f:
-        payload = json.load(f)
+    payload = app.tiers._spill.get(sid)
     return payload["last"]["next_idx"] % C
 
 
@@ -388,6 +387,120 @@ def test_hibernated_sessions_survive_restart(task, tmp_path):
         assert app.tiers.try_demote(sid) and app.tiers.hibernate(sid)
     finally:
         app.drain(timeout=10)
+
+    app2 = _app(task, spill_dir=spill)
+    try:
+        assert app2.tiers.parked(sid)
+        out = app2.label(sid, nxt)
+        assert out["n_labeled"] == 3
+        assert app2.metrics.wakes_from_cold == 1
+    finally:
+        app2.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# spill store v2: append-log + index + compression (serve/spill.py)
+# ---------------------------------------------------------------------------
+
+def test_spill_store_roundtrip_and_tombstones(tmp_path):
+    """put/get/delete over the append-log: last write wins, tombstones
+    delete, the index survives a re-scan (process restart)."""
+    from coda_tpu.serve.spill import SpillStore
+
+    d = str(tmp_path / "spill")
+    s = SpillStore(d)
+    payloads = {f"{i:04x}": {"session": f"{i:04x}", "rows": [i] * 50}
+                for i in range(100)}
+    for sid, p in payloads.items():
+        assert s.put(sid, p)
+    assert len(s) == 100
+    assert s.get("0007") == payloads["0007"]
+    # supersede: a re-put of the same sid serves the NEW payload
+    assert s.put("0007", {"session": "0007", "rows": [999]})
+    assert s.get("0007")["rows"] == [999]
+    assert s.delete("0003")
+    assert s.get("0003") is None and "0003" not in s
+    assert len(s) == 99
+    s.close()
+    # restart: the scan rebuilds the same index (tombstone honored,
+    # last-write-wins honored) from the log alone
+    s2 = SpillStore(d)
+    assert len(s2) == 99
+    assert s2.get("0003") is None
+    assert s2.get("0007")["rows"] == [999]
+    assert s2.get("0042") == payloads["0042"]
+    s2.close()
+
+
+def test_spill_store_compacts_garbage_and_tolerates_torn_tail(tmp_path):
+    """Dead frames (supersessions + tombstones) past the threshold are
+    compacted away at startup, and a torn final frame (crash mid-append)
+    is dropped without losing earlier frames."""
+    import os
+
+    from coda_tpu.serve.spill import SpillStore
+
+    d = str(tmp_path / "spill")
+    s = SpillStore(d)
+    for i in range(20):
+        s.put("churn", {"session": "churn", "n": i})  # 19 dead frames
+    s.put("keep", {"session": "keep"})
+    s.close()
+    size_before = os.path.getsize(os.path.join(d, "spill.log"))
+    # simulate a crash mid-append: glue half a frame onto the log
+    with open(os.path.join(d, "spill.log"), "ab") as f:
+        f.write(b'{"sid": "torn", "n": 9999, "crc": 1}\nonly-a-few-bytes')
+    s2 = SpillStore(d)   # startup: torn tail dropped, garbage compacted
+    assert s2.compactions == 1
+    assert os.path.getsize(os.path.join(d, "spill.log")) < size_before
+    assert s2.get("churn")["n"] == 19
+    assert s2.get("keep") == {"session": "keep"}
+    assert "torn" not in s2
+    s2.close()
+
+
+def test_spill_store_reads_and_folds_legacy_per_file_layout(tmp_path):
+    """The v1 one-JSON-file-per-session layout is still readable, and
+    startup compaction folds it into the log and removes the files —
+    a v1 spill dir upgrades itself."""
+    import os
+
+    from coda_tpu.serve.spill import SpillStore
+
+    d = str(tmp_path / "spill")
+    os.makedirs(d)
+    for i in range(3):
+        with open(os.path.join(d, f"hibernated_{i:02x}.json"), "w") as f:
+            json.dump({"session": f"{i:02x}", "legacy": True}, f)
+    s = SpillStore(d)
+    assert len(s) == 3
+    assert s.get("01") == {"session": "01", "legacy": True}
+    # folded into the log, per-file copies gone
+    assert s.compactions == 1
+    assert not [fn for fn in os.listdir(d)
+                if fn.startswith("hibernated_")]
+    assert s.get("02") == {"session": "02", "legacy": True}
+    s.close()
+
+
+def test_wake_from_legacy_hibernate_file(task, tmp_path):
+    """A session hibernated by the v1 per-file layout wakes through a
+    fresh app (the upgrade path: old spill dirs keep serving)."""
+    import os
+
+    spill = str(tmp_path / "spill")
+    app = _app(task, spill_dir=spill)
+    try:
+        sid = _drive(app, seed=11, rounds=2)
+        nxt = int(app.store.get(sid).last["next_idx"]) % C
+        assert app.tiers.try_demote(sid) and app.tiers.hibernate(sid)
+        payload = app.tiers._spill.get(sid)
+    finally:
+        app.drain(timeout=10)
+    # rewrite the hibernated payload in the V1 layout, drop the log
+    os.remove(os.path.join(spill, "spill.log"))
+    with open(os.path.join(spill, f"hibernated_{sid}.json"), "w") as f:
+        json.dump(payload, f)
 
     app2 = _app(task, spill_dir=spill)
     try:
