@@ -24,11 +24,20 @@ from coda_tpu.serve.batcher import Batcher, Ticket
 from coda_tpu.serve.faults import FaultInjected, FaultInjector
 from coda_tpu.serve.fleet import Fleet, build_fleet
 from coda_tpu.serve.router import (
+    DeadReplica,
     HttpReplica,
     InprocReplica,
     SessionRouter,
     rendezvous_owner,
     rendezvous_rank,
+)
+from coda_tpu.serve.journal import MigrationJournal, payload_digest
+from coda_tpu.serve.transport import (
+    CircuitBreaker,
+    ReplicaTransport,
+    ReplicaUnavailable,
+    RetryBudget,
+    VERB_DEADLINES,
 )
 from coda_tpu.serve.metrics import ServeMetrics
 from coda_tpu.serve.recovery import (
@@ -57,6 +66,7 @@ from coda_tpu.serve.state import (
     SlabFull,
     SlotRequest,
     SlotResult,
+    StaleOwner,
     UnknownSession,
     make_slab_step,
 )
@@ -69,11 +79,17 @@ __all__ = [
     "BucketQuarantined",
     "FaultInjected",
     "FaultInjector",
+    "CircuitBreaker",
+    "DeadReplica",
     "Fleet",
     "HttpReplica",
+    "MigrationJournal",
     "ImportRejected",
     "InprocReplica",
     "ReplayMismatch",
+    "ReplicaTransport",
+    "ReplicaUnavailable",
+    "RetryBudget",
     "SelectorSpec",
     "ServeApp",
     "ServeMetrics",
@@ -82,6 +98,8 @@ __all__ = [
     "SessionStore",
     "SlabFull",
     "SpillStore",
+    "StaleOwner",
+    "VERB_DEADLINES",
     "SlotRequest",
     "SlotResult",
     "Ticket",

@@ -37,6 +37,30 @@ Names (each is one injection point):
                         the freshly-demoted session or the demotion loses
                         cleanly to the in-flight pin).
 
+Fleet-level names (fired inside the router↔replica transport,
+``serve/transport.py``, addressable per edge with ``edge=<replica_id>``
+and per verb with ``task=<verb>``):
+
+  * ``net_drop``      — the call raises ``ConnectionError`` before the
+                        send (a lost packet; retry/breaker territory);
+  * ``partition``     — same drop, but idiomatically used with
+                        ``times=K`` for a K-arrival outage window that
+                        "heals" when the budget is spent;
+  * ``net_delay``     — the edge sleeps ``ms`` before the send (tail
+                        amplification across the fleet);
+  * ``net_dup``       — the request is DELIVERED TWICE (a retransmitted
+                        packet): the second answer is discarded and the
+                        replica's request_id dedupe must keep the
+                        posterior exactly-once;
+  * ``flap_healthz``  — the health probe answers unready without
+                        touching the replica (the eviction-hysteresis
+                        scenario);
+  * ``kill_replica``  — fired at the router's mid-migration point
+                        (between export and import): the matching
+                        replica is killed abruptly via the fleet's kill
+                        hook — SIGKILL semantics for the in-process
+                        fleet.
+
 Triggers (deterministic — a spec plus a request history replays exactly):
 
   * ``after=N``  — fire on the (N+1)-th arrival at the site (0-indexed),
@@ -74,6 +98,17 @@ FAULT_SITES = {
     # transparently wakes the session back, or it loses cleanly to an
     # in-flight pin — the matrix fails on any lost/double-applied label
     "demote_during_label": "label_pre",
+    # fleet-level faults (serve/transport.py fires these per
+    # router↔replica edge; filter with edge=<replica_id> / task=<verb>)
+    "net_drop": "edge_call",
+    "partition": "edge_call",
+    "net_delay": "edge_call",
+    "net_dup": "edge_call",
+    "flap_healthz": "edge_healthz",
+    # process fault: fired by the router between a migration's export
+    # and its import (serve/router.py); the fleet's kill hook SIGKILLs
+    # the matching replica at exactly that point
+    "kill_replica": "migrate_mid",
 }
 
 _CRASH_EXIT_CODE = 17  # distinguishable from python tracebacks (1) in tests
@@ -94,8 +129,9 @@ class _Fault:
     p: Optional[float] = None
     seed: int = 0
     times: Optional[int] = None     # max fires; default 1 for `after`
-    ms: float = 0.0                 # slow_step only
-    task: Optional[str] = None      # bucket filter; None = all
+    ms: float = 0.0                 # slow_step / net_delay only
+    task: Optional[str] = None      # bucket filter (verb at edge sites)
+    edge: Optional[str] = None      # router↔replica edge filter
     count: int = 0                  # arrivals at the site (matching task)
     fired: int = 0
 
@@ -142,6 +178,8 @@ def parse_fault_spec(spec: Optional[str]) -> list[_Fault]:
                 setattr(f, k, float(v))
             elif k == "task":
                 f.task = None if v == "*" else v
+            elif k == "edge":
+                f.edge = None if v == "*" else v
             else:
                 raise ValueError(f"unknown fault param {k!r} in {part!r}")
         faults.append(f)
@@ -167,12 +205,15 @@ class FaultInjector:
     def active(self) -> bool:
         return bool(self.faults)
 
-    def fire(self, site: str, task: Optional[str] = None) -> list[str]:
+    def fire(self, site: str, task: Optional[str] = None,
+             edge: Optional[str] = None) -> list[str]:
         """One arrival at ``site``; applies every matching triggered fault.
 
         Raise order: a crash fault exits the process outright; a
-        ``step_raise`` raises :class:`FaultInjected`; ``slow_step`` sleeps
-        then returns; ``step_nan`` is returned to the caller to apply.
+        ``step_raise`` raises :class:`FaultInjected`; ``slow_step`` /
+        ``net_delay`` sleep then return; out-of-band names (``step_nan``,
+        ``net_drop``, ``net_dup``, ``flap_healthz``, ``kill_replica``)
+        are returned to the caller to apply at the site.
         """
         fired: list[_Fault] = []
         with self._lock:
@@ -181,13 +222,17 @@ class FaultInjector:
                     continue
                 if f.task is not None and task is not None and f.task != task:
                     continue
+                if f.edge is not None and edge is not None and \
+                        f.edge != edge:
+                    continue
                 f.count += 1
                 if f.should_fire():
                     f.fired += 1
                     fired.append(f)
             # only the instances that fired sleep — matching by name would
             # charge every configured slow_step's ms when any one fires
-            slow = [f.ms for f in fired if f.name == "slow_step"]
+            slow = [f.ms for f in fired
+                    if f.name in ("slow_step", "net_delay")]
         triggered = [f.name for f in fired]
         for name in triggered:
             if name.startswith("crash_"):

@@ -37,7 +37,20 @@ import threading
 import time
 from typing import Callable, Optional
 
-from coda_tpu.serve.router import InprocReplica, SessionRouter
+from coda_tpu.serve.router import DeadReplica, InprocReplica, SessionRouter
+
+
+class _DeadApp:
+    """What a captured handle sees after its replica is SIGKILLed: every
+    attribute access — any verb, any bookkeeping read — raises
+    ``ConnectionError``, the way a dead process's socket would."""
+
+    def __init__(self, rid: str):
+        object.__setattr__(self, "_rid", rid)
+
+    def __getattr__(self, name):
+        raise ConnectionError(
+            f"replica {self._rid} is dead (killed)")
 
 
 class Fleet:
@@ -45,20 +58,54 @@ class Fleet:
 
     ``app_factory(replica_id)`` returns an UNSTARTED ServeApp for that
     replica (the same factory serves initial spawn and rolling-restart
-    respawn, so a restarted replica is configured identically)."""
+    respawn, so a restarted replica is configured identically).
+
+    ``journal_path`` arms the router's migration journal (crash-
+    consistent moves — see ``serve/journal.py``); ``fault_spec`` arms
+    per-edge transport chaos (``serve/faults.py`` net_* names) shared by
+    every replica handle's transport."""
 
     def __init__(self, app_factory: Callable, n_replicas: int = 3,
                  replica_ids: Optional[list] = None, telemetry=None,
-                 peer_paging: bool = True, auto_rebalance: bool = True):
+                 peer_paging: bool = True, auto_rebalance: bool = True,
+                 journal_path: Optional[str] = None,
+                 fault_spec: Optional[str] = None,
+                 health_hysteresis: int = 2):
+        from coda_tpu.serve.faults import FaultInjector
+
         self.app_factory = app_factory
         self.replica_ids = list(replica_ids or
                                 [f"r{i}" for i in range(n_replicas)])
         self.apps: dict[str, object] = {}
-        self.router = SessionRouter(telemetry=telemetry,
-                                    auto_rebalance=auto_rebalance)
+        self.router = SessionRouter(
+            telemetry=telemetry, auto_rebalance=auto_rebalance,
+            journal_path=journal_path,
+            faults=FaultInjector(fault_spec) if fault_spec else None,
+            health_hysteresis=health_hysteresis)
+        self.router.kill_hook = self.kill_replica
         self.peer_paging = peer_paging
+        self.kills: dict[str, int] = {}
         for rid in self.replica_ids:
             self._spawn(rid)
+        if journal_path is not None:
+            # resolve any in-doubt moves a previous incarnation left
+            # behind BEFORE this fleet serves a verb. Recovery PROBES
+            # replica state, and a freshly spawned fleet has not crash-
+            # restored its streams yet — resolving against empty stores
+            # would terminally misjudge a move whose import actually
+            # landed (and later crash restore would resurrect BOTH
+            # copies). Restore first, then resolve.
+            if self.router.journal is not None and \
+                    self.router.journal.in_doubt():
+                for rid, app in self.apps.items():
+                    rdir = getattr(app.recorder, "out_dir", None)
+                    if rdir:
+                        try:
+                            app.restore_sessions(rdir)
+                        except Exception:
+                            pass  # recovery still probes; worst case a
+                            #       move resolves as restored-at-source
+            self.journal_recovery = self.router.recover_from_journal()
 
     @property
     def peer_pages(self) -> int:
@@ -87,6 +134,57 @@ class Fleet:
         for app in self.apps.values():
             app.drain(timeout=timeout)
 
+    # -- SIGKILL semantics (the in-process fleet's process fault) ----------
+    def kill_replica(self, rid: str) -> None:
+        """Abrupt replica death: no drain, no export, no goodbye — the
+        batcher stops mid-queue, the handle becomes a dead socket, and
+        the router discovers the death exactly as it would cross-host
+        (connection errors, breaker, health poll). Any handle reference
+        captured BEFORE the kill (a mid-migration router) dies too — the
+        old handle's app is swapped for a connection-refusing tombstone,
+        because a SIGKILLed process answers nobody, however old their
+        socket. The replica's record streams and spill log stay on disk
+        for :meth:`revive_replica`'s crash restore."""
+        app = self.apps.get(rid)
+        if app is None:
+            return
+        self.kills[rid] = self.kills.get(rid, 0) + 1
+        with self.router._lock:
+            old = self.router.replicas.get(rid)
+            self.router.replicas[rid] = DeadReplica(rid)
+        if isinstance(old, InprocReplica):
+            old.app = _DeadApp(rid)
+        # stop the compute threads without any drain/flush (SIGKILL
+        # leaves no time for either); the recorder's per-row flush is
+        # the only durability, which is exactly the contract
+        try:
+            app.batcher.stop(drain=False, timeout=0.5)
+        except Exception:
+            pass
+        if getattr(app, "tiers", None) is not None:
+            try:
+                app.tiers.stop()
+            except Exception:
+                pass
+
+    def revive_replica(self, rid: str, warm: bool = True,
+                       restore_dir: Optional[str] = None) -> dict:
+        """Stand a killed replica back up from the factory (+ optional
+        crash restore from its record dir) and let health re-admit it."""
+        new_app = self.app_factory(rid)
+        if self.peer_paging and getattr(new_app, "tiers", None) is not None:
+            new_app.tiers.page_out = self._make_pager(rid)
+        new_app.start(warm=warm)
+        report = {}
+        rdir = restore_dir or getattr(new_app.recorder, "out_dir", None)
+        if rdir:
+            report = new_app.restore_sessions(rdir)
+        self.apps[rid] = new_app
+        with self.router._lock:
+            self.router.replicas[rid] = InprocReplica(rid, new_app)
+        self.router._wire_handle(self.router.replicas[rid])
+        return report
+
     # -- peer paging -------------------------------------------------------
     def _make_pager(self, src_rid: str):
         def _page_out(sid: str, payload: dict) -> bool:
@@ -106,14 +204,40 @@ class Fleet:
                 if self.router._migrating.get(sid) is not None:
                     return False  # a real migration owns the sid: yield
                 self.router._migrating[sid] = gate
+                # a peer page is an ownership change like any migration:
+                # bump the epoch so the (sealed, but crash-restorable)
+                # local stream can never serve a commit again
+                epoch_next = self.router._epochs.get(sid, 0) + 1
+            journal = self.router.journal
+            mid = None
+            if journal is not None:
+                mid = journal.begin(sid, src_rid, dst_rid, epoch_next)
+            payload = dict(payload, epoch=epoch_next)
             try:
+                if mid is not None:
+                    from coda_tpu.serve.journal import payload_digest
+
+                    journal.record(mid, "exported",
+                                   digest=payload_digest(payload),
+                                   n_labeled=payload.get("n_labeled"))
                 try:
                     handle.import_payload(payload)
-                except Exception:
+                except Exception as e:
+                    if mid is not None:
+                        journal.record(mid, "aborted", reason=repr(e))
                     return False
+                if mid is not None:
+                    journal.record(mid, "imported")
                 with self.router._lock:
                     self.router._placed[sid] = dst_rid
+                    self.router._epochs[sid] = epoch_next
                     self.router.counters["peer_pages"] += 1
+                if mid is not None:
+                    # the page's "fence" is the tier manager's own
+                    # cleanup (it pops the warm entry + seals the
+                    # stream on our True), so commit right away
+                    journal.record(mid, "committed", epoch=epoch_next,
+                                   fenced=True)
                 return True
             finally:
                 with self.router._lock:
@@ -153,8 +277,9 @@ class Fleet:
         # digest-verified; the other replicas' sessions never move
         out_report = self.router._migrate_all_off(rid)
         if out_report.get("failed"):
-            # a failed migration restored its payload to THIS replica —
-            # draining now would discard it. One more pass (transient
+            # a failed migration left its session on THIS replica (the
+            # hold was lifted, "didn't move") — draining now would
+            # discard it. One more pass (transient
             # peer pressure usually clears), then ABORT the restart:
             # the replica rejoins with its sessions intact, and the
             # restart fails attributably instead of dropping anyone.
@@ -184,6 +309,7 @@ class Fleet:
         self.apps[rid] = new_app
         with self.router._lock:
             self.router.replicas[rid] = InprocReplica(rid, new_app)
+        self.router._wire_handle(self.router.replicas[rid])
         self.router.rejoin(rid)
         # minimal rebalance: exactly the sids whose HRW owner is the
         # rejoined replica come home
@@ -222,23 +348,29 @@ class Fleet:
         return self.router.stats()
 
 
-def build_fleet(args, n_replicas: int, record_dir: Optional[str] = None
-                ) -> Fleet:
+def build_fleet(args, n_replicas: int, record_dir: Optional[str] = None,
+                fault_spec: Optional[str] = None) -> Fleet:
     """A fleet from serve CLI args (the loadgen/demo entry): each replica
     is ``build_app(args)`` with its own spill/record sub-directories so
-    replicas never share mutable disk state."""
+    replicas never share mutable disk state. A record dir also arms the
+    router's migration journal (``<record_dir>/router_migrations.log``);
+    ``fault_spec`` arms per-edge transport chaos (``--fleet-chaos``)."""
     import copy
     import os
 
     from coda_tpu.serve.server import build_app
 
+    base_record = record_dir or getattr(args, "record_dir", None)
+
     def factory(rid: str):
         a = copy.copy(args)
         if getattr(args, "tier_spill_dir", None):
             a.tier_spill_dir = os.path.join(args.tier_spill_dir, rid)
-        base_record = record_dir or getattr(args, "record_dir", None)
         if base_record:
             a.record_dir = os.path.join(base_record, rid)
         return build_app(a)
 
-    return Fleet(factory, n_replicas=n_replicas)
+    journal_path = (os.path.join(base_record, "router_migrations.log")
+                    if base_record else None)
+    return Fleet(factory, n_replicas=n_replicas,
+                 journal_path=journal_path, fault_spec=fault_spec)

@@ -52,6 +52,7 @@ class ServeMetrics:
         self.sessions_closed = 0
         self.sessions_rejected = 0   # admission-control refusals (slab full)
         self.requests_rejected = 0   # draining / bad-session refusals
+        self.fencing_rejections = 0  # stale-epoch verbs refused (StaleOwner)
         # warm pool: AOT-precompiled executables vs lazy-jit fallbacks
         self.warm_hits = 0           # dispatches served by an AOT executable
         self.warm_misses = 0         # dispatches that fell back to lazy jit
@@ -159,6 +160,11 @@ class ServeMetrics:
                 raise ValueError(f"unknown recovery event {event!r}")
             self.recovery[event] += 1
 
+    def record_fencing_rejection(self) -> None:
+        """One stale-epoch verb refused (the ownership fence held)."""
+        with self._lock:
+            self.fencing_rejections += 1
+
     def record_session(self, event: str) -> None:
         with self._lock:
             if event == "open":
@@ -187,6 +193,7 @@ class ServeMetrics:
                 "sessions_closed": self.sessions_closed,
                 "sessions_rejected": self.sessions_rejected,
                 "requests_rejected": self.requests_rejected,
+                "fencing_rejections": self.fencing_rejections,
                 "max_occupancy": self.max_occupancy,
                 "mean_occupancy": (float(np.mean(occ)) if occ else None),
                 "mean_queue_depth": (float(np.mean(depth)) if depth

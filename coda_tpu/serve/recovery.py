@@ -320,6 +320,9 @@ def build_export_payload(app, sess, snapshot=None) -> dict:
         "spec_kwargs": [list(kv) for kv in bucket.spec.kwargs],
         "acq_batch": bucket.acq_batch,
         "seed": sess.seed,
+        # ownership epoch: preserved verbatim by demote/wake round trips;
+        # only the router's migration commit bumps it (fencing)
+        "epoch": sess.epoch,
         "dataset": {k: app.store.task_meta(sess.task).get(k)
                     for k in ("shape", "digest")},
         "fingerprint": snapshot_fingerprint(bucket),
@@ -480,6 +483,9 @@ def import_session(app, payload: dict, count: bool = True) -> dict:
     # landing mid-restore must neither 404 nor double-apply
     sess = app.store.open(task, app.spec, seed=int(payload["seed"]),
                           sid=sid, restoring=True)
+    # the copy's ownership epoch is the payload's — set before the verbs
+    # unblock so a fenced verb can never race an un-epoched window
+    sess.epoch = int(payload.get("epoch") or 0)
     bucket = sess.bucket
     try:
         restored_via = None
@@ -511,6 +517,7 @@ def import_session(app, payload: dict, count: bool = True) -> dict:
                             "spec_kwargs": payload["spec_kwargs"],
                             "acq_batch": want_q,
                             "seed": sess.seed,
+                            "epoch": sess.epoch,
                             "shape": meta.get("shape"),
                             "digest": meta.get("digest"),
                             "imported_via": restored_via},
@@ -679,6 +686,11 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
                 sess = app.store.open(meta.get("task"), app.spec,
                                       seed=int(meta.get("seed", 0)),
                                       sid=sid, restoring=True)
+                # a crash-restored copy keeps its stream's ownership
+                # epoch: if the session had migrated away and this stream
+                # was never fenced (the crash window), the restored copy
+                # is STALE and the epoch makes the fence still hold
+                sess.epoch = int(meta.get("epoch") or 0)
                 sess.bucket.stage_fresh(sess.slot, sess.seed)
             except Exception as e:
                 report["failed"][sid] = repr(e)
@@ -723,6 +735,7 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
                                     or [list(kv) for kv in app.spec.kwargs],
                                     "acq_batch": app.spec.acq_batch,
                                     "seed": sess.seed,
+                                    "epoch": sess.epoch,
                                     "shape": meta.get("shape"),
                                     "digest": meta.get("digest"),
                                     "imported_via": "replay"},

@@ -48,12 +48,19 @@ paging); this module owns addressing, health, and migration mechanics.
 from __future__ import annotations
 
 import hashlib
+import re
 import threading
 import time
 import uuid
 from typing import Optional, Sequence
 
-from coda_tpu.serve.state import BucketQuarantined, SlabFull, UnknownSession
+from coda_tpu.serve.state import (
+    BucketQuarantined,
+    SlabFull,
+    StaleOwner,
+    UnknownSession,
+)
+from coda_tpu.serve.transport import ReplicaTransport, ReplicaUnavailable
 
 #: how long a verb waits out an in-flight migration of its session
 MIGRATION_WAIT_S = 30.0
@@ -102,47 +109,86 @@ def rendezvous_owner(sid: str, replica_ids: Sequence[str]) -> str:
 
 class InprocReplica:
     """One fleet member served by a ServeApp in this process (the
-    container demo; also what the tests drive)."""
+    container demo; also what the tests drive).
 
-    def __init__(self, replica_id: str, app):
+    Every verb rides the same :class:`~coda_tpu.serve.transport.
+    ReplicaTransport` policy layer the HTTP handle uses — transport
+    can't actually fail in-process, but the parity buys two things: the
+    per-edge chaos faults (``net_drop``/``net_delay``/``net_dup``/
+    ``partition``/``flap_healthz``) inject here exactly as they would on
+    a real socket, and the breaker/retry accounting the router reports
+    is one code path, not two."""
+
+    def __init__(self, replica_id: str, app, transport=None):
         self.replica_id = replica_id
         self.app = app
+        self.transport = transport or ReplicaTransport(replica_id)
 
     # -- verbs (the router forwards these; exceptions flow through) --------
     def open(self, task=None, seed=None, sid=None):
-        return self.app.open_session(task=task, seed=seed, sid=sid)
+        return self.transport.call(
+            "open", lambda t: self.app.open_session(task=task, seed=seed,
+                                                    sid=sid))
 
-    def label(self, sid, label, idx=None, request_id=None):
-        return self.app.label(sid, label, idx=idx, request_id=request_id)
+    def label(self, sid, label, idx=None, request_id=None, epoch=None):
+        return self.transport.call(
+            "label",
+            lambda t: self.app.label(sid, label, idx=idx,
+                                     request_id=request_id, epoch=epoch),
+            idempotent=request_id is not None)
 
-    def labels(self, sid, labels, idx=None, request_id=None):
-        return self.app.labels(sid, labels, idx=idx, request_id=request_id)
+    def labels(self, sid, labels, idx=None, request_id=None, epoch=None):
+        return self.transport.call(
+            "labels",
+            lambda t: self.app.labels(sid, labels, idx=idx,
+                                      request_id=request_id, epoch=epoch),
+            idempotent=request_id is not None)
 
-    def best(self, sid):
-        return self.app.best(sid)
+    def best(self, sid, epoch=None):
+        return self.transport.call(
+            "best", lambda t: self.app.best(sid, epoch=epoch))
 
-    def trace(self, sid):
-        return self.app.trace(sid)
+    def trace(self, sid, epoch=None):
+        return self.transport.call(
+            "trace", lambda t: self.app.trace(sid, epoch=epoch))
 
-    def close(self, sid):
-        return self.app.close_session(sid)
+    def close(self, sid, epoch=None):
+        return self.transport.call(
+            "close", lambda t: self.app.close_session(sid, epoch=epoch))
 
-    def export(self, sid, close=False):
-        return self.app.export_session(sid, close=close)
+    def export(self, sid, close=False, hold=False):
+        return self.transport.call(
+            "export", lambda t: self.app.export_session(sid, close=close,
+                                                        hold=hold))
+
+    def fence(self, sid, drop=True):
+        return self.transport.call(
+            "fence", lambda t: self.app.end_migration(sid, drop=drop),
+            idempotent=True)
 
     def import_payload(self, payload):
-        return self.app.import_session(payload)
+        return self.transport.call(
+            "import", lambda t: self.app.import_session(payload))
 
     def stats(self):
-        return self.app.stats()
+        return self.transport.call("stats", lambda t: self.app.stats())
 
     def healthz(self):
-        return self.app.healthz()
+        return self.transport.call("healthz",
+                                   lambda t: self.app.healthz())
 
     # -- fleet bookkeeping -------------------------------------------------
     def has_session(self, sid) -> bool:
         return self.app.store.alive(sid) or (
             self.app.tiers is not None and self.app.tiers.parked(sid))
+
+    def session_epoch(self, sid) -> Optional[int]:
+        """The ownership epoch of this replica's copy, or None when it
+        holds none (the journal-recovery probe)."""
+        try:
+            return int(self.app.session_epoch(sid)["epoch"])
+        except UnknownSession:
+            return None
 
     def open_sids(self) -> list[str]:
         return self.app.list_sessions()["sessions"]
@@ -155,20 +201,53 @@ class InprocReplica:
         return n
 
     def export_for_migration(self, sid) -> dict:
-        """Quiesce-then-export: ride the tiering demotion protocol (it
-        loses cleanly to any in-flight label ticket and wins once the
-        ticket resolves) so the payload always carries every committed
-        label; the export's ``close=True`` is the drain handoff — the
-        source forgets the session the moment the payload exists."""
-        app = self.app
-        if app.tiers is not None:
-            for _ in range(500):
-                if not app.store.alive(sid):
-                    break  # already parked (or closed) — export serves it
-                if app.tiers.try_demote(sid):
-                    break
-                time.sleep(0.002)
-        return app.export_session(sid, close=True)
+        """The migration PREPARE: quiesce + hold + export WITHOUT close
+        (``ServeApp.begin_migration``). The source keeps a recoverable —
+        but held, uncommittable — copy until :meth:`fence` commits or
+        aborts the move, so a crash or lost response anywhere in the
+        window degrades to "didn't move", never "gone"."""
+        return self.transport.call(
+            "export", lambda t: self.app.begin_migration(sid),
+            idempotent=True)
+
+
+class DeadReplica:
+    """The handle of a SIGKILLed in-process replica: every verb raises
+    ``ConnectionError``, exactly what a real dead host's socket would do
+    (``Fleet.kill_replica`` swaps this in; the health poller and the
+    breaker then discover the death the same way they would cross-host)."""
+
+    def __init__(self, replica_id: str):
+        self.replica_id = replica_id
+        self.transport = ReplicaTransport(replica_id)
+
+    def _dead(self, *a, **k):
+        raise ConnectionError(
+            f"replica {self.replica_id} is dead (killed)")
+
+    open = label = labels = best = trace = close = _dead
+    export = fence = import_payload = stats = healthz = _dead
+    export_for_migration = _dead
+
+    def has_session(self, sid) -> bool:
+        raise ConnectionError(
+            f"replica {self.replica_id} is dead (killed)")
+
+    def session_epoch(self, sid):
+        raise ConnectionError(
+            f"replica {self.replica_id} is dead (killed)")
+
+    def open_sids(self) -> list[str]:
+        raise ConnectionError(
+            f"replica {self.replica_id} is dead (killed)")
+
+    def open_count(self) -> int:
+        raise ConnectionError(
+            f"replica {self.replica_id} is dead (killed)")
+
+
+#: parses the epoch pair out of a StaleOwner error's HTTP message
+_STALE_RE = re.compile(r"session ([0-9a-f]+):.*epoch (\d+).*epoch (\d+)")
 
 
 class HttpReplica:
@@ -176,15 +255,32 @@ class HttpReplica:
 
     Maps the HTTP error envelope back onto the exceptions the in-process
     verbs raise, so the router's own front door re-encodes them
-    identically no matter which handle type served the request."""
+    identically no matter which handle type served the request. Every
+    request rides the hardened transport (``serve/transport.py``): the
+    per-verb deadline replaces the old fixed 60 s blanket, transport
+    failures retry only when the verb is provably idempotent at the
+    replica, a per-replica budget bounds the retry amplification, and a
+    circuit breaker fails fast on a black-holed host."""
 
-    def __init__(self, replica_id: str, url: str, timeout: float = 60.0):
+    def __init__(self, replica_id: str, url: str,
+                 timeout: Optional[float] = None, transport=None,
+                 deadlines: Optional[dict] = None, **transport_kw):
         self.replica_id = replica_id
         self.url = url.rstrip("/")
-        self.timeout = timeout
+        dl = dict(deadlines or {})
+        if timeout is not None:
+            # legacy blanket timeout: now just a floor-raise on every
+            # verb's deadline rather than the one number for everything
+            from coda_tpu.serve.transport import VERB_DEADLINES
 
-    def _req(self, method, path, body=None):
+            for verb, d in VERB_DEADLINES.items():
+                dl.setdefault(verb, max(d, float(timeout)))
+        self.transport = transport or ReplicaTransport(
+            replica_id, deadlines=dl, **transport_kw)
+
+    def _req(self, method, path, body=None, timeout=60.0):
         import json as _json
+        import socket
         import urllib.error
         import urllib.request
 
@@ -193,7 +289,7 @@ class HttpReplica:
             self.url + path, data=data, method=method,
             headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
                 return _json.loads(r.read())
         except urllib.error.HTTPError as e:
             try:
@@ -206,12 +302,36 @@ class HttpReplica:
                 raise BucketQuarantined(msg) if "healing" in msg \
                     else SlabFull(msg)
             if e.code == 409:
+                if msg.startswith("stale owner"):
+                    m = _STALE_RE.search(msg)
+                    raise StaleOwner(m.group(1) if m else path,
+                                     have=int(m.group(2)) if m else 0,
+                                     want=int(m.group(3)) if m else 0)
                 from coda_tpu.serve.recovery import ImportRejected
 
                 raise ImportRejected(msg)
             if e.code == 504:
                 raise TimeoutError(msg)
             raise RuntimeError(f"{e.code}: {msg}")
+        except urllib.error.URLError as e:
+            # normalize the urllib wrapper onto the transport-error types
+            # the retry/breaker policy classifies
+            reason = getattr(e, "reason", e)
+            if isinstance(reason, (ConnectionError, socket.timeout,
+                                   TimeoutError, OSError)):
+                raise reason
+            raise ConnectionError(str(e))
+
+    def _call(self, verb, method, path, body=None, idempotent=False):
+        return self.transport.call(
+            verb, lambda t: self._req(method, path, body, timeout=t),
+            idempotent=idempotent)
+
+    @staticmethod
+    def _stamp(body: dict, epoch) -> dict:
+        if epoch is not None:
+            body["epoch"] = int(epoch)
+        return body
 
     def open(self, task=None, seed=None, sid=None):
         body = {}
@@ -221,46 +341,60 @@ class HttpReplica:
             body["seed"] = seed
         if sid is not None:
             body["session"] = sid
-        return self._req("POST", "/session", body)
+        return self._call("open", "POST", "/session", body)
 
-    def label(self, sid, label, idx=None, request_id=None):
-        body = {"label": label}
+    def label(self, sid, label, idx=None, request_id=None, epoch=None):
+        body = self._stamp({"label": label}, epoch)
         if idx is not None:
             body["idx"] = idx
         if request_id is not None:
             body["request_id"] = request_id
-        return self._req("POST", f"/session/{sid}/label", body)
+        return self._call("label", "POST", f"/session/{sid}/label", body,
+                          idempotent=request_id is not None)
 
-    def labels(self, sid, labels, idx=None, request_id=None):
-        body = {"labels": list(labels)}
+    def labels(self, sid, labels, idx=None, request_id=None, epoch=None):
+        body = self._stamp({"labels": list(labels)}, epoch)
         if idx is not None:
             body["idx"] = idx
         if request_id is not None:
             body["request_id"] = request_id
-        return self._req("POST", f"/session/{sid}/labels", body)
+        return self._call("labels", "POST", f"/session/{sid}/labels", body,
+                          idempotent=request_id is not None)
 
-    def best(self, sid):
-        return self._req("GET", f"/session/{sid}/best")
+    def best(self, sid, epoch=None):
+        q = f"?epoch={int(epoch)}" if epoch is not None else ""
+        return self._call("best", "GET", f"/session/{sid}/best{q}")
 
-    def trace(self, sid):
-        return self._req("GET", f"/session/{sid}/trace")
+    def trace(self, sid, epoch=None):
+        q = f"?epoch={int(epoch)}" if epoch is not None else ""
+        return self._call("trace", "GET", f"/session/{sid}/trace{q}")
 
-    def close(self, sid):
-        return self._req("DELETE", f"/session/{sid}")
+    def close(self, sid, epoch=None):
+        return self._call("close", "DELETE", f"/session/{sid}",
+                          self._stamp({}, epoch) or None)
 
-    def export(self, sid, close=False):
-        return self._req("POST", f"/session/{sid}/export",
-                         {"close": bool(close)})
+    def export(self, sid, close=False, hold=False):
+        return self._call("export", "POST", f"/session/{sid}/export",
+                          {"close": bool(close), "hold": bool(hold)})
+
+    def fence(self, sid, drop=True):
+        return self._call("fence", "POST", f"/session/{sid}/fence",
+                          {"drop": bool(drop)}, idempotent=True)
 
     def import_payload(self, payload):
-        return self._req("POST", "/session/import", payload)
+        return self._call("import", "POST", "/session/import", payload)
 
     def stats(self):
-        return self._req("GET", "/stats")
+        return self._call("stats", "GET", "/stats")
 
     def healthz(self):
         try:
-            return self._req("GET", "/healthz")
+            return self._call("healthz", "GET", "/healthz")
+        except ReplicaUnavailable:
+            # breaker/budget fast-fail is TRANSPORT state, not the
+            # replica answering unready — let check_health report (and
+            # evict) it as breaker_open, distinctly
+            raise
         except SlabFull:
             # a 503 here is the replica saying "unready" — report it as
             # the healthz body would
@@ -274,10 +408,19 @@ class HttpReplica:
         except UnknownSession:
             return False
         except (SlabFull, BucketQuarantined):
-            return True  # restoring/healing: it exists
+            return True  # restoring/healing/migrating: it exists
+
+    def session_epoch(self, sid) -> Optional[int]:
+        try:
+            out = self._call("epoch", "GET", f"/session/{sid}/epoch")
+            return int(out.get("epoch") or 0)
+        except UnknownSession:
+            return None
+        except (SlabFull, BucketQuarantined):
+            return None  # exists but unreadable right now
 
     def open_sids(self) -> list[str]:
-        return list((self._req("GET", "/sessions") or {})
+        return list((self._call("sessions", "GET", "/sessions") or {})
                     .get("sessions", []))
 
     def open_count(self) -> int:
@@ -285,7 +428,8 @@ class HttpReplica:
         return int(st.get("open_sessions") or 0)
 
     def export_for_migration(self, sid) -> dict:
-        return self.export(sid, close=True)
+        # the PREPARE half of the hold protocol (see InprocReplica)
+        return self.export(sid, close=False, hold=True)
 
 
 # ---------------------------------------------------------------------------
@@ -304,7 +448,9 @@ class SessionRouter:
     key range."""
 
     def __init__(self, replicas: Optional[dict] = None, telemetry=None,
-                 auto_rebalance: bool = True):
+                 auto_rebalance: bool = True,
+                 journal_path: Optional[str] = None,
+                 faults=None, health_hysteresis: int = 2):
         from concurrent.futures import ThreadPoolExecutor
 
         from coda_tpu.serve.metrics import ServeMetrics
@@ -317,20 +463,38 @@ class SessionRouter:
         # deliberate off-owner placements (peer paging, mid-rebalance):
         # sid -> replica id; consulted before the HRW owner
         self._placed: dict[str, str] = {}
+        # ownership epochs: sid -> the epoch of the CURRENT owner's copy
+        # (bumped per migration/peer-page, stamped on every routed verb
+        # so a stale copy fences itself; the journal's committed records
+        # are the durable half — recover_from_journal rebuilds this)
+        self._epochs: dict[str, int] = {}
         # operator-evicted replicas the health poller must NOT re-admit
         # (a draining replica's /healthz still answers ok until it
         # stops; rejoin() lifts the cordon explicitly)
         self._cordoned: set[str] = set()
         # per-sid migration gates: verbs wait these out, then re-locate
         self._migrating: dict[str, threading.Event] = {}
+        # health hysteresis: consecutive same-direction probe outcomes
+        # required before a membership change (a single flapping probe
+        # must not churn the HRW keyspace); rid -> (direction, streak)
+        self.health_hysteresis = max(1, int(health_hysteresis))
+        self._streaks: dict[str, tuple] = {}
         self.telemetry = telemetry if telemetry is not None else Telemetry()
         self.metrics = ServeMetrics()   # router-level request accounting
         self.draining = False
         self.auto_rebalance = auto_rebalance
+        # per-edge fault injection (serve/faults.py net_* names) — shared
+        # with every handle's transport by add_replica
+        self.faults = faults
+        # Fleet installs this: kill_hook(rid) SIGKILLs a replica (the
+        # kill_replica fault's applier)
+        self.kill_hook = None
         self.counters = {
             "requests_routed": 0, "reroutes": 0, "migrations": 0,
             "migration_failures": 0, "evictions": 0, "rejoins": 0,
             "rebalances": 0, "peer_pages": 0, "sessions_dropped": 0,
+            "fencing_rejections": 0, "fence_failures": 0,
+            "journal_replays": 0, "migrations_in_doubt": 0,
         }
         self.migrations_via: dict[str, int] = {}   # snapshot vs replay
         self.routed_to: dict[str, int] = {rid: 0 for rid in self.replicas}
@@ -344,14 +508,39 @@ class SessionRouter:
             self.ready.set()
         # the span vocabulary the trace-based attribution keys on
         self._spans = self.telemetry.spans
+        # the migration journal: crash-consistent move records (intent/
+        # exported/imported/committed), replayed by recover_from_journal
+        self.journal = None
+        if journal_path is not None:
+            from coda_tpu.serve.journal import MigrationJournal
+
+            self.journal = MigrationJournal(journal_path)
+            # the committed records are the durable epoch map: a
+            # restarted router must stamp verbs at least as new as the
+            # last committed move or a stale copy could serve again
+            for sid, rec in self.journal.committed().items():
+                self._epochs[sid] = rec["epoch"]
+        for rid in self.replicas:
+            self._wire_handle(self.replicas[rid])
+
+    def _wire_handle(self, handle) -> None:
+        """Share the router's fault injector + span recorder with a
+        handle's transport (one fault domain, one trace vocabulary)."""
+        t = getattr(handle, "transport", None)
+        if t is not None:
+            if t.faults is None:
+                t.faults = self.faults
+            t.spans = self._spans
 
     # -- topology ----------------------------------------------------------
     def add_replica(self, replica_id: str, handle, rebalance: bool = True
                     ) -> None:
+        self._wire_handle(handle)
         with self._lock:
             self.replicas[replica_id] = handle
             self._routable.add(replica_id)
             self._health[replica_id] = "ok"
+            self._streaks.pop(replica_id, None)
             self.routed_to.setdefault(replica_id, 0)
             self.ready.set()
         if rebalance:
@@ -404,7 +593,13 @@ class SessionRouter:
     # -- health ------------------------------------------------------------
     def check_health(self) -> dict:
         """One poll of every replica's /healthz: unreachable or unready
-        replicas leave the routing set, recovered ones rejoin. Returns
+        replicas leave the routing set, recovered ones rejoin — but only
+        after ``health_hysteresis`` CONSECUTIVE same-direction outcomes
+        (a single flapping probe must not churn the HRW keyspace and
+        trigger needless migrations). A replica whose transport breaker
+        is open is reported (and evicted) as ``breaker_open`` — distinct
+        from health eviction on ``/stats``; the breaker's half-open
+        window makes this same poll the recovery probe. Returns
         {replica: status}; topology changes trigger a rebalance when
         ``auto_rebalance``."""
         statuses: dict[str, str] = {}
@@ -412,24 +607,50 @@ class SessionRouter:
             items = list(self.replicas.items())
         changed = False
         for rid, handle in items:
-            try:
-                hz = handle.healthz()
-                status = hz.get("status") or (
-                    "ok" if hz.get("ready") else "unready")
-                if hz.get("draining"):
-                    status = "draining"
-            except Exception:
-                status = "unreachable"
+            breaker = getattr(getattr(handle, "transport", None),
+                              "breaker", None)
+            if breaker is not None and breaker.state == "open":
+                # fail fast: K consecutive transport failures already ARE
+                # the hysteresis — don't burn a probe the breaker would
+                # refuse anyway
+                status = "breaker_open"
+            else:
+                try:
+                    hz = handle.healthz()
+                    status = hz.get("status") or (
+                        "ok" if hz.get("ready") else "unready")
+                    if hz.get("draining"):
+                        status = "draining"
+                except ReplicaUnavailable:
+                    status = "breaker_open"
+                except Exception:
+                    status = "unreachable"
             statuses[rid] = status
             routable = status in ("ok", "degraded")
             with self._lock:
                 was = rid in self._routable
                 cordoned = rid in self._cordoned
                 self._health[rid] = status
-            if routable and not was and not cordoned:
+                if routable == was:
+                    self._streaks.pop(rid, None)
+                    flip = False
+                else:
+                    d, n = self._streaks.get(rid, (routable, 0))
+                    n = n + 1 if d == routable else 1
+                    self._streaks[rid] = (routable, n)
+                    # a breaker-open edge needs no further confirmation:
+                    # the K consecutive failures that tripped it are the
+                    # hysteresis
+                    flip = n >= self.health_hysteresis or \
+                        status == "breaker_open"
+            if not flip:
+                continue
+            with self._lock:
+                self._streaks.pop(rid, None)
+            if routable and not cordoned:
                 self.rejoin(rid)
                 changed = True
-            elif not routable and was:
+            elif not routable:
                 self.evict(rid)
                 changed = True
         if changed and self.auto_rebalance:
@@ -469,6 +690,8 @@ class SessionRouter:
         self.draining = True
         self.stop()
         self._executor.shutdown(wait=False)
+        if self.journal is not None:
+            self.journal.close()
 
     # -- location ----------------------------------------------------------
     def _locate(self, sid: str) -> str:
@@ -486,51 +709,84 @@ class SessionRouter:
             routable = sorted(self._routable)
         return rendezvous_owner(sid, routable)
 
-    def _find(self, sid: str, exclude=()) -> Optional[str]:
+    def _find(self, sid: str, exclude=()) -> tuple:
         """Search the fleet for a session that is not where the shard map
         says (a topology change the rebalance has not caught up with).
         ALL known replicas are probed — an evicted-but-draining replica
         still serves its existing sessions until they migrate off it —
-        in rendezvous-rank order, the most likely ex-owners first."""
+        in rendezvous-rank order, the most likely ex-owners first.
+        Returns ``(replica_id_or_None, n_unreachable)``: a failed find
+        with unreachable probes is NOT proof of absence — the session
+        may live behind a partition, and the caller must answer
+        retryable, not 404."""
         with self._lock:
             candidates = [r for r in self.replicas if r not in exclude]
+        unreachable = 0
         for rid in rendezvous_rank(sid, candidates):
             try:
                 if self.replicas[rid].has_session(sid):
-                    return rid
+                    return rid, unreachable
             except Exception:
+                unreachable += 1
                 continue
-        return None
+        return None, unreachable
 
     def _forward(self, verb: str, sid: str, fn):
         """Route one verb: locate -> dispatch (with the route span
-        nesting the replica dispatch span) -> on UnknownSession, search
-        the fleet and re-route once; on a dead replica, evict and
-        fail over."""
+        nesting the replica dispatch span, the router's epoch stamped on
+        the call) -> on UnknownSession, search the fleet and re-route
+        once; on a StaleOwner fencing rejection, the answering replica
+        holds a pre-migration copy — exclude it and re-locate; on a dead
+        replica (or an open breaker), evict and fail over."""
         with self._spans.span(f"route/{verb}", lane="host:router"):
             last_err: Optional[BaseException] = None
-            for attempt in range(3):
+            stale: set = set()
+            for attempt in range(4):
                 rid = self._locate(sid)
                 with self._lock:
                     handle = self.replicas.get(rid)
+                    epoch = self._epochs.get(sid)
                 if handle is None:
                     continue
                 try:
                     with self._spans.span(f"dispatch/{rid}",
                                           lane="host:router"):
-                        out = fn(handle)
+                        out = fn(handle, epoch)
                     with self._lock:
                         self.counters["requests_routed"] += 1
                         self.routed_to[rid] = \
                             self.routed_to.get(rid, 0) + 1
                     return out
+                except StaleOwner as e:
+                    # the fence held: rid serves a pre-migration copy
+                    # (healed partition / crash-restored unsealed
+                    # stream). Never commit there — find the copy whose
+                    # epoch matches the stamp and re-route.
+                    last_err = e
+                    stale.add(rid)
+                    with self._lock:
+                        self.counters["fencing_rejections"] += 1
+                        if self._placed.get(sid) == rid:
+                            self._placed.pop(sid, None)
+                    found, unreachable = self._find(sid, exclude=stale)
+                    if found is None:
+                        if unreachable:
+                            raise ReplicaUnavailable(
+                                f"session {sid}: current owner "
+                                f"unreachable while re-locating after a "
+                                f"fencing rejection ({unreachable} "
+                                "replica(s) down); retry")
+                        raise
+                    with self._lock:
+                        self._placed[sid] = found
+                        self.counters["reroutes"] += 1
                 except UnknownSession as e:
                     last_err = e
                     with self._lock:
                         gate = self._migrating.get(sid)
                     if gate is not None:
                         # we located the source BEFORE its migration gate
-                        # went up and dispatched after the export-close:
+                        # went up and dispatched after the fence landed:
                         # mid-move the payload exists only in the
                         # migrating thread's hands, so neither side
                         # answers. Wait the move out, then re-locate —
@@ -538,9 +794,21 @@ class SessionRouter:
                         # transit.
                         gate.wait(MIGRATION_WAIT_S)
                         continue
-                    found = self._find(sid, exclude={rid})
+                    found, unreachable = self._find(sid,
+                                                    exclude=stale | {rid})
                     if found is None:
-                        if attempt < 2:
+                        if unreachable:
+                            # an unreachable replica may HOLD the
+                            # session: absence is unproven, so the
+                            # answer is retryable backpressure (503),
+                            # never a 404 for a session a partition is
+                            # merely hiding
+                            raise ReplicaUnavailable(
+                                f"session {sid}: not found on reachable "
+                                f"replicas and {unreachable} replica(s) "
+                                "unreachable; retry after the fleet "
+                                "heals") from e
+                        if attempt < 3:
                             # a migration's gate may have been popped
                             # between our dispatch and the check above —
                             # one short beat, then re-locate
@@ -550,6 +818,11 @@ class SessionRouter:
                     with self._lock:
                         self._placed[sid] = found
                         self.counters["reroutes"] += 1
+                except ReplicaUnavailable as e:
+                    # breaker open / retry budget gone: the edge is
+                    # black-holed — evict and fail over like a dead host
+                    last_err = e
+                    self.evict(rid)
                 except (ConnectionError, OSError) as e:
                     # replica went away under us: evict, let health/
                     # rebalance recover it, and fail over this request
@@ -600,13 +873,18 @@ class SessionRouter:
         return await loop.run_in_executor(
             self._executor, lambda: self.open_session(task, seed))
 
-    def label(self, sid: str, label, idx=None, request_id=None) -> dict:
+    def label(self, sid: str, label, idx=None, request_id=None,
+              epoch=None) -> dict:
+        # ``epoch`` is accepted for surface parity with ServeApp (the
+        # shared front door); the ROUTER's own epoch map is what gets
+        # stamped on the replica call — that map is the fence.
         return self._forward(
             "label", sid,
-            lambda h: h.label(sid, label, idx=idx, request_id=request_id))
+            lambda h, e: h.label(sid, label, idx=idx,
+                                 request_id=request_id, epoch=e))
 
     async def label_async(self, sid, label, idx=None,
-                          request_id=None) -> dict:
+                          request_id=None, epoch=None) -> dict:
         import asyncio
 
         loop = asyncio.get_running_loop()
@@ -614,14 +892,15 @@ class SessionRouter:
             self._executor,
             lambda: self.label(sid, label, idx=idx, request_id=request_id))
 
-    def labels(self, sid: str, labels, idx=None, request_id=None) -> dict:
+    def labels(self, sid: str, labels, idx=None, request_id=None,
+               epoch=None) -> dict:
         return self._forward(
             "labels", sid,
-            lambda h: h.labels(sid, labels, idx=idx,
-                               request_id=request_id))
+            lambda h, e: h.labels(sid, labels, idx=idx,
+                                  request_id=request_id, epoch=e))
 
     async def labels_async(self, sid, labels, idx=None,
-                           request_id=None) -> dict:
+                           request_id=None, epoch=None) -> dict:
         import asyncio
 
         loop = asyncio.get_running_loop()
@@ -630,25 +909,53 @@ class SessionRouter:
             lambda: self.labels(sid, labels, idx=idx,
                                 request_id=request_id))
 
-    def best(self, sid: str) -> dict:
-        return self._forward("best", sid, lambda h: h.best(sid))
+    def best(self, sid: str, epoch=None) -> dict:
+        return self._forward("best", sid,
+                             lambda h, e: h.best(sid, epoch=e))
 
-    def trace(self, sid: str) -> dict:
-        return self._forward("trace", sid, lambda h: h.trace(sid))
+    def trace(self, sid: str, epoch=None) -> dict:
+        return self._forward("trace", sid,
+                             lambda h, e: h.trace(sid, epoch=e))
 
-    def close_session(self, sid: str) -> dict:
-        out = self._forward("close", sid, lambda h: h.close(sid))
+    def close_session(self, sid: str, epoch=None) -> dict:
+        out = self._forward("close", sid,
+                            lambda h, e: h.close(sid, epoch=e))
         with self._lock:
             self._placed.pop(sid, None)
+            self._epochs.pop(sid, None)
         return out
 
-    def export_session(self, sid: str, close: bool = False) -> dict:
+    def export_session(self, sid: str, close: bool = False,
+                       hold: bool = False) -> dict:
         out = self._forward("export", sid,
-                            lambda h: h.export(sid, close=close))
+                            lambda h, e: h.export(sid, close=close,
+                                                  hold=hold))
         if close:
             with self._lock:
                 self._placed.pop(sid, None)
+                self._epochs.pop(sid, None)
         return out
+
+    def end_migration(self, sid: str, drop: bool = False) -> dict:
+        # router-mediated fence (surface parity with ServeApp)
+        return self._forward("fence", sid,
+                             lambda h, e: h.fence(sid, drop=drop))
+
+    def session_epoch(self, sid: str) -> dict:
+        """Front-door twin of ``ServeApp.session_epoch``: the router's
+        own epoch map answers when it has an entry (it is the fence's
+        authority); otherwise the located replica's copy does."""
+        with self._lock:
+            ep = self._epochs.get(sid)
+        if ep is not None:
+            return {"session": sid, "epoch": int(ep)}
+        rid = self._locate(sid)
+        with self._lock:
+            handle = self.replicas.get(rid)
+        e = handle.session_epoch(sid) if handle is not None else None
+        if e is None:
+            raise UnknownSession(sid)
+        return {"session": sid, "epoch": int(e)}
 
     def import_session(self, payload: dict) -> dict:
         if self.draining:
@@ -664,12 +971,73 @@ class SessionRouter:
                 return handle.import_payload(payload)
 
     # -- migration ---------------------------------------------------------
+    def _commit_migration(self, sid: str, src, src_rid: str, dst_rid: str,
+                          epoch_next: int, via: str, mid) -> dict:
+        """The commit half of a move whose import landed: fence the
+        source copy (best-effort — a fence the partition eats leaves a
+        STALE copy behind, which the epoch stamp defends until recovery
+        re-fences it), adopt the epoch + placement, count, journal."""
+        fenced = True
+        try:
+            src.fence(sid, drop=True)
+        except UnknownSession:
+            pass
+        except Exception:
+            fenced = False
+            with self._lock:
+                self.counters["fence_failures"] += 1
+        with self._lock:
+            self._epochs[sid] = epoch_next
+            # home placement needs no override; an off-owner
+            # destination (peer paging) keeps one
+            owner = rendezvous_owner(sid, sorted(self._routable))
+            if dst_rid == owner:
+                self._placed.pop(sid, None)
+            else:
+                self._placed[sid] = dst_rid
+            self.counters["migrations"] += 1
+            self.migrations_via[via] = \
+                self.migrations_via.get(via, 0) + 1
+        if mid is not None:
+            self.journal.record(mid, "committed", epoch=epoch_next,
+                                fenced=fenced)
+        info = {"migrated": sid, "from": src_rid, "to": dst_rid,
+                "via": via, "epoch": epoch_next}
+        if not fenced:
+            info["fence_pending"] = True
+        return info
+
+    def _kill_point(self, src_rid: str, dst_rid: str) -> None:
+        """The seeded mid-migration process-fault site: between the
+        export and the import, ``kill_replica`` (edge-addressed) fires
+        the fleet's kill hook — SIGKILL semantics for whichever end the
+        fault spec names."""
+        if self.faults is None or self.kill_hook is None:
+            return
+        for rid in (src_rid, dst_rid):
+            if "kill_replica" in self.faults.fire("migrate_mid", edge=rid):
+                self.kill_hook(rid)
+
     def migrate_session(self, sid: str, src_rid: str, dst_rid: str) -> dict:
-        """Move one session: gate its verbs, quiesce-export from the
-        source (drain handoff — the source forgets it), import on the
-        destination (digest-verified snapshot or bitwise stream replay),
-        un-gate. On an import failure the payload is restored to the
-        SOURCE so the session is never dropped."""
+        """Move one session with the journaled prepare/commit protocol:
+
+          1. journal ``intent`` (src, dst, the bumped epoch);
+          2. PREPARE on the source (quiesce + hold + export WITHOUT
+             close — the source keeps a recoverable, uncommittable
+             copy); journal ``exported`` with the payload digest;
+          3. import on the destination at the bumped ownership epoch
+             (digest-verified snapshot or bitwise stream replay);
+             journal ``imported``;
+          4. FENCE the source (drop its copy, seal its stream), commit
+             the router's epoch/placement maps, journal ``committed``.
+
+        A crash — of the router or either replica — between any two
+        steps degrades to *didn't move* (the source's held copy resumes
+        on abort or journal recovery) or *moved exactly once* (journal
+        recovery finalizes the fence); and even an unfenced stale copy
+        can never commit a label, because every routed verb carries the
+        bumped epoch the stale copy fails. On an import failure the
+        source is un-held and the session resumes there — never gone."""
         gate = threading.Event()
         with self._lock:
             if self._migrating.get(sid) is not None:
@@ -677,60 +1045,173 @@ class SessionRouter:
             self._migrating[sid] = gate
             src = self.replicas.get(src_rid)
             dst = self.replicas.get(dst_rid)
+            epoch_next = self._epochs.get(sid, 0) + 1
         info: dict = {}
+        mid = None
         try:
             if src is None or dst is None:
                 return {"skipped": "replica gone"}
+            if self.journal is not None:
+                mid = self.journal.begin(sid, src_rid, dst_rid, epoch_next)
             try:
                 payload = src.export_for_migration(sid)
             except UnknownSession:
+                if mid is not None:
+                    self.journal.record(mid, "aborted", reason="closed")
                 return {"skipped": "closed"}
+            # the ownership bump happens HERE, under the router's hand:
+            # demote/wake round trips preserve the epoch, only a
+            # committed move advances it
+            payload = dict(payload)
+            payload["epoch"] = epoch_next
+            if mid is not None:
+                from coda_tpu.serve.journal import payload_digest
+
+                self.journal.record(mid, "exported",
+                                    digest=payload_digest(payload),
+                                    n_labeled=payload.get("n_labeled"))
+            self._kill_point(src_rid, dst_rid)
             try:
                 res = None
                 for i in range(8):
                     try:
                         res = dst.import_payload(payload)
                         break
-                    except SlabFull:
+                    except SlabFull as e:
                         # transient admission pressure on the peer
                         # (every slot momentarily pinned): a migration
-                        # must out-wait it, not fail the move
-                        if i == 7:
+                        # must out-wait it, not fail the move — but a
+                        # black-holed edge (breaker open) fails NOW
+                        if isinstance(e, ReplicaUnavailable) or i == 7:
                             raise
                         time.sleep(0.01 * (i + 1))
-                via = res.get("restored_via", "?")
-                with self._lock:
-                    # home placement needs no override; an off-owner
-                    # destination (peer paging) keeps one
-                    owner = rendezvous_owner(sid, sorted(self._routable))
-                    if dst_rid == owner:
-                        self._placed.pop(sid, None)
-                    else:
-                        self._placed[sid] = dst_rid
-                    self.counters["migrations"] += 1
-                    self.migrations_via[via] = \
-                        self.migrations_via.get(via, 0) + 1
-                info = {"migrated": sid, "from": src_rid, "to": dst_rid,
-                        "via": via}
+                if mid is not None:
+                    self.journal.record(mid, "imported")
+                info = self._commit_migration(
+                    sid, src, src_rid, dst_rid, epoch_next,
+                    res.get("restored_via", "?"), mid)
             except BaseException as e:
-                # put it back where it came from — a failed migration
-                # must degrade to "didn't move", never to "gone"
+                # before restoring the source, probe the destination: a
+                # lost import RESPONSE is not a lost import — if the
+                # copy landed at the bumped epoch, the move COMMITTED
+                # and must finalize, or two live copies would serve
+                # under one sid
+                landed = False
+                try:
+                    ep = dst.session_epoch(sid)
+                    landed = ep is not None and ep >= epoch_next
+                except Exception:
+                    landed = False
+                if landed:
+                    if mid is not None:
+                        self.journal.record(mid, "imported",
+                                            ack_lost=True)
+                    info = self._commit_migration(
+                        sid, src, src_rid, dst_rid, epoch_next,
+                        "recovered", mid)
+                    return info
+                # the import never landed (or was refused): the source
+                # still holds the session — lift the hold and the move
+                # degrades to "didn't move", never to "gone"
                 with self._lock:
                     self.counters["migration_failures"] += 1
                 try:
-                    src.import_payload(payload)
+                    src.fence(sid, drop=False)
                     with self._lock:
                         self._placed[sid] = src_rid
                 except BaseException:
+                    # even the abort couldn't reach the source: its held
+                    # copy stays parked (and crash restore resurrects it
+                    # from the unsealed stream). Leave the journal at its
+                    # last NON-terminal phase so recover_from_journal
+                    # resolves the doubt — recording 'aborted' here would
+                    # terminally hide a move recovery must still settle.
                     with self._lock:
-                        self.counters["sessions_dropped"] += 1
-                    raise
+                        self.counters["migrations_in_doubt"] += 1
+                    info = {"failed": sid, "error": repr(e),
+                            "in_doubt": True}
+                    return info
+                if mid is not None:
+                    self.journal.record(mid, "aborted", reason=repr(e))
                 info = {"failed": sid, "error": repr(e)}
             return info
         finally:
             with self._lock:
                 self._migrating.pop(sid, None)
             gate.set()
+
+    def recover_from_journal(self) -> dict:
+        """Resolve every in-doubt migration after a router restart (call
+        once the replicas are registered, before serving): probe the
+        destination for the journaled copy — present at the bumped epoch
+        means the move committed on the target, so FINALIZE (fence the
+        source, adopt epoch + placement); absent means the import never
+        landed, so RESTORE (lift the source's hold; its copy — or its
+        crash-restored stream — serves again). Either way each in-doubt
+        SIGKILL window degrades to didn't-move or moved-exactly-once."""
+        if self.journal is None:
+            return {"resolved": 0}
+        report: dict = {"resolved": 0, "finalized": [], "restored": [],
+                       "in_doubt": []}
+        for move in self.journal.in_doubt():
+            sid = move.get("sid")
+            mid = move.get("mid")
+            epoch = int(move.get("epoch") or 0)
+            with self._lock:
+                src = self.replicas.get(move.get("src"))
+                dst = self.replicas.get(move.get("dst"))
+            on_dst = False
+            if dst is not None:
+                try:
+                    ep = dst.session_epoch(sid)
+                    on_dst = ep is not None and ep >= epoch
+                except Exception:
+                    on_dst = False
+            with self._lock:
+                self.counters["journal_replays"] += 1
+            if on_dst:
+                fenced = True
+                if src is not None:
+                    try:
+                        src.fence(sid, drop=True)
+                    except UnknownSession:
+                        pass
+                    except Exception:
+                        fenced = False
+                        with self._lock:
+                            self.counters["fence_failures"] += 1
+                with self._lock:
+                    self._epochs[sid] = max(self._epochs.get(sid, 0),
+                                            epoch)
+                    routable = sorted(self._routable)
+                    if routable and rendezvous_owner(
+                            sid, routable) == move.get("dst"):
+                        self._placed.pop(sid, None)
+                    else:
+                        self._placed[sid] = move.get("dst")
+                self.journal.record(mid, "committed", epoch=epoch,
+                                    fenced=fenced, replayed=True)
+                report["finalized"].append(sid)
+            else:
+                restored = False
+                if src is not None:
+                    try:
+                        src.fence(sid, drop=False)  # lift any hold
+                        restored = src.has_session(sid)
+                    except Exception:
+                        restored = False
+                self.journal.record(mid, "aborted",
+                                    reason="journal recovery: import "
+                                           "never landed", replayed=True)
+                if restored:
+                    report["restored"].append(sid)
+                else:
+                    # neither end answers for it right now — the source's
+                    # crash restore (its stream is unsealed) resurrects
+                    # it; record the doubt attributably
+                    report["in_doubt"].append(sid)
+            report["resolved"] += 1
+        return report
 
     def _migrate_all_off(self, src_rid: str) -> dict:
         """Drain-and-migrate every session off one replica to the
@@ -838,8 +1319,13 @@ class SessionRouter:
             routable = sorted(self._routable)
             health = dict(self._health)
             placed = len(self._placed)
+            epochs = len(self._epochs)
         per_replica: dict[str, dict] = {}
+        transports: dict[str, dict] = {}
         for rid, handle in items:
+            t = getattr(handle, "transport", None)
+            if t is not None:
+                transports[rid] = t.snapshot()
             try:
                 per_replica[rid] = handle.stats()
             except Exception as e:
@@ -849,20 +1335,39 @@ class SessionRouter:
                     "demotions", "wakes", "hibernates", "peer_pages")
         aggregate = {k: sum(int(s.get(k) or 0) for s in per_replica.values()
                             if "error" not in s) for k in agg_keys}
-        return {
+        # breaker-open vs health-evicted, reported DISTINCTLY: the
+        # breakers section is transport state, the health map is probe
+        # state — an operator can tell a black-holed edge from an
+        # unready process at a glance
+        breakers = {rid: {"state": t["breaker_state"],
+                          "trips": t["breaker_trips"],
+                          "consecutive_failures":
+                              t["consecutive_failures"]}
+                    for rid, t in transports.items()}
+        out = {
             "role": "router",
             "replicas": per_replica,
             "aggregate": aggregate,
             "router": {
                 "routable": routable,
                 "health": health,
+                "health_hysteresis": self.health_hysteresis,
                 "counters": counters,
                 "migrations_via": via,
                 "requests_to": routed,
                 "placed_overrides": placed,
+                "epoch_overrides": epochs,
                 "migration_verified": sum(via.values()),
+                "breakers": breakers,
+                "transport": transports,
+                "transport_retries": {
+                    rid: t["retries_total"]
+                    for rid, t in transports.items()},
             },
         }
+        if self.journal is not None:
+            out["router"]["journal"] = self.journal.stats()
+        return out
 
     def render_metrics(self) -> str:
         """The merged /metrics exposition: router registry families plus

@@ -69,6 +69,7 @@ from coda_tpu.serve.state import (
     SelectorSpec,
     SessionStore,
     SlabFull,
+    StaleOwner,
     UnknownSession,
 )
 
@@ -146,6 +147,12 @@ class ServeApp:
         self.spec = spec or SelectorSpec.create("coda", n_parallel=capacity)
         self.default_task = default_task
         self.draining = False
+        # migration holds (the fleet's prepare/commit protocol): a held
+        # sid is mid-migration — its export payload is in the router's
+        # hands and neither a local label commit nor a wake may revive
+        # the local copy until the router fences (drop) or aborts
+        # (resume). Guarded by store.lock.
+        self._holds: set = set()
         self.warm_error: Optional[str] = None  # last warm-up failure
         # readiness: set once the warm pool is compiled (or warm-up was
         # explicitly skipped). /healthz answers 503 until then — the load
@@ -279,6 +286,105 @@ class ServeApp:
             s = self._next_seed
             self._next_seed += 1
             return s
+
+    # -- fencing + migration holds -----------------------------------------
+    def held(self, sid: str) -> bool:
+        with self.store.lock:
+            return sid in self._holds
+
+    def _check_hold(self, sid: str) -> None:
+        if self.held(sid):
+            # retryable: the move either commits (the retry re-routes to
+            # the new owner) or aborts (the retry lands back here)
+            raise BucketQuarantined(
+                f"session {sid} is migrating; retry shortly")
+
+    def _check_epoch(self, sess, epoch) -> None:
+        """The fencing check: a verb stamped with an ownership epoch
+        NEWER than this copy's proves the session migrated away and this
+        copy is stale — refuse, typed, so the router re-locates. A verb
+        stamped older/equal is fine (a restarted router's map can lag; a
+        newer local copy is still the authority)."""
+        if epoch is not None and int(epoch) > sess.epoch:
+            self.metrics.record_fencing_rejection()
+            raise StaleOwner(sess.sid, have=sess.epoch, want=int(epoch))
+
+    def session_epoch(self, sid: str) -> dict:
+        """The ownership epoch of this replica's copy, without waking it
+        (``GET /session/{id}/epoch`` — the journal-recovery probe; a
+        full export just to read one integer would ship the whole
+        stream)."""
+        try:
+            return {"session": sid, "epoch": self.store.get(sid).epoch}
+        except UnknownSession:
+            if self.tiers is not None:
+                p = self.tiers.parked_payload(sid)
+                if p is not None:
+                    return {"session": sid,
+                            "epoch": int(p.get("epoch") or 0)}
+            raise
+
+    def begin_migration(self, sid: str) -> dict:
+        """The migration PREPARE verb: quiesce (demote until parked — the
+        demotion loses cleanly to any in-flight label ticket, so the
+        payload always carries every committed label), place a hold (no
+        local commit, no wake can revive the copy), and export WITHOUT
+        closing — the source keeps a recoverable copy until the router's
+        fence commits the move. A lost response is therefore harmless:
+        nothing changed hands yet."""
+        if self.tiers is not None:
+            for _ in range(500):
+                if not self.store.alive(sid):
+                    break  # already parked (or closed) — export serves it
+                if self.tiers.try_demote(sid):
+                    break
+                time.sleep(0.002)
+        else:
+            # no tiering: the session stays hot — wait out in-flight
+            # tickets (pins) so the export snapshot trails every commit
+            try:
+                sess = self.store.get(sid)
+                for _ in range(500):
+                    if sess.pins == 0:
+                        break
+                    time.sleep(0.002)
+            except UnknownSession:
+                pass
+        with self.store.lock:
+            self._holds.add(sid)
+        try:
+            return self.export_session(sid, close=False)
+        except BaseException:
+            with self.store.lock:
+                self._holds.discard(sid)
+            raise
+
+    def end_migration(self, sid: str, drop: bool) -> dict:
+        """The migration COMMIT/ABORT verb. ``drop=True`` fences the
+        local copy (the peer owns the session now): discard the parked
+        payload / close the live copy and seal its stream. ``drop=False``
+        lifts the hold — the move failed and the session resumes here,
+        untouched."""
+        with self.store.lock:
+            held = sid in self._holds
+            self._holds.discard(sid)
+        if not drop:
+            return {"session": sid, "held": held, "dropped": False}
+        dropped = False
+        try:
+            if self.store.alive(sid):
+                self.store.close(sid)
+                self.recorder.close(sid)
+                dropped = True
+        except UnknownSession:
+            pass
+        if not dropped and self.tiers is not None and \
+                self.tiers.discard(sid):
+            self.recorder.seal(sid)
+            dropped = True
+        if dropped:
+            self.metrics.record_session("close")
+        return {"session": sid, "held": held, "dropped": dropped}
 
     # -- tiering glue: wake-through lookup + demote-then-admit -------------
     def _resolve_pinned(self, sid: str, wake: bool = True):
@@ -435,7 +541,8 @@ class ServeApp:
         return self._payload(sess, res)
 
     def _label_begin(self, sid: str, label: int, idx: Optional[int],
-                     request_id: Optional[str] = None, wake: bool = True):
+                     request_id: Optional[str] = None, wake: bool = True,
+                     epoch: Optional[int] = None):
         from coda_tpu.serve.batcher import Ticket
 
         if self.faults is not None and self.tiers is not None and \
@@ -444,12 +551,20 @@ class ServeApp:
             # either wins (and the lookup below transparently wakes the
             # session) or loses cleanly to an in-flight pin — never both
             self.tiers.try_demote(sid)
+        # a held sid is mid-migration: refuse retryably BEFORE the wake-
+        # through lookup (a wake would revive the copy the export of
+        # which is already in the router's hands)
+        self._check_hold(sid)
         # wake-through lookup, PINNED: the session cannot be demoted
         # between here and the ticket's resolution (the pin is handed to
         # the ticket below; every non-ticket exit unpins in `finally`)
         sess = self._resolve_pinned(sid, wake=wake)
         handoff = False
         try:
+            # the fence: a stale copy must refuse BEFORE the dedupe
+            # lookup — its cache predates the migration, and answering
+            # from it would commit a label the new owner also commits
+            self._check_epoch(sess, epoch)
             if sess.restoring:
                 # import/restore is mid-replay: the posterior and the
                 # dedupe cache are not rebuilt yet, so a label now could
@@ -556,13 +671,16 @@ class ServeApp:
                 self.store.unpin(sess)
 
     def label(self, sid: str, label: int, idx: Optional[int] = None,
-              request_id: Optional[str] = None) -> dict:
-        sess, ticket = self._label_begin(sid, label, idx, request_id)
+              request_id: Optional[str] = None,
+              epoch: Optional[int] = None) -> dict:
+        sess, ticket = self._label_begin(sid, label, idx, request_id,
+                                         epoch=epoch)
         return self._payload(sess, ticket.wait(REQUEST_TIMEOUT_S))
 
     async def label_async(self, sid: str, label: int,
                           idx: Optional[int] = None,
-                          request_id: Optional[str] = None) -> dict:
+                          request_id: Optional[str] = None,
+                          epoch: Optional[int] = None) -> dict:
         try:
             # inline fast path with waking DISABLED: for a resident
             # session _label_begin is pure host-dict work (lookup, bounds
@@ -571,7 +689,7 @@ class ServeApp:
             # between an aliveness probe and the lookup: the wake's disk
             # read / stream replay must never run on the event loop.
             sess, ticket = self._label_begin(sid, label, idx, request_id,
-                                             wake=False)
+                                             wake=False, epoch=epoch)
         except UnknownSession:
             if self.tiers is None:
                 raise
@@ -580,12 +698,14 @@ class ServeApp:
             # and re-raises UnknownSession only for truly dead sids
             loop = asyncio.get_running_loop()
             sess, ticket = await loop.run_in_executor(
-                self._executor, self._label_begin, sid, label, idx,
-                request_id)
+                self._executor,
+                lambda: self._label_begin(sid, label, idx, request_id,
+                                          epoch=epoch))
         return self._payload(sess, await ticket.wait_async(REQUEST_TIMEOUT_S))
 
     def labels(self, sid: str, labels, idx=None,
-               request_id: Optional[str] = None) -> dict:
+               request_id: Optional[str] = None,
+               epoch: Optional[int] = None) -> dict:
         """The batch-label verb behind ``POST /session/{id}/labels``: all
         q oracle answers of one round, resolved through ONE ticket and
         ONE fused dispatch (the q-wide bucket's compiled step applies
@@ -599,16 +719,19 @@ class ServeApp:
         verbs with a list payload — no second copy of the pin/dedupe/
         wake choreography to keep in lockstep."""
         return self.label(sid, list(labels), idx=idx,
-                          request_id=request_id)
+                          request_id=request_id, epoch=epoch)
 
     async def labels_async(self, sid: str, labels, idx=None,
-                           request_id: Optional[str] = None) -> dict:
+                           request_id: Optional[str] = None,
+                           epoch: Optional[int] = None) -> dict:
         return await self.label_async(sid, list(labels), idx=idx,
-                                      request_id=request_id)
+                                      request_id=request_id, epoch=epoch)
 
-    def best(self, sid: str) -> dict:
+    def best(self, sid: str, epoch: Optional[int] = None) -> dict:
+        self._check_hold(sid)
         sess = self._resolve_pinned(sid)  # wakes a parked session
         try:
+            self._check_epoch(sess, epoch)
             if sess.restoring:
                 # the slot holds a partially-replayed posterior and
                 # n_labeled is still 0 — answering now would serve a wrong
@@ -625,9 +748,17 @@ class ServeApp:
         finally:
             self.store.unpin(sess)
 
-    def close_session(self, sid: str) -> dict:
+    def close_session(self, sid: str, epoch: Optional[int] = None) -> dict:
+        # a close landing in the migration-hold window would discard the
+        # copy whose export is already in the router's hands — and the
+        # import would then resurrect the "closed" session on the
+        # destination. Retryable: the retry lands post-commit on the new
+        # owner (and closes it there) or post-abort back here.
+        self._check_hold(sid)
         try:
-            restoring = self.store.get(sid).restoring
+            sess = self.store.get(sid)
+            self._check_epoch(sess, epoch)
+            restoring = sess.restoring
         except UnknownSession:
             # a parked session closes without waking: drop the payload /
             # hibernate file and seal the stream (close marker)
@@ -649,13 +780,15 @@ class ServeApp:
         self.metrics.record_session("close")
         return {"closed": sid}
 
-    def trace(self, sid: str) -> dict:
+    def trace(self, sid: str, epoch: Optional[int] = None) -> dict:
         """The session's per-round decision history from its record stream
         (the flight recorder's interactive face: every dispatch this
         session rode, with the proposed item, best-model answer, and the
         label that was applied)."""
+        self._check_hold(sid)
         sess = self._resolve_pinned(sid)  # wakes a parked session
         try:
+            self._check_epoch(sess, epoch)
             if sess.restoring:
                 # import_history lands only after the replay verifies; a
                 # trace served now would be empty/partial, not the
@@ -668,17 +801,27 @@ class ServeApp:
         finally:
             self.store.unpin(sess)
 
-    def export_session(self, sid: str, close: bool = False) -> dict:
+    def export_session(self, sid: str, close: bool = False,
+                       hold: bool = False) -> dict:
         """The migration verb behind ``POST /session/{id}/export``: a
         self-contained payload (recorder stream + fingerprint-guarded
         carries snapshot) any same-task server can import. ``close`` frees
         the slot once the payload is built — the drain handoff.
+        ``hold`` runs the fleet's PREPARE protocol instead (quiesce,
+        hold, export-without-close — see :meth:`begin_migration`); the
+        router commits or aborts through ``POST /session/{id}/fence``.
 
         A PARKED session exports without waking — its warm/cold payload
         IS the export (a demotion is an export minus the HTTP hop). The
         export pin means a demotion either completed before this verb
         (payload served from the tier) or cleanly aborts against it —
         the client always gets a consistent snapshot."""
+        if hold:
+            return self.begin_migration(sid)
+        if close:
+            # a closing export is a drain handoff: like close_session it
+            # must wait out a migration hold, not race it
+            self._check_hold(sid)
         from coda_tpu.serve import recovery
         from coda_tpu.serve.recovery import _counter
 
@@ -867,7 +1010,8 @@ class StaleItem(ValueError):
 
 
 _SESSION_RE = re.compile(
-    r"^/session/([0-9a-f]+)(/(label|labels|best|trace|export))?$")
+    r"^/session/([0-9a-f]+)"
+    r"(/(label|labels|best|trace|export|fence|epoch))?$")
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             409: "Conflict", 500: "Internal Server Error",
@@ -978,7 +1122,7 @@ class AsyncHTTPServer:
                 if 0 <= n <= _MAX_BODY_BYTES:
                     body = await reader.readexactly(n) if n > 0 else b""
                     status, payload, ctype = await self._handle(
-                        method, target.split("?")[0], body)
+                        method, target, body)
                 else:
                     # malformed or oversized Content-Length: answer a JSON
                     # error (never a dropped connection) and close — the
@@ -1010,8 +1154,13 @@ class AsyncHTTPServer:
                 pass
 
     # -- routing (same error envelope as the session verbs raise) ----------
-    async def _handle(self, method: str, path: str, body: bytes):
+    async def _handle(self, method: str, target: str, body: bytes):
         app = self.app
+        path, _, query = target.partition("?")
+        params = {}
+        for kv in filter(None, query.split("&")):
+            k, _, v = kv.partition("=")
+            params[k] = v
         if method == "GET" and path == "/healthz":
             # the readiness gate: 503 until the warm pool is compiled, so
             # a restarting replica takes no traffic while executables are
@@ -1045,7 +1194,7 @@ class AsyncHTTPServer:
                 return 500, {"error": f"internal: {e}"}, _JSON
             return 200, text, _PROM
         try:
-            out = await self._route(method, path, body)
+            out = await self._route(method, path, body, params)
         except Draining:
             return (503, {"error": "draining: not admitting new sessions"},
                     _JSON)
@@ -1055,6 +1204,12 @@ class AsyncHTTPServer:
             # the slab is being rebuilt from session streams — transient,
             # retryable: 503 like every other backpressure signal
             return 503, {"error": f"healing: {e}"}, _JSON
+        except StaleOwner as e:
+            # the fencing rejection: this replica's copy is stale — the
+            # router re-locates on this envelope; a direct client should
+            # re-resolve the fleet front door
+            app.metrics.record_session("request_reject")
+            return 409, {"error": f"stale owner: {e}"}, _JSON
         except ImportRejected as e:
             return 409, {"error": f"import rejected: {e}"}, _JSON
         except UnknownSession as e:
@@ -1074,10 +1229,20 @@ class AsyncHTTPServer:
             return 404, {"error": "not found"}, _JSON
         return 200, out, _JSON
 
-    async def _route(self, method: str, path: str, raw: bytes):
+    async def _route(self, method: str, path: str, raw: bytes,
+                     params: Optional[dict] = None):
         app = self.app
         loop = asyncio.get_running_loop()
         m = _SESSION_RE.match(path)
+
+        def _epoch(req=None):
+            # the router's fencing stamp: body field on POST/DELETE,
+            # ?epoch=N on GETs
+            v = (req or {}).get("epoch")
+            if v is None:
+                v = (params or {}).get("epoch")
+            return None if v in (None, "") else int(v)
+
         if method == "POST" and path == "/session/import":
             # restore an exported session (replay/snapshot verification is
             # real compute — never on the event loop)
@@ -1098,7 +1263,8 @@ class AsyncHTTPServer:
                 raise ValueError("missing 'label'")
             return await app.label_async(m.group(1), req["label"],
                                          idx=req.get("idx"),
-                                         request_id=req.get("request_id"))
+                                         request_id=req.get("request_id"),
+                                         epoch=_epoch(req))
         if m and method == "POST" and m.group(3) == "labels":
             # batch of oracle answers, one dispatch (see ServeApp.labels)
             req = json.loads(raw or b"{}")
@@ -1106,22 +1272,38 @@ class AsyncHTTPServer:
                 raise ValueError("missing non-empty 'labels' list")
             return await app.labels_async(m.group(1), req["labels"],
                                           idx=req.get("idx"),
-                                          request_id=req.get("request_id"))
+                                          request_id=req.get("request_id"),
+                                          epoch=_epoch(req))
         if m and method == "POST" and m.group(3) == "export":
             req = json.loads(raw or b"{}")
             return await loop.run_in_executor(
                 app._executor,
                 lambda: app.export_session(m.group(1),
-                                           close=bool(req.get("close"))))
+                                           close=bool(req.get("close")),
+                                           hold=bool(req.get("hold"))))
+        if m and method == "POST" and m.group(3) == "fence":
+            # the migration commit/abort half of the hold protocol
+            req = json.loads(raw or b"{}")
+            return await loop.run_in_executor(
+                app._executor,
+                lambda: app.end_migration(m.group(1),
+                                          drop=bool(req.get("drop"))))
+        if m and method == "GET" and m.group(3) == "epoch":
+            return await loop.run_in_executor(
+                app._executor, app.session_epoch, m.group(1))
         if m and method == "GET" and m.group(3) == "best":
-            return await loop.run_in_executor(app._executor, app.best,
-                                              m.group(1))
+            return await loop.run_in_executor(
+                app._executor,
+                lambda: app.best(m.group(1), epoch=_epoch()))
         if m and method == "GET" and m.group(3) == "trace":
-            return await loop.run_in_executor(app._executor, app.trace,
-                                              m.group(1))
+            return await loop.run_in_executor(
+                app._executor,
+                lambda: app.trace(m.group(1), epoch=_epoch()))
         if m and method == "DELETE" and m.group(3) is None:
-            return await loop.run_in_executor(app._executor,
-                                              app.close_session, m.group(1))
+            req = json.loads(raw or b"{}")
+            return await loop.run_in_executor(
+                app._executor,
+                lambda: app.close_session(m.group(1), epoch=_epoch(req)))
         if method == "GET" and path == "/stats":
             return await loop.run_in_executor(app._executor, app.stats)
         if method == "GET" and path == "/sessions":

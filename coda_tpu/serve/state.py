@@ -56,6 +56,26 @@ class BucketQuarantined(RuntimeError):
     digest mismatch or exhausted heal retries produces."""
 
 
+class StaleOwner(RuntimeError):
+    """The fencing rejection: a verb arrived stamped with an ownership
+    epoch NEWER than this replica's copy of the session — the session
+    migrated away and this copy survived (a healed partition, a crash
+    restore of an unsealed stream). Committing here would double-apply
+    against the copy the new owner holds, so the verb is refused and the
+    router re-locates. Structural, not probabilistic: the split-brain
+    double-apply is impossible while every routed verb carries the
+    router's epoch."""
+
+    def __init__(self, sid: str, have: int, want: int):
+        super().__init__(
+            f"session {sid}: this replica's copy is at ownership epoch "
+            f"{have} but the verb was fenced at epoch {want} — the "
+            "session migrated away; re-locate and retry")
+        self.sid = sid
+        self.have = int(have)
+        self.want = int(want)
+
+
 # ---------------------------------------------------------------------------
 # selector specs: a picklable/hashable description of a selector config
 # ---------------------------------------------------------------------------
@@ -907,6 +927,12 @@ class Session:
     # cache are not rebuilt yet — label dispatches answer retryable 503
     # instead of 404-ing or double-applying (cleared when restore completes)
     restoring: bool = False
+    # ownership epoch (serve/router.py): bumped by every migration /
+    # peer-page, stamped into the export payload and the stream meta. A
+    # routed verb carries the router's epoch; a copy whose epoch is OLDER
+    # than the verb's is stale (the session moved away and this copy
+    # survived a partition or crash) and refuses with StaleOwner.
+    epoch: int = 0
     # tiering bookkeeping (serve/tiering.py): ``pins`` counts in-flight
     # verbs/tickets holding the session resident — demotion requires the
     # count to be exactly its own pin, so it cleanly loses every race

@@ -364,6 +364,15 @@ class TierManager:
         False when the sid is in no tier — the caller's UnknownSession
         stands. Raises what the wake raised (SlabFull when no slot could
         be freed, ImportRejected when the payload cannot be verified)."""
+        if self.app.held(sid):
+            # mid-migration (serve/server.py hold protocol): the export
+            # payload is in the router's hands — a wake now would revive
+            # a copy the destination may already own. Retryable: the
+            # move commits (retry re-routes) or aborts (retry lands).
+            from coda_tpu.serve.state import BucketQuarantined
+
+            raise BucketQuarantined(
+                f"session {sid} is migrating; retry shortly")
         with self._lock:
             ev = self._waking.get(sid)
             if ev is not None:
@@ -494,6 +503,8 @@ class TierManager:
                 else:
                     lru = []
             for sid in aged + lru:
+                if self.app.held(sid):
+                    continue  # mid-migration: the router owns this move
                 # demotion-aware peer paging: a pressured replica offers
                 # the payload to a less-loaded peer first; disk is the
                 # fallback, not the only exit

@@ -92,6 +92,8 @@ _SERVE_SCALARS = [
      "Sessions refused by admission control (slab full / draining)"),
     ("requests_rejected", "serve_requests_rejected_total", "counter",
      "Requests refused (draining / unknown session / stale item)"),
+    ("fencing_rejections", "serve_fencing_rejections_total", "counter",
+     "Stale-epoch verbs this replica refused (the ownership fence held)"),
     ("max_occupancy", "serve_max_occupancy", "gauge",
      "Most requests ever served by one dispatch"),
     # tiered posterior state (serve/tiering.py)
@@ -297,10 +299,46 @@ def render_fleet(replica_snaps: dict, registry: Optional[Registry] = None,
                 ("evictions", "Replicas evicted from routing by health"),
                 ("rejoins", "Replicas re-admitted to routing by health"),
                 ("rebalances", "Topology-change rebalance passes"),
+                ("fence_failures", "Migration commits whose source fence "
+                                   "did not land (stale copy defended by "
+                                   "the epoch stamp until re-fenced)"),
         ):
             if key in counters:
                 _family(out, _name(prefix, f"router_{key}_total"),
                         "counter", help, [({}, counters[key])])
+        # the fleet-chaos families (ISSUE 14): fencing rejections the
+        # router absorbed, journal replays, per-replica transport retries
+        # and breaker state — named exactly as the runbooks grep for them
+        if "fencing_rejections" in counters:
+            _family(out, _name(prefix, "fencing_rejections_total"),
+                    "counter",
+                    "Stale-epoch verbs refused fleet-wide (each one a "
+                    "prevented split-brain double-apply)",
+                    [({}, counters["fencing_rejections"])])
+        if "journal_replays" in counters:
+            _family(out, _name(prefix, "migration_journal_replays_total"),
+                    "counter",
+                    "In-doubt migrations resolved from the journal after "
+                    "a restart (finalized or restored)",
+                    [({}, counters["journal_replays"])])
+        retries = rt.get("transport_retries") or {}
+        if retries:
+            _family(out, _name(prefix, "transport_retries_total"),
+                    "counter",
+                    "Replica-call transport retries (idempotent verbs "
+                    "only, per-replica budgeted)",
+                    [({"replica": rid}, n)
+                     for rid, n in sorted(retries.items())])
+        breakers = rt.get("breakers") or {}
+        if breakers:
+            order = {"closed": 0, "half_open": 1, "open": 2}
+            _family(out, _name(prefix, "replica_breaker_state"),
+                    "gauge",
+                    "Per-replica transport circuit breaker "
+                    "(0=closed, 1=half-open, 2=open)",
+                    [({"replica": rid},
+                      order.get(b.get("state"), 0))
+                     for rid, b in sorted(breakers.items())])
         routed = rt.get("requests_to") or {}
         if routed:
             _family(out, _name(prefix, "router_requests_to_replica_total"),

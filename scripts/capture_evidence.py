@@ -188,6 +188,15 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                  os.path.join(tmpdir, "fleet_cache"),
                  "--out", os.path.join(tmpdir, "fleet.json")] + plat,
                 os.path.join(tmpdir, "fleet.json"), 900),
+            # fleet chaos at proof scale: the partition+heal scenario
+            # (breaker trips, retries absorb, 0 errors) plus the
+            # stale-owner fencing regression — the full 6-scenario
+            # matrix is the committed FAULT_MATRIX_FLEET_* artifact
+            "serve_fleet_chaos": (
+                [py, "scripts/check_fault_matrix.py", "--fleet",
+                 "--only", "fleet_partition_heal,fleet_stale_owner_fence",
+                 "--out", os.path.join(tmpdir, "fleet_chaos.json")],
+                os.path.join(tmpdir, "fleet_chaos.json"), 900),
         }
     return {
         # the r09 evidence set the ROADMAP asks for, in one run
@@ -247,6 +256,14 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
              os.path.join(tmpdir, "fleet_cache"),
              "--out", os.path.join(tmpdir, "fleet.json")] + plat,
             os.path.join(tmpdir, "fleet.json"), 3600),
+        # the full fleet chaos matrix (the FAULT_MATRIX_FLEET_*
+        # configuration): fencing, journal recovery at every phase,
+        # kill-mid-migration, flap hysteresis, transport chaos,
+        # partition+heal — all scenarios must end clean
+        "serve_fleet_chaos": (
+            [py, "scripts/check_fault_matrix.py", "--fleet",
+             "--out", os.path.join(tmpdir, "fleet_chaos.json")],
+            os.path.join(tmpdir, "fleet_chaos.json"), 3600),
     }
 
 

@@ -31,9 +31,41 @@ Scenarios (fault → expected recovery → verification):
 The two crash scenarios spawn a child process that kills itself at the
 injected tick boundary (exit 17); ``--skip-crash`` omits them (the tier-1
 wiring test does, since ``tests/test_recovery.py`` covers crash recovery
-with a full bitwise-vs-control comparison). Runnable standalone::
+with a full bitwise-vs-control comparison).
 
-    python scripts/check_fault_matrix.py [--skip-crash]
+**Fleet matrix** (``--fleet``): the same discipline one level up — every
+fleet-layer failure mode (ISSUE 14) driven against a 2-replica fleet
+behind the rendezvous router, each scenario ending with every session
+reachable, label counts exact, ``migration_verified == migrations`` and
+zero double-applies:
+
+  ==========================  ========================================
+  fleet_stale_owner_fence     partition eats the migration's source
+                              fence; the stale copy revives and a write
+                              is attempted at it with the router's
+                              stamp — the epoch fence MUST reject it
+                              (the split-brain double-apply regression)
+  fleet_kill_replica_mid_     the destination is SIGKILLed between
+  migration                   export and import — the move degrades to
+                              didn't-move, the source serves on
+  fleet_router_restart_       the router dies mid-migration at each
+  journal                     journal phase (intent/exported/imported);
+                              a fresh router's journal recovery must
+                              restore or finalize, exactly once
+  fleet_healthz_flap          a flapping /healthz probe must NOT churn
+                              the routing set (eviction hysteresis)
+  fleet_transport_chaos       drop + delay + duplicate on live label
+                              traffic: retries + request_id dedupe
+                              absorb everything, exactly-once holds
+  fleet_partition_heal        a replica partitions for a window and
+                              heals: breaker trips, traffic fails over
+                              or waits, 0 errors end to end
+  ==========================  ========================================
+
+``--fleet --out FAULT_MATRIX_FLEET_<backend>_rNN.json`` writes the
+committed artifact ``scripts/check_perf.py`` gates. Runnable standalone::
+
+    python scripts/check_fault_matrix.py [--skip-crash] [--fleet]
 """
 
 from __future__ import annotations
@@ -45,6 +77,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 import uuid
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -310,6 +343,500 @@ def scenario_crash(site: str) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# the fleet matrix (ISSUE 14): chaos against the replicated fleet
+# ---------------------------------------------------------------------------
+
+FLEET_ROUNDS = 3
+
+
+def _make_fleet(tmpdir, n=2, fault_spec=None, hysteresis=2, capacity=6,
+                poll_s=None, fast_transport=True):
+    """A 2-replica in-process fleet with per-replica record dirs and the
+    router's migration journal armed (``<tmpdir>/router_migrations.log``)."""
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.serve import Fleet, SelectorSpec, ServeApp
+    from coda_tpu.telemetry import SessionRecorder
+
+    task = make_synthetic_task(seed=0, H=H, N=N, C=C)
+
+    def factory(rid):
+        rec = SessionRecorder(out_dir=os.path.join(tmpdir, rid))
+        app = ServeApp(capacity=capacity, max_wait=0.001,
+                       spec=SelectorSpec.create("coda",
+                                                n_parallel=capacity),
+                       recorder=rec)
+        app.add_task(task.name, task.preds)
+        return app
+
+    fleet = Fleet(factory, n_replicas=n,
+                  journal_path=os.path.join(tmpdir,
+                                            "router_migrations.log"),
+                  fault_spec=fault_spec, health_hysteresis=hysteresis)
+    if fast_transport:
+        # matrix-speed knobs: the policies under test are the same, only
+        # the waits shrink (breaker heals in 50 ms, backoff base 10 ms)
+        for h in fleet.router.replicas.values():
+            t = getattr(h, "transport", None)
+            if t is not None:
+                t.backoff_s = 0.01
+                t.breaker.cooldown_s = 0.05
+    fleet.start(warm=True, **({"poll_s": poll_s} if poll_s else {}))
+    return fleet
+
+
+def _drive_router(router, n_sessions=4, rounds=FLEET_ROUNDS, retries=12,
+                  backoff_s=0.03):
+    """Closed-loop retrying traffic through the router front door (one
+    idempotent request_id per logical label). Returns (sids, errors)."""
+    from scripts.serve_loadgen import with_retries
+
+    sids = [None] * n_sessions
+    errors: list = []
+
+    def worker(i):
+        try:
+            out = with_retries(lambda: router.open_session(seed=i),
+                               retries, backoff_s)
+            sids[i] = out["session"]
+            for _ in range(rounds):
+                lab = int(out["idx"]) % C
+                rid = uuid.uuid4().hex
+                out = with_retries(
+                    lambda: router.label(sids[i], lab, request_id=rid),
+                    retries, backoff_s)
+            if out["n_labeled"] != rounds:
+                errors.append(
+                    f"session {sids[i]}: server applied "
+                    f"{out['n_labeled']} labels, client issued {rounds}")
+        except Exception as e:
+            errors.append(f"session {i}: {e!r}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sids, errors
+
+
+def _fleet_reachability(router, sids, rounds=FLEET_ROUNDS) -> list:
+    """Every session must still answer through the router with the exact
+    committed label count — the all-scenarios postcondition."""
+    out = []
+    for sid in sids:
+        if sid is None:
+            continue
+        try:
+            b = router.best(sid)
+        except Exception as e:
+            out.append(f"session {sid} unreachable after recovery: {e!r}")
+            continue
+        if b["n_labeled"] != rounds:
+            out.append(f"session {sid}: {b['n_labeled']} labels committed"
+                       f", client issued {rounds} (lost or double)")
+    return out
+
+
+def scenario_fleet_stale_owner(tmpdir) -> tuple:
+    """The acceptance regression at matrix level: partition → migrate
+    (the source fence is eaten) → heal (the stale copy revives) → old-
+    owner write attempt with the router's stamp → the epoch fence MUST
+    reject it, and the router-mediated retry commits exactly once."""
+    from coda_tpu.serve.state import StaleOwner
+
+    fleet = _make_fleet(tmpdir, fault_spec="net_drop:task=fence,times=8")
+    r = fleet.router
+    out: list = []
+    stats: dict = {}
+    try:
+        o = r.open_session(seed=0)
+        sid = o["session"]
+        o = r.label(sid, int(o["idx"]) % C, request_id=uuid.uuid4().hex)
+        src = r._locate(sid)
+        dst = [x for x in fleet.replica_ids if x != src][0]
+        info = r.migrate_session(sid, src, dst)
+        if info.get("migrated") != sid:
+            out.append(f"stale_owner: migration did not commit: {info}")
+            return out, stats
+        if not info.get("fence_pending"):
+            out.append("stale_owner: the injected partition should have "
+                       "eaten the source fence, but it landed")
+        # the partition heals AND the source restarts (losing its
+        # in-memory hold): the stale copy is live again
+        src_app = fleet.apps[src]
+        with src_app.store.lock:
+            src_app._holds.clear()
+        epoch = r._epochs.get(sid)
+        try:
+            fleet.router.replicas[src].label(
+                sid, 0, request_id=uuid.uuid4().hex, epoch=epoch)
+            out.append("stale_owner: SPLIT BRAIN — the stale copy "
+                       "COMMITTED a fenced label (the epoch fence is "
+                       "dead)")
+        except StaleOwner:
+            pass  # the fence held
+        except Exception as e:
+            out.append(f"stale_owner: expected StaleOwner, got {e!r}")
+        # the same logical write through the router: re-located to the
+        # new owner and committed exactly once
+        o = r.label(sid, int(o["idx"]) % C, request_id=uuid.uuid4().hex)
+        if o["n_labeled"] != 2:
+            out.append(f"stale_owner: {o['n_labeled']} labels after 2 "
+                       "issued (lost or double-applied)")
+        fenced = src_app.metrics.snapshot()["fencing_rejections"]
+        if fenced < 1:
+            out.append("stale_owner: the replica never counted a "
+                       "fencing rejection")
+        stats = {"fencing_rejections": fenced,
+                 "fence_failures": r.counters["fence_failures"],
+                 "migrations": r.counters["migrations"],
+                 "migration_verified": sum(r.migrations_via.values())}
+        return out, stats
+    finally:
+        fleet.drain(timeout=10)
+
+
+def scenario_fleet_kill_mid_migration(tmpdir) -> tuple:
+    """SIGKILL of the destination replica between a migration's export
+    and its import (the seeded ``kill_replica``/``migrate_mid`` fault):
+    the move must degrade to didn't-move — the source's held copy
+    resumes, nothing is dropped — and the revived replica rejoins."""
+    from coda_tpu.serve.faults import FaultInjector
+
+    fleet = _make_fleet(tmpdir, poll_s=0.05)
+    r = fleet.router
+    out: list = []
+    stats: dict = {}
+    try:
+        o = r.open_session(seed=0)
+        sid = o["session"]
+        o = r.label(sid, int(o["idx"]) % C, request_id=uuid.uuid4().hex)
+        src = r._locate(sid)
+        dst = [x for x in fleet.replica_ids if x != src][0]
+        r.faults = FaultInjector(f"kill_replica:edge={dst}")
+        info = r.migrate_session(sid, src, dst)
+        if fleet.kills.get(dst, 0) != 1:
+            out.append("kill_mid_migration: the fault never killed the "
+                       "destination")
+        if "failed" not in info:
+            out.append(f"kill_mid_migration: migration against a dead "
+                       f"destination should fail didn't-move: {info}")
+        if r.counters["sessions_dropped"]:
+            out.append("kill_mid_migration: a session was counted "
+                       "dropped")
+        # the source serves on, exactly-once
+        o = r.label(sid, int(o["idx"]) % C, request_id=uuid.uuid4().hex)
+        if o["n_labeled"] != 2:
+            out.append(f"kill_mid_migration: {o['n_labeled']} labels "
+                       "after 2 issued")
+        # the dead replica revives (crash restore from its record dir)
+        # and health re-admits it after the hysteresis window
+        r.faults = None
+        fleet.revive_replica(dst)
+        for _ in range(3):
+            r.check_health()
+        if dst not in r.routable():
+            out.append("kill_mid_migration: revived replica never "
+                       "rejoined routing")
+        o = r.label(sid, int(o["idx"]) % C, request_id=uuid.uuid4().hex)
+        if o["n_labeled"] != 3:
+            out.append(f"kill_mid_migration: {o['n_labeled']} labels "
+                       "after 3 issued (post-revive)")
+        stats = {"kills": dict(fleet.kills),
+                 "migration_failures": r.counters["migration_failures"],
+                 "sessions_dropped": r.counters["sessions_dropped"]}
+        return out, stats
+    finally:
+        fleet.drain(timeout=10)
+
+
+def scenario_fleet_router_restart_journal(tmpdir) -> tuple:
+    """The router is SIGKILLed mid-migration at each journal phase; a
+    fresh router over the same replicas + journal resolves every
+    in-doubt move to didn't-move (intent/exported) or moved-exactly-once
+    (imported), with the session reachable and exact either way."""
+    import shutil
+
+    from coda_tpu.serve import InprocReplica, SessionRouter
+    from coda_tpu.serve.journal import payload_digest
+
+    out: list = []
+    stats: dict = {"phases": {}}
+    for phase in ("intent", "exported", "imported"):
+        d = os.path.join(tmpdir, f"journal_{phase}")
+        os.makedirs(d, exist_ok=True)
+        fleet = _make_fleet(d)
+        r = fleet.router
+        r2 = None
+        try:
+            o = r.open_session(seed=0)
+            sid = o["session"]
+            o = r.label(sid, int(o["idx"]) % C,
+                        request_id=uuid.uuid4().hex)
+            src = r._locate(sid)
+            dst = [x for x in fleet.replica_ids if x != src][0]
+            # run the migration's steps BY HAND up to `phase`, then
+            # "die": this reproduces byte-for-byte the journal + replica
+            # state a SIGKILL at that point leaves behind
+            epoch_next = r._epochs.get(sid, 0) + 1
+            mid = r.journal.begin(sid, src, dst, epoch_next)
+            if phase in ("exported", "imported"):
+                payload = dict(
+                    r.replicas[src].export_for_migration(sid),
+                    epoch=epoch_next)   # the source is now HELD
+                r.journal.record(mid, "exported",
+                                 digest=payload_digest(payload),
+                                 n_labeled=payload.get("n_labeled"))
+            if phase == "imported":
+                r.replicas[dst].import_payload(payload)
+                r.journal.record(mid, "imported")
+            r.stop()   # the old router is dead; its gate died with it
+            r2 = SessionRouter(
+                {rid: InprocReplica(rid, app)
+                 for rid, app in fleet.apps.items()},
+                journal_path=os.path.join(d, "router_migrations.log"))
+            rep = r2.recover_from_journal()
+            expect = "finalized" if phase == "imported" else "restored"
+            if sid not in rep.get(expect, []):
+                out.append(f"journal[{phase}]: expected {expect}, got "
+                           f"{rep}")
+            if phase == "imported":
+                if not fleet.apps[dst].store.alive(sid):
+                    out.append(f"journal[{phase}]: finalized session "
+                               "not live on the destination")
+                if fleet.apps[src].store.alive(sid) or \
+                        fleet.apps[src].tiers.parked(sid):
+                    out.append(f"journal[{phase}]: the source copy "
+                               "survived finalization (split brain)")
+                if r2._epochs.get(sid) != epoch_next:
+                    out.append(f"journal[{phase}]: recovered epoch "
+                               f"{r2._epochs.get(sid)} != {epoch_next}")
+            else:
+                if fleet.apps[src].held(sid):
+                    out.append(f"journal[{phase}]: the source hold was "
+                               "never lifted — the session is wedged")
+            # the client's next label commits exactly once, wherever
+            # the recovery left the session
+            o2 = r2.label(sid, int(o["idx"]) % C,
+                          request_id=uuid.uuid4().hex)
+            if o2["n_labeled"] != 2:
+                out.append(f"journal[{phase}]: {o2['n_labeled']} labels "
+                           "after 2 issued")
+            stats["phases"][phase] = {
+                "resolved": rep["resolved"],
+                "journal_replays": r2.counters["journal_replays"]}
+        finally:
+            if r2 is not None:
+                r2.drain()
+            fleet.drain(timeout=10)
+            shutil.rmtree(d, ignore_errors=True)
+    return out, stats
+
+
+def scenario_fleet_healthz_flap(tmpdir) -> tuple:
+    """A flapping /healthz must NOT churn the routing set: with
+    hysteresis K=2 an alternating probe never evicts, so no needless
+    drain-and-migrate runs and traffic is untouched."""
+    fleet = _make_fleet(tmpdir,
+                        fault_spec="flap_healthz:edge=r0,every=2,times=64",
+                        hysteresis=2, poll_s=0.02)
+    r = fleet.router
+    try:
+        sids, errors = _drive_router(r, n_sessions=4)
+        out = list(errors)
+        time.sleep(0.3)   # a few dozen flapping poll cycles
+        fired = sum(f["fired"] for f in r.faults.snapshot()
+                    if f["name"] == "flap_healthz")
+        if fired < 4:
+            out.append(f"healthz_flap: the flap only fired {fired} "
+                       "times (unexercised)")
+        if r.counters["evictions"]:
+            out.append(f"healthz_flap: {r.counters['evictions']} "
+                       "eviction(s) from a flapping probe — hysteresis "
+                       "is dead and the keyspace churned")
+        if r.counters["migrations"]:
+            out.append(f"healthz_flap: {r.counters['migrations']} "
+                       "needless migration(s) triggered by the flap")
+        out += _fleet_reachability(r, sids)
+        return out, {"flaps_fired": fired,
+                     "evictions": r.counters["evictions"]}
+    finally:
+        fleet.drain(timeout=10)
+
+
+def scenario_fleet_transport_chaos(tmpdir) -> tuple:
+    """Drop + delay + duplicate on live label traffic: transport
+    retries absorb the drops, the request_id dedupe absorbs the
+    duplicates, and every session ends with the exact label count."""
+    fleet = _make_fleet(
+        tmpdir,
+        fault_spec="net_drop:every=9,times=8;"
+                   "net_delay:every=5,ms=4,times=24;"
+                   "net_dup:every=7,times=8,task=label")
+    r = fleet.router
+    try:
+        sids, errors = _drive_router(r, n_sessions=4)
+        out = list(errors)
+        fired = {f["name"]: f["fired"] for f in r.faults.snapshot()}
+        for name in ("net_drop", "net_delay", "net_dup"):
+            if not fired.get(name):
+                out.append(f"transport_chaos: {name} never fired "
+                           "(unexercised)")
+        retries = sum(
+            (r.stats()["router"].get("transport_retries") or {}).values())
+        out += _fleet_reachability(r, sids)
+        return out, {"faults_fired": fired,
+                     "transport_retries": retries,
+                     "dropped_sessions": r.counters["sessions_dropped"]}
+    finally:
+        fleet.drain(timeout=10)
+
+
+def scenario_fleet_partition_heal(tmpdir) -> tuple:
+    """One replica partitions for an arrival window and heals, in three
+    deterministic phases: (1) clean traffic, with at least one session
+    GUARANTEED on the soon-partitioned replica (migrated there if HRW
+    put none); (2) labels under the partition — the breaker trips,
+    fail-fast bounds the amplification, client retries wait the outage
+    out; (3) the window burns through (heals) and traffic completes
+    clean. 0 errors and exact counts end to end — the partition+heal
+    proof ``capture_evidence.py`` ships."""
+    from coda_tpu.serve.faults import FaultInjector
+    from scripts.serve_loadgen import with_retries
+
+    fleet = _make_fleet(tmpdir, poll_s=0.03)
+    r = fleet.router
+    out: list = []
+    try:
+        sessions: dict = {}
+        for i in range(4):
+            o = with_retries(lambda: r.open_session(seed=i), 8, 0.03)
+            sessions[o["session"]] = o
+
+        def label_all(expected):
+            for sid in list(sessions):
+                o = sessions[sid]
+                lab = int(o["idx"]) % C
+                rid = uuid.uuid4().hex
+                o = with_retries(
+                    lambda: r.label(sid, lab, request_id=rid), 16, 0.05)
+                sessions[sid] = o
+                if o["n_labeled"] != expected:
+                    out.append(
+                        f"partition_heal: session {sid} committed "
+                        f"{o['n_labeled']} labels, client issued "
+                        f"{expected} (lost or double)")
+
+        label_all(1)   # phase 1: clean
+        if not any(r._locate(sid) == "r1" for sid in sessions):
+            # HRW put nothing on r1: move one there (clean migration)
+            # so the partition provably has traffic to eat
+            sid = next(iter(sessions))
+            info = r.migrate_session(sid, r._locate(sid), "r1")
+            if "migrated" not in info:
+                out.append(f"partition_heal: setup migration failed: "
+                           f"{info}")
+        # the partition: a 30-arrival outage window on edge r1 (every
+        # verb, healthz included), installed on the shared fault domain
+        window = 30
+        inj = FaultInjector(f"partition:edge=r1,times={window}")
+        r.faults = inj
+        for h in r.replicas.values():
+            h.transport.faults = inj
+        label_all(2)   # phase 2: under the partition, retries absorb
+        # phase 3: wait for the heal (the breaker's half-open probes and
+        # the health poller burn the remaining window arrivals)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            fired = sum(f["fired"] for f in inj.snapshot()
+                        if f["name"] == "partition")
+            if fired >= window:
+                break
+            time.sleep(0.05)
+        label_all(3)   # post-heal: clean again
+        fired = sum(f["fired"] for f in inj.snapshot()
+                    if f["name"] == "partition")
+        if fired < 1:
+            out.append("partition_heal: the partition never fired")
+        t1 = r.replicas["r1"].transport.snapshot()
+        detected = (t1["breaker_trips"] > 0
+                    or r.counters["evictions"] > 0
+                    or t1["retries_total"] > 0)
+        if not detected:
+            out.append("partition_heal: the partition was invisible to "
+                       "breaker, eviction, AND retries")
+        out += _fleet_reachability(r, list(sessions))
+        return out, {"partition_fired": fired,
+                     "partition_window": window,
+                     "breaker_trips": t1["breaker_trips"],
+                     "evictions": r.counters["evictions"],
+                     "transport_retries": t1["retries_total"]}
+    finally:
+        fleet.drain(timeout=10)
+
+
+FLEET_SCENARIOS = {
+    "fleet_stale_owner_fence": scenario_fleet_stale_owner,
+    "fleet_kill_replica_mid_migration": scenario_fleet_kill_mid_migration,
+    "fleet_router_restart_journal": scenario_fleet_router_restart_journal,
+    "fleet_healthz_flap": scenario_fleet_healthz_flap,
+    "fleet_transport_chaos": scenario_fleet_transport_chaos,
+    "fleet_partition_heal": scenario_fleet_partition_heal,
+}
+
+
+def run_fleet_matrix(only=None) -> dict:
+    """{scenario: {"violations": [...], ...stats}} for the fleet matrix
+    (each scenario in its own temp dir; ``only`` filters by name)."""
+    import tempfile as _tf
+
+    results: dict = {}
+    for name, fn in FLEET_SCENARIOS.items():
+        if only and name not in only:
+            continue
+        with _tf.TemporaryDirectory() as d:
+            violations, stats = fn(d)
+        results[name] = dict({"violations": violations}, **stats)
+    return results
+
+
+def build_fleet_artifact(results: dict) -> dict:
+    """The committed FAULT_MATRIX_FLEET_* artifact: scenario verdicts +
+    the summary fields scripts/check_perf.py gates, fingerprint-stamped."""
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    migrations = sum(int(s.get("migrations") or 0)
+                     for s in results.values())
+    verified = sum(int(s.get("migration_verified") or 0)
+                   for s in results.values())
+    return {
+        "bench": "fault_matrix_fleet",
+        "fingerprint": environment_fingerprint(knobs={
+            "capture": "check_fault_matrix", "fleet": True,
+            "shape": [H, N, C], "rounds": FLEET_ROUNDS}),
+        "scenarios": results,
+        "summary": {
+            "scenarios": len(results),
+            "clean": all(not s["violations"] for s in results.values()),
+            "violations": sum(len(s["violations"])
+                              for s in results.values()),
+            "migrations": migrations,
+            "migration_verified": verified,
+            "fencing_rejections": sum(
+                int(s.get("fencing_rejections") or 0)
+                for s in results.values()),
+            "dropped_sessions": sum(
+                int(s.get("dropped_sessions") or 0)
+                for s in results.values()),
+            "double_applied_labels": sum(
+                1 for s in results.values() for v in s["violations"]
+                if "labels after" in v or "double" in v),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 
 SCENARIOS = {
     "step_raise": scenario_step_raise,
@@ -336,9 +863,41 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--skip-crash", action="store_true",
                    help="omit the two subprocess crash scenarios")
+    p.add_argument("--fleet", action="store_true",
+                   help="run the FLEET chaos matrix instead (2 replicas "
+                        "behind the router: fencing, journal recovery, "
+                        "breaker, partition+heal); --out then writes the "
+                        "committed FAULT_MATRIX_FLEET_* artifact")
+    p.add_argument("--only", default=None,
+                   help="comma-separated scenario filter (fleet mode)")
     p.add_argument("--out", default=None,
-                   help="write the {scenario: violations} JSON here")
+                   help="write the results JSON here (single-replica "
+                        "mode: {scenario: violations}; --fleet: the "
+                        "gated artifact)")
     args = p.parse_args(argv)
+
+    if args.fleet:
+        only = set(args.only.split(",")) if args.only else None
+        results = run_fleet_matrix(only=only)
+        artifact = build_fleet_artifact(results)
+        bad = 0
+        for name, sc in results.items():
+            for v in sc["violations"]:
+                print(f"FAIL {v}")
+                bad += 1
+            if not sc["violations"]:
+                print(f"ok   {name}")
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=2)
+            print(f"wrote {args.out}")
+        if bad:
+            print(f"fleet fault matrix FAILED: {bad} violation(s)")
+            return 1
+        print(f"fleet fault matrix clean: {len(results)} scenario(s) — "
+              "every partition, kill, and in-doubt journal window ended "
+              "with sessions reachable, labels exact, zero double-applies")
+        return 0
 
     results = run_matrix(skip_crash=args.skip_crash)
     bad = 0

@@ -468,6 +468,75 @@ def batchq_check_report(report: dict) -> list[str]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# the fault-matrix contracts (ISSUE 14: the fleet chaos matrix is a
+# committed, machine-checked artifact like every perf claim)
+# ---------------------------------------------------------------------------
+
+#: the failure modes the committed fleet matrix must cover: the fencing
+#: (split-brain) regression, the kill-mid-migration window, the in-doubt
+#: journal recovery, the flap hysteresis, live transport chaos, and the
+#: partition+heal proof
+FLEET_MATRIX_REQUIRED_SCENARIOS = (
+    "fleet_stale_owner_fence",
+    "fleet_kill_replica_mid_migration",
+    "fleet_router_restart_journal",
+    "fleet_healthz_flap",
+    "fleet_transport_chaos",
+    "fleet_partition_heal",
+)
+
+
+def fleet_matrix_check(report: dict) -> list[str]:
+    """Violations of one FAULT_MATRIX_FLEET_* artifact (empty = clean):
+    every required scenario present and violation-free, zero dropped
+    sessions, zero double-applies, every migration digest-verified, and
+    the fencing scenario actually exercised (a matrix that never
+    provoked a stale-owner rejection proves nothing about the fence)."""
+    out: list[str] = []
+    sc = report.get("scenarios")
+    if not isinstance(sc, dict):
+        return ["scenarios section missing"]
+    for name in FLEET_MATRIX_REQUIRED_SCENARIOS:
+        if name not in sc:
+            out.append(f"scenario {name!r} missing — the committed "
+                       "matrix must cover it")
+    for name, s in sorted(sc.items()):
+        v = (s or {}).get("violations")
+        if v is None:
+            out.append(f"scenarios.{name}.violations missing")
+            continue
+        for item in v:
+            out.append(f"scenarios.{name}: {item}")
+    summ = report.get("summary") or {}
+    if summ.get("migration_verified") != summ.get("migrations"):
+        out.append(f"summary.migration_verified "
+                   f"{summ.get('migration_verified')!r} != migrations "
+                   f"{summ.get('migrations')!r} (every migration must "
+                   "restore digest-verified)")
+    fenced = (sc.get("fleet_stale_owner_fence") or {}).get(
+        "fencing_rejections")
+    if not fenced:
+        out.append("fleet_stale_owner_fence.fencing_rejections is "
+                   "0/missing — the fence was never exercised")
+    return out
+
+
+def legacy_matrix_check(report: dict) -> list[str]:
+    """The r10/r13 single-replica matrix layout: {scenario: violations}
+    — committed only when every list is empty."""
+    if not isinstance(report, dict) or not report:
+        return ["empty fault matrix"]
+    out: list[str] = []
+    for name, v in sorted(report.items()):
+        if not isinstance(v, list):
+            out.append(f"{name}: violations is not a list")
+            continue
+        for item in v:
+            out.append(f"{name}: {item}")
+    return out
+
+
 EVIDENCE_SCHEMA_VERSION = 1
 EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
                        "multichip_replay")
@@ -475,7 +544,8 @@ EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
 # them, and an absent optional component is a capture-config choice the
 # manifest's own "skipped" list records)
 EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered",
-                                "bench_batchq", "serve_fleet")
+                                "bench_batchq", "serve_fleet",
+                                "serve_fleet_chaos")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -535,6 +605,16 @@ def _evidence_check(report: dict) -> list[str]:
         if rr.get("replicas_restarted") != fl.get("replicas"):
             out.append("serve_fleet: rolling restart did not cycle every "
                        "replica")
+    rep = (arts.get("serve_fleet_chaos") or {}).get("report") or {}
+    if rep:
+        summ = rep.get("summary") or {}
+        if summ.get("clean") is not True:
+            out.append("serve_fleet_chaos.report.summary.clean is not "
+                       "true (a chaos scenario left a violation)")
+        sc = rep.get("scenarios") or {}
+        if "fleet_partition_heal" not in sc:
+            out.append("serve_fleet_chaos: the partition+heal proof "
+                       "scenario is missing")
     rep = (arts.get("bench") or {}).get("report") or {}
     if rep and not (isinstance(rep.get("value"), (int, float))
                     and rep["value"] > 0):
@@ -683,6 +763,27 @@ CONTRACTS: tuple = (
         regress=("round_s_marginal", "lower", 0.5),
         note="sparse:K posterior at the r05 pool shape — round time, "
              "state bytes, and the replay-triaged score contract"),
+    # -- fault matrices (recovery claims are gated artifacts too) --
+    Contract(
+        pattern="FAULT_MATRIX_FLEET_*.json", kind="fault_matrix_fleet",
+        required=("bench", "fingerprint.backend", "scenarios",
+                  "summary.scenarios", "summary.migrations",
+                  "summary.migration_verified"),
+        bounds=(("bench", "==", "fault_matrix_fleet"),
+                ("summary.clean", "==", True),
+                ("summary.violations", "==", 0),
+                ("summary.dropped_sessions", "==", 0),
+                ("summary.double_applied_labels", "==", 0)),
+        checker=fleet_matrix_check, fingerprint="required",
+        group="fault_matrix",
+        note="fleet chaos matrix (ISSUE 14): epoch fencing, journal "
+             "recovery at every phase, kill-mid-migration, healthz-flap "
+             "hysteresis, transport chaos, partition+heal — all clean"),
+    Contract(
+        pattern="FAULT_MATRIX_*.json", kind="fault_matrix_legacy",
+        checker=legacy_matrix_check, fingerprint="grandfathered",
+        note="single-replica recovery matrix (r10/r13 layout: "
+             "{scenario: violations}, committed clean)"),
     # -- one-run evidence manifests --
     Contract(
         pattern="EVIDENCE_*.json", kind="evidence_manifest",
@@ -844,7 +945,8 @@ def cross_round_violations(artifacts: list, notes: Optional[list] = None
 def discover(root: str) -> list[str]:
     """The gated artifact set at one repo root."""
     paths = []
-    for pat in ("BENCH_*.json", "EVIDENCE_*.json", "IMAGENET_*.json"):
+    for pat in ("BENCH_*.json", "EVIDENCE_*.json", "IMAGENET_*.json",
+                "FAULT_MATRIX_*.json"):
         paths += glob.glob(os.path.join(root, pat))
     return sorted(paths)
 

@@ -1,0 +1,403 @@
+"""Fleet chaos hardening tests (ISSUE 14): epoch-fenced ownership, the
+migration journal, and the hardened replica transport.
+
+The load-bearing claims: (1) the split-brain double-apply is
+STRUCTURALLY impossible — the exact interleaving (partition → migrate →
+heal → old-owner label retry) ends in a typed ``StaleOwner`` fencing
+rejection plus a single commit on the new owner, pinned as a regression
+test; (2) a SIGKILL mid-migration at ANY journal phase resolves on
+restart to didn't-move or moved-exactly-once, never gone or doubled;
+(3) the transport's breaker walks trip → half-open → recovery and a
+retry-budget exhaustion degrades to a typed retryable 503 in bounded
+time, never a hang; (4) ownership epochs survive demote/wake round trips
+unchanged (only a committed move bumps them) and the new observability
+families render lint-clean.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+
+import pytest
+
+H, N, C = 4, 48, 4
+
+
+@pytest.fixture(scope="module")
+def task():
+    from coda_tpu.data import make_synthetic_task
+
+    return make_synthetic_task(seed=0, H=H, N=N, C=C)
+
+
+def _fleet(task, tmp, n=2, fault_spec=None, capacity=4, hysteresis=2):
+    from coda_tpu.serve import Fleet, SelectorSpec, ServeApp
+    from coda_tpu.telemetry import SessionRecorder
+
+    def factory(rid):
+        app = ServeApp(capacity=capacity, max_wait=0.001,
+                       spec=SelectorSpec.create("coda",
+                                                n_parallel=capacity),
+                       recorder=SessionRecorder(
+                           out_dir=os.path.join(tmp, rid)))
+        app.add_task(task.name, task.preds)
+        return app
+
+    fleet = Fleet(factory, n_replicas=n,
+                  journal_path=os.path.join(tmp, "router_migrations.log"),
+                  fault_spec=fault_spec, health_hysteresis=hysteresis)
+    for h in fleet.router.replicas.values():
+        h.transport.backoff_s = 0.005
+        h.transport.breaker.cooldown_s = 0.05
+    return fleet.start(warm=True)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance regression: partition -> migrate -> heal -> old-owner
+# label retry => fencing rejection + exactly one commit
+# ---------------------------------------------------------------------------
+
+def test_stale_owner_fence_regression(task, tmp_path):
+    """The split-brain interleaving, forced exactly: a migration whose
+    source fence is eaten by a partition leaves a stale copy behind;
+    after the heal (and the source losing its in-memory hold, as a
+    restart would), a label retried AT the stale copy with the router's
+    epoch stamp MUST be refused typed — and the router-mediated retry
+    commits exactly once on the new owner."""
+    from coda_tpu.serve import StaleOwner
+
+    # every fence call on every edge is dropped: the partition window
+    # swallows the migration's commit-fence (retries included)
+    fleet = _fleet(task, str(tmp_path),
+                   fault_spec="net_drop:task=fence,times=8")
+    r = fleet.router
+    try:
+        out = r.open_session(seed=0)
+        sid = out["session"]
+        out = r.label(sid, int(out["idx"]) % C,
+                      request_id=uuid.uuid4().hex)
+        src = r._locate(sid)
+        dst = [x for x in fleet.replica_ids if x != src][0]
+        info = r.migrate_session(sid, src, dst)
+        assert info.get("migrated") == sid, info
+        assert info["via"] in ("snapshot", "replay")
+        assert info.get("fence_pending"), \
+            "the injected partition should have eaten the fence"
+        assert r.counters["fence_failures"] == 1
+        assert info["epoch"] == 1 and r._epochs[sid] == 1
+        # the destination's copy carries the bumped epoch
+        assert fleet.apps[dst].store.get(sid).epoch == 1
+        # partition heals; the source "restarts", losing its in-memory
+        # hold — the stale copy is revivable again
+        src_app = fleet.apps[src]
+        with src_app.store.lock:
+            src_app._holds.clear()
+        # the old-owner write attempt: a label carried to the stale copy
+        # with the router's stamp — refused, typed, nothing committed
+        with pytest.raises(StaleOwner):
+            r.replicas[src].label(sid, 0, request_id=uuid.uuid4().hex,
+                                  epoch=r._epochs[sid])
+        assert src_app.metrics.snapshot()["fencing_rejections"] == 1
+        assert src_app.store.get(sid).n_labeled == 1  # nothing committed
+        # the same logical label through the router: re-located to the
+        # new owner, committed exactly once
+        out = r.label(sid, int(out["idx"]) % C,
+                      request_id=uuid.uuid4().hex)
+        assert out["n_labeled"] == 2
+        assert fleet.apps[dst].store.get(sid).n_labeled == 2
+        # a router-routed verb that LANDS on the stale copy re-routes
+        # transparently (the _forward StaleOwner path): force the stale
+        # location and label again
+        with r._lock:
+            r._placed[sid] = src
+        out = r.label(sid, int(out["idx"]) % C,
+                      request_id=uuid.uuid4().hex)
+        assert out["n_labeled"] == 3
+        assert r.counters["fencing_rejections"] >= 1
+        assert r.counters["reroutes"] >= 1
+        assert fleet.apps[dst].store.get(sid).n_labeled == 3
+    finally:
+        fleet.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-migration at each journal phase -> restore or finalize
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("phase", ["intent", "exported", "imported"])
+def test_journal_recovery_per_phase(task, tmp_path, phase):
+    """The router dies between migration steps; a fresh router over the
+    same replicas + journal resolves the in-doubt move: didn't-move for
+    intent/exported (the source's hold lifts, its copy serves), moved-
+    exactly-once for imported (the source is fenced, the epoch adopted)."""
+    from coda_tpu.serve import InprocReplica, SessionRouter
+    from coda_tpu.serve.journal import payload_digest
+
+    fleet = _fleet(task, str(tmp_path))
+    r = fleet.router
+    r2 = None
+    try:
+        out = r.open_session(seed=0)
+        sid = out["session"]
+        out = r.label(sid, int(out["idx"]) % C,
+                      request_id=uuid.uuid4().hex)
+        src = r._locate(sid)
+        dst = [x for x in fleet.replica_ids if x != src][0]
+        epoch_next = 1
+        mid = r.journal.begin(sid, src, dst, epoch_next)
+        if phase in ("exported", "imported"):
+            payload = dict(r.replicas[src].export_for_migration(sid),
+                           epoch=epoch_next)
+            r.journal.record(mid, "exported",
+                             digest=payload_digest(payload),
+                             n_labeled=payload.get("n_labeled"))
+            assert fleet.apps[src].held(sid)
+        if phase == "imported":
+            r.replicas[dst].import_payload(payload)
+            r.journal.record(mid, "imported")
+        r.stop()  # the router is "SIGKILLed" here: gate + epoch map die
+        r2 = SessionRouter(
+            {rid: InprocReplica(rid, app)
+             for rid, app in fleet.apps.items()},
+            journal_path=str(tmp_path / "router_migrations.log"))
+        rep = r2.recover_from_journal()
+        assert rep["resolved"] == 1
+        if phase == "imported":
+            assert rep["finalized"] == [sid]
+            assert r2._epochs[sid] == epoch_next
+            assert fleet.apps[dst].store.alive(sid)
+            # the source copy is GONE — no second authority
+            assert not fleet.apps[src].store.alive(sid)
+            assert not fleet.apps[src].tiers.parked(sid)
+        else:
+            assert rep["restored"] == [sid]
+            assert not fleet.apps[src].held(sid)  # the hold lifted
+        # the client's next label commits exactly once either way
+        out = r2.label(sid, int(out["idx"]) % C,
+                       request_id=uuid.uuid4().hex)
+        assert out["n_labeled"] == 2
+        assert r2.counters["journal_replays"] == 1
+    finally:
+        if r2 is not None:
+            r2.drain()
+        fleet.drain(timeout=10)
+
+
+def test_journal_torn_tail_and_fold(tmp_path):
+    """The journal's framing contract: a torn final line (SIGKILL
+    mid-append) is dropped, earlier records fold per-mid with the last
+    phase winning, and committed() is the durable epoch map."""
+    from coda_tpu.serve.journal import MigrationJournal
+
+    p = str(tmp_path / "j.log")
+    j = MigrationJournal(p)
+    m1 = j.begin("aaaa", "r0", "r1", 1)
+    j.record(m1, "exported", digest="d1", n_labeled=3)
+    j.record(m1, "imported")
+    j.record(m1, "committed", epoch=1, fenced=True)
+    m2 = j.begin("bbbb", "r1", "r0", 4)
+    j.record(m2, "exported", digest="d2", n_labeled=7)
+    j.close()
+    with open(p, "a") as f:
+        f.write('{"mid": "cccc#9", "phase": "int')  # torn tail
+    j2 = MigrationJournal(p)
+    assert j2.torn_tail_dropped
+    doubt = j2.in_doubt()
+    assert [d["sid"] for d in doubt] == ["bbbb"]
+    assert doubt[0]["phase"] == "exported"
+    assert doubt[0]["digest"] == "d2"
+    assert j2.committed() == {"aaaa": {"epoch": 1, "dst": "r1"}}
+    # new mids never collide with replayed ones
+    m3 = j2.begin("dddd", "r0", "r1", 1)
+    assert m3.split("#")[1] not in {m1.split("#")[1], m2.split("#")[1]}
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# epochs survive demote/wake round trips; only a committed move bumps
+# ---------------------------------------------------------------------------
+
+def test_epoch_preserved_through_demote_wake_and_stream(task, tmp_path):
+    """A demote/wake round trip must NOT advance the ownership epoch (a
+    wake is a page-in, not an ownership change) — and the epoch rides
+    the stream meta so a crash-restored copy keeps it."""
+    fleet = _fleet(task, str(tmp_path))
+    r = fleet.router
+    try:
+        out = r.open_session(seed=0)
+        sid = out["session"]
+        out = r.label(sid, int(out["idx"]) % C)
+        src = r._locate(sid)
+        dst = [x for x in fleet.replica_ids if x != src][0]
+        assert r.migrate_session(sid, src, dst).get("migrated") == sid
+        app = fleet.apps[dst]
+        assert app.store.get(sid).epoch == 1
+        # demote -> payload keeps epoch 1 -> wake restores epoch 1
+        assert app.tiers.try_demote(sid)
+        assert int(app.tiers.parked_payload(sid)["epoch"]) == 1
+        out = r.label(sid, int(out["idx"]) % C)   # transparent wake
+        assert out["n_labeled"] == 2
+        assert app.store.get(sid).epoch == 1      # unchanged
+        # the stream meta carries it for crash restore: the destination's
+        # stream file was written by import_history with the bumped epoch
+        from coda_tpu.serve.recovery import load_session_stream
+
+        meta, _, _ = load_session_stream(
+            os.path.join(str(tmp_path), dst, f"session_{sid}.jsonl"))
+        assert int(meta.get("epoch") or 0) == 1
+        # ...while the SOURCE's fenced stream (sealed, pre-migration)
+        # still reads epoch 0 — a crash restore of it yields a copy the
+        # fence rejects, not a second authority
+        meta_src, _, closed = load_session_stream(
+            os.path.join(str(tmp_path), src, f"session_{sid}.jsonl"))
+        assert closed and int(meta_src.get("epoch") or 0) == 0
+    finally:
+        fleet.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# transport: breaker transitions, retry budget, typed fast-fail
+# ---------------------------------------------------------------------------
+
+def test_breaker_trip_half_open_recovery():
+    from coda_tpu.serve.transport import CircuitBreaker
+
+    b = CircuitBreaker(threshold=3, cooldown_s=0.05)
+    assert b.state == "closed"
+    for _ in range(3):
+        assert b.allow()
+        b.record_failure()
+    assert b.state == "open" and b.trips == 1
+    assert not b.allow()                  # fail fast while open
+    time.sleep(0.06)
+    assert b.state == "half_open"
+    assert b.allow()                      # exactly one probe
+    assert not b.allow()                  # ...everyone else waits
+    b.record_failure()                    # failed probe: re-open
+    assert b.state == "open" and b.trips == 2
+    time.sleep(0.06)
+    assert b.allow()
+    b.record_success()                    # recovered
+    assert b.state == "closed"
+    assert b.consecutive_failures == 0
+
+
+def test_transport_retries_only_idempotent_verbs():
+    """A timed-out label WITHOUT a request_id must not retry (it could
+    double-apply); with one it retries; reads always retry."""
+    from coda_tpu.serve.transport import ReplicaTransport
+
+    calls = {"n": 0}
+
+    def flaky(deadline):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise TimeoutError("deadline")
+        return {"ok": True}
+
+    t = ReplicaTransport("r0", max_retries=2, backoff_s=0.001)
+    calls["n"] = 0
+    with pytest.raises(TimeoutError):
+        t.call("label", flaky, idempotent=False)   # no request_id
+    assert calls["n"] == 1                          # never retried
+    calls["n"] = 0
+    assert t.call("label", flaky, idempotent=True)["ok"]  # dedupe-gated
+    assert calls["n"] == 2
+    calls["n"] = 0
+    assert t.call("best", flaky)["ok"]              # reads always
+    assert t.retries_total == 2
+    assert t.retries_by_verb == {"label": 1, "best": 1}
+
+
+def test_retry_budget_exhaustion_is_typed_503_not_hang():
+    """A black-holed replica burns the budget once, then fails FAST with
+    the typed retryable error the front door maps to 503 — bounded time,
+    bounded call amplification, never a hang."""
+    from coda_tpu.serve.state import SlabFull
+    from coda_tpu.serve.transport import ReplicaTransport, \
+        ReplicaUnavailable
+
+    t = ReplicaTransport("r0", max_retries=3, backoff_s=0.001,
+                         breaker_threshold=10_000, retry_budget=4)
+
+    def dead(deadline):
+        raise ConnectionRefusedError("refused")
+
+    t0 = time.perf_counter()
+    outcomes = []
+    for _ in range(10):
+        try:
+            t.call("best", dead)
+        except ReplicaUnavailable:
+            outcomes.append("unavailable")
+        except ConnectionRefusedError:
+            outcomes.append("refused")
+    assert time.perf_counter() - t0 < 2.0          # bounded, no hang
+    assert "unavailable" in outcomes               # the typed fast-fail
+    assert t.budget.exhaustions > 0
+    # ReplicaUnavailable IS a SlabFull: the HTTP envelope answers 503
+    assert issubclass(ReplicaUnavailable, SlabFull)
+    # the budget refills on success: service recovers organically
+    t.call("best", lambda d: {"ok": True})
+    assert t.budget.tokens > 0
+
+
+def test_breaker_drives_router_eviction_distinct_from_health(task,
+                                                             tmp_path):
+    """A tripped breaker evicts the replica with status ``breaker_open``
+    — reported distinctly from health eviction on /stats — and the
+    half-open probe via the health poll re-admits it after recovery."""
+    fleet = _fleet(task, str(tmp_path))
+    r = fleet.router
+    try:
+        h = r.replicas["r0"]
+        for _ in range(h.transport.breaker.threshold):
+            h.transport.breaker.record_failure()
+        assert h.transport.breaker.state == "open"
+        statuses = r.check_health()
+        assert statuses["r0"] == "breaker_open"
+        assert "r0" not in r.routable()
+        st = r.stats()["router"]
+        assert st["breakers"]["r0"]["state"] in ("open", "half_open")
+        assert st["health"]["r0"] == "breaker_open"
+        # cooldown passes; the next polls are the half-open probe and
+        # the hysteresis confirmation — recovery rejoins
+        time.sleep(0.06)
+        r.check_health()
+        r.check_health()
+        assert "r0" in r.routable()
+        assert r.stats()["router"]["breakers"]["r0"]["state"] == "closed"
+    finally:
+        fleet.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# observability: the new families render, lint-clean
+# ---------------------------------------------------------------------------
+
+def test_chaos_metrics_families_lint_clean(task, tmp_path):
+    from coda_tpu.telemetry.prometheus import lint
+
+    fleet = _fleet(task, str(tmp_path),
+                   fault_spec="net_drop:after=2,times=2,task=label")
+    r = fleet.router
+    try:
+        out = r.open_session(seed=0)
+        sid = out["session"]
+        for _ in range(4):
+            out = r.label(sid, int(out["idx"]) % C,
+                          request_id=uuid.uuid4().hex)
+        text = r.render_metrics()
+        assert lint(text) == []
+        assert "coda_replica_breaker_state{" in text
+        assert "coda_transport_retries_total{" in text
+        assert "coda_fencing_rejections_total" in text
+        assert "coda_migration_journal_replays_total" in text
+        st = r.stats()["router"]
+        assert st["journal"]["moves"] == 0
+        assert sum(st["transport_retries"].values()) >= 1  # drops absorbed
+        assert out["n_labeled"] == 4                       # exactly-once
+    finally:
+        fleet.drain(timeout=10)

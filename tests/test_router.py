@@ -210,7 +210,9 @@ def test_rolling_restart_zero_drop(task):
 
 def test_health_eviction_and_rejoin(task):
     """A replica whose /healthz stops reporting ready leaves the routing
-    set (new sessions route around it); recovery rejoins it."""
+    set — but only after ``health_hysteresis`` CONSECUTIVE bad probes (a
+    single flapping poll must not churn the HRW keyspace); recovery
+    rejoins it symmetrically."""
     fleet = _fleet(task, n=2, warm=False)
     r = fleet.router
     try:
@@ -219,6 +221,17 @@ def test_health_eviction_and_rejoin(task):
         app0.ready.clear()   # simulate a replica stuck compiling
         statuses = r.check_health()
         assert statuses["r0"] == "unready"
+        # ONE bad probe is a flap, not an eviction (hysteresis = 2)
+        assert r.routable() == ["r0", "r1"]
+        # ...and a recovery inside the window resets the streak
+        app0.ready.set()
+        r.check_health()
+        assert r.routable() == ["r0", "r1"]
+        assert r.counters["evictions"] == 0
+        app0.ready.clear()
+        r.check_health()
+        statuses = r.check_health()   # second consecutive bad: evict
+        assert statuses["r0"] == "unready"
         assert r.routable() == ["r1"]
         hz = r.healthz()
         assert hz["status"] == "degraded" and hz["ready"]
@@ -226,7 +239,8 @@ def test_health_eviction_and_rejoin(task):
             out = r.open_session(seed=i)
             assert fleet.apps["r1"].store.alive(out["session"])
         app0.ready.set()
-        statuses = r.check_health()
+        r.check_health()
+        statuses = r.check_health()   # second consecutive good: rejoin
         assert statuses["r0"] in ("ok", "degraded")
         assert r.routable() == ["r0", "r1"]
         assert r.counters["evictions"] == 1
@@ -319,6 +333,97 @@ def test_fleet_merged_stats_and_metrics(task):
         assert "coda_router_requests_to_replica_total" in text
     finally:
         fleet.drain(timeout=10)
+
+
+def test_multiprocess_http_fleet_smoke(task):
+    """The real multi-process fleet: 2 serve replicas as SUBPROCESSES
+    behind the router via HttpReplica — open → label → migrate (the
+    hold/fence protocol over real HTTP) → label → close, with the
+    migrated trajectory BITWISE identical to the same seed driven on a
+    single in-process app. Also pins the per-verb deadlines that retired
+    the old fixed 60 s blanket timeout."""
+    import os
+    import re
+    import subprocess
+    import sys
+    import time as _time
+    import urllib.request
+
+    from coda_tpu.serve import HttpReplica, SessionRouter, VERB_DEADLINES
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs, urls = [], {}
+    try:
+        for rid in ("h0", "h1"):
+            p = subprocess.Popen(
+                [sys.executable, "-u", "-m", "coda_tpu.cli", "serve",
+                 "--synthetic", f"{H},{N},{C}", "--port", "0",
+                 "--capacity", "4", "--no-warm"],
+                cwd=repo, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            procs.append(p)
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline:
+                line = p.stdout.readline()
+                m = re.search(r"http://127\.0\.0\.1:(\d+)/", line or "")
+                if m:
+                    urls[rid] = f"http://127.0.0.1:{m.group(1)}"
+                    break
+                if p.poll() is not None:
+                    raise RuntimeError(f"replica {rid} died at startup")
+            assert rid in urls, "replica never announced its port"
+        for url in urls.values():   # wait out readiness over real HTTP
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(url + "/healthz",
+                                                timeout=2):
+                        break
+                except Exception:
+                    _time.sleep(0.2)
+        replicas = {rid: HttpReplica(rid, url)
+                    for rid, url in urls.items()}
+        # the satellite's claim: per-verb deadlines, not one blanket 60 s
+        t = replicas["h0"].transport
+        assert t.deadline("healthz") == VERB_DEADLINES["healthz"] < 60
+        assert t.deadline("import") == VERB_DEADLINES["import"] > 60
+        r = SessionRouter(replicas)
+        out = r.open_session(seed=7)
+        sid = out["session"]
+        for _ in range(3):
+            out = r.label(sid, int(out["idx"]) % C)
+        src = r._locate(sid)
+        dst = [x for x in urls if x != src][0]
+        info = r.migrate_session(sid, src, dst)
+        assert info.get("migrated") == sid, info
+        assert info["via"] in ("snapshot", "replay")
+        assert info["epoch"] == 1
+        assert not replicas[src].has_session(sid)   # fenced over HTTP
+        for _ in range(3):
+            out = r.label(sid, int(out["idx"]) % C)
+        assert out["n_labeled"] == 6
+        rows_fleet = r.trace(sid)["rounds"]
+        r.close_session(sid)
+
+        ctrl = _factory(task)("direct")
+        ctrl.start(warm=False)
+        try:
+            o = ctrl.open_session(seed=7)
+            for _ in range(6):
+                o = ctrl.label(o["session"], int(o["idx"]) % C)
+            rows_ctrl = ctrl.recorder.history(o["session"])
+        finally:
+            ctrl.drain(timeout=10)
+        assert len(rows_fleet) == len(rows_ctrl) == 7
+        for rf, rc in zip(rows_fleet, rows_ctrl):
+            _assert_rows_bitwise(rf, rc, "http fleet vs direct")
+        r.drain()
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=10)
 
 
 def test_router_http_front_door(task):
