@@ -155,7 +155,8 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
                          mode: str = "auto",
                          eig_cache_dtype: str = "float32",
                          pi_update: str = "auto",
-                         posterior: str = "dense") -> tuple:
+                         posterior: str = "dense",
+                         eig_scorer: str = "exact") -> tuple:
     """(flops_per_step, resolved_mode, resolved_pi_update) from the
     kernels' documented shapes.
 
@@ -188,21 +189,56 @@ def _analytic_step_flops(H: int, N: int, C: int, G: int = 256,
     # report a different tier than the one that ran
     hp = CODAHyperparams(eig_mode=mode, num_points=G,
                          eig_cache_dtype=eig_cache_dtype,
-                         pi_update=pi_update, posterior=posterior)
+                         pi_update=pi_update, posterior=posterior,
+                         eig_scorer=eig_scorer)
     mode = resolve_eig_mode(hp, H, N, C)
     pi_res = resolve_pi_update(hp, N)
     if mode == "incremental":
         pi_flops = (2.0 * H * N if pi_res.startswith("delta")
                     else 2.0 * H * N * C)
-        return 6.0 * N * H * G + pi_flops + 10.0 * N * C * H, mode, pi_res
+        # the scoring pass: the exact chain sweeps the whole cache; the
+        # surrogate sweeps only its shortlist + audit rows through the
+        # exact chain and prices O(N·F) features + the kc-gather + the
+        # normal-equation refold on top (steady state — warmup/fallback
+        # rounds pay the full pass, <= 10% of rounds by the committed
+        # contract). The feature/fit cost applies to EVERY surrogate
+        # round — including the k >= N parity configuration, whose
+        # shortlist covers the pool but whose ridge still runs.
+        score_rows = _scorer_rows(hp.eig_scorer, N)
+        from coda_tpu.selectors.surrogate import (
+            N_FEATURES,
+            SURROGATE_FEATURE_KC,
+            parse_scorer,
+        )
+
+        feat_flops = (0.0 if parse_scorer(hp.eig_scorer) is None else
+                      2.0 * N * N_FEATURES * (N_FEATURES + 1)
+                      + 3.0 * N * min(SURROGATE_FEATURE_KC, C) * H)
+        return (6.0 * N * H * G + pi_flops + 10.0 * score_rows * C * H
+                + feat_flops), mode, pi_res
     return 6.0 * N * C * H * G + 2.0 * H * C * C * N, mode, pi_res
+
+
+def _scorer_rows(eig_scorer: str, N: int) -> int:
+    """Rows the scoring pass streams through the exact chain per round:
+    N for the exact scorer, shortlist+audit for the surrogate."""
+    from coda_tpu.selectors.surrogate import (
+        SURROGATE_AUDIT_ROWS,
+        parse_scorer,
+    )
+
+    k = parse_scorer(eig_scorer)
+    if k is None:
+        return N
+    return min(N, min(k, N) + SURROGATE_AUDIT_ROWS)
 
 
 def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
                          cache_bytes: int = 4,
                          pi_update: str, backend: str = "jnp",
                          eig_refresh: str = "precomputed",
-                         posterior: str = "dense") -> float:
+                         posterior: str = "dense",
+                         eig_scorer: str = "exact") -> float:
     """Analytic HBM traffic per round (bytes), for the bandwidth roofline.
 
     ``mode`` and ``pi_update`` must be the ALREADY-RESOLVED tier and
@@ -225,7 +261,18 @@ def _analytic_step_bytes(H: int, N: int, C: int, mode: str, *,
     intermediates.
     """
     if mode == "incremental":
-        cache = float(cache_bytes) * N * C * H
+        # the scoring pass streams the cache rows it actually reads: all
+        # N under the exact scorer, the shortlist + audit set under the
+        # surrogate (steady state; warmup/fallback rounds stream it all),
+        # plus the surrogate's O(N·kc·H) feature gather off the cache
+        from coda_tpu.selectors.surrogate import (
+            SURROGATE_FEATURE_KC,
+            parse_scorer,
+        )
+
+        cache = float(cache_bytes) * _scorer_rows(eig_scorer, N) * C * H
+        if parse_scorer(eig_scorer) is not None:
+            cache += float(cache_bytes) * N * SURROGATE_FEATURE_KC * H
         pi_bytes = (4.0 * H * N if pi_update.startswith("delta")
                     else 4.0 * H * N * C)
         # posterior stream: the dense per-round Beta extraction reduces
@@ -293,7 +340,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
     eig_opts = {**{k: defaults[k] for k in
                    ("eig_mode", "eig_backend", "eig_precision",
                     "eig_cache_dtype", "eig_refresh", "eig_entropy",
-                    "posterior", "eig_pbest", "pi_update")},
+                    "posterior", "eig_pbest", "eig_scorer",
+                    "pi_update")},
                 **(eig_opts or {})}
     # _mad of a single rep is 0, which would floor the noise at 1e-12 and
     # let any positive wall-clock delta pass linear_ok; the guard only
@@ -325,7 +373,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         H, N, C, mode=eig_opts["eig_mode"],
         eig_cache_dtype=eig_opts["eig_cache_dtype"],
         pi_update=eig_opts["pi_update"],
-        posterior=eig_opts["posterior"])
+        posterior=eig_opts["posterior"],
+        eig_scorer=eig_opts["eig_scorer"])
     # resolve the scoring backend with the SAME function make_coda uses
     # (and the same hyperparams _build_fn constructed) so the reported
     # metadata names the kernel that actually ran
@@ -356,7 +405,8 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         cache_bytes=np.dtype(eig_opts["eig_cache_dtype"]).itemsize,
         pi_update=pi_res, backend=backend_res,
         eig_refresh=eig_opts["eig_refresh"],
-        posterior=eig_opts["posterior"])
+        posterior=eig_opts["posterior"],
+        eig_scorer=eig_opts["eig_scorer"])
     achieved = (flops_per_step / marginal_step_s
                 if linear_ok and marginal_step_s > 0 else 0.0)
     achieved_bps = (bytes_per_step / marginal_step_s
@@ -386,6 +436,7 @@ def bench_ours(H: int, N: int, C: int, iters: int, eig_chunk: int,
         "eig_entropy": eig_opts["eig_entropy"],
         "posterior": eig_opts["posterior"],
         "eig_pbest": eig_opts["eig_pbest"],
+        "eig_scorer": eig_opts["eig_scorer"],
         "pi_update": pi_res,
         "flops_per_step_analytic": flops_per_step,
         "flops_xla_scan_body_once": _flops_of(compiled),
@@ -602,6 +653,13 @@ def main():
                          "carries top-K class rows + residual instead of "
                          "the dense (H, C, C) tensor (the large-C rung; "
                          "see --posterior on the main CLI)")
+    ap.add_argument("--eig-scorer", default="exact",
+                    metavar="exact|surrogate:k",
+                    help="who scores the round: exact (full O(N*C*H) "
+                         "chain) | surrogate:k (carried ridge scores all "
+                         "N, exact chain refreshes only the top-k "
+                         "shortlist + audit set under the measured "
+                         "contract — see the main CLI's --eig-scorer)")
     ap.add_argument("--eig-pbest", default="quad",
                     choices=["quad", "amortized"],
                     help="row-refresh P(best) integral: quad (reference "
@@ -661,6 +719,7 @@ def main():
                 "eig_entropy": args.eig_entropy,
                 "posterior": args.posterior,
                 "eig_pbest": args.eig_pbest,
+                "eig_scorer": args.eig_scorer,
                 "pi_update": args.pi_update}
     for attempt in range(2):
         ours = bench_ours(H, N, C, iters=args.iters or iters, eig_chunk=chunk,
@@ -705,7 +764,7 @@ def main():
         "compute": {k: ours[k] for k in
                     ("eig_mode", "eig_backend", "eig_precision",
                      "eig_cache_dtype", "eig_refresh", "eig_entropy",
-                     "posterior", "eig_pbest", "pi_update",
+                     "posterior", "eig_pbest", "eig_scorer", "pi_update",
                      "flops_per_step_analytic", "flop_accounting",
                      "flops_xla_scan_body_once", "achieved_flops_per_sec",
                      "peak_flops_per_sec", "mfu",

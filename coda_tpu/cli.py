@@ -162,6 +162,20 @@ def parse_args(argv=None):
                         "provably holds the 2.34e-4 score contract "
                         "(below the committed gate the quadrature runs "
                         "unchanged; opt-in numerics like --eig-entropy)")
+    p.add_argument("--eig-scorer", default="exact",
+                   metavar="exact|surrogate:k",
+                   help="who scores the round: exact (default, the full "
+                        "O(N*C*H) chain, bitwise-pinned) or surrogate:k "
+                        "— a carried closed-form ridge over ~16 cheap "
+                        "per-candidate features scores ALL N points, the "
+                        "exact chain refreshes only its top-k shortlist "
+                        "+ a rotating audit set, and a structural trust "
+                        "gate (rank agreement + the committed 2.34e-4 "
+                        "score contract, measured every round on the "
+                        "exactly-scored rows) falls back to a full exact "
+                        "pass when violated; warmup rounds are always "
+                        "exact (incremental tier only; surrogate:k>=N is "
+                        "bitwise-equal to exact)")
     p.add_argument("--pi-update", default="auto",
                    choices=["auto", "delta", "exact"],
                    help="incremental pi-hat refresh: auto (default) = exact "
@@ -290,6 +304,7 @@ def build_selector_factory(args, task_name: str):
             eig_entropy=getattr(args, "eig_entropy", "exact"),
             posterior=getattr(args, "posterior", "dense"),
             eig_pbest=getattr(args, "eig_pbest", "quad"),
+            eig_scorer=getattr(args, "eig_scorer", "exact"),
             pi_update=getattr(args, "pi_update", "auto"),
             # a --mesh run declares its sharding so the pallas fast path
             # can shard_map the kernels over the data axis (make_coda

@@ -53,6 +53,12 @@ class RoundTrace(NamedTuple):
     pbest_max: jnp.ndarray     # (T,) float32 — max of posterior P(best); NaN
     #                             when the method exposes no posterior
     pbest_entropy: jnp.ndarray  # (T,) float32 — entropy (bits) of P(best)
+    # did this round's scorer fall back to the full exact pass on a
+    # violated surrogate contract (--eig-scorer surrogate:k)? Always
+    # False for exact scorers and methods without the extras hook —
+    # recorded per round so a committed surrogate capture carries its
+    # fallback-rate evidence in the stream itself (record schema v3).
+    surrogate_fallback: jnp.ndarray  # (T,) bool
 
 
 class RunTraceAux(NamedTuple):
@@ -109,6 +115,12 @@ def make_round_trace(selector: Selector, res, state_after, k,
     else:
         pbest_max = jnp.asarray(jnp.nan, jnp.float32)
         pbest_entropy = jnp.asarray(jnp.nan, jnp.float32)
+    # the surrogate scorer's per-round fallback flag (False for exact
+    # scorers / methods without the hook) — the stream evidence behind
+    # the committed fallback-rate contract (BENCH_SURROGATE_*)
+    stats_fn = selector.extras.get("scorer_round_stats")
+    fallback = (jnp.asarray(stats_fn(state_after), bool)
+                if stats_fn is not None else jnp.asarray(False))
     return RoundTrace(
         round_key=key_bits(k),
         topk_idx=topk_idx,
@@ -117,6 +129,7 @@ def make_round_trace(selector: Selector, res, state_after, k,
         runner_up_gap=gap,
         pbest_max=pbest_max,
         pbest_entropy=pbest_entropy,
+        surrogate_fallback=fallback,
     )
 
 

@@ -66,7 +66,8 @@ _QUANTITY_GROUPS = (
     ("score-delta", ("topk_score", "chosen_score", "select_prob")),
     ("tie-break-flip", ("chosen_idx", "true_class")),
     ("posterior-drift", ("pbest_max", "pbest_entropy", "best_model")),
-    ("metric-drift", ("regret", "cumulative_regret", "runner_up_gap")),
+    ("metric-drift", ("regret", "cumulative_regret", "runner_up_gap",
+                      "surrogate_fallback")),
 )
 _INT_QUANTITIES = {"chosen_idx", "true_class", "best_model", "round_key"}
 
@@ -109,12 +110,25 @@ def replay_record(record: RunRecord, selector_factory, preds, labels,
         "runner_up_gap": np.asarray(aux.trace.runner_up_gap),
         "pbest_max": np.asarray(aux.trace.pbest_max),
         "pbest_entropy": np.asarray(aux.trace.pbest_entropy),
+        "surrogate_fallback": np.asarray(aux.trace.surrogate_fallback),
     }
 
 
 # ---------------------------------------------------------------------------
 # comparison + triage (pure numpy — also drives record-vs-record mode)
 # ---------------------------------------------------------------------------
+
+def _record_knobs(record: RunRecord) -> dict:
+    """A record's fingerprinted knob dict, NORMALIZED for comparison:
+    knobs that predate a record are filled with the default the replay
+    would rebuild them at (``eig_scorer`` missing == ``'exact'`` — the
+    knob landed in PR 14, and without this a fresh exact capture vs any
+    older record would spuriously 'differ' on it and silently loosen the
+    auto tolerance from bitwise to the 2.34e-4 contract)."""
+    knobs = dict(record.meta.get("fingerprint", {}).get("knobs", {}) or {})
+    knobs.setdefault("eig_scorer", "exact")
+    return knobs
+
 
 def _rows_equal(a: np.ndarray, b: np.ndarray, tol: float) -> np.ndarray:
     """(T,) bool: per-round equality, reducing trailing axes. ``tol=0`` is
@@ -258,25 +272,32 @@ def _label_aligned_cum(record: RunRecord, seed: int) -> np.ndarray:
     return np.repeat(cum, q)  # constant within a round's q labels
 
 
-def compare_records_batchq(a: RunRecord, b: RunRecord) -> ReplayReport:
-    """The q-vs-q' comparison (``--against`` across different acq_batch
-    knobs): the two records run DIFFERENT acquisition programs, so
-    per-round decision parity is not a meaningful contract — what is, is
-    the regret ENVELOPE at equal label budgets. Aligns both records'
-    label-weighted cumulative-regret curves on the common label prefix
-    and reports, per seed, the final gap/ratio and the worst aligned gap;
-    triage class ``acq-batch-envelope``. Parity is never claimed."""
+def _compare_records_envelope(a: RunRecord, b: RunRecord,
+                              classification: str, meta_key: str,
+                              label_a: str, label_b: str,
+                              force_diff_key: Optional[str] = None
+                              ) -> ReplayReport:
+    """The shared label-aligned regret-envelope comparison behind every
+    knob diff where the two records run genuinely DIFFERENT acquisition
+    programs (different ``acq_batch`` widths, different ``eig_scorer``
+    rungs): per-round decision parity is not a meaningful contract there
+    — what is, is the regret ENVELOPE at equal label budgets. Aligns
+    both records' label-weighted cumulative-regret curves on the common
+    label prefix and reports, per seed, the final gap/ratio and the
+    worst aligned gap under the given triage ``classification``. Parity
+    is never claimed."""
     report = ReplayReport(mode="records", score_tol=0.0, meta={
         "a": a.meta.get("run", {}), "b": b.meta.get("run", {}),
         "backend_a": a.meta.get("fingerprint", {}).get("backend"),
         "backend_b": b.meta.get("fingerprint", {}).get("backend"),
     })
-    knobs_a = a.meta.get("fingerprint", {}).get("knobs", {}) or {}
-    knobs_b = b.meta.get("fingerprint", {}).get("knobs", {}) or {}
+    knobs_a = _record_knobs(a)
+    knobs_b = _record_knobs(b)
     diff = {key: [knobs_a.get(key), knobs_b.get(key)]
             for key in sorted(set(knobs_a) | set(knobs_b))
             if knobs_a.get(key) != knobs_b.get(key)}
-    diff.setdefault("acq_batch", [a.acq_batch, b.acq_batch])
+    if force_diff_key:
+        diff.setdefault(force_diff_key, [a.acq_batch, b.acq_batch])
     report.meta["knob_diff"] = diff
     n_seeds = min(a.seeds, b.seeds)
     if a.seeds != b.seeds:
@@ -302,20 +323,56 @@ def compare_records_batchq(a: RunRecord, b: RunRecord) -> ReplayReport:
         report.seeds.append(SeedTriage(
             seed=s, parity=False, first_divergent_round=0,
             quantity="cumulative_regret",
-            classification="acq-batch-envelope",
+            classification=classification,
             quantities={"cumulative_regret": info},
             note=(f"label-aligned regret envelope over {L} labels: "
-                  f"final {ca[-1]:.4f} (q={a.acq_batch}) vs "
-                  f"{cb[-1]:.4f} (q={b.acq_batch}), "
+                  f"final {ca[-1]:.4f} ({label_a}) vs "
+                  f"{cb[-1]:.4f} ({label_b}), "
                   f"ratio {final_ratio:.3f}, "
                   f"max aligned gap {np.max(gap):.4f}")))
-    report.meta["batchq_envelope"] = {
-        "q_a": a.acq_batch, "q_b": b.acq_batch, "seeds": per_seed,
+    report.meta[meta_key] = {
+        "a": label_a, "b": label_b, "seeds": per_seed,
         "max_final_ratio_b_over_a": max(
             (i["final_ratio_b_over_a"] for i in per_seed), default=None),
         "max_aligned_gap": max(
             (i["max_aligned_gap"] for i in per_seed), default=None),
     }
+    return report
+
+
+def compare_records_batchq(a: RunRecord, b: RunRecord) -> ReplayReport:
+    """The q-vs-q' comparison (``--against`` across different acq_batch
+    knobs); triage class ``acq-batch-envelope``."""
+    report = _compare_records_envelope(
+        a, b, classification="acq-batch-envelope",
+        meta_key="batchq_envelope",
+        label_a=f"q={a.acq_batch}", label_b=f"q={b.acq_batch}",
+        force_diff_key="acq_batch")
+    report.meta["batchq_envelope"].update(
+        {"q_a": a.acq_batch, "q_b": b.acq_batch})
+    return report
+
+
+def _scorer_knob(record: RunRecord) -> str:
+    return str(record.meta.get("fingerprint", {}).get("knobs", {}).get(
+        "eig_scorer") or "exact")
+
+
+def compare_records_scorer(a: RunRecord, b: RunRecord) -> ReplayReport:
+    """The surrogate-vs-exact comparison (``--against`` across different
+    ``eig_scorer`` rungs): the surrogate's score VECTOR legitimately
+    differs outside the refreshed shortlist (unrefreshed rows carry
+    predictions), so a score tolerance would report a fake divergence —
+    the honest contract is the regret envelope at equal label budgets.
+    Triage class ``eig-scorer-envelope`` — the knob-diff path
+    ``cli replay --against`` auto-resolves to."""
+    report = _compare_records_envelope(
+        a, b, classification="eig-scorer-envelope",
+        meta_key="scorer_envelope",
+        label_a=f"eig_scorer={_scorer_knob(a)}",
+        label_b=f"eig_scorer={_scorer_knob(b)}")
+    report.meta["scorer_envelope"].update(
+        {"scorer_a": _scorer_knob(a), "scorer_b": _scorer_knob(b)})
     return report
 
 
@@ -329,12 +386,16 @@ def compare_records(a: RunRecord, b: RunRecord,
     common top-k prefix; a seed-count mismatch compares the common seeds
     and is surfaced in the report meta + triage text (never silently
     called full parity). Records captured at different ``acq_batch``
-    widths route through the label-aligned regret-envelope comparison
-    (:func:`compare_records_batchq`) — the knob-diff path, like
-    dense-vs-sparse, but with budget alignment instead of a score
-    tolerance since the two acquisition programs genuinely differ."""
+    widths — or different ``eig_scorer`` rungs — route through the
+    label-aligned regret-envelope comparison
+    (:func:`compare_records_batchq` / :func:`compare_records_scorer`) —
+    the knob-diff path, like dense-vs-sparse, but with budget alignment
+    instead of a score tolerance since the two acquisition programs
+    genuinely differ."""
     if a.acq_batch != b.acq_batch:
         return compare_records_batchq(a, b)
+    if _scorer_knob(a) != _scorer_knob(b):
+        return compare_records_scorer(a, b)
     if a.rounds != b.rounds:
         raise ValueError(
             f"records disagree on round count ({a.rounds} vs {b.rounds}); "
@@ -347,8 +408,8 @@ def compare_records(a: RunRecord, b: RunRecord,
     # name the knobs the two sides disagree on (e.g. posterior=dense vs
     # sparse:32) — the reason the auto tolerance dropped to the score
     # contract, surfaced instead of leaving the reader to diff fingerprints
-    knobs_a = a.meta.get("fingerprint", {}).get("knobs", {}) or {}
-    knobs_b = b.meta.get("fingerprint", {}).get("knobs", {}) or {}
+    knobs_a = _record_knobs(a)
+    knobs_b = _record_knobs(b)
     diff = {key: [knobs_a.get(key), knobs_b.get(key)]
             for key in sorted(set(knobs_a) | set(knobs_b))
             if knobs_a.get(key) != knobs_b.get(key)}
@@ -419,8 +480,11 @@ def format_triage(report: ReplayReport) -> str:
         pairs = ", ".join(f"{k}: {va!r} vs {vb!r}" for k, (va, vb)
                           in report.meta["knob_diff"].items())
         contract = ("the label-aligned regret envelope"
-                    if report.meta.get("batchq_envelope")
-                    else "the documented score contract")
+                    if (report.meta.get("batchq_envelope")
+                        or report.meta.get("scorer_envelope"))
+                    else ("BITWISE equality (score-tol 0 despite the "
+                          "knob diff)" if report.score_tol == 0.0
+                          else "the documented score contract"))
         lines.append(f"  knobs differ ({pairs}) — compared under "
                      f"{contract}, not bitwise")
     env = report.meta.get("batchq_envelope")
@@ -428,6 +492,13 @@ def format_triage(report: ReplayReport) -> str:
         lines.append(
             f"  acq-batch envelope: q={env['q_a']} vs q={env['q_b']}, "
             f"worst final cum-regret ratio "
+            f"{env['max_final_ratio_b_over_a']:.3f}, worst aligned gap "
+            f"{env['max_aligned_gap']:.4f}")
+    env = report.meta.get("scorer_envelope")
+    if env:
+        lines.append(
+            f"  eig-scorer envelope: {env['scorer_a']} vs "
+            f"{env['scorer_b']}, worst final cum-regret ratio "
             f"{env['max_final_ratio_b_over_a']:.3f}, worst aligned gap "
             f"{env['max_aligned_gap']:.4f}")
     for s in report.seeds:
@@ -516,8 +587,10 @@ def _auto_tol(record: RunRecord, overrides: dict,
     fp = record.meta.get("fingerprint", {})
     if against is not None:
         fp_b = against.meta.get("fingerprint", {})
+        # knob dicts compare NORMALIZED (_record_knobs): a knob one
+        # record predates is its replay default, not a difference
         same = (fp.get("backend") == fp_b.get("backend")
-                and fp.get("knobs") == fp_b.get("knobs"))
+                and _record_knobs(record) == _record_knobs(against))
         return 0.0 if same else CROSS_BACKEND_SCORE_TOL
     import jax
 

@@ -45,6 +45,7 @@ from coda_tpu.ops.masked import entropy2, masked_argmax_tiebreak
 from coda_tpu.ops.pbest import _EPS, compute_pbest, pbest_grid, pbest_row_mixture
 from coda_tpu.ops.sparse_rows import SparseRows
 from coda_tpu.selectors.protocol import Selector, SelectResult
+from coda_tpu.selectors.surrogate import SurrogateFit
 
 _PRECISION = lax.Precision.HIGHEST
 # reference coda/coda.py:307 uses isclose(rtol=1e-8) with torch's default
@@ -212,6 +213,33 @@ class CODAHyperparams(NamedTuple):
     #                               CACHED per-row P(best) (best-model
     #                               readout, recorder digests) always
     #                               stays quadrature-exact.
+    eig_scorer: str = "exact"     # exact | surrogate:k — who scores the
+    #                               round. "exact" (default, bitwise-
+    #                               pinned like every ladder rung) runs
+    #                               the full O(N·C·H) chain. "surrogate:k"
+    #                               (opt-in, incremental tier + jnp
+    #                               backend) scores all N candidates with
+    #                               a carried closed-form ridge over ~16
+    #                               cheap per-candidate features
+    #                               (selectors/surrogate.py — the LINNA
+    #                               arXiv 2203.05583 pattern), then
+    #                               refreshes ONLY its top-k shortlist +
+    #                               a rotating audit set through the
+    #                               exact chain. The trust gate is
+    #                               structural: the shortlist's exact
+    #                               scores are computed anyway, so every
+    #                               round measures rank agreement and
+    #                               |Δscore| on the ranks that matter
+    #                               (2.34e-4, the committed score-
+    #                               contract bound); a violated contract
+    #                               falls back to a full exact pass for
+    #                               that round — bitwise the exact round
+    #                               — and refolds the fit. Warmup rounds
+    #                               are always exact and seed the
+    #                               regression, so selection is never
+    #                               driven by an unaudited score.
+    #                               surrogate:k>=N is the exact-parity
+    #                               configuration (bitwise, pinned).
     pi_update: str = "auto"       # auto | delta | exact — incremental-mode
     #                               pi-hat column refresh. "auto" resolves
     #                               by backend (resolve_pi_update):
@@ -253,6 +281,18 @@ class CODAHyperparams(NamedTuple):
 # kernel (the cache is exactly as large as the prediction tensor itself, so
 # at the 100 GB ImageNet scale it must be sharded deliberately, not by default)
 _INCR_CACHE_MAX_BYTES = 4 << 30
+# under --eig-scorer surrogate:k the same residency is charged at FULL
+# weight against a HIGHER comfort ceiling: the 4 GiB bound exists because
+# the exact scorer also STREAMS the whole cache through every round's
+# scoring pass — past it, the per-round HBM traffic (not the capacity)
+# is what demands deliberate sharding. A surrogate round streams only the
+# shortlist's O((k+audit)·C·H) slice (full streams confined to warmup/
+# fallback rounds, <= 10% by the committed contract), so residency alone
+# binds and 6 GiB still leaves >half of a v5e's 16 GB for the preds
+# tensor and temps. This is what lets the C=1000 x H=2000 HF zero-shot
+# pool resolve to the incremental tier under the surrogate (boundary
+# pinned both ways in tests, like the PR 9 posterior term).
+_SURROGATE_INCR_CACHE_MAX_BYTES = 6 << 30
 # past this the factored kernel's four (C, H, num_points) fp32 Beta tables
 # don't fit either and "auto" scans class rows instead. For calibration: the
 # ImageNet-scale config (C=1000, H=500, G=256) needs 4 x 512 MB of tables —
@@ -375,6 +415,13 @@ def resolve_eig_backend(hp: "CODAHyperparams", eig_mode: str,
         # the amortized row refresh is a jnp-table path; auto must not
         # route scoring into the pallas kernels it cannot feed
         return "jnp"
+    from coda_tpu.selectors.surrogate import parse_scorer
+
+    if parse_scorer(hp.eig_scorer) is not None:
+        # the surrogate's shortlist refresh is a jnp gather-and-score
+        # path (and its hybrid vector is not the kernels' contract);
+        # auto demotes to jnp under the knob, same as eig_pbest
+        return "jnp"
     if hp.n_parallel <= 1 and jax.device_count() == 1:
         return "pallas"
     if hp.shard_spec and hp.n_parallel <= 1:
@@ -396,17 +443,27 @@ def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
     fits; else factored while its (C, H, G) tables fit; else rowscan.
     """
     from coda_tpu.ops.sparse_rows import parse_posterior, posterior_nbytes
+    from coda_tpu.selectors.surrogate import parse_scorer
 
     full_pool_eig = (hp.q == "eig"
                      and not (hp.prefilter_n and hp.prefilter_n < N))
-    # per-replica resident bytes of the incremental tier, per (N*C*H)
-    # element: the P(best) cache at its storage dtype, plus the fp32
-    # (C, H, N) transposed preds layout the delta pi-hat path keeps
-    # resident — the auto budget must charge for both or "fits comfortably
-    # on one chip" silently becomes an OOM
-    cache_bytes = jnp.dtype(hp.eig_cache_dtype).itemsize
-    incr_bytes_per_elem = cache_bytes + (
-        4 if resolve_pi_update(hp, N).startswith("delta") else 0)
+    # per-replica resident bytes of the incremental tier: the P(best)
+    # cache at its storage dtype, plus the fp32 (C, H, N) transposed
+    # preds layout the delta pi-hat path keeps resident — the auto budget
+    # must charge for both or "fits comfortably on one chip" silently
+    # becomes an OOM
+    cache_bytes = jnp.dtype(hp.eig_cache_dtype).itemsize * N * C * H
+    # the scorer tier picks the BUDGET the (full-weight) residency is
+    # held to: the exact scorer's bound also prices the whole-cache
+    # stream every scoring pass pays; the surrogate streams only its
+    # shortlist slice per round, so residency alone binds and the
+    # comfort ceiling is higher (see _SURROGATE_INCR_CACHE_MAX_BYTES —
+    # never a discounted charge, the bytes stay resident either way)
+    budget = (_SURROGATE_INCR_CACHE_MAX_BYTES
+              if parse_scorer(hp.eig_scorer) is not None
+              else _INCR_CACHE_MAX_BYTES)
+    delta_bytes = (4 * N * C * H
+                   if resolve_pi_update(hp, N).startswith("delta") else 0)
     # ...plus the POSTERIOR itself, which the scan carries alongside the
     # cache: the dense (H, C, C) tensor is 2 GB at ImageNet scale — at
     # large C it, not the cache, is what pushes a dense config out of the
@@ -424,8 +481,8 @@ def resolve_eig_mode(hp: "CODAHyperparams", H: int, N: int, C: int) -> str:
         return hp.eig_mode
     par = max(1, hp.n_parallel)
     if (full_pool_eig
-            and par * (incr_bytes_per_elem * N * C * H + post_bytes)
-            <= _INCR_CACHE_MAX_BYTES):
+            and par * (cache_bytes + delta_bytes + post_bytes)
+            <= budget):
         return "incremental"
     if par * 16 * C * H * hp.num_points <= _TABLES_MAX_BYTES:
         return "factored"
@@ -469,6 +526,12 @@ class CODAState(NamedTuple):
     # labeling round DUSes one row of each small leaf instead of pushing
     # the (H, C, C) tensor through the scan
     sparse: Optional["SparseRows"] = None
+    # contract-gated surrogate scorer (None unless hp.eig_scorer is
+    # 'surrogate:k'): the carried ridge fit — normal equations, solved
+    # weights, per-class Beta summaries, gate counters
+    # (selectors/surrogate.SurrogateFit). Shape-static, so it rides the
+    # scan carry and the serve export/import snapshot unchanged.
+    surrogate: Optional["SurrogateFit"] = None
 
 
 def update_pi_hat(
@@ -1280,6 +1343,24 @@ def make_coda(
             f"eig_refresh={hp.eig_refresh!r}) — it would silently not "
             "apply"
         )
+    from coda_tpu.selectors.surrogate import parse_scorer
+
+    scorer_k = parse_scorer(hp.eig_scorer)  # None = exact
+    if scorer_k is not None and eig_mode != "incremental":
+        raise ValueError(
+            "eig_scorer='surrogate:k' amortizes the incremental tier's "
+            f"scoring pass; this config resolved to eig_mode={eig_mode!r} "
+            "where the shortlist refresh has no carried cache to read — "
+            "shrink the config into the incremental budget or use "
+            "eig_scorer='exact'"
+        )
+    if scorer_k is not None and eig_backend == "pallas":
+        raise ValueError(
+            "eig_scorer='surrogate:k' scores through the jnp shortlist "
+            "gather; the pallas kernels score the full pool in one fused "
+            "pass and cannot take the hybrid vector (auto demotes to jnp "
+            "under the knob) — drop eig_backend='pallas' or the surrogate"
+        )
 
     def _score_cache(rows, hyp, pi, pi_xi):
         """The incremental scoring pass, backend-dispatched.
@@ -1309,6 +1390,56 @@ def make_coda(
                                      chunk=hp.eig_chunk,
                                      approx=approx_entropy)
 
+    def _exact_score_rows(rows, hyp, pi, pi_xi, sel):
+        """The exact chain on a row subset (the surrogate's shortlist
+        refresh): one ``lax.map`` over the selected rows, each reading
+        its (C, H) cache column by dynamic slice — O(m·C·H) cache bytes
+        streamed ONCE, with no materialized (C, m, H) gather copy (an
+        ``axis=1`` take at the imagenet preset copied 136 MB per round
+        and halved the measured speedup). Per-row math is exactly
+        ``eig_scores_from_cache``'s block body — same mixture delta,
+        same class-axis reduction structure, same fp32 upcast — so a
+        selected row's score is bitwise the full pass's value for that
+        row (pinned by the k >= N parity test)."""
+        mixture0 = (pi[:, None] * rows).sum(0)               # (H,)
+        h_before = entropy2(mixture0, approx=approx_entropy)
+
+        def one(i):
+            hyp_i = lax.dynamic_slice_in_dim(hyp, i, 1, axis=1)  # (C,1,H)
+            hyp_i = hyp_i.astype(mixture0.dtype)
+            mix = mixture0[None, None, :] + pi[:, None, None] * (
+                hyp_i - rows[:, None, :])
+            h_after = entropy2(mix, axis=-1,
+                               approx=approx_entropy)        # (C, 1)
+            pi_i = lax.dynamic_slice_in_dim(pi_xi, i, 1, axis=0)  # (1, C)
+            return (h_before - (pi_i.T * h_after).sum(axis=0))[0]
+
+        return lax.map(one, sel)
+
+    def _next_cand(unlabeled_new):
+        """The NEXT select's candidate mask (same rule as _candidates,
+        on the post-update unlabeled set) — what the surrogate shortlist
+        must cover."""
+        cand0 = disagree & unlabeled_new
+        return jnp.where(cand0.any(), cand0, unlabeled_new)
+
+    def _surrogate_scores(fit, prev_scores, unlabeled_new, rows, hyp, pi,
+                          pi_xi, true_classes, a_t, b_t):
+        """The contract-gated scoring pass replacing ``_score_cache``
+        (jnp incremental path only — validated above). ``true_classes``
+        (q,), ``a_t``/``b_t`` (q, H): the labeled rows' Beta parameters
+        the cache refresh already extracted."""
+        from coda_tpu.selectors import surrogate as sg
+
+        fit = sg.refresh_class_feats(fit, true_classes, a_t, b_t)
+        feats = sg.build_features(prev_scores, pi_xi, pi, fit.cls_feats,
+                                  rows, hyp, hard_preds, true_classes)
+        with jax.named_scope("eig/surrogate"):
+            return sg.surrogate_score_round(
+                fit, feats, _next_cand(unlabeled_new), scorer_k,
+                lambda sel: _exact_score_rows(rows, hyp, pi, pi_xi, sel),
+                lambda: _score_cache(rows, hyp, pi, pi_xi))
+
     def init(key):
         del key  # CODA's initialization is deterministic
         unnorm = pi_unnorm(dirichlets0, preds)
@@ -1329,6 +1460,14 @@ def make_coda(
             sparse0, dense0 = sparsify(dirichlets0, sparse_k), None
         else:
             sparse0, dense0 = None, dirichlets0
+        fit0 = None
+        if scorer_k is not None:
+            from coda_tpu.selectors.surrogate import init_fit
+
+            # init is always exact (round 0 of the warmup); the fit
+            # starts zeroed, seeded with the prior's class summaries
+            a0, b0 = dirichlet_to_beta(dirichlets0)
+            fit0 = init_fit(a0.T, b0.T)
         return CODAState(
             dirichlets=dense0,
             pi_hat_xi=pi_xi,
@@ -1340,6 +1479,7 @@ def make_coda(
             eig_scores_cached=(_score_cache(rows, hyp, pi, pi_xi)
                                if incremental else None),
             sparse=sparse0,
+            surrogate=fit0,
         )
 
     def _candidates(state: CODAState) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -1667,20 +1807,29 @@ def make_coda(
                 rows = rows.at[true_classes[j]].set(row_ts[j])
                 hyp = hyp.at[true_classes[j]].set(
                     hyp_ts[j].astype(hyp.dtype))
-            scores = _score_cache(rows, hyp, pi, pi_xi)
+            unlabeled_new = state.unlabeled.at[idxs].set(False)
+            if scorer_k is not None:
+                scores, fit = _surrogate_scores(
+                    state.surrogate, state.eig_scores_cached,
+                    unlabeled_new, rows, hyp, pi, pi_xi,
+                    true_classes, a_t, b_t)
+            else:
+                scores, fit = _score_cache(rows, hyp, pi, pi_xi), None
         else:
             pi_xi, pi = update_pi_hat(dirichlets, preds)
-            unnorm = rows = hyp = scores = None
+            unnorm = rows = hyp = scores = fit = None
+            unlabeled_new = state.unlabeled.at[idxs].set(False)
         return CODAState(
             dirichlets=dirichlets,
             pi_hat_xi=pi_xi,
             pi_hat=pi,
-            unlabeled=state.unlabeled.at[idxs].set(False),
+            unlabeled=unlabeled_new,
             pbest_rows=rows,
             pbest_hyp=hyp,
             pi_xi_unnorm=unnorm,
             eig_scores_cached=scores,
             sparse=sparse,
+            surrogate=fit,
         )
 
     def update(state: CODAState, idx, true_class, prob) -> CODAState:
@@ -1771,12 +1920,28 @@ def make_coda(
                         pi_xi, block=hp.eig_chunk,
                         approx=approx_entropy)
             else:
+                if scorer_k is not None and beta_t is None:
+                    # the surrogate's class-summary refresh needs the
+                    # labeled row's Betas; extract once here and hand
+                    # them to the cache refresh too (which would
+                    # otherwise re-derive them internally)
+                    a_cc, b_cc = dirichlet_to_beta(dirichlets)
+                    beta_t = (jnp.take(a_cc, true_class, axis=1),
+                              jnp.take(b_cc, true_class, axis=1))
                 rows, hyp = update_eig_cache(
                     dirichlets, true_class, hard_preds,
                     state.pbest_rows, state.pbest_hyp,
                     num_points=hp.num_points, precision=eig_precision,
                     beta_t=beta_t, pbest=hp.eig_pbest)
-                scores = _score_cache(rows, hyp, pi, pi_xi)
+                if scorer_k is not None:
+                    unlabeled_new = state.unlabeled.at[idx].set(False)
+                    tcs = jnp.asarray(true_class, jnp.int32)[None]
+                    scores, fit = _surrogate_scores(
+                        state.surrogate, state.eig_scores_cached,
+                        unlabeled_new, rows, hyp, pi, pi_xi, tcs,
+                        beta_t[0][None], beta_t[1][None])
+                else:
+                    scores = _score_cache(rows, hyp, pi, pi_xi)
         else:
             pi_xi, pi = update_pi_hat(dirichlets, preds)
             unnorm = rows = hyp = scores = None
@@ -1790,6 +1955,7 @@ def make_coda(
             pi_xi_unnorm=unnorm,
             eig_scores_cached=scores,
             sparse=sparse,
+            surrogate=(fit if scorer_k is not None else None),
         )
 
     def get_pbest(state: CODAState) -> jnp.ndarray:
@@ -1808,6 +1974,39 @@ def make_coda(
     def best(state: CODAState, key):
         del key  # reference uses plain argmax here (coda/coda.py:346)
         return jnp.argmax(get_pbest(state)).astype(jnp.int32), jnp.asarray(False)
+
+    extras = {"get_pbest": get_pbest, "eig_scores": eig_scores}
+    if incremental:
+        # the standalone exact scoring pass on a carried state — the
+        # baseline side of the scoring-pass speedup microbench
+        # (scripts/bench_surrogate.py)
+        extras["score_exact"] = lambda st: _score_cache(
+            st.pbest_rows, st.pbest_hyp, st.pi_hat, st.pi_hat_xi)
+    if scorer_k is not None:
+        # per-round fallback flag for the flight recorder's RoundTrace
+        # tap (engine/loop.make_round_trace)
+        extras["scorer_round_stats"] = (
+            lambda st: st.surrogate.last_fallback)
+
+        def _score_surrogate_pass(st, tcs):
+            """The surviving-round surrogate pass on a carried state
+            (features -> predict -> shortlist exact refresh -> gate ->
+            hybrid + refold), isolated for the microbench."""
+            from coda_tpu.selectors import surrogate as sg
+
+            fit = st.surrogate
+            feats = sg.build_features(
+                st.eig_scores_cached, st.pi_hat_xi, st.pi_hat,
+                fit.cls_feats, st.pbest_rows, st.pbest_hyp, hard_preds,
+                tcs)
+            scores, fit, _ = sg.hybrid_score_pass(
+                fit, feats, _next_cand(st.unlabeled), scorer_k,
+                lambda sel: _exact_score_rows(
+                    st.pbest_rows, st.pbest_hyp, st.pi_hat,
+                    st.pi_hat_xi, sel))
+            return scores, fit
+
+        extras["score_surrogate"] = _score_surrogate_pass
 
     return Selector(
         name=name,
@@ -1828,5 +2027,5 @@ def make_coda(
         always_stochastic=False,
         hyperparams=dict(hp._asdict()),
         hyperparam_defaults=dict(CODAHyperparams()._asdict()),
-        extras={"get_pbest": get_pbest, "eig_scores": eig_scores},
+        extras=extras,
     )

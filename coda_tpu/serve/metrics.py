@@ -88,6 +88,14 @@ class ServeMetrics:
         self._step_s = collections.deque(maxlen=_RING)      # slab-step exec
         self._request_s = collections.deque(maxlen=_RING)   # submit->result
         self._queue_wait_s = collections.deque(maxlen=_RING)  # submit->tick
+        # surrogate-scorer evidence provider (--eig-scorer surrogate:k):
+        # set by the app to a () -> dict callback summing the slab-carried
+        # fit counters over its buckets, so /stats and /metrics read
+        # CURRENT counters on demand without a per-tick device sync. The
+        # returned keys (surrogate_rounds, surrogate_fallbacks,
+        # surrogate_fit_refreshes, surrogate_contract_margin) merge into
+        # the snapshot; {} when no surrogate bucket exists.
+        self.surrogate_provider = None
 
     # -- recording (request path: O(1), no reductions) ---------------------
     def record_dispatch(self, n_requests: int, queue_depth: int,
@@ -238,6 +246,15 @@ class ServeMetrics:
                     "wake_latency": len(self._wake_s),
                 },
             }
+        # outside the lock: the provider takes bucket dispatch locks of
+        # its own, and a lock inversion against record_dispatch (batcher
+        # thread holding a bucket lock while recording) must be impossible
+        provider = self.surrogate_provider
+        if provider is not None:
+            try:
+                snap.update(provider() or {})
+            except Exception:
+                pass  # stats must never fail on a mid-teardown bucket
         return snap
 
     def log_to_store(self, store, experiment: str = "serve",

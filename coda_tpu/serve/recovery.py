@@ -111,7 +111,11 @@ def _stream_version_error(meta: dict) -> Optional[str]:
 # ---------------------------------------------------------------------------
 
 def _pack(arr) -> dict:
-    a = np.ascontiguousarray(np.asarray(arr))
+    a = np.asarray(arr)
+    # ascontiguousarray PROMOTES 0-d arrays to (1,); reshape back so a
+    # scalar state leaf (the surrogate fit's counters) round-trips with
+    # its rank intact — the import-side structural guard compares shapes
+    a = np.ascontiguousarray(a).reshape(a.shape)
     return {"dtype": str(a.dtype), "shape": list(a.shape),
             "data": base64.b64encode(a.tobytes()).decode("ascii")}
 

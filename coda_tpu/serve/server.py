@@ -129,6 +129,10 @@ class ServeApp:
                                telemetry=self.telemetry,
                                recorder=self.recorder,
                                faults=self.faults)
+        # surrogate-scorer evidence (--eig-scorer surrogate:k buckets):
+        # /stats and /metrics read the slab-carried fit counters on
+        # demand through the snapshot provider — never a per-tick sync
+        self.metrics.surrogate_provider = self._surrogate_totals
         # bucket self-healing: a dispatch that quarantines a bucket (step
         # failure consumed the donated carries) schedules a slab rebuild
         # from the sessions' recorder streams, digest-verified
@@ -935,6 +939,37 @@ class ServeApp:
                 "draining": self.draining, "status": status,
                 "problems": problems}
 
+    def _surrogate_totals(self) -> dict:
+        """Aggregate surrogate counters over every surrogate-scorer
+        bucket (ServeMetrics snapshot provider): rounds scored, contract
+        fallbacks, fit refolds, and the worst (minimum) escape-gate
+        margin — {} when no bucket runs the surrogate rung.
+
+        Side effect: caches the per-bucket dicts on ``_surrogate_per``
+        so ``stats()`` — which triggers this via its snapshot() call —
+        reuses them for the per-bucket sections instead of taking every
+        bucket's dispatch lock (and its device readback) a second time
+        per request."""
+        per_bucket = {}
+        for b in self.store.buckets():
+            s = b.surrogate_stats()
+            if s is not None:
+                per_bucket[id(b)] = s
+        self._surrogate_per = per_bucket
+        per = list(per_bucket.values())
+        if not per:
+            return {}
+        margins = [s["contract_margin"] for s in per
+                   if s["contract_margin"] is not None]
+        return {
+            "surrogate_rounds": sum(s["rounds"] for s in per),
+            "surrogate_fallbacks": sum(s["fallbacks"] for s in per),
+            "surrogate_fit_refreshes": sum(s["fit_refreshes"]
+                                           for s in per),
+            "surrogate_contract_margin": (min(margins) if margins
+                                          else None),
+        }
+
     def stats(self) -> dict:
         # refresh the tier occupancy FIRST so the snapshot below carries
         # current gauges even between sweeper passes
@@ -974,7 +1009,13 @@ class ServeApp:
              # device-resident bytes, roofline class — populated by
              # warm(), empty before it (or where cost_analysis is
              # unavailable)
-             "cost": dict(b.cost_info)}
+             "cost": dict(b.cost_info),
+             # surrogate-scorer evidence (None for exact-scorer buckets):
+             # rounds / contract fallbacks / fit refolds / worst margin —
+             # read from the snapshot provider's per-request cache (the
+             # snapshot() call above just refreshed it), never a second
+             # bucket-lock/device-read pass
+             "surrogate": getattr(self, "_surrogate_per", {}).get(id(b))}
             for b in self.store.buckets()
         ]
         snap["warm_error"] = self.warm_error
@@ -1336,6 +1377,20 @@ def parse_args(argv=None):
                         "POST /session/{id}/labels dispatch (fused "
                         "multi-row posterior update) — the serving face "
                         "of --acq-batch")
+    p.add_argument("--eig-scorer", default="exact",
+                   metavar="exact|surrogate:k",
+                   help="coda methods only: the scoring rung every "
+                        "session's bucket compiles (the serving face of "
+                        "the main CLI's --eig-scorer) — surrogate:k "
+                        "amortizes the per-round scoring pass behind the "
+                        "measured contract; surrogate counters surface "
+                        "on /stats and /metrics. NOTE: amortizes only "
+                        "under the 'map' slab lowering (the CPU "
+                        "default); the 'vmap' lowering executes both "
+                        "branches of the fallback cond per slot, so on "
+                        "TPU/GPU slabs the rung is strictly slower than "
+                        "exact (a one-time warning says so at bucket "
+                        "build)")
     p.add_argument("--capacity", type=int, default=64,
                    help="slab slots per bucket = max HOT (resident) "
                         "sessions per (task, config); admission past it "
@@ -1443,6 +1498,9 @@ def build_app(args) -> ServeApp:
         # budget must see the whole slab (cli.py sets the same hint from
         # the seed-vmap width)
         spec_kwargs["n_parallel"] = args.capacity
+        scorer = getattr(args, "eig_scorer", "exact")
+        if scorer != "exact":
+            spec_kwargs["eig_scorer"] = scorer
     telemetry = None
     if getattr(args, "telemetry_dir", None):
         from coda_tpu.telemetry import Telemetry

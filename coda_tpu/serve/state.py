@@ -317,6 +317,31 @@ class Bucket:
         # step and every downstream read are shape-generic, so nothing
         # else here knows about q beyond the request/result marshaling
         self.acq_batch = max(1, int(getattr(spec, "acq_batch", 1)))
+        # the surrogate scorer's warmup/fallback lax.cond lowers to a
+        # SELECT under the vmap slab step (both branches execute per slot
+        # per round), so on a vmap-lowered slab the rung costs a full
+        # exact pass PLUS the surrogate machinery — strictly slower than
+        # eig_scorer='exact'. Loud, once per bucket: an operator reading
+        # healthy surrogate counters on /metrics must not conclude the
+        # rung is amortizing anything there.
+        _scorer = dict(getattr(spec, "kwargs", ()) or ()).get(
+            "eig_scorer", "exact")
+        if _scorer != "exact":
+            import jax as _jax
+
+            _impl = step_impl or (
+                "map" if _jax.default_backend() == "cpu" else "vmap")
+            if _impl == "vmap":
+                import sys as _sys
+
+                print(
+                    f"bucket {task}/{spec.method}: eig_scorer={_scorer!r} "
+                    "under the 'vmap' slab lowering runs BOTH cond "
+                    "branches per slot (full exact pass + surrogate "
+                    "machinery — no amortization; correctness and the "
+                    "contract still hold). Use --step-impl map or "
+                    "eig_scorer='exact' on this backend.",
+                    file=_sys.stderr)
         base_selector = spec.factory()(self.preds)
         if self.acq_batch > 1:
             from coda_tpu.selectors.batch import make_batched_selector
@@ -756,6 +781,45 @@ class Bucket:
 
         self._apply_staged()  # a pre-first-dispatch read must see its init
         return jax.tree.map(lambda x: x[slot], self.states)
+
+    def surrogate_stats(self) -> Optional[dict]:
+        """Aggregate surrogate-scorer evidence from the slab carry, or
+        None when this bucket's selector runs the exact scorer.
+
+        A ``--eig-scorer surrogate:k`` bucket's slab states carry the
+        per-slot :class:`~coda_tpu.selectors.surrogate.SurrogateFit`
+        counters (rounds / fallbacks / fit refolds / last gate margin);
+        this sums them over LIVE slots under the dispatch lock — an
+        on-demand /stats-time read of a few scalar words per slot, never
+        a per-tick device sync."""
+        fit = getattr(self.states, "surrogate", None)
+        if fit is None:
+            return None
+        with self.lock:
+            self._apply_staged()
+            fit = self.states.surrogate
+            with self._host_lock:
+                free = set(self._free)
+            live = np.asarray([s for s in range(self.capacity)
+                               if s not in free], dtype=np.int64)
+            rounds = np.asarray(fit.rounds)
+            fallbacks = np.asarray(fit.fallbacks)
+            fits = np.asarray(fit.fits)
+            margins = np.asarray(fit.margin)
+        if live.size == 0:
+            return {"rounds": 0, "fallbacks": 0, "fit_refreshes": 0,
+                    "contract_margin": None}
+        active = live[rounds[live] > 0]
+        finite = (np.isfinite(margins[active])
+                  if active.size else np.zeros(0, bool))
+        margin = (float(np.min(margins[active][finite]))
+                  if finite.any() else None)
+        return {
+            "rounds": int(rounds[live].sum()),
+            "fallbacks": int(fallbacks[live].sum()),
+            "fit_refreshes": int(fits[live].sum()),
+            "contract_margin": margin,
+        }
 
     def pbest(self, slot: int):
         """P(model is best) for one slot, when the method exposes it (CODA's

@@ -117,6 +117,25 @@ _SERVE_SCALARS = [
      "Mean queue depth at tick start over the recent ring"),
     ("ring_capacity", "serve_ring_capacity", "gauge",
      "Capacity of each metrics ring (fill == capacity means wrapped)"),
+    # contract-gated EIG surrogate (--eig-scorer surrogate:k buckets):
+    # absent (not zero) on servers with no surrogate bucket. GAUGES, not
+    # counters: the values are sums over LIVE slots of the slab-carried
+    # fit state, and a session closing / demoting / migrating away takes
+    # its slot's contribution with it — a decreasing "_total" would make
+    # Prometheus rate() fabricate counter-reset spikes
+    ("surrogate_rounds", "serve_surrogate_rounds", "gauge",
+     "Rounds scored by the surrogate rung, summed over live slots "
+     "(decreases when sessions close/demote/migrate)"),
+    ("surrogate_fallbacks", "serve_surrogate_fallbacks", "gauge",
+     "Surrogate rounds that fell back to the full exact pass on a "
+     "violated contract, summed over live slots"),
+    ("surrogate_fit_refreshes", "serve_surrogate_fit_refreshes", "gauge",
+     "Surrogate ridge-fit refolds (normal-equation updates + re-solves), "
+     "summed over live slots"),
+    ("surrogate_contract_margin", "serve_surrogate_contract_margin",
+     "gauge",
+     "Worst escape-gate margin across live slots (best refreshed exact "
+     "score minus best unrefreshed prediction; healthy > 0)"),
 ]
 
 _SERVE_SUMMARIES = [

@@ -64,8 +64,15 @@ import numpy as np
 # select_prob carry a trailing (q,) axis (one entry per oracle answer of
 # the round). q = 1 records are v1's arrays exactly — v1 records load as
 # acq_batch=1 (the committed r12 captures stay replayable).
-RECORD_SCHEMA_VERSION = 2
-SUPPORTED_RECORD_VERSIONS = (1, 2)
+# v3: the contract-gated EIG surrogate (--eig-scorer surrogate:k): rounds
+# gained the per-round ``surrogate_fallback`` bool array (did the round's
+# scorer fall back to the full exact pass on a violated contract — the
+# stream evidence behind the committed fallback-rate bound), and
+# ``eig_scorer`` joined KNOB_FIELDS. v1/v2 records load unchanged (the
+# array is absent there and replay comparisons skip quantities either
+# side lacks), so the committed r12/r14 captures stay replayable.
+RECORD_SCHEMA_VERSION = 3
+SUPPORTED_RECORD_VERSIONS = (1, 2, 3)
 # v2: session-stream rows gained request_id + pbest_max/pbest_entropy
 # (the in-step posterior digest) and the session_close marker kind — a v1
 # stream replayed by this build would misreport the absent digests as a
@@ -120,13 +127,23 @@ REQUIRED_META = ("schema_version", "fingerprint", "run", "trace_k",
 # batched acquisition
 _BATCH_ARRAYS = ("chosen_idx", "true_class", "select_prob")
 
+# arrays that exist only from a given schema version on
+_VERSIONED_ARRAYS = {
+    "surrogate_fallback": (3, ("b", 2)),   # (S, T) — v3's addition
+}
 
-def required_arrays(acq_batch: int = 1) -> dict:
-    """The REQUIRED_ARRAYS spec for a record's ``acq_batch``: at q > 1
-    the decision arrays are (S, T, q) instead of (S, T)."""
-    if acq_batch <= 1:
-        return dict(REQUIRED_ARRAYS)
+
+def required_arrays(acq_batch: int = 1,
+                    schema_version: int = RECORD_SCHEMA_VERSION) -> dict:
+    """The REQUIRED_ARRAYS spec for a record's ``acq_batch`` and schema
+    version: at q > 1 the decision arrays are (S, T, q) instead of
+    (S, T); v3 records additionally carry ``surrogate_fallback``."""
     out = dict(REQUIRED_ARRAYS)
+    for name, (since, spec) in _VERSIONED_ARRAYS.items():
+        if schema_version >= since:
+            out[name] = spec
+    if acq_batch <= 1:
+        return out
     for name in _BATCH_ARRAYS:
         kind, ndim = out[name]
         out[name] = (kind, ndim + 1)
@@ -139,7 +156,7 @@ KNOB_FIELDS = (
     "multiplier", "prefilter_n", "no_diag_prior", "q", "epsilon",
     "eig_chunk", "eig_mode", "eig_backend", "eig_precision",
     "eig_cache_dtype", "eig_refresh", "eig_entropy", "posterior",
-    "eig_pbest", "pi_update", "mesh", "acq_batch",
+    "eig_pbest", "eig_scorer", "pi_update", "mesh", "acq_batch",
 )
 
 
@@ -254,6 +271,8 @@ class RunRecord:
             "runner_up_gap": np.asarray(aux.trace.runner_up_gap, np.float32),
             "pbest_max": np.asarray(aux.trace.pbest_max, np.float32),
             "pbest_entropy": np.asarray(aux.trace.pbest_entropy, np.float32),
+            "surrogate_fallback": np.asarray(aux.trace.surrogate_fallback,
+                                             bool),
             "root_key": np.asarray(aux.root_key, np.uint32).reshape(-1, 2),
             "init_key": np.asarray(aux.init_key, np.uint32).reshape(-1, 2),
             "prior_key": np.asarray(aux.prior_key, np.uint32).reshape(-1, 2),

@@ -1,0 +1,429 @@
+"""Contract-gated EIG surrogate benchmark -> BENCH_SURROGATE_<b>_rNN.json.
+
+The ``--eig-scorer surrogate:k`` claim, measured and replay-verified
+(ISSUE 15):
+
+  * **regret parity** (real-digits 100-round trace): the surrogate-scored
+    run must land within the committed envelope of the exact scorer's
+    cumulative regret at the same label budget — the trust gate's whole
+    point is that selection quality is not traded away. Both runs are
+    recorded, each self-replays bitwise (``cli replay``), the
+    surrogate-vs-exact pair is compared through the real
+    ``cli replay --against`` path (the knob diff auto-resolves to the
+    label-aligned ``eig-scorer-envelope`` triage), and the DEFAULT
+    (``--eig-scorer exact``) is pinned bitwise-unchanged against a
+    knob-less record through the same path.
+  * **scoring-pass speedup** (the imagenet preset, C=1000/H=500/N=256,
+    posterior=sparse:32, surrogate:64): the exact full O(N·C·H) cache
+    sweep vs the surrogate pass (features -> ridge predict -> exact
+    shortlist refresh -> gate -> refold), timed on the SAME carried
+    post-warmup state, min of warm reps. The committed floor: >= 3x.
+  * **fallback rate**: post-warmup contract fallbacks must stay <= 10%
+    of rounds (a surrogate that bounces off its own gate amortizes
+    nothing) — measured from the carried fit counters at the preset and
+    from the per-round ``surrogate_fallback`` stream on digits.
+
+Runnable standalone (CPU container: the preset init dominates, ~8 min
+full; ~1 min quick)::
+
+    python scripts/bench_surrogate.py --out BENCH_SURROGATE_CPU_r17.json \
+        --records-dir runs/surrogate_r17
+    python scripts/bench_surrogate.py --quick   # digits smoke + smoke shape
+
+The finished artifact is self-gated against its ``check_perf.py``
+contract before the script exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the declared bounds are the GATE's, imported from the one place they
+# are enforced (scripts/check_perf.py) so the generator can never embed
+# verdicts computed under stale thresholds
+from check_perf import (  # noqa: E402
+    SURROGATE_ENVELOPE_ABS as ENVELOPE_ABS,
+    SURROGATE_ENVELOPE_RATIO as ENVELOPE_RATIO,
+    SURROGATE_MAX_FALLBACK_RATE as MAX_FALLBACK_RATE,
+    SURROGATE_MIN_SCORE_SPEEDUP as MIN_SPEEDUP,
+)
+
+
+def _knobs(args, **extra) -> dict:
+    base = {"bench": "surrogate", "quick": bool(args.quick)}
+    base.update(extra)
+    return base
+
+
+def _fallback_rate(record) -> float:
+    """Post-warmup contract-fallback rate from the record's per-round
+    ``surrogate_fallback`` stream (schema v3)."""
+    from coda_tpu.selectors.surrogate import SURROGATE_WARMUP_ROUNDS
+
+    fb = np.asarray(record.arrays["surrogate_fallback"], bool)
+    post = fb[:, SURROGATE_WARMUP_ROUNDS:]
+    return float(post.mean()) if post.size else 0.0
+
+
+def _cli_replay(args_list) -> int:
+    """The REAL ``cli replay`` path, as a subprocess (what the artifact's
+    verification commands document)."""
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS",
+                                                        "cpu"))
+    r = subprocess.run(
+        [sys.executable, "-m", "coda_tpu.cli", "replay"] + args_list,
+        cwd=REPO, capture_output=True, text=True, timeout=900, env=env)
+    sys.stderr.write(r.stdout[-2000:])
+    return r.returncode
+
+
+def _run_digits(args, fingerprint_holder: list) -> tuple:
+    """The regret half: exact vs surrogate on the real-digits trace at
+    one label budget, recorded + replay-verified; plus the default-knob
+    bitwise pin."""
+    from coda_tpu.cli import load_dataset
+    from coda_tpu.engine.loop import run_seeds_recorded
+    from coda_tpu.engine.replay import verify_replay
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.telemetry.recorder import (
+        RunRecord,
+        environment_fingerprint,
+    )
+
+    ds = load_dataset(argparse.Namespace(
+        task="digits", data_dir=args.data_dir, synthetic=None, mesh=None))
+    iters = 40 if args.quick else 100
+    seeds = 2 if args.quick else 3
+    scorer = "surrogate:16" if args.quick else f"surrogate:{args.digits_k}"
+    out: dict = {"task": ds.name, "shape": list(ds.shape),
+                 "label_budget": iters, "seeds": seeds, "scorer": scorer}
+    records = {}
+    # "default" records the knob-less program (a pre-knob capture);
+    # "exact" records --eig-scorer exact explicitly: the two must be
+    # BITWISE identical through cli replay --against (the default pin)
+    configs = {"default": None, "exact": "exact", "surrogate": scorer}
+    for name, knob in configs.items():
+        hp_kwargs = dict(n_parallel=seeds)
+        if knob is not None:
+            hp_kwargs["eig_scorer"] = knob
+        hp = CODAHyperparams(**hp_kwargs)
+        factory = (lambda _hp: (lambda preds: make_coda(preds, _hp)))(hp)
+        t0 = time.perf_counter()
+        result, aux = run_seeds_recorded(
+            factory, ds.preds, ds.labels, iters=iters, seeds=seeds,
+            trace_k=8, cost_label=f"surrogate_digits_{name}")
+        np.asarray(result.cumulative_regret)  # sync
+        wall = time.perf_counter() - t0
+        knobs = _knobs(args, capture="digits", method="coda", loss="acc",
+                       iters=iters, seeds=seeds, n_parallel=seeds,
+                       eig_chunk=1024)
+        if knob is not None:
+            knobs["eig_scorer"] = knob
+        fp = environment_fingerprint(dataset=ds, knobs=knobs)
+        if not fingerprint_holder:
+            fingerprint_holder.append(environment_fingerprint(
+                dataset=ds, knobs=_knobs(args)))
+        record = RunRecord.from_result(
+            result, aux, fp,
+            run={"task": ds.name, "synthetic": None,
+                 "data_dir": args.data_dir, "method": "coda",
+                 "loss": "acc", "iters": iters, "seeds": seeds})
+        rec_dir = os.path.join(args.records_dir, name)
+        record.save(rec_dir)
+        records[name] = (record, rec_dir, factory)
+        cum = np.asarray(result.cumulative_regret)[:, -1]
+        entry = {
+            "iters": iters, "wall_s": round(wall, 3),
+            "record_dir": os.path.relpath(rec_dir, REPO),
+            "final_cum_regret_mean": float(cum.mean()),
+            "final_cum_regret_per_seed": [float(v) for v in cum],
+        }
+        if name == "surrogate":
+            entry["fallback_rate_post_warmup"] = _fallback_rate(record)
+        # bitwise self-replay through the identical program — the same
+        # verify path `cli replay <dir>` runs
+        rep = verify_replay(record, factory, ds.preds, ds.labels,
+                            loss="acc", score_tol=0.0)
+        entry["replay"] = {
+            "parity": bool(rep.parity),
+            "cli": f"cli replay {os.path.relpath(rec_dir, REPO)}",
+        }
+        out[name] = entry
+
+    # surrogate vs exact through the REAL cli replay --against path: the
+    # eig_scorer knob diff must auto-resolve to the envelope triage
+    _, exact_dir, _ = records["exact"]
+    _, surr_dir, _ = records["surrogate"]
+    _, default_dir, _ = records["default"]
+    report_fp = os.path.join(args.records_dir, "against_exact.json")
+    rc = _cli_replay([exact_dir, "--against", surr_dir,
+                      "--out", report_fp])
+    with open(report_fp) as f:
+        rep = json.load(f)
+    env = rep.get("meta", {}).get("scorer_envelope") or {}
+    cls = (rep.get("seeds") or [{}])[0].get("classification")
+    exact_mean = out["exact"]["final_cum_regret_mean"]
+    surr_mean = out["surrogate"]["final_cum_regret_mean"]
+    within = surr_mean <= ENVELOPE_RATIO * exact_mean + ENVELOPE_ABS
+    out["against_exact"] = {
+        "cli": (f"cli replay {os.path.relpath(exact_dir, REPO)} "
+                f"--against {os.path.relpath(surr_dir, REPO)}"),
+        "rc": rc,
+        "classification": cls,
+        "envelope": env,
+        "ratio_vs_exact": (surr_mean / exact_mean if exact_mean > 0
+                           else None),
+        "within_envelope": bool(within),
+    }
+    # the default pin: --eig-scorer exact must be BITWISE the knob-less
+    # program (rc 0 = full parity through the same real path; score-tol
+    # forced to 0 — the auto tolerance would relax on the knob diff and
+    # weaken the bitwise claim)
+    rc_pin = _cli_replay([default_dir, "--against", exact_dir,
+                          "--score-tol", "0"])
+    pin = {
+        "cli": (f"cli replay {os.path.relpath(default_dir, REPO)} "
+                f"--against {os.path.relpath(exact_dir, REPO)} "
+                "--score-tol 0"),
+        "rc": rc_pin,
+        "parity": rc_pin == 0,
+        "score_tol": 0.0,
+    }
+    out["envelope"] = {"ratio": ENVELOPE_RATIO, "abs_slack": ENVELOPE_ABS,
+                       "ok": bool(within)}
+    return out, pin
+
+
+def _time_min(fn, arg, reps: int = 7) -> float:
+    import jax
+
+    jax.block_until_ready(fn(arg))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(arg))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _run_preset(args) -> dict:
+    """The throughput half at the imagenet preset: scoring-pass speedup
+    (exact sweep vs surrogate pass on the same carried state), the
+    post-warmup fallback rate from the carried fit counters, and the
+    marginal surrogate round seconds (the cross-round regression
+    metric)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine.loop import make_step_fn
+    from coda_tpu.losses import accuracy_loss
+    from coda_tpu.oracle import true_losses
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.surrogate import SURROGATE_WARMUP_ROUNDS
+
+    if args.quick:
+        H, N, C, posterior, chunk, k = 50, 256, 100, "sparse:16", 64, 32
+        measured_rounds = 10
+    else:
+        H, N, C, posterior, chunk, k = 500, 256, 1000, "sparse:32", 64, 64
+        measured_rounds = args.preset_rounds
+    ds = make_synthetic_task(seed=0, H=H, N=N, C=C)
+    hp = CODAHyperparams(posterior=posterior, eig_chunk=chunk,
+                         eig_scorer=f"surrogate:{k}", n_parallel=1)
+    sel = make_coda(ds.preds, hp)
+    losses = true_losses(ds.preds, ds.labels, accuracy_loss)
+    t0 = time.perf_counter()
+    state0 = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    jax.block_until_ready(state0)
+    init_s = time.perf_counter() - t0
+
+    step = make_step_fn(sel, ds.labels, losses)
+
+    @jax.jit
+    def run(state, keys):
+        (s, cum), _ = lax.scan(step, (state, jnp.asarray(0.0,
+                                                         jnp.float32)),
+                               keys)
+        return s, cum
+
+    # warmup + measured rounds in one scan; the final carry's fit
+    # counters are the fallback evidence
+    R = SURROGATE_WARMUP_ROUNDS + measured_rounds
+    keys = jax.random.split(jax.random.PRNGKey(1), R)
+    t0 = time.perf_counter()
+    state, _ = run(state0, keys)
+    jax.block_until_ready(state)
+    compile_s = time.perf_counter() - t0
+    fit = state.surrogate
+    rounds = int(fit.rounds)
+    fallbacks = int(fit.fallbacks)
+    rate = fallbacks / max(1, rounds - SURROGATE_WARMUP_ROUNDS)
+
+    # scoring-pass speedup: exact full sweep vs the surviving-round
+    # surrogate pass, SAME carried post-warmup state, min of warm reps
+    score_exact = jax.jit(sel.extras["score_exact"])
+    tcs0 = jnp.zeros((1,), jnp.int32)
+    score_surr = jax.jit(lambda s: sel.extras["score_surrogate"](s, tcs0))
+    t_exact = _time_min(score_exact, state)
+    t_surr = _time_min(score_surr, state)
+    speedup = t_exact / t_surr if t_surr > 0 else None
+
+    # marginal surrogate round seconds, scan-only (bench_batchq's
+    # methodology: init outside, warm executions, min of reps)
+    R_m = 8
+    keys_m = jax.random.split(jax.random.PRNGKey(2), R_m)
+    jax.block_until_ready(run(state, keys_m))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(state, keys_m))
+        best = min(best, (time.perf_counter() - t0) / R_m)
+    return {
+        "preset": "imagenet_smoke" if args.quick else "imagenet",
+        "shape": {"H": H, "N": N, "C": C},
+        "posterior": posterior, "eig_chunk": chunk,
+        "scorer": f"surrogate:{k}",
+        "warmup_rounds": SURROGATE_WARMUP_ROUNDS,
+        "measured_rounds": rounds - SURROGATE_WARMUP_ROUNDS,
+        "init_s": round(init_s, 2),
+        "compile_and_first_run_s": round(compile_s, 2),
+        "fallbacks_post_warmup": fallbacks,
+        "fallback_rate_post_warmup": rate,
+        "scoring_pass_exact_ms": round(t_exact * 1e3, 2),
+        "scoring_pass_surrogate_ms": round(t_surr * 1e3, 2),
+        "scoring_pass_speedup": speedup,
+        "speedup_floor": None if args.quick else MIN_SPEEDUP,
+        "round_s_marginal": best,
+        "methodology": "scoring passes timed on the same carried "
+                       "post-warmup state (min of warm reps); round "
+                       "marginal scan-only, init excluded",
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default BENCH_SURROGATE_"
+                         "<backend>_rNN.json in the repo root)")
+    ap.add_argument("--records-dir", default=None,
+                    help="where the flight-recorder records land "
+                         "(default runs/surrogate_rNN under --out's "
+                         "directory)")
+    ap.add_argument("--data-dir", default=os.path.join(REPO, "data"))
+    ap.add_argument("--quick", action="store_true",
+                    help="smoke capture: digits at a smaller budget + "
+                         "the smoke shape (never gates the full "
+                         "artifact — different fingerprint knobs)")
+    ap.add_argument("--round", type=int, default=17,
+                    help="artifact round number for the default filename")
+    ap.add_argument("--digits-k", type=int, default=32,
+                    help="surrogate shortlist width for the digits half")
+    ap.add_argument("--preset-rounds", type=int, default=20,
+                    help="post-warmup rounds measured at the preset")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(args.platform)
+    import jax
+
+    backend = jax.default_backend().upper()
+    out_path = args.out or os.path.join(
+        REPO, f"BENCH_SURROGATE_{backend}_r{args.round:02d}"
+              + ("_quick" if args.quick else "") + ".json")
+    if args.records_dir is None:
+        args.records_dir = os.path.join(
+            os.path.dirname(os.path.abspath(out_path)) or ".",
+            "runs", f"surrogate{'_quick' if args.quick else ''}_r"
+                    f"{args.round:02d}")
+
+    fingerprint_holder: list = []
+    t0 = time.perf_counter()
+    digits, default_pin = _run_digits(args, fingerprint_holder)
+    preset = _run_preset(args)
+    wall = time.perf_counter() - t0
+
+    replays_ok = all(
+        (digits.get(side) or {}).get("replay", {}).get("parity") is True
+        for side in ("default", "exact", "surrogate"))
+    triaged = (digits.get("against_exact", {}).get("classification")
+               == "eig-scorer-envelope")
+    speedup = preset.get("scoring_pass_speedup")
+    floor = preset.get("speedup_floor")
+    speedup_ok = (True if floor is None
+                  else (speedup is not None and speedup >= floor))
+    rate_ok = (preset.get("fallback_rate_post_warmup", 1.0)
+               <= MAX_FALLBACK_RATE)
+    ok = bool(digits["envelope"]["ok"] and replays_ok and triaged
+              and speedup_ok and rate_ok and default_pin["parity"])
+    report = {
+        "bench": "surrogate",
+        "quick": bool(args.quick),
+        "wall_s": round(wall, 2),
+        "config": {
+            "method": "coda",
+            "scorer": "closed-form ridge over 16 cheap per-candidate "
+                      "features; exact chain refreshes the top-k "
+                      "shortlist + rotating audit set under the "
+                      "measured contract (2.34e-4 on ranks that "
+                      "matter); violated contract falls back to the "
+                      "full exact pass and refolds the fit",
+            "envelope": {"ratio": ENVELOPE_RATIO,
+                         "abs_slack": ENVELOPE_ABS},
+            "speedup_floor": MIN_SPEEDUP,
+            "max_fallback_rate": MAX_FALLBACK_RATE,
+        },
+        "digits": digits,
+        "imagenet": preset,
+        "round_s_marginal": preset["round_s_marginal"],
+        "default_exact_pin": default_pin,
+        "regret_envelope_ok": bool(digits["envelope"]["ok"]),
+        "replays_verified": bool(replays_ok),
+        "divergences_triaged": bool(triaged),
+        "fingerprint": fingerprint_holder[0] if fingerprint_holder
+        else None,
+        "ok": ok,
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"wrote {out_path} (ok={ok}, speedup={speedup}, "
+          f"envelope_ok={digits['envelope']['ok']}, "
+          f"fallback_rate={preset.get('fallback_rate_post_warmup')})")
+
+    # self-gate: the artifact must satisfy its own check_perf contract
+    # (quick captures carry no committed floors — structural gate only)
+    if not args.quick:
+        from check_perf import check_artifact, match_contract
+
+        contract = match_contract(out_path)
+        if contract is None:
+            print("self-gate: no contract matches the artifact name")
+            return 1
+        violations = check_artifact(out_path, report, contract)
+        for v in violations:
+            print(f"self-gate: {v}")
+        if violations:
+            return 1
+        print("self-gate clean")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -175,6 +175,16 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                  "--records-dir", os.path.join(tmpdir, "batchq_records")]
                 + plat,
                 os.path.join(tmpdir, "batchq.json"), 900),
+            # the contract-gated EIG surrogate at smoke scale: digits
+            # regret envelope + the smoke-shape scoring-pass probe (the
+            # committed >= 3x floor lives in the full BENCH_SURROGATE_*
+            # capture)
+            "bench_surrogate": (
+                [py, "scripts/bench_surrogate.py", "--quick",
+                 "--out", os.path.join(tmpdir, "surrogate.json"),
+                 "--records-dir",
+                 os.path.join(tmpdir, "surrogate_records")] + plat,
+                os.path.join(tmpdir, "surrogate.json"), 900),
             # the replicated fleet at proof scale: 2 replicas behind the
             # rendezvous router, rolling restart of both mid-load, every
             # migration digest-verified (the committed 3-replica claim is
@@ -243,6 +253,15 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
              "--records-dir", os.path.join(tmpdir, "batchq_records")]
             + plat,
             os.path.join(tmpdir, "batchq.json"), 3600),
+        # the contract-gated EIG surrogate in full: digits 100-round
+        # envelope + the imagenet-preset surrogate:64-vs-exact scoring
+        # pass, replay-triaged (the BENCH_SURROGATE_* configuration)
+        "bench_surrogate": (
+            [py, "scripts/bench_surrogate.py",
+             "--out", os.path.join(tmpdir, "surrogate.json"),
+             "--records-dir", os.path.join(tmpdir, "surrogate_records")]
+            + plat,
+            os.path.join(tmpdir, "surrogate.json"), 3600),
         # the full 3-replica fleet demo (the BENCH_FLEET_* configuration):
         # rolling restart of every replica in sequence under live load,
         # zero drops / zero double-applies, scaling vs the 1-replica

@@ -469,6 +469,87 @@ def batchq_check_report(report: dict) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# the contract-gated EIG surrogate (ISSUE 15 acceptance: BENCH_SURROGATE_*
+# holds the scoring-pass speedup + regret envelope + fallback-rate claims)
+# ---------------------------------------------------------------------------
+
+# the scoring pass itself (exact full sweep vs surrogate predict +
+# shortlist refresh + gate) at the imagenet preset, measured on the same
+# carried state
+SURROGATE_MIN_SCORE_SPEEDUP = 3.0
+# the real-digits regret envelope vs the exact scorer at the same label
+# budget: ratio on the label-weighted final cumulative regret, plus a
+# small absolute slack so near-zero regrets cannot turn a 0.01-vs-0.02
+# difference into a 2x "violation" (the batchq precedent)
+SURROGATE_ENVELOPE_RATIO = 1.05
+SURROGATE_ENVELOPE_ABS = 0.02
+# contract fallbacks after warmup: the surrogate must actually carry the
+# rounds, not bounce off its own gate
+SURROGATE_MAX_FALLBACK_RATE = 0.10
+
+
+def surrogate_check_report(report: dict) -> list[str]:
+    """Violations of one surrogate capture (empty = clean): the
+    scoring-pass speedup floor at the imagenet preset, the digits regret
+    envelope vs exact, the post-warmup fallback-rate bound (measured
+    from the per-round stream evidence), bitwise self-replay of both
+    recorded programs, the surrogate-vs-exact divergence triaged as
+    ``eig-scorer-envelope`` through the ``--against`` path, AND the
+    default (`--eig-scorer exact`) pinned bitwise-unchanged through the
+    same path."""
+    out: list[str] = []
+    if report.get("quick"):
+        return ["quick surrogate captures must not be committed at the "
+                "repo root (no committed floors were checked)"]
+    im = report.get("imagenet") or {}
+    speedup = im.get("scoring_pass_speedup")
+    if not isinstance(speedup, (int, float)):
+        out.append("imagenet.scoring_pass_speedup missing")
+    elif speedup < SURROGATE_MIN_SCORE_SPEEDUP:
+        out.append(f"imagenet.scoring_pass_speedup {speedup:.2f} < "
+                   f"{SURROGATE_MIN_SCORE_SPEEDUP}")
+    rate = im.get("fallback_rate_post_warmup")
+    if not isinstance(rate, (int, float)):
+        out.append("imagenet.fallback_rate_post_warmup missing")
+    elif rate > SURROGATE_MAX_FALLBACK_RATE:
+        out.append(f"imagenet.fallback_rate_post_warmup {rate:.3f} > "
+                   f"{SURROGATE_MAX_FALLBACK_RATE}")
+    dig = report.get("digits") or {}
+    base = (dig.get("exact") or {}).get("final_cum_regret_mean")
+    surr = (dig.get("surrogate") or {}).get("final_cum_regret_mean")
+    if not all(isinstance(v, (int, float)) for v in (base, surr)):
+        out.append("digits.exact/surrogate.final_cum_regret_mean missing")
+    elif surr > SURROGATE_ENVELOPE_RATIO * base + SURROGATE_ENVELOPE_ABS:
+        out.append(
+            f"digits surrogate final cum regret {surr:.4f} outside the "
+            f"committed envelope ({SURROGATE_ENVELOPE_RATIO} * {base:.4f}"
+            f" + {SURROGATE_ENVELOPE_ABS})")
+    drate = (dig.get("surrogate") or {}).get("fallback_rate_post_warmup")
+    if isinstance(drate, (int, float)) and \
+            drate > SURROGATE_MAX_FALLBACK_RATE:
+        out.append(f"digits surrogate fallback rate {drate:.3f} > "
+                   f"{SURROGATE_MAX_FALLBACK_RATE}")
+    for side in ("exact", "surrogate"):
+        rep = (dig.get(side) or {}).get("replay") or {}
+        if rep.get("parity") is not True:
+            out.append(f"digits.{side}.replay.parity is not true (every "
+                       "recorded program must self-replay bitwise)")
+    against = dig.get("against_exact") or {}
+    if against.get("classification") != "eig-scorer-envelope":
+        out.append(
+            f"digits.against_exact.classification "
+            f"{against.get('classification')!r} — the surrogate-vs-exact "
+            "divergence must be triaged through the replay --against "
+            "knob-diff path as eig-scorer-envelope")
+    pin = report.get("default_exact_pin") or {}
+    if pin.get("parity") is not True:
+        out.append("default_exact_pin.parity is not true (--eig-scorer "
+                   "exact must be bitwise the default, verified through "
+                   "the real cli replay --against path)")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # the fault-matrix contracts (ISSUE 14: the fleet chaos matrix is a
 # committed, machine-checked artifact like every perf claim)
 # ---------------------------------------------------------------------------
@@ -545,7 +626,7 @@ EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
 # manifest's own "skipped" list records)
 EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered",
                                 "bench_batchq", "serve_fleet",
-                                "serve_fleet_chaos")
+                                "serve_fleet_chaos", "bench_surrogate")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -590,6 +671,15 @@ def _evidence_check(report: dict) -> list[str]:
                        "broke in-capture)")
         if rep.get("replays_verified") is not True:
             out.append("bench_batchq.report.replays_verified is not true")
+    rep = (arts.get("bench_surrogate") or {}).get("report") or {}
+    if rep:
+        if rep.get("ok") is not True:
+            out.append("bench_surrogate.report.ok is not true (regret "
+                       "envelope / replay verification / speedup floor "
+                       "broke in-capture)")
+        if rep.get("replays_verified") is not True:
+            out.append("bench_surrogate.report.replays_verified is not "
+                       "true")
     rep = (arts.get("serve_fleet") or {}).get("report") or {}
     if rep:
         fl = rep.get("fleet") or {}
@@ -717,6 +807,31 @@ CONTRACTS: tuple = (
         note="q oracle labels per round: labels/s speedup >= 0.6*q at "
              "q=8 on the imagenet preset, real-digits regret within the "
              "declared envelope of q=1, divergences replay-triaged"),
+    # -- contract-gated EIG surrogate --
+    Contract(
+        pattern="BENCH_SURROGATE_*.json", kind="surrogate",
+        required=("bench", "wall_s", "config", "digits.label_budget",
+                  "digits.exact.final_cum_regret_mean",
+                  "digits.surrogate.final_cum_regret_mean",
+                  "digits.against_exact.classification",
+                  "imagenet.scoring_pass_speedup",
+                  "imagenet.round_s_marginal",
+                  "imagenet.fallback_rate_post_warmup",
+                  "round_s_marginal", "default_exact_pin.parity",
+                  "regret_envelope_ok", "replays_verified", "ok"),
+        bounds=(("ok", "==", True),
+                ("regret_envelope_ok", "==", True),
+                ("replays_verified", "==", True),
+                ("imagenet.scoring_pass_speedup", ">=",
+                 SURROGATE_MIN_SCORE_SPEEDUP)),
+        checker=surrogate_check_report, fingerprint="required",
+        group="surrogate",
+        regress=("round_s_marginal", "lower", 0.5),
+        note="learned score amortization under the measured contract: "
+             "scoring-pass speedup >= 3x at the imagenet preset, digits "
+             "regret envelope vs exact held, post-warmup fallback rate "
+             "<= 10%, default exact bitwise-pinned via cli replay "
+             "--against"),
     # -- recorder overhead --
     Contract(
         pattern="BENCH_RECORDER_*.json", kind="recorder_overhead",

@@ -5,9 +5,10 @@ evidence: a record that silently drifted from the schema — missing version
 stamp, renamed array, wrong dtype/rank, seed/round counts that disagree
 between meta and arrays — would make ``cli replay`` triage garbage instead
 of failing loudly. This checker walks a directory tree and validates every
-artifact it finds against the versioned schema (record v1/v2 — v2 adds the
-``acq_batch`` stamp and q-wide decision arrays; session streams at the
-current version only):
+artifact it finds against the versioned schema (record v1/v2/v3 — v2 adds
+the ``acq_batch`` stamp and q-wide decision arrays, v3 the per-round
+``surrogate_fallback`` array of the contract-gated EIG surrogate; session
+streams at the current version only):
 
   * ``record.json`` + ``rounds.npz`` pairs (batch/suite records): version
     stamp, required meta fields, every REQUIRED_ARRAYS entry present with
@@ -63,12 +64,15 @@ def check_record(dir_path: str) -> list[str]:
     elif v not in SUPPORTED_RECORD_VERSIONS:
         out.append(f"schema_version {v!r} not in supported "
                    f"{list(SUPPORTED_RECORD_VERSIONS)}")
-    # v2 must stamp acq_batch; v1 predates batching and reads as q=1
+    # v2+ must stamp acq_batch; v1 predates batching and reads as q=1
     q = meta.get("acq_batch", 1)
-    if v == 2 and not isinstance(meta.get("acq_batch"), int):
-        out.append("v2 record.json missing integer 'acq_batch'")
+    if isinstance(v, int) and v >= 2 \
+            and not isinstance(meta.get("acq_batch"), int):
+        out.append(f"v{v} record.json missing integer 'acq_batch'")
         q = 1
-    REQUIRED_ARRAYS = required_arrays(q if isinstance(q, int) else 1)
+    REQUIRED_ARRAYS = required_arrays(
+        q if isinstance(q, int) else 1,
+        schema_version=v if isinstance(v, int) else 1)
     for key in REQUIRED_META:
         if key not in meta:
             out.append(f"record.json missing required field {key!r}")

@@ -262,7 +262,9 @@ def test_batch_record_roundtrip_and_schema(task, tmp_path):
     rec = RunRecord.from_result(
         res, aux, environment_fingerprint(knobs={"acq_batch": 4}),
         run={"iters": 4, "acq_batch": 4})
-    assert rec.meta["schema_version"] == RECORD_SCHEMA_VERSION == 2
+    # v2 introduced the q-wide arrays; later bumps (v3: the surrogate
+    # fallback stream) keep stamping the current version
+    assert rec.meta["schema_version"] == RECORD_SCHEMA_VERSION >= 2
     assert rec.acq_batch == 4
     assert rec.arrays["chosen_idx"].shape == (2, 4, 4)
     rec.save(str(tmp_path / "rec"))

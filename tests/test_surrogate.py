@@ -1,0 +1,487 @@
+"""The contract-gated EIG surrogate rung (--eig-scorer surrogate:k).
+
+What tier-1 pins here (ISSUE 15):
+
+  * the DEFAULT is exact and bitwise-unchanged for every selector — the
+    knob at 'exact' runs the identical program;
+  * surrogate:k >= N is the exact-parity configuration (bitwise, the
+    same ladder idiom as sparse:K >= C);
+  * the shortlist-rows-are-exact property: the selected index's score is
+    always the exact chain's value, never the surrogate's raw
+    prediction;
+  * a forced contract violation trips the fallback and the round's
+    scores are bitwise the exact round's;
+  * the real-digits 100-round trace stays inside the committed regret
+    envelope vs the exact scorer;
+  * q-wide (--acq-batch) and sparse-tier composition;
+  * the serve bucket compiles the rung and session export/import
+    round-trips the fit state bitwise;
+  * the resolve_eig_mode auto budget charges the scorer tier (the
+    C=1000 x H=2000 boundary pinned both ways);
+  * recorder/replay: eig_scorer fingerprinted, v3 streams carry the
+    per-round fallback flag, surrogate-vs-exact triages as
+    eig-scorer-envelope, bitwise self-replay.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from coda_tpu.data import make_synthetic_task
+from coda_tpu.engine.loop import run_seeds_compiled
+from coda_tpu.losses import accuracy_loss
+from coda_tpu.oracle import true_losses
+from coda_tpu.selectors import CODAHyperparams, make_coda
+from coda_tpu.selectors import surrogate as sg
+
+
+@pytest.fixture(scope="module")
+def task():
+    return make_synthetic_task(seed=0, H=8, N=64, C=5)
+
+
+def _trees_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _run(task, hp, iters=24, seeds=2):
+    factory = (lambda preds: make_coda(preds, hp))
+    return run_seeds_compiled(factory, task.preds, task.labels,
+                              iters=iters, seeds=seeds)
+
+
+# ---------------------------------------------------------------------------
+# default-exact pins
+# ---------------------------------------------------------------------------
+
+def test_default_is_exact_bitwise(task):
+    """eig_scorer='exact' (the default) is the identical program — and
+    the exact-config state carries NO fit leaves, so pre-knob serve
+    snapshots/checkpoints keep their leaf structure."""
+    r_default = _run(task, CODAHyperparams(n_parallel=2))
+    r_exact = _run(task, CODAHyperparams(eig_scorer="exact",
+                                         n_parallel=2))
+    assert _trees_equal(r_default, r_exact)
+    sel = make_coda(task.preds, CODAHyperparams())
+    state = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    assert state.surrogate is None
+
+
+def test_default_exact_every_selector(task):
+    """Non-CODA selectors know nothing of the knob; their programs are
+    untouched (smoke: they still run and emit scores)."""
+    from coda_tpu.selectors import SELECTOR_FACTORIES
+
+    for name in ("iid", "uncertainty", "model_picker"):
+        fac = SELECTOR_FACTORIES[name]
+        r = run_seeds_compiled(lambda p, _f=fac: _f(p), task.preds,
+                               task.labels, iters=5, seeds=1)
+        assert np.isfinite(np.asarray(r.cumulative_regret)).all()
+
+
+def test_k_ge_n_is_exact_parity(task):
+    """surrogate:k >= N refreshes every row through the exact chain —
+    the whole trajectory is bitwise the exact scorer's (the ladder's
+    parity idiom), which also pins the shortlist refresh's per-row float
+    choreography against the full pass."""
+    r_exact = _run(task, CODAHyperparams(n_parallel=2))
+    r_par = _run(task, CODAHyperparams(eig_scorer="surrogate:64",
+                                       n_parallel=2))
+    assert _trees_equal(r_exact, r_par)
+
+
+def test_parse_scorer_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown eig_scorer"):
+        sg.parse_scorer("surrogate")
+    with pytest.raises(ValueError, match="unknown eig_scorer"):
+        sg.parse_scorer("surrogate:0")
+    with pytest.raises(ValueError, match="unknown eig_scorer"):
+        make_coda(make_synthetic_task(seed=0, H=4, N=16, C=3).preds,
+                  CODAHyperparams(eig_scorer="nope"))
+
+
+def test_surrogate_requires_incremental_tier(task):
+    with pytest.raises(ValueError, match="incremental"):
+        make_coda(task.preds, CODAHyperparams(eig_scorer="surrogate:8",
+                                              eig_mode="factored"))
+    with pytest.raises(ValueError, match="pallas"):
+        make_coda(task.preds, CODAHyperparams(eig_scorer="surrogate:8",
+                                              eig_backend="pallas"))
+
+
+# ---------------------------------------------------------------------------
+# the structural contract
+# ---------------------------------------------------------------------------
+
+def _drive(task, hp, rounds, seed=0):
+    sel = make_coda(task.preds, hp)
+    st = jax.jit(sel.init)(jax.random.PRNGKey(seed))
+    upd = jax.jit(sel.update)
+    slx = jax.jit(sel.select)
+    key = jax.random.PRNGKey(seed + 1)
+    for _ in range(rounds):
+        key, k = jax.random.split(key)
+        res = slx(st, k)
+        st = upd(st, res.idx, task.labels[res.idx], res.prob)
+    return sel, st, key
+
+
+def test_selected_index_score_is_exact(task):
+    """The shortlist-rows-are-exact property: on every round (warmup AND
+    surrogate-scored), the index selection argmaxes carries the exact
+    chain's score, never a raw prediction."""
+    hp = CODAHyperparams(eig_scorer="surrogate:8")
+    sel = make_coda(task.preds, hp)
+    st = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    upd = jax.jit(sel.update)
+    slx = jax.jit(sel.select)
+    score_exact = jax.jit(sel.extras["score_exact"])
+    key = jax.random.PRNGKey(1)
+    surrogate_rounds = 0
+    for _ in range(sg.SURROGATE_WARMUP_ROUNDS + 15):
+        key, k = jax.random.split(key)
+        res = slx(st, k)
+        exact = np.asarray(score_exact(st))
+        got = np.asarray(st.eig_scores_cached)
+        i = int(res.idx)
+        assert exact[i].tobytes() == got[i].tobytes()
+        if (int(st.surrogate.rounds) > sg.SURROGATE_WARMUP_ROUNDS
+                and not bool(st.surrogate.last_fallback)):
+            surrogate_rounds += 1
+            # ...and on surrogate rounds the vector genuinely differs
+            # off-shortlist (this is not the exact pass in disguise)
+        st = upd(st, res.idx, task.labels[res.idx], res.prob)
+    assert surrogate_rounds > 0, "the surrogate never carried a round"
+
+
+def test_forced_violation_falls_back_bitwise(task):
+    """Corrupting the fit weights makes the gate trip and the round run
+    the FULL exact pass: the produced score vector is bitwise the exact
+    config's round, and the fallback is counted + flagged."""
+    hp = CODAHyperparams(eig_scorer="surrogate:8")
+    sel, st, key = _drive(task, hp, sg.SURROGATE_WARMUP_ROUNDS + 3)
+    assert int(st.surrogate.rounds) > sg.SURROGATE_WARMUP_ROUNDS
+    # corrupt the solved weights: predictions become garbage, so the
+    # escape/audit/delta gate must trip on the next update
+    bad_fit = st.surrogate._replace(
+        w=jnp.full_like(st.surrogate.w, 1e3))
+    st_bad = st._replace(surrogate=bad_fit)
+    upd = jax.jit(sel.update)
+    slx = jax.jit(sel.select)
+    key, k = jax.random.split(key)
+    res = slx(st_bad, k)
+    fb0 = int(st_bad.surrogate.fallbacks)
+    st_after = upd(st_bad, res.idx, task.labels[res.idx], res.prob)
+    assert bool(st_after.surrogate.last_fallback)
+    assert int(st_after.surrogate.fallbacks) == fb0 + 1
+    # the fallback round's scores are bitwise the exact scorer's
+    exact_scores = np.asarray(
+        jax.jit(sel.extras["score_exact"])(st_after))
+    got = np.asarray(st_after.eig_scores_cached)
+    assert exact_scores.tobytes() == got.tobytes()
+
+
+def test_fallback_rate_and_margin_counters(task):
+    """Healthy run: warmup rounds are never counted as fallbacks, the
+    fit refolds every round, and the margin gauge is finite once the
+    surrogate scores rounds."""
+    hp = CODAHyperparams(eig_scorer="surrogate:16")
+    _, st, _ = _drive(task, hp, sg.SURROGATE_WARMUP_ROUNDS + 10)
+    fit = st.surrogate
+    assert int(fit.rounds) == sg.SURROGATE_WARMUP_ROUNDS + 10
+    assert int(fit.fits) == int(fit.rounds)
+    assert int(fit.fallbacks) <= 10  # never counts warmup
+    assert np.isfinite(float(fit.margin))
+
+
+# ---------------------------------------------------------------------------
+# real-digits regret envelope
+# ---------------------------------------------------------------------------
+
+def test_digits_100_round_regret_envelope():
+    """The acceptance trace: 100 labels of real digits under the
+    surrogate stay inside the committed envelope of the exact scorer's
+    label-weighted cumulative regret."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    from check_perf import (
+        SURROGATE_ENVELOPE_ABS,
+        SURROGATE_ENVELOPE_RATIO,
+    )
+
+    from coda_tpu.data import Dataset, find_task_file
+
+    fp = find_task_file(os.path.join(os.path.dirname(__file__), "..",
+                                     "data"), "digits")
+    ds = Dataset.from_file(fp, name="digits")
+    exact = _run(ds, CODAHyperparams(n_parallel=2), iters=100, seeds=2)
+    surr = _run(ds, CODAHyperparams(eig_scorer="surrogate:32",
+                                    n_parallel=2), iters=100, seeds=2)
+    ce = float(np.asarray(exact.cumulative_regret)[:, -1].mean())
+    cs = float(np.asarray(surr.cumulative_regret)[:, -1].mean())
+    assert cs <= SURROGATE_ENVELOPE_RATIO * ce + SURROGATE_ENVELOPE_ABS, \
+        f"surrogate digits regret {cs} outside envelope of exact {ce}"
+
+
+# ---------------------------------------------------------------------------
+# composition: q-wide, sparse tier
+# ---------------------------------------------------------------------------
+
+def test_sparse_tier_composition(task):
+    """surrogate:k >= N composed with the sparse tier is bitwise the
+    sparse tier's exact run (the parity rung composes within the
+    representation — dense-vs-sparse itself is the PR 9 contract, not
+    this one); and a truncated sparse:K surrogate run stays finite with
+    the fit carried on the sparse state."""
+    r_sparse = _run(task, CODAHyperparams(posterior="sparse:8",
+                                          n_parallel=2))
+    r_both = _run(task, CODAHyperparams(eig_scorer="surrogate:64",
+                                        posterior="sparse:8",
+                                        n_parallel=2))
+    assert _trees_equal(r_sparse, r_both)  # K=8 >= C=5, k=64 >= N=64
+    hp = CODAHyperparams(eig_scorer="surrogate:8", posterior="sparse:3")
+    sel, st, _ = _drive(task, hp, sg.SURROGATE_WARMUP_ROUNDS + 4)
+    assert st.sparse is not None and st.dirichlets is None
+    assert np.isfinite(np.asarray(st.eig_scores_cached)).all()
+    assert int(st.surrogate.rounds) == sg.SURROGATE_WARMUP_ROUNDS + 4
+
+
+def test_q_wide_composition(task):
+    """--acq-batch q drives select_q (re-ranking the surrogate-produced
+    hybrid vector unchanged) and the fused update_q (one multi-row
+    refresh + one surrogate pass per round): the q-wide surrogate run
+    stays inside the envelope of the q-wide exact run at the same label
+    budget, and the fit counters advance per ROUND."""
+    iters, q = 10, 4
+    r_exact = run_seeds_compiled(
+        lambda p: make_coda(p, CODAHyperparams(n_parallel=1)),
+        task.preds, task.labels, iters=iters, seeds=1, acq_batch=q)
+    r_surr = run_seeds_compiled(
+        lambda p: make_coda(p, CODAHyperparams(
+            eig_scorer="surrogate:16", n_parallel=1)),
+        task.preds, task.labels, iters=iters, seeds=1, acq_batch=q)
+    ce = float(np.asarray(r_exact.cumulative_regret)[0, -1])
+    cs = float(np.asarray(r_surr.cumulative_regret)[0, -1])
+    assert cs <= 1.5 * ce + 1.0  # the batchq envelope class
+    # fused update_q threads the fit: counters advance once per round
+    sel = make_coda(task.preds, CODAHyperparams(
+        eig_scorer="surrogate:16", n_parallel=1))
+    from coda_tpu.selectors.batch import resolve_batch_fns
+
+    sel_q, upd_q = resolve_batch_fns(sel, q)
+    st = jax.jit(sel.init)(jax.random.PRNGKey(0))
+    res = jax.jit(lambda s, k: sel_q(s, k))(st, jax.random.PRNGKey(1))
+    st2 = jax.jit(upd_q)(st, res.idx, task.labels[res.idx], res.prob)
+    assert int(st2.surrogate.rounds) == 1
+    assert int(st2.surrogate.fits) == 1
+
+
+# ---------------------------------------------------------------------------
+# resolver budget boundary
+# ---------------------------------------------------------------------------
+
+def test_resolver_charges_scorer_tier():
+    """The auto eig_mode budget prices the scorer: the C=1000 x H=2000
+    HF-pool shape at N=256 (sparse:32) exceeds the incremental budget
+    under the exact scorer's full-stream pricing but resolves to the
+    cheap tier under the surrogate — pinned BOTH ways, like the PR 9
+    posterior term."""
+    from coda_tpu.selectors.coda import resolve_eig_mode
+
+    H, N, C = 2000, 256, 1000
+    assert resolve_eig_mode(
+        CODAHyperparams(posterior="sparse:32"), H, N, C) == "rowscan"
+    assert resolve_eig_mode(
+        CODAHyperparams(posterior="sparse:32",
+                        eig_scorer="surrogate:64"), H, N, C) \
+        == "incremental"
+    # the existing pins must not have moved (PR 9's boundary)
+    assert resolve_eig_mode(
+        CODAHyperparams(posterior="sparse:32"), 2000, 64, C) \
+        == "incremental"
+    assert resolve_eig_mode(CODAHyperparams(), 500, 256, C) \
+        == "incremental"
+
+
+# ---------------------------------------------------------------------------
+# recorder / replay integration
+# ---------------------------------------------------------------------------
+
+def test_record_v3_carries_fallback_stream(task, tmp_path):
+    """New records are v3 with the per-round surrogate_fallback array
+    (all-False for exact scorers), schema-valid, and bitwise
+    self-replayable."""
+    import os
+    import sys
+
+    from coda_tpu.engine.loop import run_seeds_recorded
+    from coda_tpu.engine.replay import verify_replay
+    from coda_tpu.telemetry.recorder import (
+        KNOB_FIELDS,
+        RECORD_SCHEMA_VERSION,
+        RunRecord,
+        environment_fingerprint,
+    )
+
+    assert "eig_scorer" in KNOB_FIELDS
+    assert RECORD_SCHEMA_VERSION >= 3
+    hp = CODAHyperparams(eig_scorer="surrogate:8", n_parallel=2)
+    factory = (lambda preds: make_coda(preds, hp))
+    result, aux = run_seeds_recorded(
+        factory, task.preds, task.labels,
+        iters=sg.SURROGATE_WARMUP_ROUNDS + 6, seeds=2, trace_k=4)
+    fp = environment_fingerprint(
+        dataset=task, knobs={"method": "coda", "loss": "acc",
+                             "eig_scorer": "surrogate:8",
+                             "n_parallel": 2})
+    record = RunRecord.from_result(result, aux, fp,
+                                   run={"task": task.name, "iters":
+                                        sg.SURROGATE_WARMUP_ROUNDS + 6,
+                                        "seeds": 2, "method": "coda",
+                                        "loss": "acc"})
+    rec_dir = tmp_path / "surrogate_rec"
+    record.save(str(rec_dir))
+    fb = record.arrays["surrogate_fallback"]
+    assert fb.dtype.kind == "b" and fb.shape == (
+        2, sg.SURROGATE_WARMUP_ROUNDS + 6)
+    # schema checker accepts the v3 layout
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    from check_record_schema import check_record
+
+    assert check_record(str(rec_dir)) == []
+    # bitwise self-replay through the identical program
+    report = verify_replay(record, factory, task.preds, task.labels,
+                           loss="acc", score_tol=0.0)
+    assert report.parity
+
+
+def test_against_exact_triages_as_scorer_envelope(task):
+    """compare_records routes a surrogate-vs-exact knob diff through the
+    regret-envelope triage (classification eig-scorer-envelope) instead
+    of reporting a fake bitwise divergence."""
+    from coda_tpu.engine.loop import run_seeds_recorded
+    from coda_tpu.engine.replay import compare_records
+    from coda_tpu.telemetry.recorder import (
+        RunRecord,
+        environment_fingerprint,
+    )
+
+    iters = sg.SURROGATE_WARMUP_ROUNDS + 8
+
+    def rec(scorer):
+        hp = CODAHyperparams(eig_scorer=scorer, n_parallel=1)
+        result, aux = run_seeds_recorded(
+            lambda preds: make_coda(preds, hp), task.preds, task.labels,
+            iters=iters, seeds=1, trace_k=4)
+        fp = environment_fingerprint(
+            dataset=task, knobs={"method": "coda", "eig_scorer": scorer})
+        return RunRecord.from_result(
+            result, aux, fp, run={"task": task.name, "iters": iters,
+                                  "seeds": 1, "method": "coda",
+                                  "loss": "acc"})
+
+    a, b = rec("exact"), rec("surrogate:8")
+    report = compare_records(a, b)
+    assert report.seeds[0].classification == "eig-scorer-envelope"
+    env = report.meta["scorer_envelope"]
+    assert env["scorer_a"] == "exact"
+    assert env["scorer_b"] == "surrogate:8"
+    assert "eig_scorer" in report.meta["knob_diff"]
+    # same-scorer records still compare through the bitwise path
+    report2 = compare_records(a, rec("exact"))
+    assert report2.parity
+
+
+# ---------------------------------------------------------------------------
+# serving
+# ---------------------------------------------------------------------------
+
+def test_serve_bucket_and_export_import_roundtrip(task):
+    """A surrogate-spec bucket warms/compiles, serves labels, surfaces
+    the surrogate counters on /stats + lint-clean /metrics, and a
+    session export/import round-trips the fit state BITWISE."""
+    from coda_tpu.serve import SelectorSpec, ServeApp
+    from coda_tpu.telemetry import prometheus
+
+    def mk():
+        app = ServeApp(capacity=2, max_wait=0.001,
+                       spec=SelectorSpec.create(
+                           "coda", n_parallel=2,
+                           eig_scorer="surrogate:8"))
+        app.add_task("tiny", task.preds)
+        app.start()
+        return app
+
+    labels = np.asarray(task.labels)
+    app = mk()
+    try:
+        out = app.open_session()
+        sid = out["session"]
+        for _ in range(6):
+            out = app.label(sid, int(labels[out["idx"]]),
+                            idx=out["idx"])
+        snap = app.stats()
+        assert snap["surrogate_rounds"] >= 6
+        assert snap["surrogate_fit_refreshes"] >= 6
+        assert snap["buckets"][0]["surrogate"]["rounds"] >= 6
+        text = prometheus.render(app.telemetry.registry,
+                                 serve_metrics=app.metrics)
+        assert prometheus.lint(text) == []
+        # gauges, not _total counters: live-slot sums may decrease when
+        # sessions close/demote/migrate away
+        assert "coda_serve_surrogate_rounds " in text or \
+            "coda_serve_surrogate_rounds{" in text
+        assert "coda_serve_surrogate_rounds_total" not in text
+        payload = app.export_session(sid, close=True)
+        app2 = mk()
+        try:
+            info = app2.import_session(payload)
+            assert info.get("restored_via") == "snapshot"
+            payload2 = app2.export_session(sid)
+            assert [c["data"] for c in payload["carries"]] == \
+                [c["data"] for c in payload2["carries"]]
+            assert [tuple(c["shape"]) for c in payload["carries"]] == \
+                [tuple(c["shape"]) for c in payload2["carries"]]
+            res = app2.label(sid, int(labels[out["idx"]]),
+                             idx=out["idx"])
+            assert res["n_labeled"] == 7
+        finally:
+            app2.drain(timeout=5.0)
+    finally:
+        app.drain(timeout=5.0)
+
+
+def test_exact_server_has_no_surrogate_families(task):
+    """Exact-scorer servers carry NO surrogate keys/families — absent,
+    not zero (the families only exist where the rung runs)."""
+    from coda_tpu.serve import SelectorSpec, ServeApp
+    from coda_tpu.telemetry import prometheus
+
+    app = ServeApp(capacity=2, max_wait=0.001,
+                   spec=SelectorSpec.create("coda", n_parallel=2))
+    app.add_task("tiny", task.preds)
+    app.start()
+    try:
+        out = app.open_session()
+        app.label(out["session"],
+                  int(np.asarray(task.labels)[out["idx"]]),
+                  idx=out["idx"])
+        snap = app.stats()
+        assert "surrogate_rounds" not in snap
+        assert snap["buckets"][0]["surrogate"] is None
+        text = prometheus.render(app.telemetry.registry,
+                                 serve_metrics=app.metrics)
+        assert "surrogate" not in text
+        assert prometheus.lint(text) == []
+    finally:
+        app.drain(timeout=5.0)
