@@ -176,6 +176,26 @@ def parse_args(argv=None):
                         "pass when violated; warmup rounds are always "
                         "exact (incremental tier only; surrogate:k>=N is "
                         "bitwise-equal to exact)")
+    p.add_argument("--oracle-noise", default=None, metavar="SPEC",
+                   help="crowd-oracle spec: omitted/'clean' = the plain "
+                        "perfect oracle (bitwise-pinned program); else "
+                        "comma k=v pairs, e.g. 'annotators=8,votes=3,"
+                        "acc=0.55:0.95,abstain=0.1,adversarial=1,trust=32,"
+                        "reliability=learned,seed=0' — per-annotator "
+                        "confusion noise, abstention, poisoned annotators, "
+                        "with a jointly-learned Dawid-Skene reliability "
+                        "posterior weighting every label update "
+                        "(ARCHITECTURE.md 'Oracles')")
+    p.add_argument("--oracle-annotators", type=int, default=None,
+                   metavar="A",
+                   help="override the crowd pool size of --oracle-noise "
+                        "(sweep convenience; ignored when clean)")
+    p.add_argument("--oracle-reliability", default=None,
+                   choices=["learned", "majority"],
+                   help="override the aggregation mode of --oracle-noise: "
+                        "learned = trust-gated Dawid-Skene posterior "
+                        "weights (default), majority = plain majority "
+                        "vote (the ablation arm)")
     p.add_argument("--pi-update", default="auto",
                    choices=["auto", "delta", "exact"],
                    help="incremental pi-hat refresh: auto (default) = exact "
@@ -476,9 +496,8 @@ def main(argv=None):
     with profiler_trace(args.profile_dir):
         with tele_span("experiment", method=args.method, iters=args.iters,
                        seeds=args.seeds):
-            result, record_aux = _run_all_seeds(args, factory, selector,
-                                                dataset, model_losses,
-                                                loss_fn)
+            result, record_aux, crowd_aux = _run_all_seeds(
+                args, factory, selector, dataset, model_losses, loss_fn)
             result.regret.block_until_ready()
     if args.profile_dir:
         print(f"Profiler trace written to {args.profile_dir}")
@@ -504,7 +523,8 @@ def main(argv=None):
                  "data_dir": args.data_dir, "method": args.method,
                  "loss": args.loss, "iters": args.iters,
                  "seeds": args.seeds,
-                 "acq_batch": getattr(args, "acq_batch", 1)})
+                 "acq_batch": getattr(args, "acq_batch", 1)},
+            crowd=crowd_aux)
         record.save(args.record_dir,
                     registry=telemetry.registry if telemetry else None)
         print(f"decision record written to {args.record_dir} "
@@ -566,13 +586,57 @@ def main(argv=None):
 
 
 def _run_all_seeds(args, factory, selector, dataset, model_losses, loss_fn):
-    """Returns ``(ExperimentResult, RunTraceAux | None)`` — the aux is the
-    flight-recorder sidecar, present only under ``--record-dir``."""
+    """Returns ``(ExperimentResult, RunTraceAux | None, CrowdAux | None)``
+    — the first aux is the flight-recorder sidecar (present only under
+    ``--record-dir``), the second the crowd-oracle provenance (present
+    only under a noisy ``--oracle-noise``)."""
     import jax
 
     from coda_tpu.engine import run_seeds_compiled, run_seeds_recorded
 
     acq_batch = max(1, int(getattr(args, "acq_batch", 1) or 1))
+    spec = getattr(args, "oracle_noise", None)
+    if spec is not None:
+        from coda_tpu.crowd import parse_oracle_spec
+
+        crowd_cfg = parse_oracle_spec(spec)
+        if getattr(args, "oracle_annotators", None):
+            crowd_cfg = crowd_cfg._replace(
+                annotators=int(args.oracle_annotators))
+        if getattr(args, "oracle_reliability", None):
+            crowd_cfg = crowd_cfg._replace(
+                reliability=args.oracle_reliability)
+        if crowd_cfg.adversarial >= crowd_cfg.annotators:
+            raise SystemExit(
+                "--oracle-annotators override leaves no honest annotator "
+                f"(adversarial={crowd_cfg.adversarial} of "
+                f"{crowd_cfg.annotators})")
+        # a CLEAN spec falls through to the engine paths below — the
+        # crowd wrappers would delegate to the same programs, but falling
+        # through keeps the cost-capture plumbing identical too
+        if not crowd_cfg.clean:
+            if args.checkpoint_dir:
+                raise SystemExit(
+                    "--oracle-noise does not compose with "
+                    "--checkpoint-dir: the chunked resumable runner "
+                    "drives the perfect-oracle step; drop one flag")
+            from coda_tpu.crowd import (
+                run_seeds_crowd,
+                run_seeds_crowd_recorded,
+            )
+
+            if getattr(args, "record_dir", None):
+                result, run_aux, crowd_aux = run_seeds_crowd_recorded(
+                    factory, dataset.preds, dataset.labels, crowd_cfg,
+                    iters=args.iters, seeds=args.seeds, loss_fn=loss_fn,
+                    trace_k=getattr(args, "record_topk", 8),
+                    acq_batch=acq_batch)
+                return result, run_aux, crowd_aux
+            result, crowd_aux = run_seeds_crowd(
+                factory, dataset.preds, dataset.labels, crowd_cfg,
+                iters=args.iters, seeds=args.seeds, loss_fn=loss_fn,
+                acq_batch=acq_batch)
+            return result, None, crowd_aux
     if args.checkpoint_dir:
         if getattr(args, "record_dir", None):
             raise SystemExit(
@@ -601,19 +665,19 @@ def _run_all_seeds(args, factory, selector, dataset, model_losses, loss_fn):
         import jax.numpy as jnp
 
         result = jax.tree.map(lambda *xs: jnp.stack(xs), *per_seed)
-        return result, None
+        return result, None, None
     if getattr(args, "record_dir", None):
-        return run_seeds_recorded(factory, dataset.preds, dataset.labels,
-                                  iters=args.iters, seeds=args.seeds,
-                                  loss_fn=loss_fn,
-                                  trace_k=getattr(args, "record_topk", 8),
-                                  cost_label=args.method,
-                                  acq_batch=acq_batch)
+        result, run_aux = run_seeds_recorded(
+            factory, dataset.preds, dataset.labels,
+            iters=args.iters, seeds=args.seeds, loss_fn=loss_fn,
+            trace_k=getattr(args, "record_topk", 8),
+            cost_label=args.method, acq_batch=acq_batch)
+        return result, run_aux, None
     result = run_seeds_compiled(factory, dataset.preds, dataset.labels,
                                 iters=args.iters, seeds=args.seeds,
                                 loss_fn=loss_fn, cost_label=args.method,
                                 acq_batch=acq_batch)
-    return result, None
+    return result, None, None
 
 
 if __name__ == "__main__":

@@ -1,0 +1,44 @@
+"""Crowd oracle subsystem: noisy, abstaining, asynchronous labelers.
+
+The rest of the stack assumes ONE clean synchronous oracle
+(``coda_tpu/oracle.py``). This package is the production-labeling tier:
+
+  * :mod:`coda_tpu.crowd.oracle` — the crowd model: per-annotator
+    confusion matrices from a seeded generator (honest, adversarial),
+    the oracle verb vocabulary (answer / abstain / defer / poison), a
+    device-side vote sampler for the compiled scan and a host-side
+    deterministic sampler (:class:`HostCrowdSampler`) for the serve
+    front door and the loadgen;
+  * :mod:`coda_tpu.crowd.reliability` — the jointly-learned
+    Dawid-Skene-style annotator-reliability posterior (per-annotator
+    confusion Dirichlets carried in the scan), its vote aggregation,
+    and the trust gate that degrades to majority-vote weighting until
+    the posterior has seen enough votes;
+  * :mod:`coda_tpu.crowd.loop` — the crowd experiment loop: the
+    engine's ``lax.scan`` with (selector state, reliability state)
+    jointly carried, answers applied through the selectors'
+    reliability-weighted updates (``update_w``/``update_qw``). A clean
+    config routes through the UNMODIFIED engine program — bitwise the
+    plain run.
+"""
+
+from coda_tpu.crowd.oracle import (  # noqa: F401
+    CrowdConfig,
+    HostCrowdSampler,
+    make_annotators,
+    parse_oracle_spec,
+    sample_votes,
+)
+from coda_tpu.crowd.reliability import (  # noqa: F401
+    ReliabilityState,
+    aggregate_votes,
+    annotator_accuracy,
+    init_reliability,
+)
+from coda_tpu.crowd.loop import (  # noqa: F401
+    build_crowd_experiment_fn,
+    build_recording_crowd_experiment_fn,
+    make_crowd_step_fn,
+    run_seeds_crowd,
+    run_seeds_crowd_recorded,
+)

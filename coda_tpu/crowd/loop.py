@@ -1,0 +1,322 @@
+"""The crowd experiment loop: joint (model, annotator) posterior scan.
+
+The engine's program is ``scan(select -> oracle -> update -> best)`` with
+the oracle a perfect table lookup. Here the oracle is a crowd: each round
+the chosen point's TRUE label seeds a deterministic vote draw from the
+annotator pool, the Dawid-Skene reliability posterior aggregates the
+votes into an applied label + reliability weight, and the selector's
+weighted update (``update_w`` / the fused ``update_qw``) applies it. The
+reliability posterior rides the scan carry NEXT TO the model posterior —
+both are updated jointly every round, with no host round-trip.
+
+Key choreography is the engine's exactly: ``k_init, k_prior, k_scan =
+split(key, 3)``; per round ``k_sel, k_best = split(k)``. The crowd's vote
+randomness comes from ``fold_in(k, CROWD_SALT)`` — a key the plain
+program never consumes — so select/best see the identical stream.
+
+**Clean configs run the engine's own program**: ``cfg.clean`` is a
+static Python branch delegating to ``engine/loop.py`` verbatim (same
+functions, same jaxpr), which is what pins the clean-oracle rung bitwise
+at every layer above (records, replay, serve).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from coda_tpu.crowd.oracle import CROWD_SALT, CrowdConfig, make_annotators, \
+    sample_votes
+from coda_tpu.crowd.reliability import aggregate_votes, annotator_accuracy, \
+    init_reliability
+from coda_tpu.engine.loop import (
+    ExperimentResult,
+    RunTraceAux,
+    _validate_rounds,
+    build_experiment_fn,
+    build_recording_experiment_fn,
+    key_bits,
+    make_round_trace,
+)
+from coda_tpu.losses import accuracy_loss
+from coda_tpu.oracle import true_losses as compute_true_losses
+from coda_tpu.selectors.protocol import Selector
+
+
+class CrowdAux(NamedTuple):
+    """Per-round crowd provenance (leading axis = round; with acq_batch q
+    the first three carry a trailing (q,) answer axis)."""
+
+    oracle_label: jnp.ndarray      # ground-truth label of the chosen point
+    applied_label: jnp.ndarray     # the aggregated label the update saw
+    label_weight: jnp.ndarray      # its reliability weight in [0, 1]
+    annotator_accuracy: jnp.ndarray  # (T, A) posterior-mean accuracies
+
+
+def _require_weighted(selector: Selector) -> None:
+    if selector.update_w is None:
+        raise ValueError(
+            f"selector {selector.name!r} has no reliability-weighted "
+            "update (update_w); the crowd oracle needs one — run the "
+            "clean oracle instead")
+
+
+def make_crowd_step_fn(
+    selector: Selector,
+    labels: jnp.ndarray,
+    model_losses: jnp.ndarray,
+    cfg: CrowdConfig,
+    confusions: jnp.ndarray,
+    trace_k: int = 0,
+    acq_batch: int = 1,
+):
+    """One crowd labeling round as a pure scan step over the carry
+    ``(selector state, reliability state, cumulative regret)``.
+
+    Mirrors ``engine.loop.make_step_fn`` — same key splits, same named
+    scopes, same output tuple — plus a :class:`CrowdAux` entry appended
+    AFTER the engine outputs (and after the optional RoundTrace), so the
+    engine's harvest order is untouched.
+    """
+    assert not cfg.clean, "clean configs run the engine step (bitwise pin)"
+    _require_weighted(selector)
+    best_loss = model_losses.min()
+
+    def crowd_answer(rel, k, true_class, j: int = 0):
+        """One answer's votes + aggregation (fold_in keeps the engine's
+        select/best key stream untouched; j salts q-wide answers)."""
+        k_crowd = jax.random.fold_in(k, CROWD_SALT + j)
+        ann_ids, responses, answered = sample_votes(
+            k_crowd, confusions, true_class, cfg)
+        return aggregate_votes(rel, ann_ids, responses, answered, cfg)
+
+    if acq_batch > 1:
+        from coda_tpu.selectors.batch import resolve_batch_wfns
+
+        sel_q, upd_qw = resolve_batch_wfns(selector, acq_batch)
+
+        def step_q(carry, k):
+            state, rel, cum = carry
+            k_sel, k_best = jax.random.split(k)
+            with jax.named_scope("select_q"):
+                res = sel_q(state, k_sel)
+            tcs = labels[res.idx]                      # (q,) ground truth
+            zs, ws = [], []
+            with jax.named_scope("crowd"):
+                # the reliability posterior chains through the q answers
+                # (q is static and small — the scatter_rows idiom)
+                for j in range(acq_batch):
+                    z_j, w_j, rel = crowd_answer(rel, k, tcs[j], j)
+                    zs.append(z_j)
+                    ws.append(w_j)
+                applied = jnp.stack(zs)
+                weights = jnp.stack(ws)
+            with jax.named_scope("update_qw"):
+                state = upd_qw(state, res.idx, applied, res.prob, weights)
+            with jax.named_scope("best"):
+                best, b_stoch = selector.best(state, k_best)
+            regret = model_losses[best] - best_loss
+            cum = cum + acq_batch * regret             # label-weighted
+            outs = (res.idx, applied, best, regret, cum, res.prob,
+                    res.stochastic | b_stoch)
+            if trace_k:
+                with jax.named_scope("record"):
+                    outs = outs + (make_round_trace(selector, res, state,
+                                                    k, trace_k),)
+            aux = CrowdAux(oracle_label=tcs, applied_label=applied,
+                           label_weight=weights,
+                           annotator_accuracy=annotator_accuracy(rel))
+            return (state, rel, cum), outs + (aux,)
+
+        return step_q
+
+    def step(carry, k):
+        state, rel, cum = carry
+        k_sel, k_best = jax.random.split(k)
+        with jax.named_scope("select"):
+            res = selector.select(state, k_sel)
+        tc = labels[res.idx]                           # ground truth
+        with jax.named_scope("crowd"):
+            applied, weight, rel = crowd_answer(rel, k, tc)
+        with jax.named_scope("update_w"):
+            state = selector.update_w(state, res.idx, applied, res.prob,
+                                      weight)
+        with jax.named_scope("best"):
+            best, b_stoch = selector.best(state, k_best)
+        regret = model_losses[best] - best_loss
+        cum = cum + regret
+        outs = (res.idx, applied, best, regret, cum, res.prob,
+                res.stochastic | b_stoch)
+        if trace_k:
+            with jax.named_scope("record"):
+                outs = outs + (make_round_trace(selector, res, state, k,
+                                                trace_k),)
+        aux = CrowdAux(oracle_label=tc, applied_label=applied,
+                       label_weight=weight,
+                       annotator_accuracy=annotator_accuracy(rel))
+        return (state, rel, cum), outs + (aux,)
+
+    return step
+
+
+def _crowd_experiment(selector, labels, model_losses, cfg, iters,
+                      trace_k, acq_batch):
+    """The shared scan driver behind both build_* variants."""
+    best_loss = model_losses.min()
+    N = labels.shape[0]
+    _validate_rounds(selector, N, iters, acq_batch)
+    if isinstance(labels, jax.core.Tracer):
+        raise ValueError(
+            "the crowd loop needs concrete labels to size the annotator "
+            "confusions (got a traced labels array)")
+    import numpy as np
+
+    # host-side reduction: labels are a closed-over CONCRETE array (the
+    # guard above), and a jnp.max here would trace under the jit wrapper
+    n_classes = int(np.asarray(labels).max()) + 1
+    confusions = make_annotators(cfg, n_classes)
+    step = make_crowd_step_fn(selector, labels, model_losses, cfg,
+                              confusions, trace_k=trace_k,
+                              acq_batch=acq_batch)
+
+    def experiment(key: jax.Array):
+        k_init, k_prior, k_scan = jax.random.split(key, 3)
+        state0 = selector.init(k_init)
+        best0, stoch0 = selector.best(state0, k_prior)
+        regret0 = model_losses[best0] - best_loss
+        rel0 = init_reliability(cfg, n_classes)
+
+        keys = jax.random.split(k_scan, iters)
+        carry0 = (state0, rel0, jnp.asarray(0.0, jnp.float32))
+        if trace_k:
+            (_, _, _), (idxs, tcs, bests, regrets, cums, probs, stoch,
+                        trace, aux) = lax.scan(step, carry0, keys)
+        else:
+            (_, _, _), (idxs, tcs, bests, regrets, cums, probs, stoch,
+                        aux) = lax.scan(step, carry0, keys)
+            trace = None
+        result = ExperimentResult(
+            chosen_idx=idxs,
+            true_class=tcs,
+            best_model=bests,
+            regret=regrets,
+            cumulative_regret=cums,
+            select_prob=probs,
+            regret_at_0=regret0,
+            stochastic=stoch.any() | stoch0
+            | jnp.asarray(selector.always_stochastic),
+        )
+        if trace is None:
+            return result, aux
+        run_aux = RunTraceAux(trace=trace, root_key=key_bits(key),
+                              init_key=key_bits(k_init),
+                              prior_key=key_bits(k_prior))
+        return result, run_aux, aux
+
+    return experiment
+
+
+def build_crowd_experiment_fn(
+    selector: Selector,
+    labels: jnp.ndarray,
+    model_losses: jnp.ndarray,
+    cfg: CrowdConfig,
+    iters: int = 100,
+    acq_batch: int = 1,
+) -> Callable:
+    """``key -> (ExperimentResult, CrowdAux)`` for one seed. A clean
+    config returns ``(engine result, None)`` — the engine's own program,
+    bitwise (the crowd machinery never traces)."""
+    if cfg.clean:
+        base = build_experiment_fn(selector, labels, model_losses, iters,
+                                   acq_batch=acq_batch)
+        return lambda key: (base(key), None)
+    return _crowd_experiment(selector, labels, model_losses, cfg, iters,
+                             trace_k=0, acq_batch=acq_batch)
+
+
+def build_recording_crowd_experiment_fn(
+    selector: Selector,
+    labels: jnp.ndarray,
+    model_losses: jnp.ndarray,
+    cfg: CrowdConfig,
+    iters: int = 100,
+    trace_k: int = 8,
+    acq_batch: int = 1,
+) -> Callable:
+    """``key -> (ExperimentResult, RunTraceAux, CrowdAux)`` — the
+    flight-recorder variant; clean configs run the engine's recording
+    program with ``CrowdAux = None``."""
+    if cfg.clean:
+        base = build_recording_experiment_fn(
+            selector, labels, model_losses, iters, trace_k=trace_k,
+            acq_batch=acq_batch)
+
+        def clean(key):
+            result, aux = base(key)
+            return result, aux, None
+
+        return clean
+    N = labels.shape[0]
+    trace_k = max(1, min(int(trace_k), N))
+    return _crowd_experiment(selector, labels, model_losses, cfg, iters,
+                             trace_k=trace_k, acq_batch=acq_batch)
+
+
+def _run_crowd(selector_factory, preds, labels, cfg, iters, seeds,
+               loss_fn, trace_k, acq_batch):
+    labels = jnp.asarray(labels)
+
+    def fn(preds_arg, keys):
+        sel = selector_factory(preds_arg)
+        losses = compute_true_losses(preds_arg, labels, loss_fn)
+        exp = (build_recording_crowd_experiment_fn(
+                   sel, labels, losses, cfg, iters, trace_k=trace_k,
+                   acq_batch=acq_batch)
+               if trace_k else
+               build_crowd_experiment_fn(sel, labels, losses, cfg, iters,
+                                         acq_batch=acq_batch))
+        if keys.shape[0] == 1:
+            return jax.tree.map(lambda x: jnp.asarray(x)[None], exp(keys[0]))
+        return jax.vmap(exp)(keys)
+
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in range(seeds)])
+    return jax.jit(fn)(preds, keys)
+
+
+def run_seeds_crowd(
+    selector_factory: Callable[[jnp.ndarray], Selector],
+    preds: jnp.ndarray,
+    labels: jnp.ndarray,
+    cfg: CrowdConfig,
+    iters: int = 100,
+    seeds: int = 5,
+    loss_fn: Callable = accuracy_loss,
+    acq_batch: int = 1,
+):
+    """All seeds of the crowd experiment: ``(ExperimentResult,
+    CrowdAux | None)``, seed axis leading. The labels stay CONCRETE
+    (they size the annotator pool's confusion tensor at trace time)."""
+    return _run_crowd(selector_factory, preds, labels, cfg, iters, seeds,
+                      loss_fn, trace_k=0, acq_batch=acq_batch)
+
+
+def run_seeds_crowd_recorded(
+    selector_factory: Callable[[jnp.ndarray], Selector],
+    preds: jnp.ndarray,
+    labels: jnp.ndarray,
+    cfg: CrowdConfig,
+    iters: int = 100,
+    seeds: int = 5,
+    loss_fn: Callable = accuracy_loss,
+    trace_k: int = 8,
+    acq_batch: int = 1,
+):
+    """:func:`run_seeds_crowd` with the flight recorder on:
+    ``(ExperimentResult, RunTraceAux, CrowdAux | None)``."""
+    return _run_crowd(selector_factory, preds, labels, cfg, iters, seeds,
+                      loss_fn, trace_k=max(1, trace_k),
+                      acq_batch=acq_batch)

@@ -1,0 +1,238 @@
+"""The crowd model: seeded annotator pools and the oracle verb protocol.
+
+An annotator is a ``(C, C)`` row-stochastic confusion matrix: row ``z``
+is the response distribution when the true class is ``z``. Honest
+annotators put ``acc`` on the diagonal and spread the rest uniformly;
+adversarial (poisoned) annotators put their mass on the SHIFTED diagonal
+``(z + 1) % C`` — a systematic mislabeler the reliability posterior must
+learn to down-weight, not just average out.
+
+Verbs (the protocol beyond "answer now"):
+
+  * ``answer``  — a label drawn from the annotator's confusion row;
+  * ``abstain`` — no label this round (the slot stays open; a weighted
+    update with w=0 is the structural no-op fallback when every vote
+    abstains);
+  * ``defer``   — the answer arrives ``k`` rounds LATE, out of order
+    (host-side delivery semantics: the serve layer parks the slot and
+    the request-id dedupe makes redelivery idempotent);
+  * ``poison``  — the adversarial answer family above (also injectable
+    out-of-band at the serve answer site via ``serve/faults.py``'s
+    ``oracle_poison``).
+
+Everything is deterministic: the device-side sampler
+(:func:`sample_votes`) derives from the scan round's PRNG key via a
+fold-in salt (so the selection/best key stream of the clean run is
+untouched), and the host-side :class:`HostCrowdSampler` uses
+counter-addressed SHA-256 draws in the style of ``serve/faults.py`` —
+same (seed, session, round, slot) always produces the same verb.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fold-in salt separating the crowd's vote randomness from the engine's
+# select/best key stream (engine choreography: k_sel, k_best = split(k);
+# the crowd draws from fold_in(k, SALT) so a clean config consumes the
+# exact key material of the plain run)
+CROWD_SALT = 0xC403D
+
+
+class CrowdConfig(NamedTuple):
+    """One crowd-oracle configuration (parsed from ``--oracle-noise``)."""
+
+    spec: str = "clean"          # the original spec string (the knob)
+    clean: bool = True           # clean => the plain-oracle program runs
+    annotators: int = 8          # pool size A
+    votes: int = 3               # votes drawn per labeled item
+    acc_lo: float = 0.55         # honest-annotator accuracy range
+    acc_hi: float = 0.95
+    abstain: float = 0.0         # per-vote abstention probability
+    adversarial: int = 0         # poisoned annotators (last slots of the pool)
+    reliability: str = "learned"  # 'learned' (DS posterior) | 'majority'
+    trust_votes: float = 32.0    # pool votes before the learned gate opens
+    defer: float = 0.0           # per-answer deferral probability (serve verb)
+    defer_depth: int = 4         # max rounds an answer arrives late
+    seed: int = 0                # the annotator-pool / vote-stream seed
+
+
+def parse_oracle_spec(spec: Optional[str]) -> CrowdConfig:
+    """``None``/``'clean'`` -> the clean config; otherwise comma-separated
+    ``k=v`` pairs, e.g.
+    ``annotators=8,votes=3,acc=0.55:0.95,abstain=0.1,adversarial=1,
+    trust=32,defer=0.2:4,reliability=learned,seed=0``.
+    Fails loudly on unknown keys — the CLI forwards the string verbatim.
+    """
+    if spec is None or spec == "clean":
+        return CrowdConfig(spec="clean", clean=True)
+    cfg: dict = {"spec": spec, "clean": False}
+    for kv in filter(None, (s.strip() for s in spec.split(","))):
+        if "=" not in kv:
+            raise ValueError(f"oracle-noise param {kv!r} is not key=value")
+        k, v = kv.split("=", 1)
+        if k == "annotators":
+            cfg["annotators"] = int(v)
+        elif k == "votes":
+            cfg["votes"] = int(v)
+        elif k == "acc":
+            lo, _, hi = v.partition(":")
+            cfg["acc_lo"] = float(lo)
+            cfg["acc_hi"] = float(hi or lo)
+        elif k == "abstain":
+            cfg["abstain"] = float(v)
+        elif k == "adversarial":
+            cfg["adversarial"] = int(v)
+        elif k == "trust":
+            cfg["trust_votes"] = float(v)
+        elif k == "defer":
+            p, _, d = v.partition(":")
+            cfg["defer"] = float(p)
+            if d:
+                cfg["defer_depth"] = int(d)
+        elif k == "reliability":
+            if v not in ("learned", "majority"):
+                raise ValueError(
+                    f"oracle-noise reliability={v!r} (use 'learned' or "
+                    "'majority')")
+            cfg["reliability"] = v
+        elif k == "seed":
+            cfg["seed"] = int(v)
+        else:
+            raise ValueError(
+                f"unknown oracle-noise key {k!r} in {spec!r}")
+    out = CrowdConfig(**cfg)
+    if out.annotators < 1 or out.votes < 1:
+        raise ValueError(f"oracle-noise needs annotators >= 1 and "
+                         f"votes >= 1 (got {out.annotators}, {out.votes})")
+    if out.adversarial >= out.annotators:
+        raise ValueError(
+            f"adversarial={out.adversarial} must leave at least one "
+            f"honest annotator (pool of {out.annotators})")
+    if not (0.0 <= out.abstain < 1.0) or not (0.0 <= out.defer < 1.0):
+        raise ValueError("abstain/defer rates must be in [0, 1)")
+    return out
+
+
+def planted_accuracies(cfg: CrowdConfig) -> np.ndarray:
+    """The pool's (A,) diagonal accuracies — honest annotators drawn
+    uniformly from ``[acc_lo, acc_hi]`` by the seeded generator,
+    adversarial slots at ``acc_lo`` ON THE SHIFTED DIAGONAL (their true-
+    diagonal accuracy is the uniform remainder). Host-side numpy: built
+    once per experiment, the same values :func:`make_annotators` bakes
+    into the confusion tensor."""
+    rng = np.random.RandomState(cfg.seed)
+    return cfg.acc_lo + (cfg.acc_hi - cfg.acc_lo) * rng.rand(cfg.annotators)
+
+
+def make_annotators(cfg: CrowdConfig, n_classes: int) -> jnp.ndarray:
+    """The pool's ``(A, C, C)`` row-stochastic confusion matrices.
+
+    Deterministic in ``cfg.seed``. The last ``cfg.adversarial`` slots are
+    poisoned: their accuracy mass sits on ``(z + 1) % C`` instead of the
+    diagonal — a consistent wrong answer, the hardest case for naive
+    majority voting and the reason the reliability posterior exists.
+    """
+    A, C = cfg.annotators, n_classes
+    acc = planted_accuracies(cfg)                                # (A,)
+    eye = np.eye(C)
+    shift = np.eye(C)[:, list(range(1, C)) + [0]]                # (z+1)%C
+    off = (1.0 - acc)[:, None, None] / max(C - 1, 1)
+    conf = acc[:, None, None] * eye[None] + off * (1.0 - eye[None])
+    if cfg.adversarial:
+        bad = (acc[:, None, None] * shift[None]
+               + off * (1.0 - shift[None]))
+        is_bad = np.arange(A)[:, None, None] >= (A - cfg.adversarial)
+        conf = np.where(is_bad, bad, conf)
+    return jnp.asarray(conf, jnp.float32)
+
+
+def sample_votes(key, confusions: jnp.ndarray, true_class,
+                 cfg: CrowdConfig):
+    """Draw one round's crowd response inside the compiled scan.
+
+    Returns ``(ann_ids (V,) int32, responses (V,) int32, answered (V,)
+    bool)`` — ``V = cfg.votes`` annotators drawn uniformly with
+    replacement, each answering from its confusion row for
+    ``true_class`` or abstaining. Abstained slots keep a valid class id
+    (their response draw) but ``answered`` is False and every consumer
+    masks on it.
+    """
+    V = cfg.votes
+    k_who, k_resp, k_abst = jax.random.split(key, 3)
+    ann_ids = jax.random.randint(k_who, (V,), 0, cfg.annotators,
+                                 dtype=jnp.int32)
+    rows = confusions[ann_ids, true_class, :]                    # (V, C)
+    responses = jax.random.categorical(
+        k_resp, jnp.log(jnp.clip(rows, 1e-30, None)), axis=-1
+    ).astype(jnp.int32)
+    answered = (jax.random.uniform(k_abst, (V,)) >= cfg.abstain
+                if cfg.abstain > 0.0 else jnp.ones((V,), bool))
+    return ann_ids, responses, answered
+
+
+def _draw(seed: int, *fields) -> float:
+    """Counter-addressed uniform in [0, 1): a pure function of
+    ``(seed, fields...)`` — the ``serve/faults.py`` determinism idiom, so
+    a host-side crowd run replays exactly from its spec."""
+    h = hashlib.sha256(
+        ":".join([str(seed)] + [str(f) for f in fields]).encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+class HostCrowdSampler:
+    """Host-side deterministic crowd: the serve/loadgen half of the verb
+    protocol. Where the compiled scan samples votes from the round key,
+    the serve front door receives one answer per (session, round, slot) —
+    this class decides, reproducibly, WHAT that answer is and WHEN it
+    arrives.
+
+    ``answer(session, round, slot, true_label)`` returns a dict::
+
+        {"verb": "answer" | "abstain",
+         "label": int,          # the (possibly noisy) response
+         "annotator": int,      # who answered
+         "defer": int}          # rounds late (0 = deliver now)
+
+    A deferred answer is the SAME answer delivered late — the caller
+    (loadgen's ``--oracle-noise`` mode) holds it for ``defer`` rounds
+    and posts it out of order; the serve layer's slot parking plus
+    request-id dedupe make the delivery order immaterial.
+    """
+
+    def __init__(self, cfg: CrowdConfig, n_classes: int):
+        self.cfg = cfg
+        self.n_classes = n_classes
+        self.confusions = np.asarray(make_annotators(cfg, n_classes))
+
+    def answer(self, session: str, round_idx: int, slot: int,
+               true_label: int, attempt: int = 0) -> dict:
+        # `attempt` re-addresses the draw when a slot's annotator
+        # abstained and the caller re-requests the item (a different
+        # worker picks it up) — still a pure function of its key
+        cfg = self.cfg
+        key = (session, round_idx, slot, attempt)
+        ann = int(_draw(cfg.seed, "who", *key) * cfg.annotators)
+        ann = min(ann, cfg.annotators - 1)
+        if cfg.clean:
+            return {"verb": "answer", "label": int(true_label),
+                    "annotator": ann, "defer": 0}
+        if _draw(cfg.seed, "abstain", *key) < cfg.abstain:
+            return {"verb": "abstain", "label": int(true_label),
+                    "annotator": ann, "defer": 0}
+        # invert the annotator's confusion row CDF at a deterministic draw
+        row = self.confusions[ann, int(true_label)]
+        u = _draw(cfg.seed, "resp", *key)
+        label = int(np.searchsorted(np.cumsum(row), u))
+        label = min(label, self.n_classes - 1)
+        defer = 0
+        if cfg.defer > 0.0 and _draw(cfg.seed, "defer", *key) < cfg.defer:
+            defer = 1 + int(
+                _draw(cfg.seed, "depth", *key) * cfg.defer_depth)
+        return {"verb": "answer", "label": label, "annotator": ann,
+                "defer": defer}

@@ -1,0 +1,132 @@
+"""The jointly-learned annotator-reliability posterior (Dawid-Skene).
+
+Each annotator ``a`` carries a Dirichlet posterior over its ``(C, C)``
+confusion matrix — ``counts[a, z, r]`` is the (soft) number of times
+annotator ``a`` answered ``r`` when the aggregated label said ``z``,
+plus a symmetric Laplace prior. That is the classic Dawid-Skene model
+(per-annotator confusion, EM over items) restated as carried-fit
+statistics in the PR-14 surrogate style: a closed-form E-step per round
+inside the scan, no host round-trips, the whole state a small pytree
+riding next to the model posterior.
+
+Per labeling round with votes ``(a_v, r_v, answered_v)``:
+
+  1. **E-step (aggregate)**: ``log p(z) = Σ_v answered_v ·
+     log ĉonf_a_v[z, r_v] + log(1 + tally_z)`` — the vote likelihood
+     under the posterior-mean confusion ``ĉonf = counts /
+     counts.sum(-1)``, anchored by the majority tally as a log-prior
+     (the online restatement of batch Dawid-Skene's majority-vote EM
+     initialization; see the inline comment for why the unanchored
+     form collapses at cold start). The aggregated label is the
+     argmax, its posterior mass the *learned* reliability weight.
+  2. **Trust gate**: until the pool has accumulated
+     ``cfg.trust_votes`` answered votes, the learned estimate is one
+     noisy matrix judging another — the gate degrades aggregation to
+     MAJORITY VOTE (label = modal response, weight = modal fraction)
+     so an unconverged posterior can never poison the selection argmax.
+     Both branches are computed and a scalar ``jnp.where`` picks — the
+     lax.cond-under-vmap idiom the codebase's other gates use.
+  3. **M-step (update)**: ``counts[a_v, z, r_v] += answered_v ·
+     p(z)`` — the soft-assignment increment, so confident rounds teach
+     more than ambiguous ones.
+
+All-abstain rounds aggregate to weight 0 — combined with the weighted
+update's ``w=0`` structural no-op, the model posterior is untouched
+while the round still consumes its point.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from coda_tpu.crowd.oracle import CrowdConfig
+
+
+class ReliabilityState(NamedTuple):
+    """The carried annotator posterior (a scan-friendly pytree)."""
+
+    counts: jnp.ndarray   # (A, C, C) f32 — confusion Dirichlet counts
+    n_votes: jnp.ndarray  # scalar f32 — answered votes seen by the pool
+
+
+def init_reliability(cfg: CrowdConfig, n_classes: int) -> ReliabilityState:
+    """Symmetric Laplace prior (1 pseudo-count per cell): proper, and
+    the posterior-mean confusion starts uniform — no annotator is
+    trusted or distrusted before evidence arrives."""
+    A, C = cfg.annotators, n_classes
+    return ReliabilityState(
+        counts=jnp.ones((A, C, C), jnp.float32),
+        n_votes=jnp.asarray(0.0, jnp.float32),
+    )
+
+
+def annotator_accuracy(rel: ReliabilityState) -> jnp.ndarray:
+    """Posterior-mean diagonal accuracy per annotator, (A,) — the
+    quantity the robustness gate compares against the planted pool."""
+    conf = rel.counts / rel.counts.sum(-1, keepdims=True)
+    return jnp.diagonal(conf, axis1=-2, axis2=-1).mean(-1)
+
+
+def aggregate_votes(rel: ReliabilityState, ann_ids, responses, answered,
+                    cfg: CrowdConfig):
+    """One round's E-step + trust gate + M-step.
+
+    ``ann_ids``/``responses``/``answered`` are the (V,) vote arrays of
+    :func:`coda_tpu.crowd.oracle.sample_votes`. Returns
+    ``(label, weight, rel')`` — the aggregated label (int32 scalar), its
+    reliability weight in [0, 1] (f32 scalar, 0 when every vote
+    abstained), and the updated posterior.
+    """
+    C = rel.counts.shape[-1]
+    V = ann_ids.shape[0]
+    ans_f = answered.astype(jnp.float32)                          # (V,)
+    n_ans = ans_f.sum()
+
+    # -- majority-vote tally ----------------------------------------------
+    onehot = jax.nn.one_hot(responses, C, dtype=jnp.float32)      # (V, C)
+    tally = (ans_f[:, None] * onehot).sum(0)                      # (C,)
+    z_maj = jnp.argmax(tally).astype(jnp.int32)  # ties -> smallest class
+    w_maj = tally[z_maj] / jnp.clip(n_ans, 1.0, None)
+
+    # -- learned (Dawid-Skene) aggregation --------------------------------
+    conf = rel.counts / rel.counts.sum(-1, keepdims=True)         # (A, C, C)
+    # log-likelihood of each hypothesized true label z given the votes
+    ll_votes = jnp.log(jnp.clip(conf[ann_ids, :, responses],
+                                1e-30, None))                     # (V, C)
+    ll = (ans_f[:, None] * ll_votes).sum(0)                       # (C,)
+    # majority-anchored E-step: a near-uniform confusion posterior (the
+    # Laplace-prior cold start) has a FLAT likelihood whose argmax is a
+    # constant class — and teaching the M-step with that flat posterior
+    # keeps the confusions uniform forever (a self-reinforcing
+    # collapse). Anchoring with the vote tally as a log-prior makes the
+    # cold-start DS label degrade to majority vote, while the
+    # likelihood term (which grows with the sharpness of the learned
+    # confusions, not with round count) dominates once the posterior
+    # has real evidence — the classic majority-vote initialization of
+    # batch Dawid-Skene EM, restated for the online carried-fit form.
+    ll = ll + jnp.log1p(tally)
+    p_z = jax.nn.softmax(ll)                                      # (C,)
+    z_ds = jnp.argmax(p_z).astype(jnp.int32)
+    w_ds = p_z[z_ds]
+
+    # -- trust gate --------------------------------------------------------
+    trusted = (rel.n_votes >= cfg.trust_votes) if \
+        cfg.reliability == "learned" else jnp.asarray(False)
+    label = jnp.where(trusted, z_ds, z_maj)
+    weight = jnp.where(trusted, w_ds, w_maj)
+    # all-abstain round: no evidence at all -> weight 0 (the update's
+    # structural no-op); the label falls back to the majority slot's
+    # argmax over an all-zero tally (class 0) — immaterial under w=0
+    weight = jnp.where(n_ans > 0, weight, 0.0)
+
+    # -- M-step: soft-assignment counts update ----------------------------
+    # teach with the distribution of the branch actually APPLIED, so the
+    # posterior and the model update never disagree about the round
+    p_teach = jnp.where(trusted, p_z, jax.nn.one_hot(z_maj, C))
+    inc = ans_f[:, None] * jnp.broadcast_to(p_teach, (V, C))      # (V, C)
+    counts = rel.counts.at[ann_ids, :, responses].add(inc)
+    rel2 = ReliabilityState(counts=counts, n_votes=rel.n_votes + n_ans)
+    return label, weight, rel2

@@ -127,6 +127,16 @@ def _record_knobs(record: RunRecord) -> dict:
     auto tolerance from bitwise to the 2.34e-4 contract)."""
     knobs = dict(record.meta.get("fingerprint", {}).get("knobs", {}) or {})
     knobs.setdefault("eig_scorer", "exact")
+    # crowd-oracle knobs (PR 18): a CLEAN oracle runs the plain-oracle
+    # program bitwise, so 'clean'/'none' normalizes to ABSENT — a pre-v4
+    # record vs a fresh clean-crowd capture must take the bitwise path,
+    # not spuriously 'differ' on a knob that changes nothing. The
+    # satellite knobs only mean anything under a noisy spec, so they are
+    # dropped alongside it.
+    if knobs.get("oracle_noise") in (None, "clean", "none"):
+        for key in ("oracle_noise", "oracle_annotators",
+                    "oracle_reliability"):
+            knobs.pop(key, None)
     return knobs
 
 
@@ -376,6 +386,32 @@ def compare_records_scorer(a: RunRecord, b: RunRecord) -> ReplayReport:
     return report
 
 
+def _oracle_knob(record: RunRecord) -> str:
+    """A record's normalized ``--oracle-noise`` spec: 'clean' when absent
+    (every pre-v4 record) or when explicitly clean."""
+    spec = record.meta.get("fingerprint", {}).get("knobs", {}).get(
+        "oracle_noise")
+    return "clean" if spec in (None, "clean", "none") else str(spec)
+
+
+def compare_records_oracle(a: RunRecord, b: RunRecord) -> ReplayReport:
+    """The clean-vs-noisy (or noisy-vs-noisy) oracle comparison
+    (``--against`` across different ``--oracle-noise`` specs): a noisy
+    crowd legitimately labels with corrupted answers, so per-round
+    decision parity is not the contract — the regret ENVELOPE at equal
+    label budgets is (how much selection quality the noise model costs).
+    Triage class ``oracle-noise-envelope``, the crowd analogue of
+    ``acq-batch-envelope``."""
+    report = _compare_records_envelope(
+        a, b, classification="oracle-noise-envelope",
+        meta_key="oracle_envelope",
+        label_a=f"oracle={_oracle_knob(a)}",
+        label_b=f"oracle={_oracle_knob(b)}")
+    report.meta["oracle_envelope"].update(
+        {"oracle_a": _oracle_knob(a), "oracle_b": _oracle_knob(b)})
+    return report
+
+
 def compare_records(a: RunRecord, b: RunRecord,
                     score_tol: float = 0.0) -> ReplayReport:
     """Direct record-vs-record comparison (no re-execution): the shared
@@ -396,6 +432,8 @@ def compare_records(a: RunRecord, b: RunRecord,
         return compare_records_batchq(a, b)
     if _scorer_knob(a) != _scorer_knob(b):
         return compare_records_scorer(a, b)
+    if _oracle_knob(a) != _oracle_knob(b):
+        return compare_records_oracle(a, b)
     if a.rounds != b.rounds:
         raise ValueError(
             f"records disagree on round count ({a.rounds} vs {b.rounds}); "
@@ -481,7 +519,8 @@ def format_triage(report: ReplayReport) -> str:
                           in report.meta["knob_diff"].items())
         contract = ("the label-aligned regret envelope"
                     if (report.meta.get("batchq_envelope")
-                        or report.meta.get("scorer_envelope"))
+                        or report.meta.get("scorer_envelope")
+                        or report.meta.get("oracle_envelope"))
                     else ("BITWISE equality (score-tol 0 despite the "
                           "knob diff)" if report.score_tol == 0.0
                           else "the documented score contract"))
@@ -499,6 +538,13 @@ def format_triage(report: ReplayReport) -> str:
         lines.append(
             f"  eig-scorer envelope: {env['scorer_a']} vs "
             f"{env['scorer_b']}, worst final cum-regret ratio "
+            f"{env['max_final_ratio_b_over_a']:.3f}, worst aligned gap "
+            f"{env['max_aligned_gap']:.4f}")
+    env = report.meta.get("oracle_envelope")
+    if env:
+        lines.append(
+            f"  oracle-noise envelope: {env['oracle_a']} vs "
+            f"{env['oracle_b']}, worst final cum-regret ratio "
             f"{env['max_final_ratio_b_over_a']:.3f}, worst aligned gap "
             f"{env['max_aligned_gap']:.4f}")
     for s in report.seeds:

@@ -144,41 +144,54 @@ def row_beta(s: SparseRows, c: jnp.ndarray
 
 
 def _scatter_into_row(dcol, rv, ri, r, true_class, pred_classes, lr: float,
-                      C: int, K: int):
+                      C: int, K: int, w=None):
     """The per-row scatter core on COMPACT row leaves: ``(dcol (H,),
     rv (H, K), ri (H, K), r (H,))`` -> the same four, updated. Shared by
     the single-row :func:`scatter_row` and the multi-row
     :func:`scatter_rows` so the eviction/mass choreography can never
     drift between them (the float ops are exactly the pre-refactor
-    single-row body's)."""
+    single-row body's).
+
+    ``w`` is an optional traced scalar reliability weight: the effective
+    increment becomes ``lr * w``. ``w=None`` is a static Python branch
+    using the float ``lr`` directly — the pre-weighting jaxpr, so the
+    clean ladder cannot drift. ``w=1`` multiplies by 1.0 (bitwise
+    identity); ``w=0`` must be a STRUCTURAL no-op, so the untracked
+    insert (which would otherwise evict a tracked entry on the strength
+    of the residual share alone) is gated on ``w > 0``.
+    """
     H = dcol.shape[0]
+    eff = lr if w is None else lr * w
     is_diag = pred_classes == true_class                       # (H,)
     hit = ri == pred_classes[:, None]                          # (H, K)
     tracked = hit & (~is_diag)[:, None]
-    rv1 = rv + lr * tracked.astype(rv.dtype)
+    rv1 = rv + eff * tracked.astype(rv.dtype)
     hit_any = hit.any(-1)
 
     n_untracked = C - 1 - K                                    # static
     share = r / max(n_untracked, 1)
-    v_new = share + lr
+    v_new = share + eff
     m_pos = jnp.argmin(rv, axis=-1)                            # (H,)
     m_val = jnp.take_along_axis(rv, m_pos[:, None], axis=-1)[:, 0]
     miss = (~is_diag) & (~hit_any) if n_untracked > 0 else jnp.zeros(
         (H,), bool)
     insert = miss & (v_new > m_val)
+    if w is not None:
+        insert = insert & (w > 0)
     sel = insert[:, None] & (jnp.arange(K) == m_pos[:, None])  # (H, K)
     rv2 = jnp.where(sel, v_new[:, None], rv1)
     ri2 = jnp.where(sel, pred_classes[:, None], ri)
     # residual: evicted entry in, departed share out; or absorb the whole
     # increment when the new entry would not rank
     r2 = r + jnp.where(insert, m_val - share,
-                       jnp.where(miss, lr, 0.0))
-    diag1 = dcol + lr * is_diag.astype(dcol.dtype)
+                       jnp.where(miss, eff, 0.0))
+    diag1 = dcol + eff * is_diag.astype(dcol.dtype)
     return diag1, rv2, ri2, r2
 
 
 def scatter_row(s: SparseRows, true_class: jnp.ndarray,
-                pred_classes: jnp.ndarray, lr: float) -> SparseRows:
+                pred_classes: jnp.ndarray, lr: float,
+                weight=None) -> SparseRows:
     """One labeling round: add ``lr`` at ``(h, true_class, pred_classes[h])``
     for every model h — the sparse analog of the dense
     ``dirichlets.at[:, true_class, :].add(lr * onehot)``.
@@ -189,26 +202,33 @@ def scatter_row(s: SparseRows, true_class: jnp.ndarray,
     residual — unless it still would not rank, in which case the whole
     increment is absorbed by the residual. Row mass is conserved by every
     branch, so the row's Beta reduction stays exact (see module doc).
+
+    ``weight`` (optional traced scalar) scales the increment to
+    ``lr * weight`` — the reliability-weighted crowd update. ``None`` is
+    a static branch reproducing the unweighted jaxpr; ``1.0`` is bitwise
+    the exact update; ``0.0`` is a structural no-op (see
+    :func:`_scatter_into_row`).
     """
     H, C = s.diag.shape
     K = s.k
     rv = jnp.take(s.vals, true_class, axis=1)                  # (H, K)
     dcol = jnp.take(s.diag, true_class, axis=1)                # (H,)
+    eff = lr if weight is None else lr * weight
 
     if s.full:
         # parity layout: the same float add at the same position the
         # dense one-hot path performs (adding lr*0.0 elsewhere is a
         # bitwise no-op on positive concentrations)
         onehot = jax.nn.one_hot(pred_classes, C, dtype=rv.dtype)
-        rv1 = rv + lr * onehot
-        diag1 = dcol + lr * jnp.take(onehot, true_class, axis=1)
+        rv1 = rv + eff * onehot
+        diag1 = dcol + eff * jnp.take(onehot, true_class, axis=1)
         return s._replace(vals=s.vals.at[:, true_class, :].set(rv1),
                           diag=s.diag.at[:, true_class].set(diag1))
 
     ri = jnp.take(s.idx, true_class, axis=1)                   # (H, K)
     r = jnp.take(s.resid, true_class, axis=1)                  # (H,)
     diag1, rv2, ri2, r2 = _scatter_into_row(
-        dcol, rv, ri, r, true_class, pred_classes, lr, C, K)
+        dcol, rv, ri, r, true_class, pred_classes, lr, C, K, w=weight)
     return SparseRows(
         diag=s.diag.at[:, true_class].set(diag1),
         vals=s.vals.at[:, true_class, :].set(rv2),
@@ -218,7 +238,8 @@ def scatter_row(s: SparseRows, true_class: jnp.ndarray,
 
 
 def scatter_rows(s: SparseRows, true_classes: jnp.ndarray,
-                 pred_classes: jnp.ndarray, lr: float) -> SparseRows:
+                 pred_classes: jnp.ndarray, lr: float,
+                 weights=None) -> SparseRows:
     """One FUSED multi-row scatter: ``q`` oracle answers applied in a
     single pass — ``true_classes`` (q,) int32, ``pred_classes`` (q, H)
     int32 (each answer's per-model hard predictions). The batched analog
@@ -235,10 +256,17 @@ def scatter_rows(s: SparseRows, true_classes: jnp.ndarray,
     runs the exact :func:`_scatter_into_row` core, so per-row mass
     conservation — and therefore the Beta reduction the EIG quadrature
     consumes — holds for the batch exactly as for q sequential rounds.
+
+    ``weights`` (optional (q,) traced) scales answer j's increment to
+    ``lr * weights[j]`` — the per-answer reliability weights of the
+    crowd-oracle update. ``None`` reproduces the unweighted jaxpr;
+    all-ones is bitwise the exact update; a zero weight is a structural
+    no-op for its answer.
     """
     q = int(true_classes.shape[0])
     if q == 1:
-        return scatter_row(s, true_classes[0], pred_classes[0], lr)
+        return scatter_row(s, true_classes[0], pred_classes[0], lr,
+                           weight=None if weights is None else weights[0])
     H, C = s.diag.shape
     K = s.k
 
@@ -246,10 +274,16 @@ def scatter_rows(s: SparseRows, true_classes: jnp.ndarray,
         # parity layout: one scatter-add of all q one-hot increments
         # (duplicate rows accumulate — addition is the whole update)
         onehot = jax.nn.one_hot(pred_classes, C, dtype=s.vals.dtype)  # (q,H,C)
-        vals = s.vals.at[:, true_classes, :].add(
-            lr * jnp.transpose(onehot, (1, 0, 2)))
-        diag_inc = lr * (pred_classes == true_classes[:, None]).astype(
-            s.diag.dtype)                                      # (q, H)
+        if weights is None:
+            inc = lr * jnp.transpose(onehot, (1, 0, 2))
+            diag_inc = lr * (pred_classes == true_classes[:, None]).astype(
+                s.diag.dtype)                                  # (q, H)
+        else:
+            eff = lr * weights                                 # (q,)
+            inc = jnp.transpose(eff[:, None, None] * onehot, (1, 0, 2))
+            diag_inc = eff[:, None] * (
+                pred_classes == true_classes[:, None]).astype(s.diag.dtype)
+        vals = s.vals.at[:, true_classes, :].add(inc)
         diag = s.diag.at[:, true_classes].add(diag_inc.T)
         return s._replace(vals=vals, diag=diag)
 
@@ -270,8 +304,9 @@ def scatter_rows(s: SparseRows, true_classes: jnp.ndarray,
             rv = jnp.where(same, rv2_, rv)
             ri = jnp.where(same, ri2_, ri)
             r = jnp.where(same, r2_, r)
-        outs.append(_scatter_into_row(dcol, rv, ri, r, true_classes[j],
-                                      pred_classes[j], lr, C, K))
+        outs.append(_scatter_into_row(
+            dcol, rv, ri, r, true_classes[j], pred_classes[j], lr, C, K,
+            w=None if weights is None else weights[j]))
     # write-back, earliest first so a duplicated row keeps its LAST result
     diag, vals, idx, resid = s.diag, s.vals, s.idx, s.resid
     for j in range(q):

@@ -44,3 +44,13 @@ class Oracle:
 
     def __call__(self, idx) -> int:
         return int(self.labels[idx])
+
+    def answer_batch(self, idxs) -> list[int]:
+        """All q labels of a q-wide round in ONE host sync: a single
+        fancy-index gather + one ``np.asarray`` device read, instead of
+        the q separate ``int(...)`` round-trips the scalar ``__call__``
+        loop pays. Pinned identical to ``[self(i) for i in idxs]``."""
+        import numpy as np
+
+        idxs = np.asarray(idxs, dtype=np.int64)
+        return [int(v) for v in np.asarray(self.labels)[idxs]]

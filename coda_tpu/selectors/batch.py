@@ -117,6 +117,38 @@ def generic_update_q(selector: Selector) -> Callable:
     return update_q
 
 
+def generic_update_qw(selector: Selector) -> Callable:
+    """Sequential fallback for the WEIGHTED q-wide update: a ``lax.scan``
+    of the single-label ``update_w`` (same shape as
+    :func:`generic_update_q`, one extra scanned leaf for the per-answer
+    weights)."""
+    if selector.update_w is None:
+        raise ValueError(
+            f"selector {selector.name!r} has no weighted update "
+            "(update_w); reliability-weighted crowd rounds need one")
+
+    def update_qw(state, idxs, true_classes, probs, ws):
+        def body(st, xs):
+            i, t, p, w = xs
+            return selector.update_w(st, i, t, p, w), None
+
+        st, _ = lax.scan(body, state, (idxs, true_classes, probs, ws))
+        return st
+
+    return update_qw
+
+
+def resolve_batch_wfns(selector: Selector, q: int):
+    """The weighted analog of :func:`resolve_batch_fns`: the concrete
+    ``(select_q(state, key), update_qw(state, idxs, tcs, probs, ws))``
+    pair for a static q >= 2 — the selector's fused ``update_qw`` when
+    declared, the scanned ``update_w`` fallback otherwise."""
+    sel_q, _ = resolve_batch_fns(selector, q)
+    upd_qw = (selector.update_qw if selector.update_qw is not None
+              else generic_update_qw(selector))
+    return sel_q, upd_qw
+
+
 def resolve_batch_fns(selector: Selector, q: int):
     """The concrete ``(select_q(state, key), update_q(state, idxs, tcs,
     probs))`` pair for a static batch width ``q >= 2`` — selector-native

@@ -1703,14 +1703,21 @@ def make_coda(
                 )
         return _greedy_overlap_topq(state, scores, cand, k_tie, q)
 
-    def update_q(state: CODAState, idxs, true_classes, probs) -> CODAState:
+    def _update_q_impl(state: CODAState, idxs, true_classes, probs,
+                       ws=None) -> CODAState:
         """All q oracle answers as ONE fused update: a single multi-row
         posterior scatter (``ops.sparse_rows.scatter_rows`` / one dense
         scatter-add), ONE batched pi-hat column refresh, ONE batched
         multi-row EIG-cache refresh from the FINAL posterior (duplicate
         class rows recompute identical values — the row refresh depends
         only on the end state), and one scoring pass — per-round cost
-        approaches 1 scoring pass + 1 update instead of q of each."""
+        approaches 1 scoring pass + 1 update instead of q of each.
+
+        ``ws`` ((q,) traced, optional) are per-answer reliability weights
+        scaling each answer's posterior increment — the crowd-oracle
+        path. ``ws=None`` is a static branch reproducing the unweighted
+        jaxpr exactly (the exact pi / cache refreshes read the FINAL
+        posterior, so they are weight-automatic)."""
         del probs
         preds_at = hard_preds[idxs]                     # (q, H)
         if sparse_k is not None:
@@ -1721,7 +1728,7 @@ def make_coda(
             )
 
             sparse = scatter_rows(state.sparse, true_classes, preds_at,
-                                  update_strength)
+                                  update_strength, weights=ws)
             dirichlets = None
         else:
             sparse = None
@@ -1734,8 +1741,10 @@ def make_coda(
             # exactly.
             dirichlets = state.dirichlets
             for j in range(preds_at.shape[0]):
+                eff_j = (update_strength if ws is None
+                         else update_strength * ws[j])
                 dirichlets = dirichlets.at[:, true_classes[j], :].add(
-                    update_strength * onehot[j])
+                    eff_j * onehot[j])
         if incremental:
             if pi_update.startswith("delta"):
                 if pi_gather is None:
@@ -1744,8 +1753,10 @@ def make_coda(
                     )
                 else:
                     _gfn = pi_gather
-                deltas = update_strength * jax.vmap(
+                gathered = jax.vmap(
                     _gfn, in_axes=(None, 0))(preds_by_class, preds_at)
+                deltas = (update_strength * gathered if ws is None
+                          else (update_strength * ws)[:, None] * gathered)
                 unnorm = state.pi_xi_unnorm.at[:, true_classes].add(
                     deltas.T)
                 pi_xi, pi = _normalize_pi(unnorm)
@@ -1832,8 +1843,23 @@ def make_coda(
             surrogate=fit,
         )
 
-    def update(state: CODAState, idx, true_class, prob) -> CODAState:
+    def update_q(state: CODAState, idxs, true_classes, probs) -> CODAState:
+        return _update_q_impl(state, idxs, true_classes, probs)
+
+    def update_qw(state: CODAState, idxs, true_classes, probs,
+                  ws) -> CODAState:
+        """The reliability-weighted fused update (crowd oracle): answer
+        j's increment is scaled by ``ws[j]``. w=1 everywhere is bitwise
+        ``update_q``; w=0 answers are structural no-ops."""
+        return _update_q_impl(state, idxs, true_classes, probs, ws=ws)
+
+    def _update_impl(state: CODAState, idx, true_class, prob,
+                     w=None) -> CODAState:
         del prob
+        # w (optional traced scalar) scales the posterior increment —
+        # effective strength = learning_rate * w. None is a static branch
+        # producing the unweighted jaxpr (eff stays the Python float).
+        eff = update_strength if w is None else update_strength * w
         pred_at = hard_preds[idx]                       # (H,) int32
         if sparse_k is not None:
             from coda_tpu.ops.sparse_rows import (
@@ -1847,21 +1873,21 @@ def make_coda(
             # the carry; the labeled row's Beta parameters come from the
             # O(H*K) compact reduction, not a dense (H, C, C) pass
             sparse = scatter_row(state.sparse, true_class, pred_at,
-                                 update_strength)
+                                 update_strength, weight=w)
             dirichlets = None
             beta_t = row_beta(sparse, true_class)
         else:
             sparse = None
             onehot = jax.nn.one_hot(pred_at, C, dtype=preds.dtype)  # (H, C)
             dirichlets = state.dirichlets.at[:, true_class, :].add(
-                update_strength * onehot
+                eff * onehot
             )
             beta_t = None
         if incremental:
             if pi_update.startswith("delta"):
                 pi_xi, pi, unnorm = update_pi_hat_column_delta(
                     true_class, pred_at, preds_by_class,
-                    state.pi_xi_unnorm, update_strength,
+                    state.pi_xi_unnorm, eff,
                     gather_fn=pi_gather,
                 )
             elif sparse_k is not None:
@@ -1958,6 +1984,16 @@ def make_coda(
             surrogate=(fit if scorer_k is not None else None),
         )
 
+    def update(state: CODAState, idx, true_class, prob) -> CODAState:
+        return _update_impl(state, idx, true_class, prob)
+
+    def update_w(state: CODAState, idx, true_class, prob, w) -> CODAState:
+        """The reliability-weighted single-label update (crowd oracle).
+        w=1 is bitwise ``update``; w=0 is a structural posterior no-op
+        (the point is still marked labeled — an answered round consumes
+        its point regardless of how much the posterior trusts it)."""
+        return _update_impl(state, idx, true_class, prob, w=w)
+
     def get_pbest(state: CODAState) -> jnp.ndarray:
         if incremental:
             # the cached per-row P(best) is exactly compute_pbest of the
@@ -2024,6 +2060,11 @@ def make_coda(
         select_q=(select_q if hp.q == "eig" and not use_prefilter
                   else None),
         update_q=(None if eig_backend == "pallas" else update_q),
+        # weighted (crowd) updates: the single-label update_w threads the
+        # weight through the same jnp-level scatter/pi lines on every
+        # backend; the fused update_qw mirrors update_q's pallas gate
+        update_w=update_w,
+        update_qw=(None if eig_backend == "pallas" else update_qw),
         always_stochastic=False,
         hyperparams=dict(hp._asdict()),
         hyperparam_defaults=dict(CODAHyperparams()._asdict()),

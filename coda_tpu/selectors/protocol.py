@@ -72,6 +72,20 @@ class Selector:
     select_q: Optional[Callable[[Any, jax.Array, int], SelectResult]] = None
     update_q: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray,
                                  jnp.ndarray], Any]] = None
+    # -- reliability-weighted updates (the crowd-oracle protocol) ----------
+    # update_w(state, idx, true_class, prob, w): the single-label update
+    # with a traced scalar weight w scaling the posterior increment
+    # (effective strength = learning_rate * w). Contract: w=1 is bitwise
+    # the exact `update`; w=0 is a structural no-op on the posterior.
+    # update_qw(state, idxs, true_classes, probs, ws) with (q,) arrays is
+    # the fused q-wide analog. None = the method has no weighted path;
+    # `selectors/batch.py` derives update_qw from update_w when present,
+    # and the crowd loop refuses methods without update_w (weighting is
+    # meaningless for loss-table methods that never carry a posterior).
+    update_w: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray,
+                                 jnp.ndarray, jnp.ndarray], Any]] = None
+    update_qw: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray,
+                                  jnp.ndarray, jnp.ndarray], Any]] = None
     # True when the method is stochastic by construction (e.g. IID sampling);
     # deterministic methods let the driver skip redundant seeds, mirroring the
     # reference's `stochastic` early-stop (reference main.py:128-130).

@@ -35,7 +35,12 @@ Names (each is one injection point):
                         moment a label arrives for the session
                         (demotion-vs-ticket race: either the label wakes
                         the freshly-demoted session or the demotion loses
-                        cleanly to the in-flight pin).
+                        cleanly to the in-flight pin);
+  * ``oracle_poison``  — an arriving crowd answer (``POST /session/{id}/
+                        answer``) is corrupted to the adversarial family
+                        ``(label+1) % C`` before parking;
+  * ``oracle_abstain`` — an arriving crowd answer is converted into an
+                        abstention (the slot stays open).
 
 Fleet-level names (fired inside the router↔replica transport,
 ``serve/transport.py``, addressable per edge with ``edge=<replica_id>``
@@ -109,6 +114,15 @@ FAULT_SITES = {
     # and its import (serve/router.py); the fleet's kill hook SIGKILLs
     # the matching replica at exactly that point
     "kill_replica": "migrate_mid",
+    # crowd-oracle answer faults (fired by ServeApp.answer, applied
+    # OUT-OF-BAND by the answer path itself): oracle_poison corrupts the
+    # arriving label to the adversarial family ((label+1) % C — the
+    # systematic mislabeler of coda_tpu/crowd/oracle.py), oracle_abstain
+    # converts the answer into an abstention (the slot stays open). The
+    # robustness matrix drives both through the front door to show the
+    # parking + dedupe layer keeps labels exactly-once regardless.
+    "oracle_poison": "oracle_answer",
+    "oracle_abstain": "oracle_answer",
 }
 
 _CRASH_EXIT_CODE = 17  # distinguishable from python tracebacks (1) in tests

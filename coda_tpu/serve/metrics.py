@@ -88,6 +88,20 @@ class ServeMetrics:
         self._step_s = collections.deque(maxlen=_RING)      # slab-step exec
         self._request_s = collections.deque(maxlen=_RING)   # submit->result
         self._queue_wait_s = collections.deque(maxlen=_RING)  # submit->tick
+        # crowd-oracle answer path (POST /session/{id}/answer): per-slot
+        # parking, abstentions, fault-injected poisons, and the async
+        # delivery evidence — how many rounds completed out of the parked
+        # path, the deepest arrival reorder observed, and how many
+        # duplicate answers the dedupe refused (the committed
+        # ROBUSTNESS artifact's 0-double-apply bound reads this)
+        self.oracle = {
+            "answers_parked": 0,     # per-slot answers accepted into a park
+            "abstentions": 0,        # abstain verbs (slot left open)
+            "poisoned": 0,           # answers corrupted by oracle_poison
+            "deferred_rounds_completed": 0,  # rounds dispatched via parking
+            "reorder_depth_max": 0,  # deepest out-of-order arrival seen
+            "double_apply_rejects": 0,  # duplicate answers refused
+        }
         # surrogate-scorer evidence provider (--eig-scorer surrogate:k):
         # set by the app to a () -> dict callback summing the slab-carried
         # fit counters over its buckets, so /stats and /metrics read
@@ -168,6 +182,27 @@ class ServeMetrics:
                 raise ValueError(f"unknown recovery event {event!r}")
             self.recovery[event] += 1
 
+    def record_oracle(self, event: str, depth: int = None) -> None:
+        """One crowd-oracle answer event: ``parked`` | ``abstain`` |
+        ``poisoned`` | ``round_completed`` | ``double_apply_reject``;
+        ``depth`` updates the reorder-depth high-water mark."""
+        with self._lock:
+            if event == "parked":
+                self.oracle["answers_parked"] += 1
+            elif event == "abstain":
+                self.oracle["abstentions"] += 1
+            elif event == "poisoned":
+                self.oracle["poisoned"] += 1
+            elif event == "round_completed":
+                self.oracle["deferred_rounds_completed"] += 1
+            elif event == "double_apply_reject":
+                self.oracle["double_apply_rejects"] += 1
+            else:
+                raise ValueError(f"unknown oracle event {event!r}")
+            if depth is not None:
+                self.oracle["reorder_depth_max"] = max(
+                    self.oracle["reorder_depth_max"], int(depth))
+
     def record_fencing_rejection(self) -> None:
         """One stale-epoch verb refused (the ownership fence held)."""
         with self._lock:
@@ -220,6 +255,7 @@ class ServeMetrics:
                     "misses": self.warm_misses,
                 },
                 "recovery": dict(self.recovery),
+                "oracle": dict(self.oracle),
                 # tiered-state evidence: occupancy per tier, paging
                 # counters, and the wake-latency ring percentiles
                 "tiers": dict(self.tier_occupancy),

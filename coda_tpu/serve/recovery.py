@@ -349,6 +349,12 @@ def build_export_payload(app, sess, snapshot=None) -> dict:
     payload["rows"] = rows
     payload["n_labeled"] = sum(_row_label_count(r) for r in rows)
     payload["last"] = dict(rows[-1]) if rows else None
+    # parked per-slot crowd answers of the CURRENT round (the async
+    # answer verb): they ride the payload so a migration loses none
+    with app.store.lock:
+        if sess.parked:
+            payload["parked"] = {str(j): dict(e)
+                                 for j, e in sess.parked.items()}
     return payload
 
 
@@ -428,6 +434,52 @@ def _finalize_restored(sess, rows) -> None:
                 k: row.get(k) for k in ("next_idx", "next_prob",
                                         "best", "stochastic",
                                         "pbest_max", "pbest_entropy")}
+
+
+def _repark_answers(app, sess, parked) -> None:
+    """Re-park a restored session's pending per-slot crowd answers (the
+    async answer verb) and re-stream their park rows — the restored
+    stream is rewritten from data rows only, and a crash after THIS
+    restore must find the parks again (0 lost answers, the robustness
+    artifact's bound)."""
+    if not parked:
+        return
+    q = sess.bucket.acq_batch
+    round_idx = sess.n_labeled // q
+    entries = {}
+    for j, e in parked.items():
+        j = int(j)
+        if 0 <= j < q:
+            entries[j] = {"label": int(e["label"]),
+                          "request_id": e.get("request_id"),
+                          "seq": int(e.get("seq", 0))}
+    with app.store.lock:
+        sess.parked = entries
+        sess.park_seq = 1 + max((e["seq"] for e in entries.values()),
+                                default=-1)
+    for j in sorted(entries):
+        e = entries[j]
+        app.recorder.append(sess.sid, {
+            "kind": "answer_park", "session": sess.sid,
+            "round": round_idx, "slot": j, "label": e["label"],
+            "request_id": e.get("request_id"), "seq": e["seq"]})
+
+
+def parked_from_rows(raw_rows, n_rounds: int) -> dict:
+    """The pending per-slot answers of a raw stream: ``answer_park`` rows
+    addressed to the CURRENT round (``round == n_rounds`` — parks of
+    completed rounds are superseded by their data row). Later rows win a
+    slot (re-park after a failed drain)."""
+    parked = {}
+    for r in (raw_rows or []):
+        if r.get("kind") != "answer_park":
+            continue
+        if int(r.get("round", -1)) != n_rounds:
+            continue
+        parked[int(r["slot"])] = {"label": r.get("label"),
+                                  "request_id": r.get("request_id"),
+                                  "seq": int(r.get("seq", 0))}
+    return parked
 
 
 def import_session(app, payload: dict, count: bool = True) -> dict:
@@ -526,6 +578,9 @@ def import_session(app, payload: dict, count: bool = True) -> dict:
                             "digest": meta.get("digest"),
                             "imported_via": restored_via},
             rows=rows)
+        # pending async crowd answers ride the payload; import_history
+        # rewrote the stream from data rows only, so re-stream the parks
+        _repark_answers(app, sess, payload.get("parked") or {})
     except ReplayMismatch as e:
         _close_quietly(app.store, sess.sid)
         raise ImportRejected(f"stream failed replay verification: {e}")
@@ -638,7 +693,13 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
         if not _SID_RE.match(sid):
             report["failed"][sid] = f"invalid session id {sid!r} in stream"
             continue
-        rows = data_rows(rows)
+        raw_rows, rows = rows, data_rows(rows)
+        # pending async crowd answers live in answer_park kind-rows of the
+        # CURRENT round; rebuild them after replay so a crash between an
+        # answer arriving and its round completing loses nothing
+        n_rounds = (sum(_row_label_count(r) for r in rows)
+                    // max(1, int(meta.get("acq_batch", 1))))
+        parked = parked_from_rows(raw_rows, n_rounds)
         task = meta.get("task")
         try:
             if task not in app.store.tasks():
@@ -677,15 +738,15 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
         except Exception as e:
             report["failed"][sid] = repr(e)
             continue
-        pending.append((sid, meta, rows))
+        pending.append((sid, meta, rows, parked))
     # phase 2: admit + replay in slab-sized waves (one wave = the whole
     # set when everything fits; beyond-capacity restarts need app.tiers)
     tiers = getattr(app, "tiers", None)
     wave_size = max(1, int(app.store.capacity))
     while pending:
         wave, pending = pending[:wave_size], pending[wave_size:]
-        staged: list = []      # (sess, rows, meta)
-        for sid, meta, rows in wave:
+        staged: list = []      # (sess, rows, meta, parked)
+        for sid, meta, rows, parked in wave:
             try:
                 sess = app.store.open(meta.get("task"), app.spec,
                                       seed=int(meta.get("seed", 0)),
@@ -699,16 +760,17 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
             except Exception as e:
                 report["failed"][sid] = repr(e)
                 continue
-            staged.append((sess, rows, meta))
+            staged.append((sess, rows, meta, parked))
         # coalesced bitwise-verified replay, one dispatch per round per
         # bucket; a diverging stream fails ONLY its session
         by_bucket: dict = {}
-        for sess, rows, meta in staged:
+        for sess, rows, meta, parked in staged:
             by_bucket.setdefault(
                 id(sess.bucket), (sess.bucket, []))[1].append(
-                    (sess, rows, meta))
+                    (sess, rows, meta, parked))
         for bucket, items in by_bucket.values():
-            live = {sess.slot: (sess.sid, rows) for sess, rows, _ in items}
+            live = {sess.slot: (sess.sid, rows)
+                    for sess, rows, _, _ in items}
 
             def locked_dispatch(reqs, _bucket=bucket):
                 with _bucket.lock:
@@ -727,7 +789,7 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
             # check needed)
             replay_live_coalesced(bucket, live, dispatch=locked_dispatch,
                                   on_fail=on_fail)
-            for sess, rows, meta in items:
+            for sess, rows, meta, parked in items:
                 if sess.slot not in live:
                     continue
                 _finalize_restored(sess, rows)
@@ -744,6 +806,7 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
                                     "digest": meta.get("digest"),
                                     "imported_via": "replay"},
                     rows=rows)
+                _repark_answers(app, sess, parked)
                 sess.restoring = False
                 report["restored"].append(sess.sid)
                 app.metrics.record_session("open")
@@ -759,7 +822,7 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
             # unstarted sessions (a stream with zero data rows) demote
             # too, or their slots would starve every later wave.
             demote_by_bucket: dict = {}
-            for sess, rows, meta in staged:
+            for sess, rows, meta, parked in staged:
                 if app.store.alive(sess.sid):
                     demote_by_bucket.setdefault(
                         id(sess.bucket), (sess.bucket, []))[1].append(

@@ -731,6 +731,158 @@ class ServeApp:
         return await self.label_async(sid, list(labels), idx=idx,
                                       request_id=request_id, epoch=epoch)
 
+    def answer(self, sid: str, slot, label=None,
+               request_id: Optional[str] = None,
+               epoch: Optional[int] = None, abstain: bool = False) -> dict:
+        """The asynchronous oracle verb (``POST /session/{id}/answer``):
+        ONE per-slot crowd answer of the current round, in ANY order.
+
+        Where ``labels`` demands all q answers at once, a crowd delivers
+        them one by one — noisy, late, out of order, some abstaining.
+        Each arriving answer is PARKED per slot (a park row rides the
+        recorder stream, so a crash loses nothing); when all ``acq_batch``
+        slots are filled the park drains through ONE batch-label dispatch
+        in slot order under a deterministic synthetic request_id — so an
+        out-of-order delivery commits the exact bytes the in-order one
+        does, and the dedupe cache makes redelivery of any answer (or of
+        the fused round) idempotent. An ``abstain`` leaves its slot open.
+        Injectable at the ``oracle_answer`` fault site (``oracle_poison``
+        corrupts the label to the adversarial family, ``oracle_abstain``
+        converts the answer into an abstention)."""
+        self._check_hold(sid)
+        sess = self._resolve_pinned(sid)
+        to_dispatch = None
+        round_idx = 0
+        try:
+            self._check_epoch(sess, epoch)
+            if sess.restoring:
+                raise BucketQuarantined(
+                    f"session {sid} is being restored; retry shortly")
+            if not sess.last:
+                raise UnknownSession(sid)
+            q = sess.bucket.acq_batch
+            slot = int(slot)
+            if not 0 <= slot < q:
+                raise ValueError(
+                    f"slot {slot} out of range [0, {q}) for session {sid}")
+            fired = (self.faults.fire("oracle_answer", task=sess.task)
+                     if self.faults is not None else [])
+            if "oracle_abstain" in fired:
+                abstain = True
+            if not abstain:
+                if label is None:
+                    raise ValueError(
+                        "missing 'label' (or set 'abstain': true)")
+                label = int(label)
+                if "oracle_poison" in fired:
+                    label = (label + 1) % sess.bucket.n_classes
+                    self.metrics.record_oracle("poisoned")
+                if not 0 <= label < sess.bucket.n_classes:
+                    raise ValueError(f"label {label} out of range "
+                                     f"[0, {sess.bucket.n_classes})")
+            round_idx = sess.n_labeled // q
+            park_row = None
+            with self.store.lock:
+                if request_id is not None:
+                    done = sess.recent.get(request_id)
+                    if done is not None:
+                        # the round this answer was part of has already
+                        # committed — answer from the cached result, never
+                        # re-apply (redelivery of a deferred answer)
+                        out = self._payload(sess, dict(done))
+                        out.update({"verb": "committed", "slot": slot,
+                                    "duplicate": True})
+                        return out
+                missing = [j for j in range(q) if j not in sess.parked]
+                if abstain:
+                    self.metrics.record_oracle("abstain")
+                    return {"session": sid, "verb": "abstain",
+                            "slot": slot, "round": round_idx,
+                            "parked": q - len(missing), "missing": missing}
+                entry = sess.parked.get(slot)
+                if entry is not None:
+                    if request_id is not None and \
+                            entry.get("request_id") == request_id:
+                        return {"session": sid, "verb": "parked",
+                                "slot": slot, "round": round_idx,
+                                "duplicate": True,
+                                "parked": q - len(missing),
+                                "missing": missing}
+                    self.metrics.record_oracle("double_apply_reject")
+                    raise ValueError(
+                        f"session {sid} round {round_idx} slot {slot} "
+                        "already has a parked answer (duplicate delivery "
+                        "refused)")
+                # reorder depth: how many LATER slots arrived before this
+                # one — the loadgen's deferred-delivery evidence
+                depth = sum(1 for j in sess.parked if j > slot)
+                seq = sess.park_seq
+                sess.park_seq += 1
+                sess.parked[slot] = {"label": label,
+                                     "request_id": request_id, "seq": seq}
+                self.metrics.record_oracle("parked", depth=depth)
+                park_row = {"kind": "answer_park", "session": sid,
+                            "round": round_idx, "slot": slot,
+                            "label": label, "request_id": request_id,
+                            "seq": seq}
+                if len(sess.parked) == q:
+                    to_dispatch = dict(sess.parked)
+                    sess.parked = {}
+            # stream the park OUTSIDE the store lock (disk write): the
+            # row carries its slot + seq, so concurrent parks interleaving
+            # in the file restore identically regardless of write order
+            self.recorder.append(sid, park_row)
+            if to_dispatch is None:
+                with self.store.lock:
+                    missing = [j for j in range(q) if j not in sess.parked]
+                return {"session": sid, "verb": "parked", "slot": slot,
+                        "round": round_idx, "parked": q - len(missing),
+                        "missing": missing}
+        finally:
+            self.store.unpin(sess)
+        # all q slots filled: drain through ONE fused dispatch in SLOT
+        # order under a deterministic synthetic request_id — delivery
+        # order is now immaterial, and a crashed/retried drain dedupes
+        q = sess.bucket.acq_batch
+        ordered = [to_dispatch[j]["label"] for j in range(q)]
+        rid = f"answer:{sid}:{round_idx}"
+        try:
+            payload = self.label(sid, ordered if q > 1 else ordered[0],
+                                 request_id=rid, epoch=epoch)
+        except BaseException:
+            # failed drain: re-park so the answers survive for a retry
+            # (the park rows are still in the stream; nothing is lost)
+            with self.store.lock:
+                for j, e in to_dispatch.items():
+                    sess.parked.setdefault(j, e)
+            raise
+        self.metrics.record_oracle("round_completed")
+        with self.store.lock:
+            done = sess.recent.get(rid)
+            if done is not None:
+                # every per-answer request_id now answers from the
+                # committed round — late redelivery reads, never re-applies
+                for e in to_dispatch.values():
+                    if e.get("request_id"):
+                        sess.recent[e["request_id"]] = done
+        payload = dict(payload)
+        payload.update({"verb": "dispatched", "slot": slot,
+                        "round": round_idx, "applied": ordered})
+        return payload
+
+    async def answer_async(self, sid: str, slot, label=None,
+                           request_id: Optional[str] = None,
+                           epoch: Optional[int] = None,
+                           abstain: bool = False) -> dict:
+        # parking is host-dict work but the drain dispatch blocks on the
+        # batcher — always off the event loop (like the wake-through path)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor,
+            lambda: self.answer(sid, slot, label=label,
+                                request_id=request_id, epoch=epoch,
+                                abstain=abstain))
+
     def best(self, sid: str, epoch: Optional[int] = None) -> dict:
         self._check_hold(sid)
         sess = self._resolve_pinned(sid)  # wakes a parked session
@@ -1052,7 +1204,7 @@ class StaleItem(ValueError):
 
 _SESSION_RE = re.compile(
     r"^/session/([0-9a-f]+)"
-    r"(/(label|labels|best|trace|export|fence|epoch))?$")
+    r"(/(label|labels|answer|best|trace|export|fence|epoch))?$")
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             409: "Conflict", 500: "Internal Server Error",
@@ -1315,6 +1467,18 @@ class AsyncHTTPServer:
                                           idx=req.get("idx"),
                                           request_id=req.get("request_id"),
                                           epoch=_epoch(req))
+        if m and method == "POST" and m.group(3) == "answer":
+            # one per-slot crowd answer, any order (see ServeApp.answer)
+            req = json.loads(raw or b"{}")
+            if "slot" not in req:
+                raise ValueError("missing 'slot'")
+            if "label" not in req and not req.get("abstain"):
+                raise ValueError("missing 'label' (or 'abstain': true)")
+            return await app.answer_async(m.group(1), req["slot"],
+                                          label=req.get("label"),
+                                          request_id=req.get("request_id"),
+                                          epoch=_epoch(req),
+                                          abstain=bool(req.get("abstain")))
         if m and method == "POST" and m.group(3) == "export":
             req = json.loads(raw or b"{}")
             return await loop.run_in_executor(

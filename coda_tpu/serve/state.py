@@ -986,6 +986,17 @@ class Session:
     # from the recorder stream so retries survive a process death too.
     recent: dict = field(default_factory=dict)
     pending: dict = field(default_factory=dict)
+    # asynchronous crowd answers (POST /session/{id}/answer): per-slot
+    # parked answers of the CURRENT round — slot -> {label, request_id,
+    # seq} — plus the arrival counter the reorder-depth metric reads.
+    # When all acq_batch slots are filled the park drains through ONE
+    # batch-label dispatch (slot order, a deterministic synthetic
+    # request_id), so out-of-order delivery commits identically to
+    # in-order. Mutates only under the store lock; park rows in the
+    # recorder stream + the export payload's ``parked`` field carry the
+    # state across crash restore and migration (0 lost answers).
+    parked: dict = field(default_factory=dict)
+    park_seq: int = 0
     # set while import/restore is mid-replay: the sid is already published
     # (the client's handle must resolve) but the posterior and the dedupe
     # cache are not rebuilt yet — label dispatches answer retryable 503

@@ -71,8 +71,15 @@ import numpy as np
 # ``eig_scorer`` joined KNOB_FIELDS. v1/v2 records load unchanged (the
 # array is absent there and replay comparisons skip quantities either
 # side lacks), so the committed r12/r14 captures stay replayable.
-RECORD_SCHEMA_VERSION = 3
-SUPPORTED_RECORD_VERSIONS = (1, 2, 3)
+# v4: the crowd oracle (--oracle-noise): oracle_noise /
+# oracle_annotators / oracle_reliability joined KNOB_FIELDS, and crowd
+# runs OPTIONALLY carry the per-round ``oracle_label`` (ground truth of
+# the chosen point) and ``label_weight`` (the reliability weight the
+# update applied) arrays — OPTIONAL_ARRAYS, validated only when present,
+# so clean and pre-crowd records carry nothing new and still compare
+# bitwise (the r12-r16 captures stay replayable).
+RECORD_SCHEMA_VERSION = 4
+SUPPORTED_RECORD_VERSIONS = (1, 2, 3, 4)
 # v2: session-stream rows gained request_id + pbest_max/pbest_entropy
 # (the in-step posterior digest) and the session_close marker kind — a v1
 # stream replayed by this build would misreport the absent digests as a
@@ -86,8 +93,14 @@ SUPPORTED_RECORD_VERSIONS = (1, 2, 3)
 # accepts it (a deploy must not discard every in-flight session) and
 # treats its missing ``acq_batch`` meta as 1; a v2 stream on a q>1
 # server is rejected with the real acq_batch-mismatch reason.
-SESSION_SCHEMA_VERSION = 3
-SUPPORTED_SESSION_VERSIONS = (2, 3)
+# v4: asynchronous oracle answers (POST /session/{id}/answer): streams may
+# carry ``answer_park`` rows — a per-slot crowd answer parked until the
+# whole q-wide round is filled — so a crash between parking and the
+# round's dispatch replays with 0 lost labels. v3 readers would drop the
+# parked answers on restore, so v4 streams gate them out; v2/v3 streams
+# (no park rows possible) still restore here unchanged.
+SESSION_SCHEMA_VERSION = 4
+SUPPORTED_SESSION_VERSIONS = (2, 3, 4)
 
 # the documented cross-backend score contract: pallas kernels vs the XLA
 # lowering agree on EIG scores to the MEASURED 2.34e-4 at the headline shape
@@ -132,6 +145,25 @@ _VERSIONED_ARRAYS = {
     "surrogate_fallback": (3, ("b", 2)),   # (S, T) — v3's addition
 }
 
+# arrays a record MAY carry but need not (validated only when present):
+# crowd-oracle runs record what the noisy crowd answered and how much the
+# reliability posterior trusted it; clean runs carry neither, so their
+# rounds.npz stays byte-identical to a pre-v4 capture. Both grow the
+# trailing (q,) axis under batched acquisition, like _BATCH_ARRAYS.
+_OPTIONAL_ARRAYS = {
+    "oracle_label": ("i", 2),   # (S, T) — the aggregated crowd answer
+    "label_weight": ("f", 2),   # (S, T) — the applied reliability weight
+}
+
+
+def optional_arrays(acq_batch: int = 1) -> dict:
+    """The OPTIONAL per-round arrays (crowd-oracle runs) at a record's
+    ``acq_batch``: same q-axis rule as the required decision arrays."""
+    out = dict(_OPTIONAL_ARRAYS)
+    if acq_batch <= 1:
+        return out
+    return {name: (kind, ndim + 1) for name, (kind, ndim) in out.items()}
+
 
 def required_arrays(acq_batch: int = 1,
                     schema_version: int = RECORD_SCHEMA_VERSION) -> dict:
@@ -157,6 +189,7 @@ KNOB_FIELDS = (
     "eig_chunk", "eig_mode", "eig_backend", "eig_precision",
     "eig_cache_dtype", "eig_refresh", "eig_entropy", "posterior",
     "eig_pbest", "eig_scorer", "pi_update", "mesh", "acq_batch",
+    "oracle_noise", "oracle_annotators", "oracle_reliability",
 )
 
 
@@ -250,9 +283,13 @@ class RunRecord:
     # -- construction ------------------------------------------------------
     @classmethod
     def from_result(cls, result, aux, fingerprint: dict, run: dict,
-                    extra_meta: Optional[dict] = None) -> "RunRecord":
+                    extra_meta: Optional[dict] = None,
+                    crowd=None) -> "RunRecord":
         """Build a record from an ``(ExperimentResult, RunTraceAux)`` pair
-        (leading seed axis on both, as ``run_seeds_recorded`` returns)."""
+        (leading seed axis on both, as ``run_seeds_recorded`` returns).
+        ``crowd`` is the optional ``CrowdAux`` of a crowd-oracle run —
+        it adds the v4 OPTIONAL arrays; clean runs pass None and the
+        record stays byte-identical to a pre-v4 capture."""
         arrays = {
             "chosen_idx": np.asarray(result.chosen_idx, np.int32),
             "true_class": np.asarray(result.true_class, np.int32),
@@ -277,6 +314,11 @@ class RunRecord:
             "init_key": np.asarray(aux.init_key, np.uint32).reshape(-1, 2),
             "prior_key": np.asarray(aux.prior_key, np.uint32).reshape(-1, 2),
         }
+        if crowd is not None:
+            arrays["oracle_label"] = np.asarray(crowd.applied_label,
+                                                np.int32)
+            arrays["label_weight"] = np.asarray(crowd.label_weight,
+                                                np.float32)
         # batched acquisition: (S, T, q) decision arrays carry their q in
         # meta so readers never infer it from ranks alone
         ci_shape = arrays["chosen_idx"].shape
@@ -400,7 +442,10 @@ def _count_stream_rows(path: str) -> tuple:
                 return n, False
             if kind == "session_close":
                 return n, False
-            if kind != "session_meta":
+            if not kind:
+                # only DATA rows count toward the resume prefix: marker
+                # rows (session_meta, v4's answer_park) are not part of
+                # the decision-row sequence import_history aligns on
                 n += 1
     return n, True
 

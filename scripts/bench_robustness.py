@@ -1,0 +1,463 @@
+"""Crowd-oracle robustness matrix -> ROBUSTNESS_<b>_rNN.json.
+
+The ISSUE-16 crowd subsystem's gated evidence, four claims in one
+artifact:
+
+  * **clean parity (bitwise)** — ``--oracle-noise clean`` is the plain
+    oracle program: its record must verify bitwise against a knob-less
+    record through the real ``cli replay --against --score-tol 0`` path,
+    and the plain record must self-replay bitwise. The crowd layer adds
+    NOTHING to the clean path.
+  * **noisy regret envelope** — a noisy crowd (confusion-matrix
+    annotators, abstentions, one adversary) vs the clean run compares
+    through ``compare_records``'s ``oracle-noise-envelope`` triage; the
+    label-aligned final cumulative-regret ratio must stay inside the
+    committed envelope (``check_perf.ORACLE_ENVELOPE_RATIO/ABS``).
+  * **reliability recovery** — the Dawid-Skene posterior
+    (``coda_tpu/crowd/reliability.py``), fed only the votes it
+    aggregates itself, must recover the PLANTED per-annotator diagonal
+    accuracies (rank-correlate and bound the error) and push every
+    adversarial annotator below every honest one.
+  * **async delivery (serve)** — deferred / out-of-order / duplicated
+    per-slot answers through ``POST /session/{id}/answer`` must commit
+    the same per-round stream (digest-identical) as in-order delivery,
+    with 0 lost and 0 double-applied labels, and parked answers must
+    survive a crash-restore.
+
+Runnable standalone (CPU container, ~2 min quick / ~6 min full)::
+
+    python scripts/bench_robustness.py --quick
+    python scripts/bench_robustness.py --out ROBUSTNESS_CPU_r18.json \
+        --records-dir runs/robustness_r18
+
+The finished artifact is self-gated against its ``check_perf.py``
+contract before the script exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the declared bounds are the GATE's, imported from the one place they
+# are enforced (scripts/check_perf.py) so the generator can never embed
+# verdicts computed under stale thresholds
+from check_perf import (  # noqa: E402
+    ORACLE_ENVELOPE_ABS as ENVELOPE_ABS,
+    ORACLE_ENVELOPE_RATIO as ENVELOPE_RATIO,
+    ORACLE_MIN_RELIABILITY_CORR as MIN_CORR,
+    ORACLE_MAX_RELIABILITY_MAE as MAX_MAE,
+)
+
+NOISY_SPEC = ("annotators=8,votes=3,acc=0.6:0.95,abstain=0.1,"
+              "adversarial=1,trust=16,seed=0")
+RELIABILITY_SPEC = ("annotators=8,votes=3,acc=0.55:0.95,abstain=0.05,"
+                    "adversarial=2,trust=24,seed=1")
+SERVE_SPEC = "annotators=6,votes=3,abstain=0.15,defer=0.4:3,seed=2"
+
+
+def _run_cli(flags, timeout=900) -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([sys.executable, "-m", "coda_tpu.cli"] + flags,
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=timeout, env=env)
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout[-2000:] + r.stderr[-2000:])
+    return r.returncode
+
+
+def _base_flags(args) -> list:
+    return ["--synthetic", args.shape, "--iters", str(args.iters),
+            "--seeds", str(args.seeds), "--method", "coda",
+            "--no-mlflow", "--platform", "cpu"]
+
+
+# ---------------------------------------------------------------------------
+# clean parity + noisy envelope (the recorded-experiment half)
+# ---------------------------------------------------------------------------
+
+def _record_three(args, rdir: str) -> dict:
+    """Record plain / clean-crowd / noisy-crowd runs of the same config."""
+    dirs = {"plain": os.path.join(rdir, "plain"),
+            "clean": os.path.join(rdir, "clean"),
+            "noisy": os.path.join(rdir, "noisy")}
+    runs = {
+        "plain": [],
+        "clean": ["--oracle-noise", "clean"],
+        "noisy": ["--oracle-noise", NOISY_SPEC],
+    }
+    for tag, extra in runs.items():
+        rc = _run_cli(_base_flags(args)
+                      + ["--record-dir", dirs[tag]] + extra)
+        if rc != 0:
+            raise SystemExit(f"recording the {tag} run failed (rc={rc})")
+    return dirs
+
+
+def _clean_parity(dirs: dict) -> dict:
+    """The bitwise pin: plain self-replays; clean-crowd == plain through
+    the real ``cli replay --against --score-tol 0`` path."""
+    self_rc = _run_cli(["replay", dirs["plain"], "--platform", "cpu"])
+    against_rc = _run_cli(["replay", dirs["clean"], "--against",
+                           dirs["plain"], "--score-tol", "0",
+                           "--platform", "cpu"])
+    return {"replay_rc": self_rc, "against_rc": against_rc,
+            "parity": self_rc == 0 and against_rc == 0}
+
+
+def _noisy_envelope(dirs: dict) -> dict:
+    """Noisy-vs-clean through ``compare_records``: the oracle-knob diff
+    must route to the ``oracle-noise-envelope`` triage, and every seed's
+    final label-aligned cumulative regret must stay inside the committed
+    envelope ``cum_noisy <= RATIO * cum_clean + ABS``."""
+    from coda_tpu.engine.replay import compare_records
+    from coda_tpu.telemetry.recorder import RunRecord
+
+    a = RunRecord.load(dirs["clean"])
+    b = RunRecord.load(dirs["noisy"])
+    report = compare_records(a, b)
+    env = (report.meta or {}).get("oracle_envelope") or {}
+    per_seed = env.get("seeds") or []
+    within = []
+    for info in per_seed:
+        ca = float(info["final_cum_a"])
+        cb = float(info["final_cum_b"])
+        within.append(cb <= ENVELOPE_RATIO * ca + ENVELOPE_ABS)
+    classifications = {s.classification for s in report.seeds}
+    classification = (classifications.pop()
+                      if len(classifications) == 1 else None)
+    return {
+        "spec": NOISY_SPEC,
+        "classification": classification,
+        "per_seed": per_seed,
+        "max_final_ratio": env.get("max_final_ratio_b_over_a"),
+        "envelope_ratio_bound": ENVELOPE_RATIO,
+        "envelope_abs_bound": ENVELOPE_ABS,
+        "envelope_ok": bool(
+            classification == "oracle-noise-envelope"
+            and per_seed and all(within)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Dawid-Skene recovery of the planted pool
+# ---------------------------------------------------------------------------
+
+def _reliability_recovery(rounds: int, n_classes: int = 4) -> dict:
+    """Feed the reliability posterior its own aggregated votes for
+    ``rounds`` labeling rounds and compare the learned per-annotator
+    accuracies against the PLANTED confusion diagonals."""
+    import jax
+    import jax.numpy as jnp
+
+    from coda_tpu.crowd import (aggregate_votes, annotator_accuracy,
+                                init_reliability, make_annotators,
+                                parse_oracle_spec, sample_votes)
+
+    cfg = parse_oracle_spec(RELIABILITY_SPEC)
+    conf = make_annotators(cfg, n_classes)
+    planted = np.asarray(
+        jnp.diagonal(conf, axis1=-2, axis2=-1).mean(-1))      # (A,)
+
+    def step(carry, key):
+        rel = carry
+        k_z, k_votes = jax.random.split(key)
+        z = jax.random.randint(k_z, (), 0, n_classes, dtype=jnp.int32)
+        ann_ids, responses, answered = sample_votes(
+            k_votes, conf, z, cfg)
+        _, _, rel2 = aggregate_votes(rel, ann_ids, responses, answered,
+                                     cfg)
+        return rel2, None
+
+    keys = jax.random.split(jax.random.PRNGKey(7), rounds)
+    rel, _ = jax.lax.scan(step, init_reliability(cfg, n_classes), keys)
+    learned = np.asarray(annotator_accuracy(rel))
+
+    honest = np.arange(cfg.annotators) < cfg.annotators - cfg.adversarial
+    corr = float(np.corrcoef(learned, planted)[0, 1])
+    mae = float(np.abs(learned - planted).mean())
+    separated = bool(learned[~honest].max() < learned[honest].min())
+    return {
+        "spec": RELIABILITY_SPEC, "rounds": rounds,
+        "planted_accuracy": [round(float(v), 4) for v in planted],
+        "learned_accuracy": [round(float(v), 4) for v in learned],
+        "corr": corr, "mae": mae,
+        "adversaries_separated": separated,
+        "corr_bound": MIN_CORR, "mae_bound": MAX_MAE,
+        "ok": bool(corr >= MIN_CORR and mae <= MAX_MAE and separated),
+    }
+
+
+# ---------------------------------------------------------------------------
+# async serve delivery matrix
+# ---------------------------------------------------------------------------
+
+def _mkapp(record_dir: str, q: int, task):
+    from coda_tpu.serve.server import ServeApp
+    from coda_tpu.serve.state import SelectorSpec
+    from coda_tpu.telemetry import SessionRecorder
+
+    app = ServeApp(capacity=3, max_wait=0.001,
+                   spec=SelectorSpec.create("coda", n_parallel=3,
+                                            acq_batch=q),
+                   recorder=SessionRecorder(out_dir=record_dir))
+    app.add_task("t", task.preds)
+    app.start()
+    return app
+
+
+def _stream_digest(app, sid) -> str:
+    from coda_tpu.serve.recovery import data_rows
+
+    rows = data_rows(app.recorder.history(sid))
+    keys = ("n_labeled", "labeled_idx", "label", "next_idx", "next_prob",
+            "best", "pbest_max")
+    return hashlib.sha256(json.dumps(
+        [{k: r.get(k) for k in keys} for r in rows],
+        sort_keys=True).encode()).hexdigest()
+
+
+def _drive_session(app, sid, first, sampler, n_classes, rounds, q,
+                   in_order: bool, redeliver: bool, errors: list) -> dict:
+    """Answer ``rounds`` rounds slot-by-slot; out-of-order mode delivers
+    deferred answers late and redelivers ~every third answer after its
+    round committed (the dedupe must read, never re-apply)."""
+    stats = {"reorder_depth_max": 0, "redelivered": 0, "abstentions": 0}
+    out = first
+    for rnd in range(rounds):
+        idxs = out["idx"] if q > 1 else [out["idx"]]
+        held = []
+        for j, idx in enumerate(idxs):
+            true = int(idx) % n_classes
+            for attempt in range(64):
+                a = sampler.answer(sid, rnd, j, true, attempt=attempt)
+                if a["verb"] != "abstain":
+                    break
+                stats["abstentions"] += 1
+                app.answer(sid, j, abstain=True)
+            held.append((a["defer"], j, a["label"]))
+        order = sorted(held) if not in_order \
+            else sorted(held, key=lambda t: t[1])
+        delivered: list = []
+        committed = []
+        for d, j, lab in order:
+            depth = sum(1 for k in delivered if k > j)
+            stats["reorder_depth_max"] = max(stats["reorder_depth_max"],
+                                             depth)
+            rid = f"crowd:{sid}:{rnd}:{j}"
+            res = app.answer(sid, j, label=lab, request_id=rid)
+            delivered.append(j)
+            committed.append((j, lab, rid))
+        if res.get("verb") != "dispatched":
+            errors.append(f"round {rnd}: last answer verb "
+                          f"{res.get('verb')!r}")
+        out = res
+        if redeliver:
+            for j, lab, rid in committed[::3]:
+                dup = app.answer(sid, j, label=lab, request_id=rid)
+                if not dup.get("duplicate"):
+                    errors.append(f"round {rnd} slot {j}: redelivery was "
+                                  "not deduped")
+                stats["redelivered"] += 1
+    return stats
+
+
+def _async_matrix(args, rdir: str) -> dict:
+    """Out-of-order + duplicated delivery vs in-order delivery: same
+    committed stream, 0 lost / 0 double-applied; parked answers survive
+    a crash-restore."""
+    from coda_tpu.crowd import HostCrowdSampler, parse_oracle_spec
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.serve import recovery
+
+    q, rounds, n_classes = 3, args.serve_rounds, 4
+    task = make_synthetic_task(0, H=8, N=64, C=n_classes)
+    cfg = parse_oracle_spec(SERVE_SPEC)
+    errors: list = []
+
+    # the same deterministic sampler drives both delivery orders: the
+    # answers are identical, only WHEN each one arrives differs
+    d_ooo = os.path.join(rdir, "serve_ooo")
+    app = _mkapp(d_ooo, q, task)
+    first = app.open_session("t", seed=0)
+    sid = first["session"]
+    sampler = HostCrowdSampler(cfg, n_classes)
+    # pin the session id into the draw key so both apps sample identically
+    sampler_sid = "matrix"
+
+    class _Pinned:
+        def answer(self, _sid, rnd, j, true, attempt=0):
+            return sampler.answer(sampler_sid, rnd, j, true,
+                                  attempt=attempt)
+
+    stats = _drive_session(app, sid, first, _Pinned(), n_classes, rounds,
+                           q, in_order=False, redeliver=True,
+                           errors=errors)
+    n_ooo = app.store.get(sid).n_labeled
+    dig_ooo = _stream_digest(app, sid)
+    oracle_metrics = app.metrics.snapshot()["oracle"]
+
+    d_ino = os.path.join(rdir, "serve_inorder")
+    app2 = _mkapp(d_ino, q, task)
+    first2 = app2.open_session("t", seed=0)
+    sid2 = first2["session"]
+    _drive_session(app2, sid2, first2, _Pinned(), n_classes, rounds, q,
+                   in_order=True, redeliver=False, errors=errors)
+    n_ino = app2.store.get(sid2).n_labeled
+    dig_ino = _stream_digest(app2, sid2)
+
+    # crash-restore of parked answers: park q-1 answers of the next
+    # round, rebuild the app from the streams, finish the round
+    restored_ok = False
+    sess = app.store.get(sid)
+    nxt = sess.last["next_idx"]
+    for j in (1, 0):
+        app.answer(sid, j, label=int(nxt[j]) % n_classes,
+                   request_id=f"park:{j}")
+    app3 = _mkapp(d_ooo, q, task)
+    rep = recovery.restore_app_sessions(app3, d_ooo)
+    if sid in rep["restored"]:
+        s3 = app3.store.get(sid)
+        parked_restored = sorted(s3.parked) == [0, 1]
+        fin = app3.answer(sid, 2, label=int(nxt[2]) % n_classes,
+                          request_id="park:2")
+        restored_ok = bool(parked_restored
+                           and fin.get("verb") == "dispatched"
+                           and s3.n_labeled == (rounds + 1) * q)
+    else:
+        errors.append(f"crash-restore failed: {rep['failed']}")
+    for a in (app, app2, app3):
+        a.drain()
+
+    lost = abs(rounds * q - n_ooo) + abs(rounds * q - n_ino)
+    return {
+        "spec": SERVE_SPEC, "rounds": rounds, "acq_batch": q,
+        "digest_out_of_order": dig_ooo, "digest_in_order": dig_ino,
+        "digest_match": dig_ooo == dig_ino,
+        "labels_applied": int(n_ooo), "lost": int(lost),
+        # applied-exactly-once: every duplicate redelivery was READ from
+        # the committed round, never re-applied (label counts agree and
+        # the streams are digest-identical)
+        "redelivered": stats["redelivered"],
+        "double_applied": int(lost if dig_ooo == dig_ino else 1),
+        "reorder_depth_max": stats["reorder_depth_max"],
+        "abstentions": stats["abstentions"],
+        "parked_restored": restored_ok,
+        "server_metrics": oracle_metrics,
+        "errors": errors[:10], "n_errors": len(errors),
+        "ok": bool(dig_ooo == dig_ino and lost == 0 and restored_ok
+                   and not errors),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="ROBUSTNESS_CPU_r18.json")
+    p.add_argument("--quick", action="store_true",
+                   help="small shapes / fewer rounds (smoke; still gated)")
+    p.add_argument("--records-dir", default=None,
+                   help="keep the run records here (default: a tempdir)")
+    p.add_argument("--skip-gate", action="store_true",
+                   help="write the artifact without self-gating (debug)")
+    args = p.parse_args(argv)
+
+    args.shape = "8,128,4" if args.quick else "8,256,4"
+    args.iters = 20 if args.quick else 40
+    args.seeds = 2 if args.quick else 3
+    args.serve_rounds = 4 if args.quick else 8
+    reliability_rounds = 150 if args.quick else 400
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rdir = args.records_dir or tempfile.mkdtemp(prefix="robustness_")
+    os.makedirs(rdir, exist_ok=True)
+
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    print(f"[1/4] recording plain/clean/noisy runs ({args.shape}, "
+          f"iters={args.iters}, seeds={args.seeds}) ...")
+    dirs = _record_three(args, rdir)
+    print("[2/4] clean parity (cli replay --against --score-tol 0) ...")
+    clean = _clean_parity(dirs)
+    print(f"      parity={clean['parity']}")
+    noisy = _noisy_envelope(dirs)
+    print(f"      noisy envelope: ratio="
+          f"{noisy['max_final_ratio']} ok={noisy['envelope_ok']}")
+    print(f"[3/4] Dawid-Skene recovery ({reliability_rounds} rounds) ...")
+    reliability = _reliability_recovery(reliability_rounds)
+    print(f"      corr={reliability['corr']:.3f} "
+          f"mae={reliability['mae']:.3f} "
+          f"separated={reliability['adversaries_separated']}")
+    print("[4/4] async serve delivery matrix ...")
+    async_m = _async_matrix(args, rdir)
+    print(f"      digest_match={async_m['digest_match']} "
+          f"lost={async_m['lost']} restored={async_m['parked_restored']}")
+
+    ok = bool(clean["parity"] and noisy["envelope_ok"]
+              and reliability["ok"] and async_m["ok"])
+    report = {
+        "bench": "oracle_robustness",
+        "quick": bool(args.quick),
+        "config": {"shape": args.shape, "iters": args.iters,
+                   "seeds": args.seeds,
+                   "serve_rounds": args.serve_rounds,
+                   "reliability_rounds": reliability_rounds},
+        "clean": clean,
+        "noisy": noisy,
+        "reliability": reliability,
+        "async": async_m,
+        "verify": [
+            f"python -m coda_tpu.cli replay {dirs['plain']}",
+            f"python -m coda_tpu.cli replay {dirs['clean']} "
+            f"--against {dirs['plain']} --score-tol 0",
+        ],
+        "fingerprint": environment_fingerprint(knobs={
+            "bench": "oracle_robustness", "quick": bool(args.quick),
+            "shape": args.shape, "iters": args.iters,
+            "seeds": args.seeds, "noisy_spec": NOISY_SPEC,
+            "reliability_spec": RELIABILITY_SPEC,
+            "serve_spec": SERVE_SPEC}),
+        "ok": ok,
+    }
+    out_path = os.path.abspath(args.out)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"wrote {out_path} (ok={ok})")
+    if args.records_dir is None:
+        shutil.rmtree(rdir, ignore_errors=True)
+
+    # self-gate: the artifact must satisfy its own check_perf contract
+    if not args.skip_gate:
+        from check_perf import check_artifact, match_contract
+
+        contract = match_contract(out_path)
+        if contract is None:
+            print("self-gate: no contract matches the artifact name")
+            return 1
+        violations = check_artifact(out_path, report, contract)
+        for v in violations:
+            print(f"self-gate: {v}")
+        if violations:
+            return 1
+        print("self-gate clean")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,6 +207,16 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                  "--only", "fleet_partition_heal,fleet_stale_owner_fence",
                  "--out", os.path.join(tmpdir, "fleet_chaos.json")],
                 os.path.join(tmpdir, "fleet_chaos.json"), 900),
+            # the crowd-oracle robustness matrix at smoke scale: clean
+            # bitwise parity, noisy regret envelope, Dawid-Skene
+            # recovery, async out-of-order delivery (the committed
+            # bounds live in the full ROBUSTNESS_* capture)
+            "oracle_noise": (
+                [py, "scripts/bench_robustness.py", "--quick",
+                 "--out", os.path.join(tmpdir, "robustness.json"),
+                 "--records-dir",
+                 os.path.join(tmpdir, "robustness_records")],
+                os.path.join(tmpdir, "robustness.json"), 900),
         }
     return {
         # the r09 evidence set the ROADMAP asks for, in one run
@@ -283,6 +293,16 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
             [py, "scripts/check_fault_matrix.py", "--fleet",
              "--out", os.path.join(tmpdir, "fleet_chaos.json")],
             os.path.join(tmpdir, "fleet_chaos.json"), 3600),
+        # the full crowd-oracle robustness matrix (the ROBUSTNESS_*
+        # configuration): clean parity bitwise, the committed noisy
+        # regret envelope, Dawid-Skene recovery of the planted pool,
+        # async out-of-order delivery digest-equivalent
+        "oracle_noise": (
+            [py, "scripts/bench_robustness.py",
+             "--out", os.path.join(tmpdir, "robustness.json"),
+             "--records-dir",
+             os.path.join(tmpdir, "robustness_records")],
+            os.path.join(tmpdir, "robustness.json"), 3600),
     }
 
 

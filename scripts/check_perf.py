@@ -550,6 +550,87 @@ def surrogate_check_report(report: dict) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# the crowd-oracle robustness contract (ISSUE 16: noisy / abstaining /
+# asynchronous labelers with a learned annotator-reliability posterior)
+# ---------------------------------------------------------------------------
+
+# noisy-crowd regret envelope vs the clean oracle at the same label
+# budget (label-aligned final cumulative regret): a reliability-weighted
+# noisy crowd costs labels, not correctness — bounded ratio plus the
+# near-zero-regret absolute slack (the batchq/surrogate precedent)
+ORACLE_ENVELOPE_RATIO = 2.0
+ORACLE_ENVELOPE_ABS = 1.0
+# the Dawid-Skene recovery floor: learned per-annotator accuracies vs
+# the planted confusion diagonals after the artifact's vote budget.
+# Correlation + adversary separation are the recovery claims; the MAE
+# bound only guards gross miscalibration — the posterior-mean diagonal
+# is systematically shrunk toward 1/C by the Laplace prior and by
+# soft-assignment teaching (imperfect aggregated labels spread mass
+# off the true confusion row), so absolute agreement tighter than
+# ~0.2 is not achievable without a supervised debias pass
+ORACLE_MIN_RELIABILITY_CORR = 0.8
+ORACLE_MAX_RELIABILITY_MAE = 0.25
+
+
+def robustness_check_report(report: dict) -> list[str]:
+    """Violations of one crowd-oracle robustness capture (empty = clean):
+    clean-config bitwise parity through the real ``cli replay --against
+    --score-tol 0`` path, the noisy regret envelope triaged as
+    ``oracle-noise-envelope``, Dawid-Skene recovery of the planted pool
+    (with every adversarial annotator ranked below every honest one),
+    and the async serve matrix (out-of-order == in-order digest, 0
+    lost / double-applied labels, parked answers surviving restore)."""
+    out: list[str] = []
+    clean = report.get("clean") or {}
+    if clean.get("parity") is not True:
+        out.append("clean.parity is not true (--oracle-noise clean must "
+                   "verify bitwise against the knob-less record through "
+                   "cli replay --against --score-tol 0)")
+    noisy = report.get("noisy") or {}
+    if noisy.get("classification") != "oracle-noise-envelope":
+        out.append(f"noisy.classification "
+                   f"{noisy.get('classification')!r} — the oracle-knob "
+                   "diff must route to the regret-envelope triage")
+    for i, seed in enumerate(noisy.get("per_seed") or []):
+        ca, cb = seed.get("final_cum_a"), seed.get("final_cum_b")
+        if not all(isinstance(v, (int, float)) for v in (ca, cb)):
+            out.append(f"noisy.per_seed[{i}] missing final cum regrets")
+        elif cb > ORACLE_ENVELOPE_RATIO * ca + ORACLE_ENVELOPE_ABS:
+            out.append(
+                f"noisy seed {i} final cum regret {cb:.4f} outside the "
+                f"committed envelope ({ORACLE_ENVELOPE_RATIO} * {ca:.4f}"
+                f" + {ORACLE_ENVELOPE_ABS})")
+    if not noisy.get("per_seed"):
+        out.append("noisy.per_seed missing/empty")
+    rel = report.get("reliability") or {}
+    corr, mae = rel.get("corr"), rel.get("mae")
+    if not all(isinstance(v, (int, float)) for v in (corr, mae)):
+        out.append("reliability.corr/mae missing")
+    else:
+        if corr < ORACLE_MIN_RELIABILITY_CORR:
+            out.append(f"reliability.corr {corr:.3f} < "
+                       f"{ORACLE_MIN_RELIABILITY_CORR} (the posterior "
+                       "did not recover the planted pool)")
+        if mae > ORACLE_MAX_RELIABILITY_MAE:
+            out.append(f"reliability.mae {mae:.3f} > "
+                       f"{ORACLE_MAX_RELIABILITY_MAE}")
+    if rel.get("adversaries_separated") is not True:
+        out.append("reliability.adversaries_separated is not true (an "
+                   "adversarial annotator ranked above an honest one)")
+    asyn = report.get("async") or {}
+    if asyn.get("digest_match") is not True:
+        out.append("async.digest_match is not true (out-of-order "
+                   "deferred delivery must commit the in-order stream)")
+    if asyn.get("parked_restored") is not True:
+        out.append("async.parked_restored is not true (parked answers "
+                   "must survive a crash-restore)")
+    if not asyn.get("redelivered"):
+        out.append("async.redelivered is 0/missing (the dedupe path "
+                   "went unexercised)")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # the fault-matrix contracts (ISSUE 14: the fleet chaos matrix is a
 # committed, machine-checked artifact like every perf claim)
 # ---------------------------------------------------------------------------
@@ -626,7 +707,8 @@ EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
 # manifest's own "skipped" list records)
 EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered",
                                 "bench_batchq", "serve_fleet",
-                                "serve_fleet_chaos", "bench_surrogate")
+                                "serve_fleet_chaos", "bench_surrogate",
+                                "oracle_noise")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -705,6 +787,16 @@ def _evidence_check(report: dict) -> list[str]:
         if "fleet_partition_heal" not in sc:
             out.append("serve_fleet_chaos: the partition+heal proof "
                        "scenario is missing")
+    rep = (arts.get("oracle_noise") or {}).get("report") or {}
+    if rep:
+        if rep.get("ok") is not True:
+            out.append("oracle_noise.report.ok is not true (clean "
+                       "parity / noisy envelope / reliability recovery "
+                       "/ async delivery broke in-capture)")
+        asyn = rep.get("async") or {}
+        if asyn.get("lost") or asyn.get("double_applied"):
+            out.append("oracle_noise.report.async lost/double-applied "
+                       "labels != 0")
     rep = (arts.get("bench") or {}).get("report") or {}
     if rep and not (isinstance(rep.get("value"), (int, float))
                     and rep["value"] > 0):
@@ -878,6 +970,27 @@ CONTRACTS: tuple = (
         regress=("round_s_marginal", "lower", 0.5),
         note="sparse:K posterior at the r05 pool shape — round time, "
              "state bytes, and the replay-triaged score contract"),
+    # -- crowd-oracle robustness matrix (ISSUE 16) --
+    Contract(
+        pattern="ROBUSTNESS_*.json", kind="oracle_robustness",
+        required=("bench", "fingerprint.backend", "clean.parity",
+                  "noisy.max_final_ratio", "reliability.corr",
+                  "reliability.mae", "async.digest_match",
+                  "async.lost", "async.double_applied", "ok"),
+        bounds=(("bench", "==", "oracle_robustness"),
+                ("ok", "==", True),
+                ("clean.parity", "==", True),
+                ("clean.replay_rc", "==", 0),
+                ("clean.against_rc", "==", 0),
+                ("async.lost", "==", 0),
+                ("async.double_applied", "==", 0),
+                ("async.n_errors", "==", 0)),
+        checker=robustness_check_report, fingerprint="required",
+        group="robustness",
+        note="crowd-oracle matrix (ISSUE 16): clean-config bitwise "
+             "parity, noisy regret envelope, Dawid-Skene recovery of "
+             "the planted pool, async out-of-order delivery digest-"
+             "equivalent with 0 lost/double-applied labels"),
     # -- fault matrices (recovery claims are gated artifacts too) --
     Contract(
         pattern="FAULT_MATRIX_FLEET_*.json", kind="fault_matrix_fleet",

@@ -5,9 +5,10 @@ evidence: a record that silently drifted from the schema — missing version
 stamp, renamed array, wrong dtype/rank, seed/round counts that disagree
 between meta and arrays — would make ``cli replay`` triage garbage instead
 of failing loudly. This checker walks a directory tree and validates every
-artifact it finds against the versioned schema (record v1/v2/v3 — v2 adds
+artifact it finds against the versioned schema (record v1-v4 — v2 adds
 the ``acq_batch`` stamp and q-wide decision arrays, v3 the per-round
-``surrogate_fallback`` array of the contract-gated EIG surrogate; session
+``surrogate_fallback`` array of the contract-gated EIG surrogate, v4 the
+OPTIONAL crowd-oracle arrays ``oracle_label``/``label_weight``; session
 streams at the current version only):
 
   * ``record.json`` + ``rounds.npz`` pairs (batch/suite records): version
@@ -47,6 +48,7 @@ def check_record(dir_path: str) -> list[str]:
     from coda_tpu.telemetry.recorder import (
         REQUIRED_META,
         SUPPORTED_RECORD_VERSIONS,
+        optional_arrays,
         required_arrays,
     )
 
@@ -73,6 +75,10 @@ def check_record(dir_path: str) -> list[str]:
     REQUIRED_ARRAYS = required_arrays(
         q if isinstance(q, int) else 1,
         schema_version=v if isinstance(v, int) else 1)
+    # v4's crowd-oracle arrays: allowed (and validated) when present,
+    # never demanded — clean records carry neither
+    OPTIONAL_ARRAYS = optional_arrays(q if isinstance(q, int) else 1) \
+        if isinstance(v, int) and v >= 4 else {}
     for key in REQUIRED_META:
         if key not in meta:
             out.append(f"record.json missing required field {key!r}")
@@ -115,7 +121,22 @@ def check_record(dir_path: str) -> list[str]:
                 and a.shape[2] != q:
             out.append(f"{name}: label-batch extent {a.shape[2]} != "
                        f"meta acq_batch {q}")
-    extra = set(arrays) - set(REQUIRED_ARRAYS)
+    for name, (kind, ndim) in OPTIONAL_ARRAYS.items():
+        a = arrays.get(name)
+        if a is None:
+            continue
+        if a.dtype.kind != kind:
+            out.append(f"{name}: dtype kind {a.dtype.kind!r} != "
+                       f"expected {kind!r}")
+        if a.ndim != ndim:
+            out.append(f"{name}: rank {a.ndim} != expected {ndim}")
+        elif isinstance(S, int) and a.shape[0] != S:
+            out.append(f"{name}: leading seed extent {a.shape[0]} != "
+                       f"meta seeds {S}")
+        elif isinstance(T, int) and a.shape[1] != T:
+            out.append(f"{name}: round extent {a.shape[1]} != "
+                       f"meta rounds {T}")
+    extra = set(arrays) - set(REQUIRED_ARRAYS) - set(OPTIONAL_ARRAYS)
     if extra:
         out.append(f"unversioned field drift: unexpected arrays "
                    f"{sorted(extra)} (bump RECORD_SCHEMA_VERSION)")
@@ -148,9 +169,12 @@ def check_session_stream(fp: str) -> list[str]:
                        f"{list(SUPPORTED_SESSION_VERSIONS)}")
         kind = row.get("kind")
         if kind is not None:
-            # marker lines: the open header and the clean-close marker
-            # (crash restore keys on its absence); anything else is drift
-            if kind not in ("session_meta", "session_close"):
+            # marker lines: the open header, the clean-close marker
+            # (crash restore keys on its absence), the exported-session
+            # tombstone, and v4's parked per-slot crowd answers; anything
+            # else is drift
+            if kind not in ("session_meta", "session_close",
+                            "session_export", "answer_park"):
                 out.append(f"line {i}: unknown row kind {kind!r} "
                            "(bump SESSION_SCHEMA_VERSION)")
             continue

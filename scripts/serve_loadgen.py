@@ -82,6 +82,11 @@ class InprocClient:
     def labels(self, sid, labels, request_id=None):
         return self.app.labels(sid, labels, request_id=request_id)
 
+    def answer(self, sid, slot, label=None, request_id=None,
+               abstain=False):
+        return self.app.answer(sid, slot, label=label,
+                               request_id=request_id, abstain=abstain)
+
     def close(self, sid):
         app = self.app
         out = app.close_session(sid)
@@ -152,6 +157,17 @@ class HttpClient:
         if request_id is not None:
             body["request_id"] = request_id
         return self._req("POST", f"/session/{sid}/labels", body)
+
+    def answer(self, sid, slot, label=None, request_id=None,
+               abstain=False):
+        body = {"slot": slot}
+        if abstain:
+            body["abstain"] = True
+        else:
+            body["label"] = label
+        if request_id is not None:
+            body["request_id"] = request_id
+        return self._req("POST", f"/session/{sid}/answer", body)
 
     def close(self, sid):
         return self._req("DELETE", f"/session/{sid}")
@@ -351,6 +367,120 @@ def _batch_run(client, n_classes, workers, sessions, rounds, q,
                     dt = time.perf_counter() - t0
                     latencies.append(dt)
                     label_latencies.extend([dt / q] * q)
+                n = out.get("n_labeled")
+                if n is not None and n != rounds * q:
+                    errors.append(
+                        f"session {sid}: server applied {n} labels, "
+                        f"client issued {rounds * q}")
+                client.close(sid)
+                sid = None
+            except Exception as e:
+                errors.append(repr(e))
+                if sid is not None:
+                    try:
+                        client.close(sid)
+                    except Exception:
+                        pass
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _oracle_run(client, n_classes, workers, sessions, rounds, q,
+                oracle_cfg, latencies, errors, crowd, retries=0,
+                backoff_s=0.05, retried=None):
+    """``--oracle-noise`` mode: the free-run arrival model driving the
+    per-slot ``answer`` verb with a deterministic noisy crowd
+    (``coda_tpu/crowd/oracle.py``'s :class:`HostCrowdSampler`).
+
+    Each round, every proposed slot gets an answer from the sampled
+    annotator: abstentions are posted (the slot stays open) and the item
+    re-requested from another annotator; deferred answers are DELIVERED
+    LATE — non-deferred slots post first in slot order, deferred ones
+    after, sorted by depth — so the server's parking layer sees genuine
+    out-of-order arrival. ``crowd`` accumulates the per-annotator answer
+    mix, abstention count, and deferral/reorder depths the report prints
+    next to the latency rings."""
+    from coda_tpu.crowd import HostCrowdSampler
+
+    sampler = HostCrowdSampler(oracle_cfg, n_classes)
+    counter = {"next": 0}
+    lock = threading.Lock()
+
+    def take():
+        with lock:
+            s = counter["next"]
+            if s >= sessions:
+                return None
+            counter["next"] = s + 1
+            return s
+
+    def bump(field, n=1):
+        with lock:
+            crowd[field] += n
+
+    def high_water(field, v):
+        with lock:
+            crowd[field] = max(crowd[field], v)
+
+    def worker():
+        while True:
+            seed = take()
+            if seed is None:
+                return
+            sid = None
+            try:
+                t0 = time.perf_counter()
+                out = with_retries(lambda: client.open(seed),
+                                   retries, backoff_s, retried)
+                sid = out["session"]
+                latencies.append(time.perf_counter() - t0)
+                for rnd in range(rounds):
+                    idxs = out["idx"] if q > 1 else [out["idx"]]
+                    held = []          # (defer_depth, slot, label)
+                    for j, idx in enumerate(idxs):
+                        true = int(idx) % n_classes
+                        for attempt in range(64):
+                            a = sampler.answer(sid, rnd, j, true,
+                                               attempt=attempt)
+                            with lock:
+                                crowd["mix"][a["annotator"]] += 1
+                            if a["verb"] != "abstain":
+                                break
+                            # post the abstention (the slot stays open)
+                            # and re-request from another annotator
+                            bump("abstentions")
+                            with_retries(
+                                lambda j=j: client.answer(
+                                    sid, j, abstain=True),
+                                retries, backoff_s, retried)
+                        held.append((a["defer"], j, a["label"]))
+                        if a["defer"]:
+                            bump("deferred")
+                            high_water("defer_depth_max", a["defer"])
+                    # delivery order: prompt answers in slot order first,
+                    # deferred ones late (by depth) — out-of-order arrival
+                    delivered: list = []
+                    for d, j, lab in sorted(held):
+                        depth = sum(1 for k in delivered if k > j)
+                        high_water("reorder_depth_max", depth)
+                        rid = f"crowd:{sid}:{rnd}:{j}"
+                        t0 = time.perf_counter()
+                        out = with_retries(
+                            lambda j=j, lab=lab, rid=rid: client.answer(
+                                sid, j, label=lab, request_id=rid),
+                            retries, backoff_s, retried)
+                        latencies.append(time.perf_counter() - t0)
+                        delivered.append(j)
+                        bump("answers")
+                    if out.get("verb") != "dispatched":
+                        errors.append(
+                            f"session {sid} round {rnd}: last answer did "
+                            f"not complete the round ({out.get('verb')!r})")
+                        break
                 n = out.get("n_labeled")
                 if n is not None and n != rounds * q:
                     errors.append(
@@ -1007,10 +1137,12 @@ def run_loadgen(args) -> dict:
     if getattr(args, "fleet", None):
         if args.url or args.http or args.mux or args.lockstep or \
                 getattr(args, "zipf", None) is not None or \
+                getattr(args, "oracle_noise", None) or \
                 (getattr(args, "labels_per_round", None) or 1) > 1:
             raise SystemExit("--fleet drives the in-process router with "
                              "the free-run loop; drop --url/--http/--mux/"
-                             "--lockstep/--zipf/--labels-per-round")
+                             "--lockstep/--zipf/--labels-per-round/"
+                             "--oracle-noise")
         if getattr(args, "rolling_restart_at", None) is not None \
                 and args.retries < 1:
             raise SystemExit("--rolling-restart-at needs --retries >= 1")
@@ -1026,7 +1158,18 @@ def run_loadgen(args) -> dict:
     app = srv = None
     warm_s = None
     lpr = getattr(args, "labels_per_round", None)
-    if lpr is not None and lpr > 1:
+    oracle_cfg = None
+    if getattr(args, "oracle_noise", None):
+        from coda_tpu.crowd import parse_oracle_spec
+
+        oracle_cfg = parse_oracle_spec(args.oracle_noise)
+        if args.lockstep or args.mux or getattr(args, "zipf", None) \
+                is not None:
+            raise SystemExit("--oracle-noise drives the per-slot answer "
+                             "verb with its own arrival model; drop "
+                             "--lockstep/--mux/--zipf")
+        if lpr is not None and lpr > 1:
+            args.acq_batch = lpr
         if args.lockstep or args.mux or getattr(args, "zipf", None) \
                 is not None:
             # those arrival models drive the single-label verb, which a
@@ -1107,6 +1250,17 @@ def run_loadgen(args) -> dict:
              latencies, errors, ramp_s=args.ramp_s,
              retries=args.retries, backoff_s=backoff_s, retried=retried)
         mode = "mux"
+    elif oracle_cfg is not None:
+        n_sessions = args.sessions
+        q = lpr if (lpr is not None and lpr > 1) else 1
+        crowd = {"mix": np.zeros(oracle_cfg.annotators, np.int64),
+                 "answers": 0, "abstentions": 0, "deferred": 0,
+                 "defer_depth_max": 0, "reorder_depth_max": 0}
+        _oracle_run(client, n_classes, args.workers, args.sessions,
+                    args.labels, q, oracle_cfg, latencies, errors, crowd,
+                    retries=args.retries, backoff_s=backoff_s,
+                    retried=retried)
+        mode = "oracle"
     elif lpr is not None and lpr > 1:
         n_sessions = args.sessions
         label_latencies: list = []
@@ -1201,6 +1355,7 @@ def run_loadgen(args) -> dict:
         "think_ms": getattr(args, "think_ms", 0.0),
         "requests": getattr(args, "requests", None),
         "labels_per_round": lpr,
+        "oracle_noise": getattr(args, "oracle_noise", None),
         "task": args.task or args.synthetic or "default"})
     # per-bucket executable cost attribution (warm-pool harvest): which
     # side of the roofline the slab step sits on, machine-read
@@ -1258,6 +1413,24 @@ def run_loadgen(args) -> dict:
                 "mean": float(np.mean(label_latencies) * 1e3)
                 if label_latencies else None,
             },
+        },
+        # crowd-oracle evidence (--oracle-noise): the client-side answer
+        # mix per annotator, abstention rate, and deferral/reorder depths
+        # next to the latency rings, plus the server's parking counters
+        # (answers parked, rounds completed via deferred delivery, dedupe
+        # rejections — the exactly-once evidence under reordering)
+        "oracle": None if oracle_cfg is None else {
+            "spec": args.oracle_noise,
+            "annotators": oracle_cfg.annotators,
+            "answers": int(crowd["answers"]),
+            "annotator_mix": [int(v) for v in crowd["mix"]],
+            "abstentions": int(crowd["abstentions"]),
+            "abstention_rate": (crowd["abstentions"]
+                                / max(1, int(crowd["mix"].sum()))),
+            "deferred": int(crowd["deferred"]),
+            "defer_depth_max": int(crowd["defer_depth_max"]),
+            "reorder_depth_max": int(crowd["reorder_depth_max"]),
+            "server": stats.get("oracle"),
         },
         "server": {
             "dispatches": stats.get("dispatches"),
@@ -1399,6 +1572,17 @@ def parse_args(argv=None):
                         "import (replay-verified), and swap the clients "
                         "over — the zero-drop migration demo (in-process "
                         "free-run only; needs --retries)")
+    p.add_argument("--oracle-noise", default=None, metavar="SPEC",
+                   help="crowd-oracle mode: answer every proposed slot "
+                        "through POST /session/{id}/answer with a "
+                        "deterministic noisy crowd (coda_tpu/crowd "
+                        "spec grammar, e.g. 'annotators=8,abstain=0.1,"
+                        "defer=0.3:4'); abstentions re-request the item, "
+                        "deferred answers are delivered late/out of "
+                        "order; reports per-annotator answer mix, "
+                        "abstention rate, and reorder depth next to the "
+                        "latency rings (with --labels-per-round Q the "
+                        "rounds are Q wide)")
     p.add_argument("--http", action="store_true",
                    help="drive the in-process app over real HTTP instead "
                         "of direct calls")

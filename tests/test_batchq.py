@@ -497,7 +497,7 @@ def test_old_session_stream_version_gated(tmp_path, task):
     # unknown versions: the schema gate names the real reason
     with pytest.raises(ValueError, match="stream schema v1"):
         verify_session_stream(store, dict(meta, v=1), [], sid="v1")
-    assert _stream_version_error({"v": 4}) is not None
+    assert _stream_version_error({"v": 5}) is not None
     assert _stream_version_error({"v": 3}) is None
     # a v2 stream restoring onto an acq_batch>1 server: rejected for the
     # acq_batch mismatch (restore_app_sessions path)

@@ -1,0 +1,451 @@
+"""The crowd oracle subsystem contract (ISSUE 16).
+
+  * the reliability-weighted scatter conserves row mass in EVERY branch
+    (tracked, untracked-insert, untracked-absorb) under arbitrary
+    weights, and the Beta reduction matches the dense weighted add;
+  * ``weight=1`` is BITWISE the unweighted update — dense and sparse,
+    q=1 (``update_w``) and q=8 (``update_qw``) — so a clean config can
+    never drift by riding the weighted code path;
+  * ``weight=0`` is a STRUCTURAL no-op on the posterior (no eviction,
+    no residual motion), the all-abstain fallback;
+  * the Dawid-Skene posterior recovers a planted annotator pool —
+    ranking correlation against the planted diagonals, with every
+    adversarial annotator ranked below every honest one;
+  * ``cfg.clean`` runs the engine's own program bitwise (the crowd
+    machinery never traces);
+  * ``Oracle.answer_batch`` is pinned identical to the scalar loop;
+  * the serve ``answer`` verb: out-of-order delivery parks and matches
+    the in-order stream digest byte-for-byte, request-id dedupe makes
+    redelivery idempotent and rejects conflicting payloads, abstention
+    leaves the slot open, parked answers survive crash-restore, and
+    ``oracle_abstain``/``oracle_poison`` inject through the front door.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _rand_dirichlets(key, H, C):
+    return jax.random.uniform(key, (H, C, C), minval=0.05, maxval=3.0)
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        if x is None or y is None:
+            assert x is y
+            continue
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# spec parsing + host oracle
+# ---------------------------------------------------------------------------
+
+def test_parse_oracle_spec():
+    from coda_tpu.crowd.oracle import parse_oracle_spec
+
+    assert parse_oracle_spec(None).clean
+    assert parse_oracle_spec("clean").clean
+    cfg = parse_oracle_spec(
+        "annotators=6,votes=3,acc=0.6:0.9,abstain=0.1,adversarial=2,"
+        "trust=16,defer=0.2:5,reliability=majority,seed=7")
+    assert not cfg.clean
+    assert (cfg.annotators, cfg.votes) == (6, 3)
+    assert (cfg.acc_lo, cfg.acc_hi) == (0.6, 0.9)
+    assert (cfg.abstain, cfg.adversarial, cfg.trust_votes) == (0.1, 2, 16.0)
+    assert (cfg.defer, cfg.defer_depth) == (0.2, 5)
+    assert cfg.reliability == "majority" and cfg.seed == 7
+
+    for bad in ("bogus=1", "reliability=vote", "annotators=0",
+                "annotators=2,adversarial=2", "abstain=1.5", "votes"):
+        with pytest.raises(ValueError):
+            parse_oracle_spec(bad)
+
+
+def test_host_sampler_deterministic_and_attempt_readdressed():
+    from coda_tpu.crowd.oracle import HostCrowdSampler, parse_oracle_spec
+
+    cfg = parse_oracle_spec(
+        "annotators=4,votes=1,abstain=0.3,defer=0.4:3,seed=5")
+    s = HostCrowdSampler(cfg, n_classes=4)
+    a1 = s.answer("sess", 3, 1, true_label=2)
+    a2 = s.answer("sess", 3, 1, true_label=2)
+    assert a1 == a2  # pure function of (session, round, slot, attempt)
+    # a re-request (attempt bump) re-addresses the draws
+    alts = {json.dumps(s.answer("sess", 3, 1, 2, attempt=t))
+            for t in range(8)}
+    assert len(alts) > 1
+    # verbs stay in-protocol and labels in-range over a sweep
+    for r in range(20):
+        out = s.answer("x", r, 0, true_label=r % 4)
+        assert out["verb"] in ("answer", "abstain")
+        assert 0 <= out["label"] < 4 and 0 <= out["defer"] <= 3
+
+
+def test_answer_batch_matches_scalar_loop(tiny_task):
+    from coda_tpu.oracle import Oracle
+
+    oracle = Oracle(tiny_task)
+    idxs = [0, 5, 3, 5, 47, 1, 0, 12]
+    got = oracle.answer_batch(idxs)
+    want = [oracle(i) for i in idxs]
+    assert got == want
+    assert all(isinstance(v, int) for v in got)
+
+
+# ---------------------------------------------------------------------------
+# weighted scatter: mass conservation, w=1 bitwise, w=0 structural no-op
+# ---------------------------------------------------------------------------
+
+def test_weighted_scatter_conserves_row_mass():
+    """Arbitrary per-answer weights: every row's total mass grows by
+    exactly lr * sum(weights landing on it), in every branch (tracked
+    hit, untracked insert-with-eviction, untracked residual-absorb) —
+    so the Beta reduction matches the dense weighted add."""
+    from coda_tpu.ops.beta import dirichlet_to_beta
+    from coda_tpu.ops.sparse_rows import scatter_rows, sparsify, to_beta
+
+    H, C, K, lr = 6, 12, 3, 0.7
+    d = _rand_dirichlets(jax.random.PRNGKey(3), H, C)
+    s = sparsify(d, K)
+    rng = np.random.default_rng(0)
+    q = 5
+    tcs = jnp.asarray([2, 7, 2, 0, 7], jnp.int32)     # with collisions
+    pcs = jnp.asarray(rng.integers(0, C, (q, H)), jnp.int32)
+    ws = jnp.asarray([0.25, 1.0, 0.0, 0.6, 1.7], jnp.float32)
+
+    s2 = scatter_rows(s, tcs, pcs, lr, weights=ws)
+    mass = lambda st: (st.diag + st.vals.sum(-1) + st.resid)   # (H, C)
+    inc = np.zeros((H, C), np.float32)
+    for j in range(q):
+        inc[:, int(tcs[j])] += lr * float(ws[j])
+    np.testing.assert_allclose(np.asarray(mass(s2)),
+                               np.asarray(mass(s)) + inc,
+                               rtol=0, atol=1e-4)
+
+    # Beta reduction matches the dense weighted scatter-add
+    d2 = d
+    for j in range(q):
+        onehot = jax.nn.one_hot(pcs[j], C, dtype=d.dtype)
+        d2 = d2.at[:, tcs[j], :].add(lr * ws[j] * onehot)
+    a_ref, b_ref = dirichlet_to_beta(d2)
+    a, b = to_beta(s2)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref),
+                               rtol=0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(b), np.asarray(b_ref),
+                               rtol=0, atol=1e-4)
+
+
+def test_weight_one_bitwise_scatter():
+    """w=1 (and all-ones ws) produce bit-identical leaves to the
+    unweighted path — truncated AND parity layouts, q=1 and q=8."""
+    from coda_tpu.ops.sparse_rows import scatter_row, scatter_rows, sparsify
+
+    H, C = 5, 10
+    d = _rand_dirichlets(jax.random.PRNGKey(4), H, C)
+    rng = np.random.default_rng(1)
+    q = 8
+    tcs = jnp.asarray(rng.integers(0, C, (q,)), jnp.int32)
+    pcs = jnp.asarray(rng.integers(0, C, (q, H)), jnp.int32)
+    ones = jnp.ones((q,), jnp.float32)
+    for k in (3, C):
+        s = sparsify(d, k)
+        _leaves_equal(
+            scatter_row(s, tcs[0], pcs[0], 0.5, weight=jnp.float32(1.0)),
+            scatter_row(s, tcs[0], pcs[0], 0.5))
+        _leaves_equal(scatter_rows(s, tcs, pcs, 0.5, weights=ones),
+                      scatter_rows(s, tcs, pcs, 0.5))
+
+
+def test_weight_zero_structural_noop():
+    """w=0 leaves every posterior leaf bitwise untouched — including the
+    index leaf (no eviction on the strength of the residual share)."""
+    from coda_tpu.ops.sparse_rows import scatter_row, sparsify
+
+    H, C = 5, 10
+    d = _rand_dirichlets(jax.random.PRNGKey(5), H, C)
+    s = sparsify(d, 3)
+    rng = np.random.default_rng(2)
+    for tc in range(C):
+        pc = jnp.asarray(rng.integers(0, C, (H,)), jnp.int32)
+        s0 = scatter_row(s, jnp.int32(tc), pc, 0.5,
+                         weight=jnp.float32(0.0))
+        _leaves_equal(s0, s)
+
+
+def test_weight_one_bitwise_selector_dense_and_sparse(tiny_task):
+    """The selector-level pin: update_w(w=1) == update and
+    update_qw(ones) == update_q on real CODA states, dense and sparse."""
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+    from coda_tpu.selectors.batch import resolve_batch_fns, resolve_batch_wfns
+
+    task = tiny_task
+    q = 8
+    rng = np.random.default_rng(3)
+    idxs = jnp.asarray(rng.choice(task.preds.shape[1], q, replace=False),
+                       jnp.int32)
+    tcs = jnp.asarray(rng.integers(0, 4, (q,)), jnp.int32)
+    probs = jnp.full((q,), 0.5, jnp.float32)
+    for posterior in ("dense", "sparse:4"):
+        sel = make_coda(task.preds, CODAHyperparams(
+            eig_chunk=64, num_points=64, posterior=posterior))
+        state = sel.init(jax.random.PRNGKey(0))
+        # q=1
+        s_w = sel.update_w(state, idxs[0], tcs[0], probs[0],
+                           jnp.float32(1.0))
+        s_u = sel.update(state, idxs[0], tcs[0], probs[0])
+        _leaves_equal(s_w, s_u)
+        # q=8 fused
+        _, upd_qw = resolve_batch_wfns(sel, q)
+        _, upd_q = resolve_batch_fns(sel, q)
+        _leaves_equal(upd_qw(state, idxs, tcs, probs, jnp.ones((q,))),
+                      upd_q(state, idxs, tcs, probs))
+
+
+# ---------------------------------------------------------------------------
+# the reliability posterior
+# ---------------------------------------------------------------------------
+
+def test_ds_recovers_planted_confusions():
+    """300 rounds of votes from a seeded pool (2 adversaries): the
+    learned accuracies rank-correlate with the planted diagonals and
+    every adversary ranks below every honest annotator."""
+    from coda_tpu.crowd.oracle import (
+        make_annotators,
+        parse_oracle_spec,
+        planted_accuracies,
+        sample_votes,
+    )
+    from coda_tpu.crowd.reliability import (
+        aggregate_votes,
+        annotator_accuracy,
+        init_reliability,
+    )
+
+    cfg = parse_oracle_spec(
+        "annotators=8,votes=3,acc=0.55:0.95,abstain=0.05,adversarial=2,"
+        "trust=24,seed=1")
+    C = 4
+    conf = make_annotators(cfg, C)
+    rel0 = init_reliability(cfg, C)
+    kz, kv = jax.random.split(jax.random.PRNGKey(0))
+    rounds = 300
+    zs = jax.random.randint(kz, (rounds,), 0, C, dtype=jnp.int32)
+
+    def step(rel, inp):
+        z, k = inp
+        ann, resp, ans = sample_votes(k, conf, z, cfg)
+        label, w, rel2 = aggregate_votes(rel, ann, resp, ans, cfg)
+        return rel2, (label, w)
+
+    keys = jax.random.split(kv, rounds)
+    rel, (labels, ws) = jax.lax.scan(step, rel0, (zs, keys))
+
+    learned = np.asarray(annotator_accuracy(rel))
+    planted = planted_accuracies(cfg)
+    adv = np.zeros(cfg.annotators, bool)
+    adv[-cfg.adversarial:] = True
+    planted_diag = np.where(
+        adv, (1.0 - planted) / (C - 1), planted)  # true-diagonal accuracy
+    corr = float(np.corrcoef(learned, planted_diag)[0, 1])
+    assert corr > 0.9, (corr, learned, planted_diag)
+    assert learned[adv].max() < learned[~adv].min()
+    # aggregation is materially better than chance, weights in [0, 1]
+    acc = float((np.asarray(labels) == np.asarray(zs)).mean())
+    assert acc > 0.5, acc
+    w_np = np.asarray(ws)
+    assert (w_np >= 0).all() and (w_np <= 1).all()
+
+
+def test_crowd_clean_pin_bitwise(tiny_task):
+    """cfg.clean runs the engine's own program — same functions, same
+    closed-over losses, bit-identical results (the crowd machinery never
+    traces)."""
+    from coda_tpu.crowd.loop import build_crowd_experiment_fn
+    from coda_tpu.crowd.oracle import parse_oracle_spec
+    from coda_tpu.engine.loop import build_experiment_fn
+    from coda_tpu.oracle import true_losses
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    task = tiny_task
+    sel = make_coda(task.preds, CODAHyperparams(eig_chunk=64,
+                                                num_points=64))
+    losses = true_losses(task.preds, task.labels)
+    base = build_experiment_fn(sel, task.labels, losses, iters=6)
+    crowd = build_crowd_experiment_fn(sel, task.labels, losses,
+                                      parse_oracle_spec("clean"), iters=6)
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in range(2)])
+    want = jax.jit(jax.vmap(base))(keys)
+    got, aux = jax.jit(jax.vmap(crowd))(keys)
+    assert aux is None
+    _leaves_equal(got, want)
+
+
+def test_crowd_noisy_loop_runs(tiny_task):
+    """A noisy config traces, scans, and reports in-protocol aux."""
+    from coda_tpu.crowd.loop import run_seeds_crowd
+    from coda_tpu.crowd.oracle import parse_oracle_spec
+    from coda_tpu.selectors import CODAHyperparams, make_coda
+
+    task = tiny_task
+    cfg = parse_oracle_spec(
+        "annotators=4,votes=3,abstain=0.2,adversarial=1,trust=8,seed=0")
+    res, aux = run_seeds_crowd(
+        lambda p: make_coda(p, CODAHyperparams(eig_chunk=64,
+                                               num_points=64)),
+        task.preds, task.labels, cfg, iters=6, seeds=2)
+    assert aux is not None
+    assert aux.applied_label.shape == (2, 6)
+    w = np.asarray(aux.label_weight)
+    assert (w >= 0).all() and (w <= 1).all()
+    assert aux.annotator_accuracy.shape == (2, 6, 4)
+    assert np.asarray(res.cumulative_regret).shape == (2, 6)
+
+
+# ---------------------------------------------------------------------------
+# the serve answer verb (park / dedupe / abstain / restore / faults)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def answer_scenario(tmp_path_factory):
+    """One full out-of-order answer choreography (module-scoped: the
+    warm-pool builds dominate, so every assertion rides one run)."""
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.serve import recovery
+    from coda_tpu.serve.faults import FaultInjector
+    from coda_tpu.serve.server import ServeApp
+    from coda_tpu.serve.state import SelectorSpec
+    from coda_tpu.telemetry import SessionRecorder
+
+    tmp = tmp_path_factory.mktemp("crowd_serve")
+    task = make_synthetic_task(0, H=8, N=64, C=4)
+
+    def mkapp(record_dir):
+        app = ServeApp(capacity=3, max_wait=0.001,
+                       spec=SelectorSpec.create("coda", n_parallel=3,
+                                                acq_batch=3),
+                       recorder=SessionRecorder(out_dir=str(record_dir)))
+        app.add_task("t", task.preds)
+        app.start()
+        return app
+
+    facts = {}
+    rd = tmp / "rec"
+    app = mkapp(rd)
+    r = app.open_session("t", seed=0)
+    sid = r["session"]
+
+    # round 0 (q=3) delivered out of order: slots 2, 0, then 1 completes
+    facts["park2"] = app.answer(sid, 2, label=1, request_id="a2")
+    facts["park0"] = app.answer(sid, 0, label=0, request_id="a0")
+    facts["park_dup"] = app.answer(sid, 2, label=1, request_id="a2")
+    try:
+        app.answer(sid, 2, label=3, request_id="zz")
+        facts["conflict_raised"] = False
+    except ValueError:
+        facts["conflict_raised"] = True
+    facts["complete"] = app.answer(sid, 1, label=2, request_id="a1")
+    facts["n_after_round0"] = app.store.get(sid).n_labeled
+    facts["late_dup"] = app.answer(sid, 0, label=0, request_id="a0")
+    facts["abstain"] = app.answer(sid, 1, abstain=True)
+    # round 1: park two answers, then crash-restore mid-round
+    app.answer(sid, 1, label=3, request_id="b1")
+    app.answer(sid, 0, label=1, request_id="b0")
+    facts["metrics"] = app.metrics.snapshot()["oracle"]
+
+    app2 = mkapp(rd)
+    rep = recovery.restore_app_sessions(app2, str(rd))
+    facts["restored"] = sid in rep["restored"]
+    s2 = app2.store.get(sid)
+    facts["restored_n"] = s2.n_labeled
+    facts["restored_parked"] = {j: dict(e) for j, e in s2.parked.items()}
+    facts["finish"] = app2.answer(sid, 2, label=0, request_id="b2")
+    facts["final_n"] = app2.store.get(sid).n_labeled
+
+    # the same labels delivered IN order on a fresh app
+    app3 = mkapp(tmp / "rec3")
+    sid3 = app3.open_session("t", seed=0)["session"]
+    for rnd, labs in enumerate([[0, 2, 1], [1, 3, 0]]):
+        for j, lab in enumerate(labs):
+            app3.answer(sid3, j, label=lab, request_id=f"r{rnd}s{j}")
+
+    def digest(a, s):
+        rows = recovery.data_rows(a.recorder.history(s))
+        keys = ("n_labeled", "labeled_idx", "label", "next_idx",
+                "next_prob", "best", "pbest_max")
+        return hashlib.sha256(json.dumps(
+            [{k: r.get(k) for k in keys} for r in rows],
+            sort_keys=True).encode()).hexdigest()
+
+    facts["digest_ooo"] = digest(app2, sid)
+    facts["digest_ino"] = digest(app3, sid3)
+
+    # fault injection through the front door
+    app3.faults = FaultInjector("oracle_abstain:after=0;oracle_poison:after=1")
+    facts["fault_abstain"] = app3.answer(sid3, 0, label=1, request_id="f0")
+    facts["fault_poison"] = app3.answer(sid3, 0, label=1, request_id="f1")
+    facts["poisoned_label"] = app3.store.get(sid3).parked[0]["label"]
+    return facts
+
+
+def test_answer_out_of_order_parks_then_dispatches(answer_scenario):
+    f = answer_scenario
+    assert f["park2"]["verb"] == "parked" and f["park2"]["missing"] == [0, 1]
+    assert f["park0"]["verb"] == "parked"
+    assert f["complete"]["verb"] == "dispatched"
+    assert f["complete"]["applied"] == [0, 2, 1]  # slot order, not arrival
+    assert f["n_after_round0"] == 3
+
+
+def test_answer_request_id_dedupe(answer_scenario):
+    f = answer_scenario
+    # redelivery of a parked answer is idempotent
+    assert f["park_dup"]["verb"] == "parked" and f["park_dup"]["duplicate"]
+    # a conflicting request-id on a parked slot is a double-apply reject
+    assert f["conflict_raised"]
+    # redelivery AFTER the round committed reads the committed result
+    assert f["late_dup"]["verb"] == "committed" and f["late_dup"]["duplicate"]
+    m = answer_scenario["metrics"]
+    assert m["double_apply_rejects"] == 1
+
+
+def test_answer_abstain_and_metrics(answer_scenario):
+    f = answer_scenario
+    assert f["abstain"]["verb"] == "abstain"
+    m = f["metrics"]
+    assert m["abstentions"] == 1
+    assert m["deferred_rounds_completed"] == 1
+    assert m["reorder_depth_max"] == 1  # slot 0 arrived after slot 2
+
+
+def test_answer_crash_restore_reparks(answer_scenario):
+    f = answer_scenario
+    assert f["restored"] and f["restored_n"] == 3
+    assert sorted(f["restored_parked"]) == [0, 1]
+    assert f["restored_parked"][1]["label"] == 3
+    assert f["finish"]["verb"] == "dispatched"
+    assert f["finish"]["applied"] == [1, 3, 0]
+    assert f["final_n"] == 6
+
+
+def test_answer_out_of_order_matches_in_order_digest(answer_scenario):
+    f = answer_scenario
+    assert f["digest_ooo"] == f["digest_ino"]
+
+
+def test_answer_fault_injection(answer_scenario):
+    f = answer_scenario
+    assert f["fault_abstain"]["verb"] == "abstain"
+    assert f["fault_poison"]["verb"] == "parked"
+    assert f["poisoned_label"] == 2  # (1 + 1) % 4
