@@ -176,6 +176,14 @@ def parse_args(argv=None):
                         "pass when violated; warmup rounds are always "
                         "exact (incremental tier only; surrogate:k>=N is "
                         "bitwise-equal to exact)")
+    p.add_argument("--surrogate-prior", default="off",
+                   choices=["off", "pool"],
+                   help="surrogate scorer only: 'pool' seeds the carried "
+                        "ridge fit from a cross-session prior (the serve "
+                        "pool's statistics — see serve/priors.py) instead "
+                        "of zeros, granting warmup credit; the per-round "
+                        "trust gate is unchanged. 'off' (default) is "
+                        "bitwise-identical to the pre-pool scorer")
     p.add_argument("--oracle-noise", default=None, metavar="SPEC",
                    help="crowd-oracle spec: omitted/'clean' = the plain "
                         "perfect oracle (bitwise-pinned program); else "
@@ -325,6 +333,7 @@ def build_selector_factory(args, task_name: str):
             posterior=getattr(args, "posterior", "dense"),
             eig_pbest=getattr(args, "eig_pbest", "quad"),
             eig_scorer=getattr(args, "eig_scorer", "exact"),
+            surrogate_prior=getattr(args, "surrogate_prior", "off"),
             pi_update=getattr(args, "pi_update", "auto"),
             # a --mesh run declares its sharding so the pallas fast path
             # can shard_map the kernels over the data axis (make_coda

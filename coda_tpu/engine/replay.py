@@ -137,6 +137,13 @@ def _record_knobs(record: RunRecord) -> dict:
         for key in ("oracle_noise", "oracle_annotators",
                     "oracle_reliability"):
             knobs.pop(key, None)
+    # cross-session prior (PR 18): 'off' runs the pre-pool program
+    # bitwise, so it normalizes to ABSENT — a pre-pool record vs a fresh
+    # --surrogate-prior off capture compares bitwise (the PR-14 pin); the
+    # pool-digest satellite knob means nothing without the mode
+    if knobs.get("surrogate_prior") in (None, "off"):
+        knobs.pop("surrogate_prior", None)
+        knobs.pop("surrogate_prior_digest", None)
     return knobs
 
 
@@ -412,6 +419,37 @@ def compare_records_oracle(a: RunRecord, b: RunRecord) -> ReplayReport:
     return report
 
 
+def _prior_knob(record: RunRecord) -> str:
+    """A record's normalized ``--surrogate-prior`` mode, digest-qualified:
+    'off' when absent (every pre-pool record); a pool-seeded record is
+    ``pool@<digest>`` — two runs seeded from DIFFERENT pools ran
+    different warm-starts and must not be conflated."""
+    knobs = record.meta.get("fingerprint", {}).get("knobs", {}) or {}
+    mode = knobs.get("surrogate_prior")
+    if mode in (None, "off"):
+        return "off"
+    digest = knobs.get("surrogate_prior_digest")
+    return f"{mode}@{digest}" if digest else str(mode)
+
+
+def compare_records_prior(a: RunRecord, b: RunRecord) -> ReplayReport:
+    """The warm-vs-cold comparison (``--against`` across different
+    ``--surrogate-prior`` modes, or across different pool digests): a
+    pool-seeded run legitimately skips already-paid exact warmup rounds,
+    so per-round decision parity is not the contract — the regret
+    ENVELOPE at equal label budgets is (how much selection quality the
+    transferred prior costs, which the BENCH_PRIOR gate bounds at 1.05x
+    + 0.02 absolute). Triage class ``surrogate-prior-envelope``."""
+    report = _compare_records_envelope(
+        a, b, classification="surrogate-prior-envelope",
+        meta_key="prior_envelope",
+        label_a=f"surrogate_prior={_prior_knob(a)}",
+        label_b=f"surrogate_prior={_prior_knob(b)}")
+    report.meta["prior_envelope"].update(
+        {"prior_a": _prior_knob(a), "prior_b": _prior_knob(b)})
+    return report
+
+
 def compare_records(a: RunRecord, b: RunRecord,
                     score_tol: float = 0.0) -> ReplayReport:
     """Direct record-vs-record comparison (no re-execution): the shared
@@ -434,6 +472,8 @@ def compare_records(a: RunRecord, b: RunRecord,
         return compare_records_scorer(a, b)
     if _oracle_knob(a) != _oracle_knob(b):
         return compare_records_oracle(a, b)
+    if _prior_knob(a) != _prior_knob(b):
+        return compare_records_prior(a, b)
     if a.rounds != b.rounds:
         raise ValueError(
             f"records disagree on round count ({a.rounds} vs {b.rounds}); "
@@ -520,7 +560,8 @@ def format_triage(report: ReplayReport) -> str:
         contract = ("the label-aligned regret envelope"
                     if (report.meta.get("batchq_envelope")
                         or report.meta.get("scorer_envelope")
-                        or report.meta.get("oracle_envelope"))
+                        or report.meta.get("oracle_envelope")
+                        or report.meta.get("prior_envelope"))
                     else ("BITWISE equality (score-tol 0 despite the "
                           "knob diff)" if report.score_tol == 0.0
                           else "the documented score contract"))
@@ -545,6 +586,13 @@ def format_triage(report: ReplayReport) -> str:
         lines.append(
             f"  oracle-noise envelope: {env['oracle_a']} vs "
             f"{env['oracle_b']}, worst final cum-regret ratio "
+            f"{env['max_final_ratio_b_over_a']:.3f}, worst aligned gap "
+            f"{env['max_aligned_gap']:.4f}")
+    env = report.meta.get("prior_envelope")
+    if env:
+        lines.append(
+            f"  surrogate-prior envelope: {env['prior_a']} vs "
+            f"{env['prior_b']}, worst final cum-regret ratio "
             f"{env['max_final_ratio_b_over_a']:.3f}, worst aligned gap "
             f"{env['max_aligned_gap']:.4f}")
     for s in report.seeds:

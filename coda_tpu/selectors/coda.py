@@ -240,6 +240,25 @@ class CODAHyperparams(NamedTuple):
     #                               driven by an unaudited score.
     #                               surrogate:k>=N is the exact-parity
     #                               configuration (bitwise, pinned).
+    surrogate_prior: str = "off"  # off | pool — cross-session warm-start
+    #                               of the surrogate fit. "off" (default)
+    #                               is bitwise the prior-less program.
+    #                               "pool" seeds a fresh fit from a
+    #                               merged per-(task, pool-fingerprint)
+    #                               prior aggregated from closed/demoted
+    #                               sessions' normal equations
+    #                               (selectors/surrogate.PriorStats —
+    #                               the A/b form is mergeable by pure
+    #                               sum), granting warmup-round credit;
+    #                               the per-round trust gate still
+    #                               audits every credited round, so
+    #                               selection is never driven by an
+    #                               unaudited score. The prior ARRAYS
+    #                               arrive via make_coda(prior=...) or
+    #                               the serve bucket's seeding hook —
+    #                               this knob only declares/fingerprints
+    #                               the mode (it is hashable; the stats
+    #                               are not).
     pi_update: str = "auto"       # auto | delta | exact — incremental-mode
     #                               pi-hat column refresh. "auto" resolves
     #                               by backend (resolve_pi_update):
@@ -1198,8 +1217,16 @@ def make_coda(
     preds: jnp.ndarray,
     hp: Optional[CODAHyperparams] = None,
     name: str = "coda",
+    prior=None,
 ) -> Selector:
-    """Build the CODA selector closed over a prediction tensor."""
+    """Build the CODA selector closed over a prediction tensor.
+
+    ``prior``: an optional :class:`~coda_tpu.selectors.surrogate.
+    PriorStats` — the merged cross-session pool the init seeds the
+    surrogate fit from (requires ``hp.surrogate_prior='pool'``; the
+    engine/CLI path passes it here, the serve path seeds per-admission
+    at the bucket instead so a live pool can keep evolving without
+    retracing)."""
     hp = hp or CODAHyperparams()
     H, N, C = preds.shape
     prior_strength = 1.0 - hp.alpha
@@ -1343,9 +1370,23 @@ def make_coda(
             f"eig_refresh={hp.eig_refresh!r}) — it would silently not "
             "apply"
         )
-    from coda_tpu.selectors.surrogate import parse_scorer
+    from coda_tpu.selectors.surrogate import parse_prior, parse_scorer
 
     scorer_k = parse_scorer(hp.eig_scorer)  # None = exact
+    prior_on = parse_prior(hp.surrogate_prior)
+    if prior_on and scorer_k is None:
+        raise ValueError(
+            "surrogate_prior='pool' warm-starts the carried surrogate "
+            "fit; eig_scorer='exact' carries none — it would silently "
+            "not apply (use eig_scorer='surrogate:k' or "
+            "surrogate_prior='off')"
+        )
+    if prior is not None and not prior_on:
+        raise ValueError(
+            "a prior was passed but surrogate_prior='off' — seeding "
+            "under the off knob would break the off-config bitwise pin; "
+            "set surrogate_prior='pool'"
+        )
     if scorer_k is not None and eig_mode != "incremental":
         raise ValueError(
             "eig_scorer='surrogate:k' amortizes the incremental tier's "
@@ -1468,6 +1509,13 @@ def make_coda(
             # starts zeroed, seeded with the prior's class summaries
             a0, b0 = dirichlet_to_beta(dirichlets0)
             fit0 = init_fit(a0.T, b0.T)
+            if prior_on and prior is not None:
+                from coda_tpu.selectors.surrogate import seed_fit
+
+                # the pool only contributes the regression sufficient
+                # statistics (A, b, n) and warmup credit; the class
+                # summaries above stay this session's own
+                fit0 = seed_fit(fit0, prior)
         return CODAState(
             dirichlets=dense0,
             pi_hat_xi=pi_xi,

@@ -75,6 +75,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from coda_tpu.ops.masked import entropy2
@@ -118,6 +119,25 @@ SURROGATE_FEATURE_KC = 8
 #: whole history
 SURROGATE_RIDGE_LAMBDA = 1e-4
 SURROGATE_FIT_DECAY = 0.9
+
+#: cap on the effective pair mass a merged cross-session prior may carry
+#: into a fresh fit: the prior should shortcut warmup, not outweigh the
+#: session's own evidence forever (the per-round SURROGATE_FIT_DECAY
+#: halves its influence in ~7 rounds either way; the cap bounds the
+#: transient)
+SURROGATE_PRIOR_MAX_PAIRS = 4096.0
+
+#: pool forgetting: each contribution folds as
+#: ``pool' = merge_fits(scale_prior(pool, DECAY), contribution)`` so the
+#: shared prior tracks the recent session population instead of averaging
+#: over its whole history (the cross-session analogue of
+#: SURROGATE_FIT_DECAY)
+SURROGATE_PRIOR_DECAY = 0.98
+
+#: a session's fit must have survived at least this many labeling rounds
+#: before its statistics are folded into the shared pool — an immature
+#: fit (mid-warmup close) carries no trustworthy normal-equation mass
+SURROGATE_PRIOR_MIN_ROUNDS = SURROGATE_WARMUP_ROUNDS
 
 # deterministic audit rotation stride (coprime-ish large prime): the
 # update step has no PRNG key (score-ahead runs inside update), so audit
@@ -166,6 +186,20 @@ class SurrogateFit(NamedTuple):
     # last gated round: the escape-gate margin (healthy > 0; the gauge
     # serve /metrics exposes)
     margin: jnp.ndarray     # scalar f32
+    # cross-session prior bookkeeping (--surrogate-prior pool; both stay
+    # 0 with the prior off, which keeps the off-config round program
+    # bitwise the PR 14 one):
+    # warmup-round credit granted by a merged pool prior at seed time —
+    # the warm condition counts (rounds + prior_rounds), so a mature
+    # prior shortens or skips the 10 exact warmup rounds while every
+    # served round still passes the trust gate
+    prior_rounds: jnp.ndarray   # scalar i32
+    # gate fallbacks that fired while the session was still inside the
+    # warmup window it only skipped BECAUSE of the prior (rounds <
+    # SURROGATE_WARMUP_ROUNDS <= rounds + prior_rounds): the pool prior
+    # being rejected by the per-round contract, counted separately so
+    # /metrics can show the fallback safety net actually catching it
+    prior_rejects: jnp.ndarray  # scalar i32
 
 
 def class_feats_from_beta(a_row: jnp.ndarray, b_row: jnp.ndarray
@@ -197,6 +231,7 @@ def init_fit(a_cc_T: jnp.ndarray, b_cc_T: jnp.ndarray) -> SurrogateFit:
         rounds=z32, fallbacks=z32, fits=z32,
         last_fallback=jnp.asarray(False),
         margin=jnp.asarray(jnp.nan, jnp.float32),
+        prior_rounds=z32, prior_rejects=z32,
     )
 
 
@@ -467,7 +502,12 @@ def surrogate_score_round(fit: SurrogateFit,
     """
     N = feats.shape[0]
     m = max(1, min(k, N)) + max(1, min(SURROGATE_AUDIT_ROWS, N))
-    warm = fit.rounds < SURROGATE_WARMUP_ROUNDS
+    # a merged cross-session prior grants warmup-round credit
+    # (prior_rounds, 0 with --surrogate-prior off — the PR 14 condition
+    # exactly): credited rounds skip the always-exact warmup pass, but
+    # every skipped round still runs propose -> gate -> fallback, so
+    # selection is never driven by an unaudited score
+    warm = (fit.rounds + fit.prior_rounds) < SURROGATE_WARMUP_ROUNDS
 
     def propose():
         return propose_shortlist(fit, feats, cand, k, exact_rows_fn)
@@ -502,10 +542,188 @@ def surrogate_score_round(fit: SurrogateFit,
     scores, pair_mask = lax.cond(need_full, full_round, hybrid_round)
     fit = fold_pairs(fit, feats, scores, pair_mask)
     fell_back = verdict.violated & ~warm
+    # a fallback inside the window the prior skipped is the gate
+    # REJECTING the pool prior (the round still ran exact — nothing was
+    # lost; the counter is the prior's audit trail)
+    prior_reject = fell_back & (fit.rounds < SURROGATE_WARMUP_ROUNDS)
     fit = fit._replace(
         rounds=fit.rounds + 1,
         fallbacks=fit.fallbacks + fell_back.astype(jnp.int32),
         last_fallback=fell_back,
         margin=verdict.margin,
+        prior_rejects=fit.prior_rejects + prior_reject.astype(jnp.int32),
     )
     return scores, fit
+
+
+# ---------------------------------------------------------------------------
+# cross-session prior pool (--surrogate-prior pool)
+# ---------------------------------------------------------------------------
+
+def parse_prior(spec: str) -> bool:
+    """``'off'`` -> False; ``'pool'`` -> True. Fails loudly on anything
+    else — the CLI forwards the string verbatim."""
+    if spec == "off":
+        return False
+    if spec == "pool":
+        return True
+    raise ValueError(
+        f"unknown surrogate_prior {spec!r} (use 'off' or 'pool')")
+
+
+class PriorStats(NamedTuple):
+    """Host-side mergeable cross-session surrogate prior.
+
+    The A/b normal-equation form is mergeable BY CONSTRUCTION: A = ΣFᵀF
+    and b = ΣFᵀy are sums over (feature, exact-score) pairs, so merging
+    two sessions' statistics is a pure elementwise sum — commutative
+    bitwise (IEEE a+b == b+a), associative to fp rounding, with the
+    all-zeros pool as an exact neutral element (x + 0.0 == x for every
+    finite x, and the counters are exact integers in f64 at any
+    realistic scale). ``merge_fits`` below is that sum, property-tested
+    in tests/test_prior.py.
+
+    Everything is float64 numpy on the host: the pool lives outside the
+    jit boundary (serve admission / tracking store / router transport)
+    and is cast to f32 only at :func:`seed_fit` time.
+    """
+
+    A: np.ndarray       # (F, F) f64 — summed decayed FᵀF
+    b: np.ndarray       # (F,)   f64 — summed decayed Fᵀy
+    n: float            # summed decayed pair count
+    rounds: float       # summed labeling rounds of the contributors
+    sessions: float     # contributing sessions folded in (decays too)
+
+
+def empty_prior() -> PriorStats:
+    """The neutral element: merge_fits(empty_prior(), p) == p bitwise."""
+    F = N_FEATURES
+    return PriorStats(A=np.zeros((F, F), np.float64),
+                      b=np.zeros((F,), np.float64),
+                      n=0.0, rounds=0.0, sessions=0.0)
+
+
+def prior_from_fit(A, b, n, rounds) -> PriorStats:
+    """One closed/demoted session's contribution, from its carried
+    :class:`SurrogateFit` leaves (host copies). A fit that accumulated
+    nothing (n == 0 — e.g. the w=0-count fit of a session closed before
+    its first label) contributes the exact neutral element, so folding
+    it into a pool is a bitwise no-op."""
+    A = np.asarray(A, np.float64).reshape(N_FEATURES, N_FEATURES)
+    b = np.asarray(b, np.float64).reshape(N_FEATURES)
+    n = float(np.asarray(n))
+    if not np.isfinite(n) or n <= 0.0:
+        return empty_prior()
+    return PriorStats(A=A, b=b, n=n, rounds=float(np.asarray(rounds)),
+                      sessions=1.0)
+
+
+def merge_fits(p: PriorStats, q: PriorStats) -> PriorStats:
+    """The pool merge: a pure elementwise sum (see :class:`PriorStats`
+    for why that is correct). No decay here — decay is the FOLD policy
+    (:func:`fold_prior`), kept out of the merge so the merge stays
+    commutative/associative/neutral-element clean."""
+    return PriorStats(A=p.A + q.A, b=p.b + q.b, n=p.n + q.n,
+                      rounds=p.rounds + q.rounds,
+                      sessions=p.sessions + q.sessions)
+
+
+def merge_many(priors) -> PriorStats:
+    """Left fold of :func:`merge_fits` over ``priors`` starting from the
+    neutral element — merge-of-one is the identity (property-tested)."""
+    out = empty_prior()
+    for p in priors:
+        out = merge_fits(out, p)
+    return out
+
+
+def scale_prior(p: PriorStats, gamma: float) -> PriorStats:
+    """Uniformly scale a pool's mass (the decay/cap primitive)."""
+    g = float(gamma)
+    return PriorStats(A=p.A * g, b=p.b * g, n=p.n * g,
+                      rounds=p.rounds * g, sessions=p.sessions * g)
+
+
+def clip_prior(p: PriorStats,
+               max_pairs: float = SURROGATE_PRIOR_MAX_PAIRS) -> PriorStats:
+    """Bound the effective pair mass (A/b/n scale together so the ridge
+    solution is unchanged; only the prior's WEIGHT against the session's
+    own incoming pairs is capped). rounds/sessions are provenance, not
+    mass — they stay."""
+    if p.n <= max_pairs:
+        return p
+    g = max_pairs / p.n
+    return p._replace(A=p.A * g, b=p.b * g, n=p.n * g)
+
+
+def fold_prior(pool: PriorStats, contribution: PriorStats,
+               decay: float = SURROGATE_PRIOR_DECAY) -> PriorStats:
+    """The pool's fold policy: exponential forgetting of the existing
+    pool, then the pure-sum merge, then the mass cap."""
+    return clip_prior(merge_fits(scale_prior(pool, decay), contribution))
+
+
+def prior_warmup_credit(p: PriorStats) -> int:
+    """Warmup rounds a seeded session may skip: the pool's accumulated
+    round evidence, capped at the full warmup — a pool that has seen a
+    full warmup's worth of labeling rounds earns the full skip, a
+    thinner one earns a partial shortening, an empty one earns none.
+    The per-round trust gate still audits every skipped round."""
+    if p.n <= 0.0:
+        return 0
+    return int(min(float(SURROGATE_WARMUP_ROUNDS), p.rounds))
+
+
+def seed_fit(fit: SurrogateFit, p: PriorStats) -> SurrogateFit:
+    """A fresh session's fit, warm-started from a merged pool prior:
+    the prior's normal equations are added to the (zeroed) fit's, the
+    ridge is re-solved, and the warmup credit is granted. The session's
+    per-round folds then decay the prior mass exactly like old own
+    evidence (SURROGATE_FIT_DECAY). cls_feats are NOT transferred — the
+    fresh init posterior's class summaries are the correct features for
+    THIS session's rounds."""
+    credit = prior_warmup_credit(p)
+    if credit == 0 and p.n <= 0.0:
+        return fit
+    A = fit.A + jnp.asarray(p.A, jnp.float32)
+    b = fit.b + jnp.asarray(p.b, jnp.float32)
+    n = fit.n + jnp.asarray(p.n, jnp.float32)
+    lam = SURROGATE_RIDGE_LAMBDA * jnp.clip(n, 1.0, None)
+    w = jnp.linalg.solve(A + lam * jnp.eye(N_FEATURES, dtype=A.dtype), b)
+    w = jnp.where(jnp.isfinite(w), w, 0.0)
+    return fit._replace(
+        A=A, b=b, w=w, n=n,
+        prior_rounds=fit.prior_rounds + jnp.asarray(credit, jnp.int32))
+
+
+def prior_to_dict(p: PriorStats) -> dict:
+    """JSON-safe form (router transport, tracking-store persistence)."""
+    return {"v": 1, "A": np.asarray(p.A, np.float64).tolist(),
+            "b": np.asarray(p.b, np.float64).tolist(),
+            "n": float(p.n), "rounds": float(p.rounds),
+            "sessions": float(p.sessions)}
+
+
+def prior_from_dict(d: dict) -> PriorStats:
+    if int(d.get("v", 1)) != 1:
+        raise ValueError(f"unknown prior stats version {d.get('v')!r}")
+    return PriorStats(
+        A=np.asarray(d["A"], np.float64).reshape(N_FEATURES, N_FEATURES),
+        b=np.asarray(d["b"], np.float64).reshape(N_FEATURES),
+        n=float(d["n"]), rounds=float(d["rounds"]),
+        sessions=float(d.get("sessions", 0.0)))
+
+
+def prior_digest(p: PriorStats) -> str:
+    """Short stable digest of a pool prior's VALUES — the recorder
+    stamps it next to the surrogate_prior knob so two prior-seeded
+    records are comparable only when they were seeded from the same
+    pool state."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=8)
+    h.update(np.asarray(p.A, np.float64).tobytes())
+    h.update(np.asarray(p.b, np.float64).tobytes())
+    h.update(np.float64(p.n).tobytes())
+    h.update(np.float64(p.rounds).tobytes())
+    return h.hexdigest()

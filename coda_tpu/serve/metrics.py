@@ -110,6 +110,18 @@ class ServeMetrics:
         # surrogate_fit_refreshes, surrogate_contract_margin) merge into
         # the snapshot; {} when no surrogate bucket exists.
         self.surrogate_provider = None
+        # cross-session prior evidence provider (--surrogate-prior pool):
+        # set by the app to a () -> dict callback merging the pool's
+        # contribution counters with the slab-read warmup-credit/
+        # gate-rejection counters (prior_sessions_contributed,
+        # prior_warmup_rounds_skipped, prior_gate_rejections). None when
+        # the prior is off — the families are then ABSENT from /stats
+        # and /metrics, not zero, exactly like the surrogate's.
+        self.prior_provider = None
+        # cold-tier spill store stats provider (serve/tiering.py): a
+        # () -> dict of the v3 store's segment/index/compaction gauges,
+        # surfaced under snapshot["spill"]. None when no spill dir.
+        self.spill_provider = None
 
     # -- recording (request path: O(1), no reductions) ---------------------
     def record_dispatch(self, n_requests: int, queue_depth: int,
@@ -291,6 +303,20 @@ class ServeMetrics:
                 snap.update(provider() or {})
             except Exception:
                 pass  # stats must never fail on a mid-teardown bucket
+        provider = self.prior_provider
+        if provider is not None:
+            try:
+                snap.update(provider() or {})
+            except Exception:
+                pass
+        provider = self.spill_provider
+        if provider is not None:
+            try:
+                spill = provider()
+                if spill:
+                    snap["spill"] = spill
+            except Exception:
+                pass
         return snap
 
     def log_to_store(self, store, experiment: str = "serve",
@@ -306,8 +332,8 @@ class ServeMetrics:
             for key, val in snap.items():
                 if isinstance(val, dict):
                     for sub, v in val.items():
-                        if v is not None:
+                        if isinstance(v, (int, float)):
                             run.log_metric(f"{key}.{sub}", float(v))
-                elif val is not None:
+                elif isinstance(val, (int, float)):
                     run.log_metric(key, float(val))
         return run.run_uuid

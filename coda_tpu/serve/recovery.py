@@ -121,8 +121,14 @@ def _pack(arr) -> dict:
 
 
 def _unpack(d: dict) -> np.ndarray:
+    data = d["data"]
+    if isinstance(data, str):
+        data = base64.b64decode(data)
+    # raw bytes pass through untouched — the spill store's lazy frames
+    # (serve/spill.py) hand the decompressed leaf bytes over directly,
+    # skipping the base64 round trip the JSON transport needs
     return np.frombuffer(
-        base64.b64decode(d["data"]), dtype=np.dtype(d["dtype"])
+        data, dtype=np.dtype(d["dtype"])
     ).reshape(d["shape"]).copy()
 
 
@@ -333,6 +339,15 @@ def build_export_payload(app, sess, snapshot=None) -> dict:
         "carries": None,
         "key": None,
     }
+    if sess.prior_fit is not None:
+        # the applied-prior record rides the payload so the import-side
+        # REPLAY fallback (and any later heal on the destination) seeds
+        # the same init this session was admitted with
+        payload["prior_fit"] = dict(sess.prior_fit)
+    if sess.prior_contributed:
+        # the once-flag rides too: a demoted session contributes at
+        # demotion, and a later wake+close must not fold it in twice
+        payload["prior_contributed"] = True
     # snapshot FIRST (host-materialized under the bucket lock — see
     # Bucket.snapshot_slot for the donation race), stream second: if a
     # dispatch lands between the two, the stream is ahead of the snapshot,
@@ -537,11 +552,15 @@ def import_session(app, payload: dict, count: bool = True) -> dict:
     # handle must resolve), but labels answer retryable 503 until the
     # posterior AND the request_id dedupe cache are rebuilt — a retry
     # landing mid-restore must neither 404 nor double-apply
+    # the imported copy's prior is the RECORDED one (payload), never the
+    # pool's current state — replay must reproduce the admitted init
     sess = app.store.open(task, app.spec, seed=int(payload["seed"]),
-                          sid=sid, restoring=True)
+                          sid=sid, restoring=True,
+                          prior=payload.get("prior_fit"))
     # the copy's ownership epoch is the payload's — set before the verbs
     # unblock so a fenced verb can never race an un-epoched window
     sess.epoch = int(payload.get("epoch") or 0)
+    sess.prior_contributed = bool(payload.get("prior_contributed"))
     bucket = sess.bucket
     try:
         restored_via = None
@@ -564,7 +583,7 @@ def import_session(app, payload: dict, count: bool = True) -> dict:
             # no digest on either side -> the snapshot is UNVERIFIABLE;
             # fall through to the replay path, which verifies every round
         if restored_via is None:
-            bucket.stage_fresh(sess.slot, sess.seed)
+            bucket.stage_fresh(sess.slot, sess.seed, prior=sess.prior_fit)
             replay_rows_into_slot(bucket, sess.slot, rows, sid=sess.sid)
             restored_via = "replay"
         _finalize_restored(sess, rows)
@@ -576,6 +595,8 @@ def import_session(app, payload: dict, count: bool = True) -> dict:
                             "epoch": sess.epoch,
                             "shape": meta.get("shape"),
                             "digest": meta.get("digest"),
+                            **({"surrogate_prior": dict(sess.prior_fit)}
+                               if sess.prior_fit is not None else {}),
                             "imported_via": restored_via},
             rows=rows)
         # pending async crowd answers ride the payload; import_history
@@ -748,15 +769,20 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
         staged: list = []      # (sess, rows, meta, parked)
         for sid, meta, rows, parked in wave:
             try:
+                # the stream meta's applied-prior record (if the session
+                # was admitted prior-seeded) re-applies here — the pool
+                # may have moved on, this session's history has not
                 sess = app.store.open(meta.get("task"), app.spec,
                                       seed=int(meta.get("seed", 0)),
-                                      sid=sid, restoring=True)
+                                      sid=sid, restoring=True,
+                                      prior=meta.get("surrogate_prior"))
                 # a crash-restored copy keeps its stream's ownership
                 # epoch: if the session had migrated away and this stream
                 # was never fenced (the crash window), the restored copy
                 # is STALE and the epoch makes the fence still hold
                 sess.epoch = int(meta.get("epoch") or 0)
-                sess.bucket.stage_fresh(sess.slot, sess.seed)
+                sess.bucket.stage_fresh(sess.slot, sess.seed,
+                                        prior=sess.prior_fit)
             except Exception as e:
                 report["failed"][sid] = repr(e)
                 continue
@@ -804,6 +830,10 @@ def restore_app_sessions(app, record_dir: Optional[str] = None) -> dict:
                                     "epoch": sess.epoch,
                                     "shape": meta.get("shape"),
                                     "digest": meta.get("digest"),
+                                    **({"surrogate_prior":
+                                        dict(sess.prior_fit)}
+                                       if sess.prior_fit is not None
+                                       else {}),
                                     "imported_via": "replay"},
                     rows=rows)
                 _repark_answers(app, sess, parked)
@@ -863,7 +893,10 @@ def heal_bucket(bucket, store, recorder) -> dict:
         # through the `_healing` override.
         bucket.reset_slab()
         for s in sessions:
-            bucket.stage_fresh(s.slot, s.seed)
+            # re-apply each session's RECORDED admission prior: a heal
+            # replays from the admitted init, and a prior-seeded session
+            # healed cold would diverge bitwise on its first verify row
+            bucket.stage_fresh(s.slot, s.seed, prior=s.prior_fit)
         # no on_fail: one divergence invalidates the WHOLE rebuild (the
         # caller degrades the bucket to terminal)
         n_replayed = replay_live_coalesced(
@@ -991,7 +1024,10 @@ def verify_session_stream(store, meta: dict, rows, sid: str = "?") -> dict:
     spec = SelectorSpec.create(meta.get("method", "coda"),
                                acq_batch=int(meta.get("acq_batch", 1)),
                                **kwargs)
-    sess = store.open(task, spec, seed=int(meta.get("seed", 0)))
+    # a prior-seeded stream verifies against the SAME applied-prior
+    # record its meta stamped at admission (pool state since is moot)
+    sess = store.open(task, spec, seed=int(meta.get("seed", 0)),
+                      prior=meta.get("surrogate_prior"))
     try:
         rows = data_rows(rows)
         replay_rows_into_slot(sess.bucket, sess.slot, rows, sid=sid)

@@ -173,6 +173,10 @@ class InprocReplica:
     def stats(self):
         return self.transport.call("stats", lambda t: self.app.stats())
 
+    def sync_prior(self, pool_snap=None):
+        return self.transport.call(
+            "prior_sync", lambda t: self.app.sync_prior(pool_snap))
+
     def healthz(self):
         return self.transport.call("healthz",
                                    lambda t: self.app.healthz())
@@ -227,7 +231,7 @@ class DeadReplica:
 
     open = label = labels = best = trace = close = _dead
     export = fence = import_payload = stats = healthz = _dead
-    export_for_migration = _dead
+    export_for_migration = sync_prior = _dead
 
     def has_session(self, sid) -> bool:
         raise ConnectionError(
@@ -387,6 +391,10 @@ class HttpReplica:
     def stats(self):
         return self._call("stats", "GET", "/stats")
 
+    def sync_prior(self, pool_snap=None):
+        body = {} if pool_snap is None else {"pool": pool_snap}
+        return self._call("prior_sync", "POST", "/prior/sync", body)
+
     def healthz(self):
         try:
             return self._call("healthz", "GET", "/healthz")
@@ -495,7 +503,13 @@ class SessionRouter:
             "rebalances": 0, "peer_pages": 0, "sessions_dropped": 0,
             "fencing_rejections": 0, "fence_failures": 0,
             "journal_replays": 0, "migrations_in_doubt": 0,
+            "prior_syncs": 0, "prior_deltas_merged": 0,
         }
+        # the fleet's merged surrogate-prior pool (serve/priors.py),
+        # created lazily on the first replica delta; exchange rides the
+        # health poll — see check_health
+        self.prior_pool = None
+        self._prior_unsupported: set[str] = set()
         self.migrations_via: dict[str, int] = {}   # snapshot vs replay
         self.routed_to: dict[str, int] = {rid: 0 for rid in self.replicas}
         self._executor = ThreadPoolExecutor(
@@ -626,6 +640,8 @@ class SessionRouter:
                 except Exception:
                     status = "unreachable"
             statuses[rid] = status
+            if status in ("ok", "degraded"):
+                self._sync_prior_with(rid, handle)
             routable = status in ("ok", "degraded")
             with self._lock:
                 was = rid in self._routable
@@ -659,6 +675,44 @@ class SessionRouter:
             except Exception:
                 pass  # the poller must survive a mid-rebalance hiccup
         return statuses
+
+    def _sync_prior_with(self, rid: str, handle) -> None:
+        """The prior-pool exchange piggybacked on one healthy probe:
+        push the router's merged pool, fold the replica's drained delta
+        back in. Never fails the poll — a replica that doesn't speak the
+        verb (older build, pool off) is remembered and skipped."""
+        if rid in self._prior_unsupported:
+            return
+        sync = getattr(handle, "sync_prior", None)
+        if sync is None:
+            self._prior_unsupported.add(rid)
+            return
+        try:
+            snap = (self.prior_pool.snapshot()
+                    if self.prior_pool is not None else None)
+            delta = (sync(snap) or {}).get("delta") or {}
+        except (ConnectionError, OSError, TimeoutError,
+                ReplicaUnavailable):
+            return  # transport trouble: the delta stays queued replica-
+            #         side (drain happens inside a successful call only)
+        except Exception:
+            # an app-level rejection (404 on an old server): permanent
+            self._prior_unsupported.add(rid)
+            return
+        with self._lock:
+            self.counters["prior_syncs"] += 1
+        if not delta:
+            return
+        if self.prior_pool is None:
+            from coda_tpu.serve.priors import PriorPool
+
+            with self._lock:
+                if self.prior_pool is None:
+                    self.prior_pool = PriorPool()
+        n = self.prior_pool.merge_delta(delta)
+        if n:
+            with self._lock:
+                self.counters["prior_deltas_merged"] += n
 
     def start(self, poll_s: float = 0.25) -> "SessionRouter":
         if self._poll_thread is not None:
@@ -1367,6 +1421,8 @@ class SessionRouter:
         }
         if self.journal is not None:
             out["router"]["journal"] = self.journal.stats()
+        if self.prior_pool is not None:
+            out["router"]["prior_pool"] = self.prior_pool.stats()
         return out
 
     def render_metrics(self) -> str:

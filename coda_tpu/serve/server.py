@@ -55,6 +55,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import re
 import socket
 import threading
@@ -149,6 +150,25 @@ class ServeApp:
             idle_cold_s=idle_cold_s, max_warm=max_warm,
             free_fraction=tier_free_fraction) if tiering else None
         self.spec = spec or SelectorSpec.create("coda", n_parallel=capacity)
+        # cross-session surrogate prior pool (serve/priors.py): live only
+        # when the spec's surrogate_prior knob says 'pool' — under the
+        # default 'off' there is no pool, no provider, and admission
+        # seeds nothing (the PR-14 bitwise pin)
+        self.prior_pool = None
+        _prior_knob = dict(getattr(self.spec, "kwargs", ()) or ()).get(
+            "surrogate_prior", "off")
+        from coda_tpu.selectors.surrogate import parse_prior
+
+        if parse_prior(str(_prior_knob)):
+            from coda_tpu.serve.priors import PriorPool
+
+            self.prior_pool = PriorPool()
+            self.metrics.prior_provider = self._prior_totals
+            # lazily-built buckets resolve their seed prior at build time
+            from coda_tpu.serve.priors import bucket_pool_key
+
+            self.store.prior_resolver = (
+                lambda b: self.prior_pool.get(bucket_pool_key(self, b)))
         self.default_task = default_task
         self.draining = False
         # migration holds (the fleet's prepare/commit protocol): a held
@@ -484,7 +504,13 @@ class ServeApp:
             "spec_kwargs": [list(kv) for kv in self.spec.kwargs],
             "acq_batch": self.spec.acq_batch,
             "seed": sess.seed, "shape": tm.get("shape"),
-            "digest": tm.get("digest")})
+            "digest": tm.get("digest"),
+            # the applied-prior record (pool values + digest + credit)
+            # ONLY when this admission was actually seeded — cold
+            # sessions keep the exact pre-prior meta, so their streams
+            # stay bitwise identical to PR-14 ones
+            **({"surrogate_prior": dict(sess.prior_fit)}
+               if sess.prior_fit is not None else {})})
         # the start ticket carries a demotion pin (set BEFORE submit so a
         # racing sweep can never page out a session whose first dispatch
         # is still in flight); resolution — result, error, or timeout
@@ -929,6 +955,13 @@ class ServeApp:
             # it — corrupting whichever session lands there
             raise BucketQuarantined(
                 f"session {sid} is being restored; retry shortly")
+        if self.prior_pool is not None and not sess.parked:
+            # harvest the fit before the slot is freed (a parked session
+            # already contributed at demotion)
+            try:
+                self.contribute_prior(sess, sess.bucket.slot_fit(sess.slot))
+            except Exception:
+                pass  # a close must never fail on pool bookkeeping
         self.store.close(sid)
         self.recorder.close(sid)
         if self.tiers is not None:
@@ -1091,6 +1124,129 @@ class ServeApp:
                 "draining": self.draining, "status": status,
                 "problems": problems}
 
+    # -- cross-session surrogate prior (serve/priors.py) -------------------
+    def contribute_prior(self, sess, fit_stats) -> bool:
+        """Fold one session's fit statistics into the pool (at close or
+        demotion; exactly once per session). A SEEDED session's inherited
+        pool mass is subtracted first — the per-refold decay is linear,
+        so what is left of the seed after ``fits`` refolds is exactly
+        ``SURROGATE_FIT_DECAY ** fits`` of it; without the subtraction
+        every generation would re-contribute its ancestors' statistics
+        and the pool would amplify instead of track."""
+        if self.prior_pool is None or fit_stats is None \
+                or sess.prior_contributed:
+            return False
+        import numpy as np
+
+        from coda_tpu.selectors.surrogate import (SURROGATE_FIT_DECAY,
+                                                  prior_from_dict)
+        from coda_tpu.serve.priors import bucket_pool_key
+
+        if sess.prior_fit is not None:
+            g = SURROGATE_FIT_DECAY ** float(
+                np.asarray(fit_stats.get("fits", 0)))
+            seed = prior_from_dict(sess.prior_fit)
+            fit_stats = {
+                "A": np.asarray(fit_stats["A"], np.float64) - g * seed.A,
+                "b": np.asarray(fit_stats["b"], np.float64) - g * seed.b,
+                "n": max(0.0, float(fit_stats["n"]) - g * seed.n),
+                "rounds": fit_stats["rounds"],
+            }
+        ok = self.prior_pool.contribute(
+            bucket_pool_key(self, sess.bucket), fit_stats)
+        if ok:
+            sess.prior_contributed = True
+            self.refresh_bucket_priors()
+        return ok
+
+    def refresh_bucket_priors(self) -> int:
+        """Re-resolve each bucket's admission prior from the pool (after
+        a contribution, a router pool push, or a restart restore)."""
+        if self.prior_pool is None:
+            return 0
+        from coda_tpu.serve.priors import bucket_pool_key
+
+        n = 0
+        for b in self.store.buckets():
+            stats = self.prior_pool.get(bucket_pool_key(self, b))
+            if b.set_prior(stats) is not None:
+                n += 1
+        return n
+
+    def _prior_totals(self) -> dict:
+        """ServeMetrics snapshot provider for the prior evidence triple
+        (+ pool gauges): contributions accepted into the pool, warmup
+        rounds the pool credited to live sessions (slab-read), and gate
+        rejections that fired inside a credited warmup window."""
+        if self.prior_pool is None:
+            return {}
+        per = getattr(self, "_surrogate_per", None)
+        if per is None:
+            per = {}
+            for b in self.store.buckets():
+                s = b.surrogate_stats()
+                if s is not None:
+                    per[id(b)] = s
+        pool = self.prior_pool.stats()
+        return {
+            "prior_sessions_contributed": pool["sessions_contributed"],
+            "prior_warmup_rounds_skipped": sum(
+                s.get("prior_rounds", 0) for s in per.values()),
+            "prior_gate_rejections": sum(
+                s.get("prior_rejects", 0) for s in per.values()),
+            "prior_pools": pool["pools"],
+            "prior_rounds_pooled": pool["rounds_pooled"],
+        }
+
+    def sync_prior(self, pool_snap: Optional[dict] = None) -> dict:
+        """The router exchange verb (``POST /prior/sync``, piggybacked on
+        the health poll): drain this replica's since-last-poll delta for
+        the caller, adopt the router's merged pool when one is pushed,
+        then re-fold the just-drained delta locally so this replica's own
+        recent contributions stay live until the next push echoes them
+        back (uncounted — contribute() already counted them)."""
+        if self.prior_pool is None:
+            return {"delta": {}}
+        delta = self.prior_pool.drain_delta()
+        if pool_snap:
+            self.prior_pool.replace(pool_snap)
+            if delta:
+                self.prior_pool.merge_delta(delta, count=False)
+            self.refresh_bucket_priors()
+        return {"delta": delta}
+
+    def save_prior_pool(self, tracking_store) -> Optional[str]:
+        """Persist the pool into the tracking store (one stable named
+        run, ``prior_pool.json`` artifact) — the restart-survival half."""
+        if self.prior_pool is None:
+            return None
+        import json as _json
+
+        with tracking_store.run("serve", "surrogate-prior-pool") as run:
+            return run.log_artifact_bytes(
+                "prior_pool.json",
+                _json.dumps(self.prior_pool.snapshot()).encode())
+
+    def load_prior_pool(self, tracking_store) -> int:
+        """Adopt the persisted pool (restart path); returns pools loaded."""
+        if self.prior_pool is None:
+            return 0
+        import json as _json
+
+        found = tracking_store.find_run("serve", "surrogate-prior-pool")
+        if not found:
+            return 0
+        path = os.path.join(tracking_store.artifact_root, found[0],
+                            "prior_pool.json")
+        try:
+            with open(path) as f:
+                snap = _json.load(f)
+        except (OSError, ValueError):
+            return 0
+        n = self.prior_pool.replace(snap)
+        self.refresh_bucket_priors()
+        return n
+
     def _surrogate_totals(self) -> dict:
         """Aggregate surrogate counters over every surrogate-scorer
         bucket (ServeMetrics snapshot provider): rounds scored, contract
@@ -1170,6 +1326,8 @@ class ServeApp:
              "surrogate": getattr(self, "_surrogate_per", {}).get(id(b))}
             for b in self.store.buckets()
         ]
+        if self.prior_pool is not None:
+            snap["prior_pool"] = self.prior_pool.stats()
         snap["warm_error"] = self.warm_error
         snap["recorder_degraded_streams"] = int(
             getattr(self.recorder, "degraded_streams", 0))
@@ -1509,6 +1667,12 @@ class AsyncHTTPServer:
             return await loop.run_in_executor(
                 app._executor,
                 lambda: app.close_session(m.group(1), epoch=_epoch(req)))
+        if method == "POST" and path == "/prior/sync":
+            # the router's pool-exchange half of the health poll: push the
+            # merged pool, collect this replica's contribution delta
+            req = json.loads(raw or b"{}")
+            return await loop.run_in_executor(
+                app._executor, lambda: app.sync_prior(req.get("pool")))
         if method == "GET" and path == "/stats":
             return await loop.run_in_executor(app._executor, app.stats)
         if method == "GET" and path == "/sessions":
@@ -1555,6 +1719,20 @@ def parse_args(argv=None):
                         "TPU/GPU slabs the rung is strictly slower than "
                         "exact (a one-time warning says so at bucket "
                         "build)")
+    p.add_argument("--surrogate-prior", default="off",
+                   choices=["off", "pool"],
+                   help="coda + surrogate scorer only: warm-start every "
+                        "session's surrogate fit from the cross-session "
+                        "prior pool (serve/priors.py) — closed/demoted "
+                        "sessions contribute their fit statistics, new "
+                        "admissions seed from the merged pool and skip "
+                        "already-paid exact warmup rounds; the per-round "
+                        "trust gate is unchanged, so a selection is never "
+                        "driven by an unaudited score. 'off' (default) is "
+                        "bitwise-identical to the pre-pool behavior. With "
+                        "--tracking-db the pool survives restarts; in a "
+                        "fleet, replicas exchange pool deltas through the "
+                        "router's health poll")
     p.add_argument("--capacity", type=int, default=64,
                    help="slab slots per bucket = max HOT (resident) "
                         "sessions per (task, config); admission past it "
@@ -1665,6 +1843,11 @@ def build_app(args) -> ServeApp:
         scorer = getattr(args, "eig_scorer", "exact")
         if scorer != "exact":
             spec_kwargs["eig_scorer"] = scorer
+        prior_knob = getattr(args, "surrogate_prior", "off")
+        if prior_knob and prior_knob != "off":
+            # rides the spec so every bucket (and the recorder's knob
+            # row) sees the mode; the pool fingerprint excludes it
+            spec_kwargs["surrogate_prior"] = prior_knob
     telemetry = None
     if getattr(args, "telemetry_dir", None):
         from coda_tpu.telemetry import Telemetry
@@ -1712,6 +1895,17 @@ def main(argv=None):
     pin_platform(args.platform)
 
     app = build_app(args)
+    if app.prior_pool is not None and args.tracking_db:
+        # adopt the persisted pool BEFORE any admission so the first
+        # session of this process already warm-starts
+        from coda_tpu.tracking import TrackingStore
+
+        _ts = TrackingStore(args.tracking_db)
+        n = app.load_prior_pool(_ts)
+        _ts.close()
+        if n:
+            print(f"surrogate prior pool restored: {n} pool(s) from "
+                  f"{args.tracking_db}")
     if args.restore and args.record_dir:
         # crash restore BEFORE taking traffic: rebuild every un-closed
         # session stream (bitwise replay-verified), then open the doors
@@ -1750,6 +1944,8 @@ def main(argv=None):
             app.metrics.log_to_store(store, params={
                 "method": app.spec.method,
                 "capacity": app.store.capacity})
+            if app.prior_pool is not None:
+                app.save_prior_pool(store)  # the restart-survival half
             store.close()
             print(f"metrics logged to {args.tracking_db}")
 

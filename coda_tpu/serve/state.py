@@ -421,6 +421,18 @@ class Bucket:
         # mirrors the in-step digest so an imported snapshot verifies
         # against the stream's last recorded digest without a dispatch
         self._digest_fn = None
+        # cross-session surrogate prior (serve/priors.py): the admission-
+        # time applied-prior record {"digest", "credit", <prior_to_dict
+        # fields>} or None. set_prior installs/clears it; admission seeds
+        # every NEW session's fit from it (restore paths re-apply the
+        # per-session RECORDED prior instead — the pool may have evolved
+        # since, and replay must reproduce the admitted init bitwise).
+        self.prior: Optional[dict] = None
+        # cached flat-leaf indices of the surrogate fit's (A, b, n,
+        # rounds) within the state pytree — fit_from_leaves' map, built
+        # lazily (the demote-time pool contribution reads host leaves
+        # the sweeper already materialized; no extra device sync)
+        self._fit_leaf_idx: Optional[dict] = None
         self.last_timing: dict = {}  # per-dispatch phase wall times
         # the slab: state pytree with a leading (capacity,) slot axis. All
         # slots start from init(key=0) — real sessions overwrite their slot
@@ -575,11 +587,18 @@ class Bucket:
                 f"(slab rebuild in progress, retry shortly): "
                 f"{self.quarantined}")
 
-    def _fresh_slot_state(self, seed: int):
+    def _fresh_slot_state(self, seed: int, prior: Optional[dict] = None):
         """Reference-choreography ``(state, key)`` for a new session:
         ``PRNGKey(seed)``, init consumes one split (always — even when the
         cached key-independent init state makes its VALUE moot). Shared by
-        admission and the heal/restore replay paths."""
+        admission and the heal/restore replay paths.
+
+        ``prior`` (an applied-prior record — see ``set_prior``) seeds the
+        state's carried surrogate fit from the cross-session pool: the
+        regression sufficient statistics fold in and warmup credit is
+        granted, everything else of the init stays the reference value.
+        The caller owns replay consistency: a restore must pass the
+        SAME record the session was admitted under."""
         import jax
         import jax.numpy as jnp
 
@@ -593,10 +612,34 @@ class Bucket:
             state = init(sub.astype(jnp.uint32))
             if self.n_valid < self.shape[1]:
                 state = _deactivate_padded(state, self.n_valid)
+        if prior is not None and getattr(state, "surrogate",
+                                         None) is not None:
+            from coda_tpu.selectors.surrogate import (prior_from_dict,
+                                                      seed_fit)
+
+            state = state._replace(
+                surrogate=seed_fit(state.surrogate,
+                                   prior_from_dict(prior)))
         return state, key.astype(jnp.uint32)
 
+    def set_prior(self, stats) -> Optional[dict]:
+        """Install (or clear, with None) the pool prior new admissions
+        seed from; returns the applied-prior record now in force."""
+        from coda_tpu.selectors.surrogate import (prior_digest,
+                                                  prior_to_dict,
+                                                  prior_warmup_credit)
+
+        if stats is None or getattr(stats, "n", 0) <= 0:
+            self.prior = None
+        else:
+            rec = prior_to_dict(stats)
+            rec["digest"] = prior_digest(stats)
+            rec["credit"] = prior_warmup_credit(stats)
+            self.prior = rec
+        return self.prior
+
     # -- slot lifecycle (no bucket lock needed: slab writes are staged) ----
-    def allocate(self, seed: int) -> int:
+    def allocate(self, seed: int, prior: Optional[dict] = None) -> int:
         """Take a free slot and stage its freshly-initialized state.
 
         Runs WITHOUT the bucket (dispatch) lock: the init computation
@@ -610,7 +653,7 @@ class Bucket:
                     f"bucket {self.task}/{self.spec.method}: all "
                     f"{self.capacity} slots live")
             slot = self._free.pop()
-        state, key = self._fresh_slot_state(seed)
+        state, key = self._fresh_slot_state(seed, prior=prior)
         with self._host_lock:
             self._staged.append((slot, state, key))
         return slot
@@ -806,9 +849,12 @@ class Bucket:
             fallbacks = np.asarray(fit.fallbacks)
             fits = np.asarray(fit.fits)
             margins = np.asarray(fit.margin)
+            prounds = np.asarray(getattr(fit, "prior_rounds", 0))
+            prejects = np.asarray(getattr(fit, "prior_rejects", 0))
         if live.size == 0:
             return {"rounds": 0, "fallbacks": 0, "fit_refreshes": 0,
-                    "contract_margin": None}
+                    "contract_margin": None, "prior_rounds": 0,
+                    "prior_rejects": 0}
         active = live[rounds[live] > 0]
         finite = (np.isfinite(margins[active])
                   if active.size else np.zeros(0, bool))
@@ -819,6 +865,13 @@ class Bucket:
             "fallbacks": int(fallbacks[live].sum()),
             "fit_refreshes": int(fits[live].sum()),
             "contract_margin": margin,
+            # the prior evidence pair, device-read from the same carry:
+            # warmup rounds the pool credited to live sessions, and gate
+            # rejections that fired INSIDE a credited warmup window
+            "prior_rounds": (int(prounds[live].sum())
+                             if prounds.ndim else 0),
+            "prior_rejects": (int(prejects[live].sum())
+                              if prejects.ndim else 0),
         }
 
     def pbest(self, slot: int):
@@ -869,6 +922,47 @@ class Bucket:
         state = self._state_from_leaves(leaves)
         m, e = self._ensure_digest_fn()(state)
         return float(np.asarray(m)), float(np.asarray(e))
+
+    def fit_from_leaves(self, leaves) -> Optional[dict]:
+        """The surrogate fit's pool-contribution statistics ``{"A", "b",
+        "n", "rounds"}`` extracted from HOST snapshot leaves (the
+        sweeper's batched demotion materialized them already — the
+        pool's demote-time contribution costs no extra device sync).
+        None when this bucket's selector carries no fit."""
+        if getattr(self.states, "surrogate", None) is None:
+            return None
+        if self._fit_leaf_idx is None:
+            import jax
+
+            ref, _ = self._fresh_slot_state(0)
+            idx = {}
+            flat = jax.tree_util.tree_flatten_with_path(ref)[0]
+            for i, (path, _leaf) in enumerate(flat):
+                names = [getattr(p, "name", None) for p in path]
+                if "surrogate" in names:
+                    idx[names[-1]] = i
+            self._fit_leaf_idx = idx
+        idx = self._fit_leaf_idx
+        try:
+            return {k: np.asarray(leaves[idx[k]])
+                    for k in ("A", "b", "n", "rounds", "fits")}
+        except (KeyError, IndexError):
+            return None
+
+    def slot_fit(self, slot: int) -> Optional[dict]:
+        """One LIVE slot's fit contribution statistics ``{"A", "b", "n",
+        "rounds", "fits"}`` as host arrays (the close-time pool
+        contribution's read — a few hundred words, on demand)."""
+        if getattr(self.states, "surrogate", None) is None:
+            return None
+        with self.lock:
+            self._apply_staged()
+            fit = self.states.surrogate
+            return {"A": np.asarray(fit.A[slot]),
+                    "b": np.asarray(fit.b[slot]),
+                    "n": np.asarray(fit.n[slot]),
+                    "rounds": np.asarray(fit.rounds[slot]),
+                    "fits": np.asarray(fit.fits[slot])}
 
     def snapshot_slot(self, slot: int):
         """Host-materialized ``(state leaves, key)`` of one slot.
@@ -944,12 +1038,15 @@ class Bucket:
             self._staged.append(
                 (slot, state, jnp.asarray(np.asarray(key), jnp.uint32)))
 
-    def stage_fresh(self, slot: int, seed: int) -> None:
+    def stage_fresh(self, slot: int, seed: int,
+                    prior: Optional[dict] = None) -> None:
         """Stage a freshly-initialized state for an ALLOCATED slot — the
         replay-restore entry point: replay starts from the reference init
         (overriding any previously staged snapshot write; staged rows
-        apply in order, last write wins)."""
-        state, key = self._fresh_slot_state(seed)
+        apply in order, last write wins). ``prior`` re-applies the
+        applied-prior record the session was ADMITTED under, so a
+        prior-seeded session's replay reproduces its init bitwise."""
+        state, key = self._fresh_slot_state(seed, prior=prior)
         with self._host_lock:
             self._staged.append((slot, state, key))
 
@@ -1015,6 +1112,16 @@ class Session:
     # watermark demotion order on. Both mutate only under the store lock.
     pins: int = 0
     last_used: float = field(default_factory=time.monotonic)
+    # the applied-prior record this session's fit was SEEDED from at
+    # admission ({"digest", "credit", <prior_to_dict fields>}; None =
+    # cold init). Rides the recorder stream meta and the export payload
+    # so every replay-based restore (import fallback, crash restore,
+    # heal, offline verify) re-applies the exact same prior — the pool
+    # may have evolved since, but this session's history has not.
+    prior_fit: Optional[dict] = None
+    # whether this session's fit statistics were already folded into the
+    # cross-session pool (contribute exactly once: close OR demote)
+    prior_contributed: bool = False
 
 
 def _round_up(n: int, quantum: int) -> int:
@@ -1054,6 +1161,10 @@ class SessionStore:
         self.registry = registry             # cost-gauge registry (or None
         #                                      = process-global); ServeApp
         #                                      sets its telemetry's here
+        self.prior_resolver = None           # bucket -> PriorStats|None;
+        #                                      ServeApp installs the pool
+        #                                      lookup so lazily-built
+        #                                      buckets seed immediately
         self._tasks: dict[str, Any] = {}     # name -> (H, N, C) ndarray
         self._meta: dict[str, dict] = {}     # name -> class/model names
         self._buckets: dict[tuple, Bucket] = {}
@@ -1138,18 +1249,32 @@ class SessionStore:
             b = Bucket(preds, spec, self.capacity, n_valid=N, task=task,
                        step_impl=self.step_impl, donate=self.donate,
                        faults=self.faults, registry=self.registry)
+            if self.prior_resolver is not None:
+                # buckets build lazily at first admission — a pool loaded
+                # before that (restart restore) must still seed it
+                try:
+                    b.set_prior(self.prior_resolver(b))
+                except Exception:
+                    pass  # the pool never blocks a bucket build
             with self.lock:
                 self._buckets[key] = b
             return b
 
     # -- sessions ----------------------------------------------------------
     def open(self, task: str, spec: SelectorSpec, seed: int = 0,
-             sid: Optional[str] = None, restoring: bool = False) -> Session:
+             sid: Optional[str] = None, restoring: bool = False,
+             prior="pool") -> Session:
         """Admit a session. ``sid`` pins the session id — the
         import/restore path, where the client already holds its handle
         from the exporting server and must keep it across the migration.
         ``restoring`` publishes the session already gated (see
-        :class:`Session`) so no label can slip in before the flag is set."""
+        :class:`Session`) so no label can slip in before the flag is set.
+
+        ``prior``: ``"pool"`` (default) seeds a NEW session's surrogate
+        fit from the bucket's current pool prior (a no-op until
+        ``Bucket.set_prior`` installed one); an explicit applied-prior
+        record re-applies exactly that one (the restore paths); None
+        forces a cold init."""
         with self.lock:
             if task not in self._tasks:
                 raise KeyError(f"unknown task {task!r}; registered: "
@@ -1157,12 +1282,16 @@ class SessionStore:
             if sid is not None and sid in self._sessions:
                 raise ValueError(f"session id {sid!r} already live here")
         bucket = self._bucket_for(task, spec)
+        # resolve the prior ONCE so the allocate-time seeding and the
+        # session's recorded prior_fit can never disagree (the pool may
+        # swap the bucket prior concurrently)
+        applied = bucket.prior if prior == "pool" else prior
         # no bucket (dispatch) lock: allocate stages its slab write, so
         # admission never waits out an in-flight slab step
-        slot = bucket.allocate(seed)  # raises SlabFull when exhausted
+        slot = bucket.allocate(seed, prior=applied)  # raises SlabFull
         sess = Session(sid=sid or secrets.token_hex(8), task=task,
                        bucket=bucket, slot=slot, seed=seed,
-                       restoring=restoring)
+                       restoring=restoring, prior_fit=applied)
         with self.lock:
             if sess.sid in self._sessions:  # lost an import race
                 bucket.release(slot)

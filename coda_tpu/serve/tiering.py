@@ -123,6 +123,10 @@ class TierManager:
         # hibernated_<sid>.json files, so cold sessions survive process
         # death across both layouts
         self._spill = SpillStore(spill_dir) if spill_dir else None
+        if self._spill is not None:
+            # the v3 store's segment/index/compaction gauges ride every
+            # /stats and /metrics snapshot (read on demand, no sync)
+            self.app.metrics.spill_provider = self._spill.stats
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "TierManager":
@@ -140,6 +144,9 @@ class TierManager:
         t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=5.0)
+        if self._spill is not None:
+            # flush the sidecar index so the next start is O(index)
+            self._spill.close()
 
     # -- reads -------------------------------------------------------------
     def counts(self) -> dict:
@@ -179,7 +186,11 @@ class TierManager:
                 return entry["payload"]
         if self._spill is None:
             return None
-        return self._spill.get(sid)
+        from coda_tpu.serve.spill import materialize
+
+        # the export/migration surfaces serialize this: hand them a
+        # plain JSON-safe dict, not the store's lazy mmap view
+        return materialize(self._spill.get(sid))
 
     def export_parked(self) -> list:
         """Every parked session's payload (the drain/migrate sweep's
@@ -270,6 +281,18 @@ class TierManager:
             # from here no verb can reach the session (get raises):
             # release the slot, park the stream, publish the payload
             sess.bucket.release(sess.slot)
+            if getattr(app, "prior_pool", None) is not None:
+                # the demotion snapshot is the last host view of the fit:
+                # contribute it now (close of a parked session never wakes)
+                try:
+                    if app.contribute_prior(sess,
+                                            bucket.fit_from_leaves(snap[0])):
+                        # the payload was built pre-contribution: mark it
+                        # so a wake (or a migration of the parked copy)
+                        # restores the once-flag and never re-contributes
+                        payload["prior_contributed"] = True
+                except Exception:
+                    pass
             app.recorder.park(sess.sid)
             with self._lock:
                 self._warm[sess.sid] = {"payload": payload,
@@ -306,17 +329,24 @@ class TierManager:
 
     # -- hibernation (warm -> cold) ----------------------------------------
     def hibernate(self, sid: str) -> bool:
-        """Move one warm payload into the spill log. Runs under the tier
-        lock end to end (one compressed append) so the sid is never
-        unreachable mid-move; a failed disk write leaves the session
-        warm, counted, never lost."""
+        """Move one warm payload into the spill store. Compression runs
+        OUTSIDE the tier lock (the old end-to-end hold stalled concurrent
+        wakes behind zlib for the whole demotion batch); the commit
+        window re-checks the entry is the SAME object — a wake or a
+        re-park between the two lock windows aborts the move, so the sid
+        is never unreachable and never spilled stale. A failed disk
+        write leaves the session warm, counted, never lost."""
         if self._spill is None:
             return False
         with self._lock:
             entry = self._warm.get(sid)
-            if entry is None:
-                return False
-            if not self._spill.put(sid, entry["payload"]):
+        if entry is None:
+            return False
+        encoded = self._spill.encode(entry["payload"])
+        with self._lock:
+            if self._warm.get(sid) is not entry:
+                return False  # woke (or was re-parked fresh) mid-encode
+            if not self._spill.put_encoded(sid, encoded):
                 self.spill_errors += 1
                 return False
             del self._warm[sid]
@@ -512,6 +542,11 @@ class TierManager:
                     n_hibernated += 1
                     continue
                 n_hibernated += self.hibernate(sid)
+        if self._spill is not None:
+            # per-segment compaction rides the sweeper, not startup —
+            # it copies raw frame bytes forward one short lock window at
+            # a time, so it never stops wakes or demotions
+            self._spill.maybe_compact()
         self._publish_gauges()
         from coda_tpu.telemetry.registry import sample_process_rss
 

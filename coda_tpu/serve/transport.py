@@ -69,6 +69,9 @@ VERB_DEADLINES = {
     "healthz": 5.0,
     "sessions": 60.0,
     "epoch": 10.0,
+    # the prior-pool exchange rides the health cadence but moves a
+    # payload (the merged pool), so it gets stats-class headroom
+    "prior_sync": 30.0,
 }
 
 #: verbs that are idempotent at the replica regardless of payload: a

@@ -136,6 +136,51 @@ _SERVE_SCALARS = [
      "gauge",
      "Worst escape-gate margin across live slots (best refreshed exact "
      "score minus best unrefreshed prediction; healthy > 0)"),
+    # cross-session surrogate prior pool (--surrogate-prior pool): absent
+    # (not zero) under the default 'off'. The warmup/rejection pair are
+    # live-slot sums of slab-carried counters (same decrease-on-close
+    # semantics as the surrogate gauges above); sessions_contributed is
+    # pool state and only ever grows, but stays a gauge so the family
+    # keeps one scrape semantics
+    ("prior_sessions_contributed", "serve_prior_sessions_contributed",
+     "gauge",
+     "Sessions whose surrogate fit statistics were folded into the "
+     "cross-session prior pool"),
+    ("prior_warmup_rounds_skipped", "serve_prior_warmup_rounds_skipped",
+     "gauge",
+     "Exact warmup rounds the pool prior credited to live sessions "
+     "(summed over live slots; decreases when sessions close/demote)"),
+    ("prior_gate_rejections", "serve_prior_gate_rejections", "gauge",
+     "Trust-gate fallbacks fired inside a prior-credited warmup window, "
+     "summed over live slots (a transferring-badly prior shows up here)"),
+    ("prior_pools", "serve_prior_pools", "gauge",
+     "Distinct (task, pool-fingerprint) priors this replica holds"),
+    ("prior_rounds_pooled", "serve_prior_rounds_pooled", "gauge",
+     "Decay-weighted audited rounds aggregated across all pool priors"),
+]
+
+# spill store v3 evidence (serve/spill.py, nested under snapshot["spill"]):
+# absent without --tier-spill-dir
+_SERVE_SPILL = [
+    ("entries", "serve_spill_entries", "gauge",
+     "Live hibernated payloads in the spill store"),
+    ("segments", "serve_spill_segments", "gauge",
+     "Sharded segment files currently on disk"),
+    ("live_bytes", "serve_spill_live_bytes", "gauge",
+     "Bytes of live frames across all segments"),
+    ("log_bytes", "serve_spill_log_bytes", "gauge",
+     "Total bytes across all segment files"),
+    ("garbage_bytes", "serve_spill_garbage_bytes", "gauge",
+     "Bytes of superseded/tombstoned frames awaiting compaction"),
+    ("segment_compactions", "serve_spill_segment_compactions_total",
+     "counter",
+     "Per-segment compactions (live frames copied forward, file "
+     "reclaimed) — never stop-the-world"),
+    ("put_errors", "serve_spill_put_errors_total", "counter",
+     "Spill appends that failed (payload kept warm instead)"),
+    ("startup_scan_frames", "serve_spill_startup_scan_frames", "gauge",
+     "Frames the last startup had to scan past the persisted index "
+     "(0 = pure O(index) startup)"),
 ]
 
 _SERVE_SUMMARIES = [
@@ -390,6 +435,12 @@ def render_fleet(replica_snaps: dict, registry: Optional[Registry] = None,
             _family(out, _name(prefix, f"serve_sessions_{tier}"), "gauge",
                     f"Open sessions currently in the {tier} tier",
                     samples)
+    for key, suffix, kind, help in _SERVE_SPILL:
+        samples = [({"replica": rid}, (s.get("spill") or {}).get(key))
+                   for rid, s in snaps.items()
+                   if (s.get("spill") or {}).get(key) is not None]
+        if samples:
+            _family(out, _name(prefix, suffix), kind, help, samples)
     for key, suffix, count_key, help in _SERVE_SUMMARIES:
         name = _name(prefix, suffix)
         samples = []
@@ -426,6 +477,11 @@ def _render_serve(out: list, snap: dict, prefix: str) -> None:
             _family(out, _name(prefix, f"serve_sessions_{tier}"), "gauge",
                     f"Open sessions currently in the {tier} tier",
                     [({}, tiers[tier])])
+    spill = snap.get("spill") or {}
+    for key, suffix, kind, help in _SERVE_SPILL:
+        v = spill.get(key)
+        if v is not None:
+            _family(out, _name(prefix, suffix), kind, help, [({}, v)])
     fills = snap.get("ring_fill") or {}
     if fills:
         _family(out, _name(prefix, "serve_ring_fill"), "gauge",

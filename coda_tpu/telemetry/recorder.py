@@ -190,6 +190,11 @@ KNOB_FIELDS = (
     "eig_cache_dtype", "eig_refresh", "eig_entropy", "posterior",
     "eig_pbest", "eig_scorer", "pi_update", "mesh", "acq_batch",
     "oracle_noise", "oracle_annotators", "oracle_reliability",
+    # v4 (PR 18): the cross-session surrogate prior mode + the digest of
+    # the applied pool prior (serve/priors.py) — the digest, not the
+    # statistics, is the knob: two runs seeded from different pools are
+    # different environments and must not compare bitwise
+    "surrogate_prior", "surrogate_prior_digest",
 )
 
 

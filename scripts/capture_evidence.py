@@ -185,6 +185,16 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                  "--records-dir",
                  os.path.join(tmpdir, "surrogate_records")] + plat,
                 os.path.join(tmpdir, "surrogate.json"), 900),
+            # cross-session surrogate priors at smoke scale: warmup
+            # amortization + gate rejection + off parity at a smaller
+            # budget (the committed >= 3x reduction floor lives in the
+            # full BENCH_PRIOR_* capture)
+            "bench_prior": (
+                [py, "scripts/bench_prior.py", "--quick",
+                 "--out", os.path.join(tmpdir, "prior.json"),
+                 "--records-dir",
+                 os.path.join(tmpdir, "prior_records")] + plat,
+                os.path.join(tmpdir, "prior.json"), 900),
             # the replicated fleet at proof scale: 2 replicas behind the
             # rendezvous router, rolling restart of both mid-load, every
             # migration digest-verified (the committed 3-replica claim is
@@ -234,18 +244,18 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
              "--max-wait-ms", "15", "--max-linger-ms", "250",
              "--out", os.path.join(tmpdir, "serve.json")] + plat,
             os.path.join(tmpdir, "serve.json"), 3600),
-        # the full ≥100k-open-sessions tiered capture (the BENCH_TIERED_*
-        # configuration)
+        # the full ≥1M-open-sessions tiered capture (the BENCH_TIERED_*
+        # configuration: spill v3 sharded segments, O(index) reopen)
         "serve_tiered": (
             [py, "scripts/serve_loadgen.py", "--synthetic", "4,48,4",
-             "--zipf", "1.5", "--sessions", "100000", "--workers", "64",
-             "--labels", "0", "--requests", "10000", "--capacity", "128",
+             "--zipf", "1.5", "--sessions", "1000000", "--workers", "64",
+             "--labels", "0", "--requests", "20000", "--capacity", "128",
              "--retries", "8", "--tier-free-frac", "0.5",
              "--idle-warm-s", "5", "--idle-cold-s", "10",
              "--max-warm", "2048", "--think-ms", "1",
              "--tier-spill-dir", os.path.join(tmpdir, "spill"),
              "--out", os.path.join(tmpdir, "tiered.json")] + plat,
-            os.path.join(tmpdir, "tiered.json"), 3600),
+            os.path.join(tmpdir, "tiered.json"), 7200),
         "multichip_replay": (
             [py, "scripts/dryrun_multichip.py", "8",
              "--out", os.path.join(tmpdir, "multichip.json")],
@@ -272,6 +282,15 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
              "--records-dir", os.path.join(tmpdir, "surrogate_records")]
             + plat,
             os.path.join(tmpdir, "surrogate.json"), 3600),
+        # cross-session surrogate priors in full: the >= 3x warmup
+        # amortization floor, seeded-vs-cold digits envelope, gate
+        # rejection, off parity (the BENCH_PRIOR_* configuration)
+        "bench_prior": (
+            [py, "scripts/bench_prior.py",
+             "--out", os.path.join(tmpdir, "prior.json"),
+             "--records-dir", os.path.join(tmpdir, "prior_records")]
+            + plat,
+            os.path.join(tmpdir, "prior.json"), 3600),
         # the full 3-replica fleet demo (the BENCH_FLEET_* configuration):
         # rolling restart of every replica in sequence under live load,
         # zero drops / zero double-applies, scaling vs the 1-replica

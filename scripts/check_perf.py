@@ -231,6 +231,25 @@ def tiered_check_report(report: dict) -> list[str]:
     if not t.get("wakes"):
         out.append("tiering.wakes == 0 (no wake ever happened — the "
                    "claim is unexercised)")
+    # spill v3 (ISSUE 18): captures that carry the reopen probe must
+    # evidence the O(index) startup — the sidecar honored, not a full
+    # frame scan (older captures predate the probe; absent = not checked)
+    reopen = t.get("spill_reopen")
+    if isinstance(reopen, dict):
+        if reopen.get("startup_mode") != "index":
+            out.append(
+                f"tiering.spill_reopen.startup_mode "
+                f"{reopen.get('startup_mode')!r} != 'index' (restart "
+                "fell back to the full frame scan — the persisted "
+                "sidecar index was not honored)")
+        entries = reopen.get("entries")
+        scanned = reopen.get("startup_scan_frames")
+        if isinstance(entries, int) and isinstance(scanned, int) and \
+                entries > 0 and scanned >= entries:
+            out.append(
+                f"tiering.spill_reopen.startup_scan_frames {scanned} >= "
+                f"entries {entries}: startup re-parsed the whole store, "
+                "not just the unindexed tail")
     return out
 
 
@@ -550,6 +569,91 @@ def surrogate_check_report(report: dict) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# cross-session surrogate priors (ISSUE 18 acceptance: BENCH_PRIOR_*
+# holds the warmup-cost reduction + regret envelope + gate-fallback +
+# never-unaudited claims of --surrogate-prior pool)
+# ---------------------------------------------------------------------------
+
+# exact warmup rounds a pool-seeded session pays vs a cold one: the
+# amortization claim (a mature pool grants the full warmup credit, so a
+# seeded session pays >= 3x fewer exact warmup rounds)
+PRIOR_MIN_WARMUP_REDUCTION = 3.0
+# real-digits regret of the seeded run vs the cold run at the same label
+# budget — the surrogate envelope's numbers (the prior changes WHEN the
+# surrogate starts carrying rounds, never the audit/trust contract, so
+# it inherits the same quality bound)
+PRIOR_ENVELOPE_RATIO = 1.05
+PRIOR_ENVELOPE_ABS = 0.02
+
+
+def prior_check_report(report: dict) -> list[str]:
+    """Violations of one surrogate-prior capture (empty = clean): the
+    exact-warmup-rounds reduction floor, the seeded-vs-cold digits
+    regret envelope, zero unaudited argmax picks across every driven
+    round, the hostile-prior gate rejection actually falling back to
+    the exact pass, bitwise self-replay of every recorded program, the
+    pool-vs-off divergence triaged as ``surrogate-prior-envelope``
+    through ``cli replay --against``, and ``--surrogate-prior off``
+    bitwise-pinned to the knob-less program through the same real path
+    at score-tol 0."""
+    out: list[str] = []
+    if report.get("quick"):
+        return ["quick prior captures must not be committed at the repo "
+                "root (no committed floors were checked)"]
+    warm = report.get("warmup") or {}
+    red = warm.get("reduction")
+    if not isinstance(red, (int, float)):
+        out.append("warmup.reduction missing")
+    elif red < PRIOR_MIN_WARMUP_REDUCTION:
+        out.append(f"warmup.reduction {red:.2f} < "
+                   f"{PRIOR_MIN_WARMUP_REDUCTION} (the pool prior did "
+                   "not amortize the exact warmup)")
+    dig = report.get("digits") or {}
+    base = (dig.get("cold") or {}).get("final_cum_regret_mean")
+    seeded = (dig.get("seeded") or {}).get("final_cum_regret_mean")
+    if not all(isinstance(v, (int, float)) for v in (base, seeded)):
+        out.append("digits.cold/seeded.final_cum_regret_mean missing")
+    elif seeded > PRIOR_ENVELOPE_RATIO * base + PRIOR_ENVELOPE_ABS:
+        out.append(
+            f"digits seeded final cum regret {seeded:.4f} outside the "
+            f"committed envelope ({PRIOR_ENVELOPE_RATIO} * {base:.4f} + "
+            f"{PRIOR_ENVELOPE_ABS})")
+    audit = report.get("audit") or {}
+    if audit.get("unaudited_argmax_picks") != 0:
+        out.append(
+            f"audit.unaudited_argmax_picks "
+            f"{audit.get('unaudited_argmax_picks')!r} != 0 (a selection "
+            "was driven by a score the exact chain never audited)")
+    gate = report.get("gate_rejection") or {}
+    if not gate.get("prior_rejects"):
+        out.append("gate_rejection.prior_rejects is 0/missing (the "
+                   "hostile-prior probe never tripped the contract)")
+    if gate.get("fell_back_exact") is not True:
+        out.append("gate_rejection.fell_back_exact is not true (a "
+                   "rejected prior round must run the exact pass "
+                   "bitwise)")
+    for side in ("cold", "seeded"):
+        rep = (dig.get(side) or {}).get("replay") or {}
+        if rep.get("parity") is not True:
+            out.append(f"digits.{side}.replay.parity is not true (every "
+                       "recorded program must self-replay bitwise)")
+    against = dig.get("against_cold") or {}
+    if against.get("classification") != "surrogate-prior-envelope":
+        out.append(
+            f"digits.against_cold.classification "
+            f"{against.get('classification')!r} — the pool-vs-off "
+            "divergence must be triaged through the replay --against "
+            "knob-diff path as surrogate-prior-envelope")
+    pin = report.get("off_parity") or {}
+    if pin.get("parity") is not True:
+        out.append("off_parity.parity is not true (--surrogate-prior "
+                   "off must be bitwise the knob-less PR 14 program, "
+                   "verified through the real cli replay --against "
+                   "--score-tol 0 path)")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # the crowd-oracle robustness contract (ISSUE 16: noisy / abstaining /
 # asynchronous labelers with a learned annotator-reliability posterior)
 # ---------------------------------------------------------------------------
@@ -708,7 +812,7 @@ EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
 EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered",
                                 "bench_batchq", "serve_fleet",
                                 "serve_fleet_chaos", "bench_surrogate",
-                                "oracle_noise")
+                                "oracle_noise", "bench_prior")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -762,6 +866,17 @@ def _evidence_check(report: dict) -> list[str]:
         if rep.get("replays_verified") is not True:
             out.append("bench_surrogate.report.replays_verified is not "
                        "true")
+    rep = (arts.get("bench_prior") or {}).get("report") or {}
+    if rep:
+        if rep.get("ok") is not True:
+            out.append("bench_prior.report.ok is not true (warmup "
+                       "reduction / regret envelope / gate rejection / "
+                       "off parity broke in-capture)")
+        if rep.get("replays_verified") is not True:
+            out.append("bench_prior.report.replays_verified is not true")
+        if (rep.get("audit") or {}).get("unaudited_argmax_picks") != 0:
+            out.append("bench_prior.report.audit.unaudited_argmax_picks "
+                       "!= 0")
     rep = (arts.get("serve_fleet") or {}).get("report") or {}
     if rep:
         fl = rep.get("fleet") or {}
@@ -924,6 +1039,34 @@ CONTRACTS: tuple = (
              "regret envelope vs exact held, post-warmup fallback rate "
              "<= 10%, default exact bitwise-pinned via cli replay "
              "--against"),
+    # -- cross-session surrogate priors --
+    Contract(
+        pattern="BENCH_PRIOR_*.json", kind="prior",
+        required=("bench", "wall_s", "config", "digits.label_budget",
+                  "digits.cold.final_cum_regret_mean",
+                  "digits.seeded.final_cum_regret_mean",
+                  "digits.against_cold.classification",
+                  "warmup.cold_exact_rounds",
+                  "warmup.seeded_exact_rounds", "warmup.reduction",
+                  "audit.unaudited_argmax_picks",
+                  "gate_rejection.prior_rejects",
+                  "gate_rejection.fell_back_exact",
+                  "off_parity.parity",
+                  "regret_envelope_ok", "replays_verified", "ok"),
+        bounds=(("ok", "==", True),
+                ("regret_envelope_ok", "==", True),
+                ("replays_verified", "==", True),
+                ("audit.unaudited_argmax_picks", "==", 0),
+                ("warmup.reduction", ">=", PRIOR_MIN_WARMUP_REDUCTION)),
+        checker=prior_check_report, fingerprint="required",
+        group="prior",
+        regress=("warmup.reduction", "higher", 0.5),
+        note="fleet-amortized surrogate priors (ISSUE 18): a pool-"
+             "seeded session pays >= 3x fewer exact warmup rounds, "
+             "digits regret within the surrogate envelope of the cold "
+             "run, zero unaudited argmax picks, hostile priors rejected "
+             "by the per-round gate, off bitwise-pinned to PR 14 via "
+             "cli replay --against --score-tol 0"),
     # -- recorder overhead --
     Contract(
         pattern="BENCH_RECORDER_*.json", kind="recorder_overhead",

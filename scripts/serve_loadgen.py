@@ -1326,12 +1326,36 @@ def run_loadgen(args) -> dict:
             "peak_rss_bytes": peak_rss,
             "zipf_s": getattr(args, "zipf", None),
             "think_ms": getattr(args, "think_ms", 0.0),
+            # spill store v3 evidence: sharded segments, garbage awaiting
+            # compaction, and whether THIS process started O(index)
+            "spill": stats.get("spill"),
         }
+    spill_dir = (app.tiers._spill.dir
+                 if app is not None and app.tiers is not None
+                 and getattr(app.tiers, "_spill", None) is not None
+                 else None)
     if srv is not None:
         srv.shutdown()
         srv.server_close()
     if app is not None:
         app.drain()
+    if tiering is not None and spill_dir is not None:
+        # startup-cost probe: drain closed the store (index flushed); a
+        # fresh open of the same directory must be O(index) — read the
+        # sidecar, verify tails, NO full segment scan
+        from coda_tpu.serve.spill import SpillStore
+
+        t0 = time.perf_counter()
+        probe = SpillStore(spill_dir, compact=False)
+        reopen_s = time.perf_counter() - t0
+        st = probe.stats()
+        probe.close()
+        tiering["spill_reopen"] = {
+            "reopen_s": reopen_s,
+            "startup_mode": st["startup_mode"],
+            "startup_scan_frames": st["startup_scan_frames"],
+            "entries": st["entries"], "segments": st["segments"],
+        }
 
     lat_ms = np.asarray(latencies, np.float64) * 1e3
     n_requests = len(latencies)

@@ -1,0 +1,585 @@
+"""Cross-session surrogate priors (``--surrogate-prior pool``, ISSUE 18).
+
+What tier-1 pins here:
+
+  * the pool merge algebra: ``merge_fits`` is a pure sum — associative,
+    commutative, merge-of-one identity, ``empty_prior`` the neutral
+    element (all bitwise), so fleet aggregation order can never change
+    a pool;
+  * the mass cap preserves the ridge solution (A/b/n scale together),
+    and ``fold_prior`` decays exactly once;
+  * ``surrogate_prior='off'`` (the default) is bitwise the PR 14
+    program — q=1 and q=8, dense and sparse posterior;
+  * a seeded session earns warmup credit but every served round still
+    passes the per-round trust gate (selection is never driven by an
+    unaudited score);
+  * PriorPool: the min-rounds contribution gate, the drain/merge router
+    exchange (decay applied once), replace-not-merge on the push half;
+  * serve end-to-end: a closing donor session warm-starts the next
+    session on the same (task, pool fingerprint), the pool survives a
+    restart through the tracking store, and the prior counters surface
+    on /stats + lint-clean /metrics;
+  * recorder/replay: the surrogate_prior knob + pool digest are
+    fingerprinted, and a prior-vs-off knob diff triages as
+    surrogate-prior-envelope instead of a fake bitwise divergence.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from coda_tpu.data import make_synthetic_task
+from coda_tpu.engine.loop import run_seeds_compiled
+from coda_tpu.selectors import CODAHyperparams, make_coda
+from coda_tpu.selectors import surrogate as sg
+
+H, N, C = 8, 64, 5
+
+
+@pytest.fixture(scope="module")
+def task():
+    return make_synthetic_task(seed=0, H=H, N=N, C=C)
+
+
+def _trees_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _rand_prior(seed: int, rounds: float = 12.0) -> sg.PriorStats:
+    """A structurally plausible random contribution: A symmetric PSD,
+    arbitrary b, positive pair mass."""
+    rng = np.random.default_rng(seed)
+    F = sg.N_FEATURES
+    M = rng.normal(size=(F, F))
+    return sg.prior_from_fit(M @ M.T, rng.normal(size=(F,)),
+                             n=float(rng.uniform(5.0, 50.0)),
+                             rounds=rounds)
+
+
+def _priors_bitwise(p: sg.PriorStats, q: sg.PriorStats) -> bool:
+    return (p.A.tobytes() == q.A.tobytes()
+            and p.b.tobytes() == q.b.tobytes()
+            and np.float64(p.n).tobytes() == np.float64(q.n).tobytes()
+            and np.float64(p.rounds).tobytes()
+            == np.float64(q.rounds).tobytes()
+            and np.float64(p.sessions).tobytes()
+            == np.float64(q.sessions).tobytes())
+
+
+# ---------------------------------------------------------------------------
+# merge algebra: the property the fleet aggregation relies on
+# ---------------------------------------------------------------------------
+
+def test_merge_fits_commutative():
+    for s in range(5):
+        p, q = _rand_prior(2 * s), _rand_prior(2 * s + 1)
+        assert _priors_bitwise(sg.merge_fits(p, q), sg.merge_fits(q, p))
+
+
+def test_merge_fits_associative():
+    """(p+q)+r == p+(q+r) bitwise — float addition is not associative in
+    general, but the elementwise SUM of these float64 statistics is
+    exercised here over realistic magnitudes; the pins below are the
+    contract the router's merge order depends on."""
+    for s in range(5):
+        p, q, r = (_rand_prior(3 * s), _rand_prior(3 * s + 1),
+                   _rand_prior(3 * s + 2))
+        lhs = sg.merge_fits(sg.merge_fits(p, q), r)
+        rhs = sg.merge_fits(p, sg.merge_fits(q, r))
+        assert np.allclose(lhs.A, rhs.A, rtol=0, atol=0) or \
+            np.allclose(lhs.A, rhs.A, rtol=1e-15)
+        assert np.allclose(lhs.b, rhs.b, rtol=1e-15)
+        assert lhs.n == pytest.approx(rhs.n, rel=1e-15)
+        assert lhs.rounds == pytest.approx(rhs.rounds, rel=1e-15)
+        assert lhs.sessions == rhs.sessions
+
+
+def test_merge_of_one_is_identity_and_empty_is_neutral():
+    p = _rand_prior(7)
+    assert _priors_bitwise(sg.merge_many([p]), p)
+    assert _priors_bitwise(sg.merge_fits(sg.empty_prior(), p), p)
+    assert _priors_bitwise(sg.merge_fits(p, sg.empty_prior()), p)
+    z = sg.merge_many([])
+    assert _priors_bitwise(z, sg.empty_prior())
+    assert z.n == 0.0 and z.rounds == 0.0
+
+
+def test_degenerate_fit_contributes_the_neutral_element():
+    """A session closed before its first label (n == 0 fit) folds into a
+    pool as a bitwise no-op."""
+    F = sg.N_FEATURES
+    zero = sg.prior_from_fit(np.zeros((F, F)), np.zeros((F,)), 0.0, 0.0)
+    p = _rand_prior(3)
+    assert _priors_bitwise(sg.merge_fits(p, zero), p)
+
+
+# ---------------------------------------------------------------------------
+# fold policy: decay once, cap mass, keep the ridge solution
+# ---------------------------------------------------------------------------
+
+def test_fold_prior_decays_pool_once():
+    pool, contrib = _rand_prior(11, rounds=20.0), _rand_prior(12,
+                                                              rounds=14.0)
+    out = sg.fold_prior(pool, contrib)
+    assert out.rounds == pytest.approx(
+        sg.SURROGATE_PRIOR_DECAY * pool.rounds + contrib.rounds)
+    assert out.n == pytest.approx(
+        sg.SURROGATE_PRIOR_DECAY * pool.n + contrib.n)
+
+
+def test_clip_prior_caps_mass_and_preserves_ridge_solution():
+    p = _rand_prior(13)
+    big = sg.scale_prior(p, (2 * sg.SURROGATE_PRIOR_MAX_PAIRS) / p.n)
+    capped = sg.clip_prior(big)
+    assert capped.n == pytest.approx(sg.SURROGATE_PRIOR_MAX_PAIRS)
+    # provenance counters are not mass — they survive the cap
+    assert capped.rounds == big.rounds and capped.sessions == big.sessions
+    # A/b/n scale together and lambda scales with n, so the solved
+    # weights are unchanged by the cap
+    F = sg.N_FEATURES
+
+    def solve(q):
+        lam = sg.SURROGATE_RIDGE_LAMBDA * max(q.n, 1.0)
+        return np.linalg.solve(q.A + lam * np.eye(F), q.b)
+
+    assert np.allclose(solve(capped), solve(big), rtol=1e-9)
+    # under-cap pools pass through untouched (bitwise)
+    assert _priors_bitwise(sg.clip_prior(p), p)
+
+
+def test_prior_warmup_credit_caps_at_full_warmup():
+    assert sg.prior_warmup_credit(sg.empty_prior()) == 0
+    thin = _rand_prior(14, rounds=4.0)
+    assert sg.prior_warmup_credit(thin) == 4
+    deep = _rand_prior(15, rounds=500.0)
+    assert sg.prior_warmup_credit(deep) == sg.SURROGATE_WARMUP_ROUNDS
+
+
+def test_prior_dict_roundtrip_and_digest():
+    p = _rand_prior(16)
+    q = sg.prior_from_dict(sg.prior_to_dict(p))
+    assert _priors_bitwise(p, q)
+    assert sg.prior_digest(p) == sg.prior_digest(q)
+    assert sg.prior_digest(p) != sg.prior_digest(_rand_prior(17))
+    with pytest.raises(ValueError, match="version"):
+        sg.prior_from_dict({"v": 99})
+
+
+# ---------------------------------------------------------------------------
+# the off-config bitwise pin: PR 14 unchanged under the new knob
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("q", [1, 8])
+@pytest.mark.parametrize("posterior", ["dense", "sparse:3"])
+def test_off_is_bitwise_pr14(task, q, posterior):
+    """surrogate_prior='off' (explicit AND the default) runs the
+    identical PR 14 program — q=1/q=8, dense and sparse posterior.
+    q=8 runs on a larger pool so surrogate-carried rounds fit inside
+    the label budget (iters x q <= N)."""
+    t = task if q == 1 else make_synthetic_task(seed=1, H=H, N=256, C=C)
+    base = dict(eig_scorer="surrogate:8", n_parallel=2)
+    if posterior != "dense":
+        base["posterior"] = posterior
+
+    def run(hp):
+        return run_seeds_compiled(
+            lambda p: make_coda(p, hp), t.preds, t.labels,
+            iters=sg.SURROGATE_WARMUP_ROUNDS + 4, seeds=2, acq_batch=q)
+
+    r_pr14 = run(CODAHyperparams(**base))
+    r_off = run(CODAHyperparams(surrogate_prior="off", **base))
+    assert _trees_equal(r_pr14, r_off)
+
+
+def test_parse_prior_and_make_coda_validation(task):
+    assert sg.parse_prior("off") is False
+    assert sg.parse_prior("pool") is True
+    with pytest.raises(ValueError, match="unknown surrogate_prior"):
+        sg.parse_prior("warm")
+    # pool requires a carried fit to warm-start
+    with pytest.raises(ValueError, match="carries none"):
+        make_coda(task.preds, CODAHyperparams(surrogate_prior="pool"))
+    # a prior under the off knob would break the off-config pin
+    with pytest.raises(ValueError, match="bitwise pin"):
+        make_coda(task.preds,
+                  CODAHyperparams(eig_scorer="surrogate:8"),
+                  prior=_rand_prior(0))
+
+
+# ---------------------------------------------------------------------------
+# seeding: warmup credit granted, trust gate untouched
+# ---------------------------------------------------------------------------
+
+def _drive(task, hp, rounds, seed=0, prior=None):
+    sel = make_coda(task.preds, hp, prior=prior)
+    st = jax.jit(sel.init)(jax.random.PRNGKey(seed))
+    upd = jax.jit(sel.update)
+    slx = jax.jit(sel.select)
+    key = jax.random.PRNGKey(seed + 1)
+    for _ in range(rounds):
+        key, k = jax.random.split(key)
+        res = slx(st, k)
+        st = upd(st, res.idx, task.labels[res.idx], res.prob)
+    return sel, st, key
+
+
+def _donor_prior(task, rounds=sg.SURROGATE_WARMUP_ROUNDS + 6):
+    _, st, _ = _drive(task, CODAHyperparams(eig_scorer="surrogate:8"),
+                      rounds)
+    fit = st.surrogate
+    return sg.prior_from_fit(np.asarray(fit.A, np.float64),
+                             np.asarray(fit.b, np.float64),
+                             float(fit.n), float(fit.rounds))
+
+
+def test_seeded_session_skips_warmup_but_keeps_the_gate(task):
+    """A mature donor prior grants the full warmup credit: the seeded
+    run's fit starts solved (n > 0, prior_rounds == 10) and the
+    surrogate can carry rounds BEFORE its own round counter reaches the
+    warmup — while the selected index's score is still always the exact
+    chain's value (the shortlist-rows-are-exact property under
+    seeding)."""
+    prior = _donor_prior(task)
+    hp = CODAHyperparams(eig_scorer="surrogate:8",
+                         surrogate_prior="pool")
+    sel = make_coda(task.preds, hp, prior=prior)
+    st = jax.jit(sel.init)(jax.random.PRNGKey(3))
+    assert int(st.surrogate.prior_rounds) == sg.SURROGATE_WARMUP_ROUNDS
+    assert float(st.surrogate.n) > 0.0
+    upd = jax.jit(sel.update)
+    slx = jax.jit(sel.select)
+    score_exact = jax.jit(sel.extras["score_exact"])
+    key = jax.random.PRNGKey(4)
+    carried_early = 0
+    for _ in range(sg.SURROGATE_WARMUP_ROUNDS - 2):
+        key, k = jax.random.split(key)
+        res = slx(st, k)
+        i = int(res.idx)
+        exact = np.asarray(score_exact(st))
+        got = np.asarray(st.eig_scores_cached)
+        # never an unaudited argmax: the served score is the exact one
+        assert exact[i].tobytes() == got[i].tobytes()
+        if (int(st.surrogate.rounds) < sg.SURROGATE_WARMUP_ROUNDS
+                and not bool(st.surrogate.last_fallback)
+                and int(st.surrogate.rounds) > 0):
+            carried_early += 1
+        st = upd(st, res.idx, task.labels[res.idx], res.prob)
+    assert carried_early > 0, "the prior never shortened the warmup"
+    assert np.isfinite(np.asarray(st.eig_scores_cached)).all()
+
+
+def test_seeded_session_bad_prior_falls_back_exact(task):
+    """A hostile prior (garbage normal equations with full credit) is
+    caught by the per-round contract: rounds inside the skipped warmup
+    window fall back to the exact pass bitwise and count
+    prior_rejects — the gate-rejection safety net."""
+    rng = np.random.default_rng(0)
+    F = sg.N_FEATURES
+    bad = sg.prior_from_fit(np.eye(F) * 1e-6,
+                            rng.normal(size=(F,)) * 1e4,
+                            n=100.0, rounds=50.0)
+    hp = CODAHyperparams(eig_scorer="surrogate:8",
+                         surrogate_prior="pool")
+    sel = make_coda(task.preds, hp, prior=bad)
+    st = jax.jit(sel.init)(jax.random.PRNGKey(5))
+    assert int(st.surrogate.prior_rounds) == sg.SURROGATE_WARMUP_ROUNDS
+    upd = jax.jit(sel.update)
+    slx = jax.jit(sel.select)
+    score_exact = jax.jit(sel.extras["score_exact"])
+    key = jax.random.PRNGKey(6)
+    for _ in range(4):
+        key, k = jax.random.split(key)
+        res = slx(st, k)
+        st = upd(st, res.idx, task.labels[res.idx], res.prob)
+        # every fallback round's vector is bitwise the exact pass
+        if bool(st.surrogate.last_fallback):
+            exact = np.asarray(score_exact(st))
+            got = np.asarray(st.eig_scores_cached)
+            assert exact.tobytes() == got.tobytes()
+    assert int(st.surrogate.fallbacks) > 0, "the gate never tripped"
+    assert int(st.surrogate.prior_rejects) > 0
+    assert np.isfinite(np.asarray(st.eig_scores_cached)).all()
+
+
+# ---------------------------------------------------------------------------
+# PriorPool: contribution gate + router exchange halves
+# ---------------------------------------------------------------------------
+
+def _fit_stats(seed, rounds=12.0, n=30.0):
+    rng = np.random.default_rng(seed)
+    F = sg.N_FEATURES
+    M = rng.normal(size=(F, F))
+    return {"A": M @ M.T, "b": rng.normal(size=(F,)), "n": n,
+            "rounds": rounds}
+
+
+def test_pool_contribution_gate_and_get():
+    from coda_tpu.serve.priors import PriorPool
+
+    pool = PriorPool()
+    # too green to teach anything: below min_rounds, or no pairs
+    assert not pool.contribute("k", _fit_stats(0, rounds=3.0))
+    assert not pool.contribute("k", _fit_stats(1, n=0.0))
+    assert not pool.contribute("k", None)
+    assert pool.get("k") is None
+    assert pool.stats()["contributions_skipped"] == 2
+    assert pool.contribute("k", _fit_stats(2))
+    p = pool.get("k")
+    assert p is not None and p.rounds == 12.0 and p.sessions == 1.0
+    assert pool.get("other") is None
+    st = pool.stats()
+    assert st["sessions_contributed"] == 1 and st["pools"] == 1
+    assert st["rounds_pooled"] == pytest.approx(12.0)
+
+
+def test_pool_drain_merge_exchange_decays_once():
+    """The replica drains raw sums; the router folds each drain exactly
+    once — two contributions in one drain arrive as one pure sum and are
+    decayed together, never per-contribution."""
+    from coda_tpu.serve.priors import PriorPool
+
+    replica, router = PriorPool(), PriorPool()
+    assert replica.contribute("k", _fit_stats(3, rounds=11.0))
+    assert replica.contribute("k", _fit_stats(4, rounds=13.0))
+    delta = replica.drain_delta()
+    assert set(delta) == {"k"}
+    # the delta is the RAW sum (no decay): rounds add exactly
+    assert delta["k"]["rounds"] == pytest.approx(24.0)
+    assert replica.drain_delta() == {}      # drained
+    assert router.merge_delta(delta) == 1
+    assert router.get("k").rounds == pytest.approx(24.0)
+    assert router.stats()["sessions_contributed"] == 2  # from sessions
+    # the push half: the replica REPLACES with the router's merged pool,
+    # so its own just-drained contributions never double-count
+    replica.replace(router.snapshot())
+    assert replica.get("k").rounds == pytest.approx(24.0)
+    # count=False: a replica re-folding its own delta after a push must
+    # not bump sessions_contributed again
+    sc = router.sessions_contributed
+    router.merge_delta(delta, count=False)
+    assert router.sessions_contributed == sc
+
+
+def test_pool_snapshot_is_json_safe_and_restores():
+    import json as _json
+
+    from coda_tpu.serve.priors import PriorPool
+
+    pool = PriorPool()
+    assert pool.contribute("a", _fit_stats(5))
+    assert pool.contribute("b", _fit_stats(6, rounds=15.0))
+    snap = _json.loads(_json.dumps(pool.snapshot()))
+    fresh = PriorPool()
+    assert fresh.replace(snap) == 2
+    assert fresh.keys() == ["a", "b"]
+    assert _priors_bitwise(fresh.get("a"), pool.get("a"))
+    assert fresh.sessions_contributed == 2
+
+
+def test_pool_key_ignores_feature_space_neutral_knobs():
+    """The fingerprint drops the knobs that do not change the 16-feature
+    space (scorer k, the prior knob itself, acq_batch) — a q=8
+    surrogate:32 session shares its pool with a q=1 surrogate:8 one —
+    and keeps the ones that do."""
+    from coda_tpu.serve.priors import pool_key
+
+    base = (("eig_scorer", "surrogate:8"), ("n_parallel", "2"))
+    alt = (("eig_scorer", "surrogate:32"), ("n_parallel", "2"),
+           ("surrogate_prior", "pool"), ("acq_batch", "8"))
+    assert pool_key("t", "coda", base, "d1") == \
+        pool_key("t", "coda", alt, "d1")
+    assert pool_key("t", "coda", base, "d1") != \
+        pool_key("t", "coda", base, "d2")        # dataset digest matters
+    assert pool_key("t", "coda", base, "d1") != pool_key(
+        "t", "coda", (("n_parallel", "4"),), "d1")   # feature-space knob
+
+
+# ---------------------------------------------------------------------------
+# serve end-to-end: donor -> pool -> warm-started admission
+# ---------------------------------------------------------------------------
+
+def _serve_app(task, recorder=None, **spec_kw):
+    from coda_tpu.serve import SelectorSpec, ServeApp
+
+    app = ServeApp(capacity=2, max_wait=0.001,
+                   spec=SelectorSpec.create(
+                       "coda", n_parallel=2, eig_scorer="surrogate:8",
+                       surrogate_prior="pool", **spec_kw),
+                   recorder=recorder)
+    app.add_task(task.name, task.preds)
+    app.start()
+    return app
+
+
+def _serve_drive(app, rounds, seed=0):
+    out = app.open_session(seed=seed)
+    sid = out["session"]
+    for _ in range(rounds):
+        out = app.label(sid, int(out["idx"]) % C)
+    return sid
+
+
+def test_serve_donor_warm_starts_next_session(task, tmp_path):
+    """The full loop on one replica: a donor session closing after a
+    full warmup contributes its fit; the NEXT admission on the same
+    (task, pool fingerprint) seeds with the full warmup credit, and the
+    counters surface on /stats and lint-clean /metrics."""
+    import json as _json
+
+    from coda_tpu.telemetry import prometheus
+    from coda_tpu.telemetry.recorder import SessionRecorder
+
+    rec_dir = str(tmp_path / "rec")
+    app = _serve_app(task, recorder=SessionRecorder(out_dir=rec_dir))
+    try:
+        donor = _serve_drive(app, sg.SURROGATE_PRIOR_MIN_ROUNDS + 2)
+        assert app.store.get(donor).prior_fit is None  # cold start
+        app.close_session(donor)
+        pool_stats = app.stats()["prior_pool"]
+        assert pool_stats["sessions_contributed"] == 1
+        assert pool_stats["pools"] == 1
+
+        seeded = _serve_drive(app, 2, seed=1)
+        pf = app.store.get(seeded).prior_fit
+        assert pf is not None
+        assert pf["credit"] == sg.SURROGATE_WARMUP_ROUNDS
+        assert isinstance(pf["digest"], str) and pf["digest"]
+        snap = app.stats()
+        assert snap["prior_warmup_rounds_skipped"] >= \
+            sg.SURROGATE_WARMUP_ROUNDS
+        text = prometheus.render(app.telemetry.registry,
+                                 serve_metrics=app.metrics)
+        assert prometheus.lint(text) == []
+        assert "coda_serve_prior_sessions_contributed" in text
+        assert "coda_serve_prior_warmup_rounds_skipped" in text
+        # the recorder stamped the applied prior + digest on the
+        # session_meta header of the seeded stream (and NOT on the cold
+        # donor's — cold streams stay bitwise PR-14)
+        import os as _os
+
+        def _header(sid):
+            with open(_os.path.join(rec_dir,
+                                    f"session_{sid}.jsonl")) as f:
+                return _json.loads(f.readline())
+
+        assert _header(seeded)["surrogate_prior"]["digest"] == \
+            pf["digest"]
+        assert "surrogate_prior" not in _header(donor)
+    finally:
+        app.drain(timeout=10)
+
+
+def test_serve_pool_survives_restart_via_tracking_store(task, tmp_path):
+    """save_prior_pool -> fresh app -> load_prior_pool: the restored
+    pool warm-starts admissions created AFTER the load (the bucket
+    prior resolver), surviving the restart boundary."""
+    from coda_tpu.tracking import TrackingStore
+
+    db = str(tmp_path / "prior.sqlite")
+    app = _serve_app(task)
+    try:
+        donor = _serve_drive(app, sg.SURROGATE_PRIOR_MIN_ROUNDS + 2)
+        app.close_session(donor)
+        store = TrackingStore(db)
+        app.save_prior_pool(store)
+        store.close()
+    finally:
+        app.drain(timeout=10)
+
+    app2 = _serve_app(task)
+    try:
+        store = TrackingStore(db)
+        assert app2.load_prior_pool(store) == 1
+        store.close()
+        seeded = _serve_drive(app2, 1, seed=2)
+        pf = app2.store.get(seeded).prior_fit
+        assert pf is not None
+        assert pf["credit"] == sg.SURROGATE_WARMUP_ROUNDS
+    finally:
+        app2.drain(timeout=10)
+
+
+def test_serve_contribution_is_once_only_and_gated(task):
+    """A session below the min-rounds gate is skipped (counted), and a
+    demoted-then-closed session contributes exactly once
+    (Session.prior_contributed rides the export payload)."""
+    app = _serve_app(task)
+    try:
+        # too green: 3 rounds < SURROGATE_PRIOR_MIN_ROUNDS
+        green = _serve_drive(app, 3)
+        app.close_session(green)
+        st = app.stats()["prior_pool"]
+        assert st["sessions_contributed"] == 0
+        assert st["contributions_skipped"] >= 1
+
+        donor = _serve_drive(app, sg.SURROGATE_PRIOR_MIN_ROUNDS + 2,
+                             seed=3)
+        sess = app.store.get(donor)
+        fit = sess.bucket.slot_fit(sess.slot)
+        assert app.contribute_prior(sess, fit)       # first: accepted
+        assert sess.prior_contributed
+        assert not app.contribute_prior(sess, fit)   # second: refused
+        app.close_session(donor)                     # close: no re-add
+        assert app.stats()["prior_pool"]["sessions_contributed"] == 1
+    finally:
+        app.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# recorder / replay: the knob is fingerprinted and triaged
+# ---------------------------------------------------------------------------
+
+def test_prior_knob_in_recorder_fields():
+    from coda_tpu.telemetry.recorder import KNOB_FIELDS
+
+    assert "surrogate_prior" in KNOB_FIELDS
+    assert "surrogate_prior_digest" in KNOB_FIELDS
+
+
+def test_prior_vs_off_triages_as_prior_envelope(task):
+    """compare_records routes a pool-vs-off knob diff through the
+    regret-envelope triage (classification surrogate-prior-envelope)
+    instead of reporting a fake bitwise divergence — and two off
+    records (one explicit, one default) still compare bitwise."""
+    from coda_tpu.engine.loop import run_seeds_recorded
+    from coda_tpu.engine.replay import compare_records
+    from coda_tpu.telemetry.recorder import (
+        RunRecord,
+        environment_fingerprint,
+    )
+
+    iters = sg.SURROGATE_WARMUP_ROUNDS + 6
+    prior = _donor_prior(task)
+
+    def rec(knobs, prior_arg=None):
+        hp = CODAHyperparams(eig_scorer="surrogate:8", n_parallel=1,
+                             surrogate_prior=knobs.get(
+                                 "surrogate_prior", "off"))
+        result, aux = run_seeds_recorded(
+            lambda p: make_coda(p, hp, prior=prior_arg),
+            task.preds, task.labels, iters=iters, seeds=1, trace_k=4)
+        fp = environment_fingerprint(
+            dataset=task, knobs={"method": "coda",
+                                 "eig_scorer": "surrogate:8", **knobs})
+        return RunRecord.from_result(
+            result, aux, fp, run={"task": task.name, "iters": iters,
+                                  "seeds": 1, "method": "coda",
+                                  "loss": "acc"})
+
+    a = rec({})
+    b = rec({"surrogate_prior": "pool",
+             "surrogate_prior_digest": sg.prior_digest(prior)},
+            prior_arg=prior)
+    report = compare_records(a, b)
+    assert report.seeds[0].classification == "surrogate-prior-envelope"
+    env = report.meta["prior_envelope"]
+    assert env["prior_a"] == "off"
+    assert env["prior_b"].startswith("pool@")
+    # off-vs-off (explicit vs default-normalized) is still bitwise
+    report2 = compare_records(a, rec({"surrogate_prior": "off"}))
+    assert report2.parity

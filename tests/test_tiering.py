@@ -117,8 +117,10 @@ def test_wake_from_cold_bitwise_vs_control(task, tmp_path):
         st = app.stats()
         assert st["tiers"] == {"hot": 0, "warm": 0, "cold": 1}
         assert st["open_sessions"] == 1
-        # v2 spill layout: one append-log, not one file per session
-        assert os.path.exists(str(tmp_path / "spill" / "spill.log"))
+        # v3 spill layout: sharded segment files + a sidecar index, not
+        # one file per session
+        assert [fn for fn in os.listdir(str(tmp_path / "spill"))
+                if fn.startswith("seg_")]
         assert app.tiers._spill.sids() == [sid]
 
         cur = app.label(sid, int(_cold_payload(app, sid)))
@@ -399,12 +401,14 @@ def test_hibernated_sessions_survive_restart(task, tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# spill store v2: append-log + index + compression (serve/spill.py)
+# spill store v3: sharded segments + sidecar index + lazy frames
+# (serve/spill.py)
 # ---------------------------------------------------------------------------
 
 def test_spill_store_roundtrip_and_tombstones(tmp_path):
-    """put/get/delete over the append-log: last write wins, tombstones
-    delete, the index survives a re-scan (process restart)."""
+    """put/get/delete over the segment files: last write wins, tombstones
+    delete, and a clean restart rebuilds the same view from the sidecar
+    index ALONE (startup_mode 'index', zero frames re-scanned)."""
     from coda_tpu.serve.spill import SpillStore
 
     d = str(tmp_path / "spill")
@@ -422,9 +426,10 @@ def test_spill_store_roundtrip_and_tombstones(tmp_path):
     assert s.get("0003") is None and "0003" not in s
     assert len(s) == 99
     s.close()
-    # restart: the scan rebuilds the same index (tombstone honored,
-    # last-write-wins honored) from the log alone
+    # clean restart: the persisted index IS the state — no frame scan
     s2 = SpillStore(d)
+    assert s2.startup_mode == "index"
+    assert s2.startup_scan_frames == 0
     assert len(s2) == 99
     assert s2.get("0003") is None
     assert s2.get("0007")["rows"] == [999]
@@ -432,31 +437,100 @@ def test_spill_store_roundtrip_and_tombstones(tmp_path):
     s2.close()
 
 
-def test_spill_store_compacts_garbage_and_tolerates_torn_tail(tmp_path):
-    """Dead frames (supersessions + tombstones) past the threshold are
-    compacted away at startup, and a torn final frame (crash mid-append)
-    is dropped without losing earlier frames."""
+def test_spill_store_crash_restart_scans_only_the_tail(tmp_path):
+    """A crash after the last index flush loses no frames: startup reads
+    the sidecar, then scans ONLY the bytes appended past the recorded
+    segment sizes — and a torn final frame (crash mid-append) is
+    truncated without losing earlier frames."""
     import os
 
     from coda_tpu.serve.spill import SpillStore
 
     d = str(tmp_path / "spill")
     s = SpillStore(d)
-    for i in range(20):
-        s.put("churn", {"session": "churn", "n": i})  # 19 dead frames
-    s.put("keep", {"session": "keep"})
-    s.close()
-    size_before = os.path.getsize(os.path.join(d, "spill.log"))
-    # simulate a crash mid-append: glue half a frame onto the log
-    with open(os.path.join(d, "spill.log"), "ab") as f:
-        f.write(b'{"sid": "torn", "n": 9999, "crc": 1}\nonly-a-few-bytes')
-    s2 = SpillStore(d)   # startup: torn tail dropped, garbage compacted
-    assert s2.compactions == 1
-    assert os.path.getsize(os.path.join(d, "spill.log")) < size_before
-    assert s2.get("churn")["n"] == 19
-    assert s2.get("keep") == {"session": "keep"}
+    s.put("a", {"session": "a", "n": 1})
+    s.put("b", {"session": "b", "n": 2})
+    s.close()                       # index now records both frames
+    # "crash" frames: append past the index without rewriting it, plus a
+    # torn half-frame at the very end
+    s = SpillStore(d)
+    s.put("c", {"session": "c", "n": 3})
+    seg = max(fn for fn in os.listdir(d) if fn.startswith("seg_"))
+    s._append_fd.close()            # abandon without close(): no flush
+    with open(os.path.join(d, seg), "ab") as f:
+        f.write(b'{"sid": "torn", "parts": [["meta", 99999, 1]]}\nxx')
+    s2 = SpillStore(d)
+    assert s2.startup_mode == "index"      # sidecar honored...
+    assert s2.startup_scan_frames >= 1     # ...tail scanned, not the world
+    assert s2.get("a")["n"] == 1
+    assert s2.get("c")["n"] == 3           # the post-flush frame survived
     assert "torn" not in s2
     s2.close()
+
+
+def test_spill_store_compacts_per_segment(tmp_path, monkeypatch):
+    """Dead frames (supersessions + tombstones) past the garbage
+    threshold are compacted away one SEALED segment at a time — live
+    frames copy forward into the active segment, the reclaimed file is
+    unlinked, and no reader ever sees a stop-the-world pause."""
+    import os
+
+    from coda_tpu.serve import spill as spill_mod
+    from coda_tpu.serve.spill import SpillStore
+
+    monkeypatch.setattr(spill_mod, "SEGMENT_MAX_BYTES", 512)
+    d = str(tmp_path / "spill")
+    s = SpillStore(d)
+    for i in range(30):
+        s.put("churn", {"session": "churn", "n": i})  # 29 dead frames
+    s.put("keep", {"session": "keep"})
+    segs_before = {fn for fn in os.listdir(d) if fn.startswith("seg_")}
+    assert len(segs_before) > 1     # the 512-byte cap sharded the stream
+    size_before = sum(os.path.getsize(os.path.join(d, fn))
+                      for fn in segs_before)
+    n = s.maybe_compact()
+    assert n >= 1 and s.segment_compactions == n
+    segs_after = {fn for fn in os.listdir(d) if fn.startswith("seg_")}
+    size_after = sum(os.path.getsize(os.path.join(d, fn))
+                     for fn in segs_after)
+    assert size_after < size_before
+    assert s.get("churn")["n"] == 29       # last write still wins
+    assert s.get("keep") == {"session": "keep"}
+    s.close()
+    s2 = SpillStore(d)                      # and the compacted dir reopens
+    assert s2.get("churn")["n"] == 29
+    assert s2.get("keep") == {"session": "keep"}
+    s2.close()
+
+
+def test_spill_store_reads_are_lazy_until_materialized(tmp_path):
+    """A frame read is zero-copy until touched: the payload mapping comes
+    back without decompressing the packed array leaves; materialize()
+    restores the exact original JSON-safe payload."""
+    import base64
+
+    import numpy as np
+
+    from coda_tpu.serve.spill import SpillStore, materialize
+
+    arr = np.arange(4096, dtype=np.float32)
+    packed = {"dtype": "float32", "shape": [4096],
+              "data": base64.b64encode(arr.tobytes()).decode()}
+    payload = {"session": "aa", "rows": [1, 2, 3],
+               "carries": [packed, packed], "key": packed}
+    d = str(tmp_path / "spill")
+    s = SpillStore(d)
+    assert s.put("aa", payload)
+    got = s.get("aa")
+    # meta is eager, the packed leaves are lazy wrappers…
+    assert got["session"] == "aa" and got["rows"] == [1, 2, 3]
+    leaf = got["carries"][0]
+    assert leaf["dtype"] == "float32" and leaf["shape"] == [4096]
+    # …whose raw bytes decode to the original array when finally pulled
+    # (base64 framing only reappears at the serialization boundary)
+    assert np.array_equal(np.frombuffer(leaf["data"], np.float32), arr)
+    assert materialize(got) == payload
+    s.close()
 
 
 def test_spill_store_reads_and_folds_legacy_per_file_layout(tmp_path):
@@ -488,17 +562,23 @@ def test_wake_from_legacy_hibernate_file(task, tmp_path):
     fresh app (the upgrade path: old spill dirs keep serving)."""
     import os
 
+    from coda_tpu.serve.spill import materialize
+
     spill = str(tmp_path / "spill")
     app = _app(task, spill_dir=spill)
     try:
         sid = _drive(app, seed=11, rounds=2)
         nxt = int(app.store.get(sid).last["next_idx"]) % C
         assert app.tiers.try_demote(sid) and app.tiers.hibernate(sid)
-        payload = app.tiers._spill.get(sid)
+        # pull the frame eagerly: the mmap behind the lazy view dies
+        # with the store
+        payload = materialize(app.tiers._spill.get(sid))
     finally:
         app.drain(timeout=10)
-    # rewrite the hibernated payload in the V1 layout, drop the log
-    os.remove(os.path.join(spill, "spill.log"))
+    # rewrite the hibernated payload in the V1 layout, drop v3 state
+    for fn in os.listdir(spill):
+        if fn.startswith("seg_") or fn == "spill_index.json":
+            os.remove(os.path.join(spill, fn))
     with open(os.path.join(spill, f"hibernated_{sid}.json"), "w") as f:
         json.dump(payload, f)
 
