@@ -408,6 +408,52 @@ def _log_debug_viz(run, selector, result, seed: int, iters: int) -> None:
     )
 
 
+def trace_main(argv=None):
+    """``cli trace <trace_id> --url http://host:port --out trace.json``.
+
+    Hits the serve front door's ``GET /trace/id/{trace_id}``. Against a
+    router that is the cross-process stitched Chrome file (one Perfetto
+    process lane per replica); against a single replica it is that
+    replica's own spans for the trace, wrapped for the same viewer."""
+    import json as _json
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="coda_tpu.cli trace",
+        description="fetch one distributed trace as Chrome/Perfetto JSON")
+    p.add_argument("trace_id", help="32-hex trace id (from an exemplar, a "
+                   "recorder row, or loadgen --trace-sample output)")
+    p.add_argument("--url", default="http://127.0.0.1:8000",
+                   help="serve front door (router or replica) base URL")
+    p.add_argument("--out", default="trace.json",
+                   help="output path for the Chrome trace_event JSON")
+    args = p.parse_args(argv)
+
+    url = args.url.rstrip("/") + f"/trace/id/{args.trace_id}"
+    with urllib.request.urlopen(url, timeout=30.0) as resp:
+        payload = _json.loads(resp.read().decode("utf-8"))
+    if "traceEvents" not in payload:
+        # a bare replica returns its trace_payload wire form; wrap it so
+        # the output is always Perfetto-loadable
+        from coda_tpu.telemetry.spans import stitch_traces
+
+        payload = stitch_traces(
+            [dict(payload, process=payload.get("process") or "replica")])
+    n = len([e for e in payload.get("traceEvents", ())
+             if e.get("ph") == "X"])
+    procs = payload.get("processes")
+    with open(args.out, "w") as f:
+        _json.dump(payload, f)
+    print(f"trace {args.trace_id}: {n} span(s)"
+          + (f" across {procs}" if procs else "")
+          + f" -> {args.out}")
+    if n == 0:
+        print("warning: no spans retained for this trace "
+              "(evicted, unsampled, or tracing disabled)")
+        return 1
+    return 0
+
+
 def main(argv=None):
     if argv is None:
         argv = sys.argv[1:]
@@ -433,6 +479,11 @@ def main(argv=None):
         from coda_tpu.serve.recovery import replay_serve_main
 
         return replay_serve_main(argv[1:])
+    if argv and argv[0] == "trace":
+        # `python -m coda_tpu.cli trace <trace_id> --url http://router`:
+        # fetch one distributed trace, stitched across every replica's
+        # process lane, and write a Perfetto-loadable trace.json
+        return trace_main(argv[1:])
     if argv and argv[0] == "suite":
         # `python -m coda_tpu.cli suite ...`: the in-process sweep driver
         # (scripts/run_suite.py) — grows --task-batch/--suite-devices/

@@ -61,6 +61,11 @@ class Ticket:
     label: int = 0
     prob: float = 0.0
     request_id: Optional[str] = None  # client idempotency token (labels)
+    # trace context (telemetry.trace.TraceContext) of the request this
+    # ticket carries, or None when untraced. Read ONLY by spans/metrics/
+    # recorder rows — never by dispatch math (the non-perturbation
+    # contract: tracing off and on take bitwise-identical trajectories).
+    trace: Optional[object] = None
     submitted: float = field(default_factory=time.perf_counter)
     collected: float = 0.0          # when the batcher picked it into a batch
     done: threading.Event = field(default_factory=threading.Event)
@@ -314,14 +319,16 @@ class Batcher:
                                            "stopped"))
         return ticket
 
-    def submit_start(self, session) -> Ticket:
-        return self.submit(Ticket(session=session, do_update=False))
+    def submit_start(self, session, trace=None) -> Ticket:
+        return self.submit(Ticket(session=session, do_update=False,
+                                  trace=trace))
 
     def submit_label(self, session, idx: int, label: int, prob: float,
-                     request_id: Optional[str] = None) -> Ticket:
+                     request_id: Optional[str] = None,
+                     trace=None) -> Ticket:
         return self.submit(Ticket(session=session, do_update=True, idx=idx,
                                   label=label, prob=prob,
-                                  request_id=request_id))
+                                  request_id=request_id, trace=trace))
 
     # -- the tick ----------------------------------------------------------
     def _collect(self) -> list:
@@ -472,9 +479,18 @@ class Batcher:
                        "label": t.label, "prob": t.prob}
                 for slot, t in slots.items()
             }
+            # OTel-style span links: one coalesced tick serves many
+            # requests, so the tick span links to every member TRACE
+            # (fan-in) instead of parenting to any single one — the span
+            # recorder files it under each linked trace's retention ring
+            links = sorted({t.trace.trace_id for t in slots.values()
+                            if t.trace is not None})
+            span_attrs = {"requests": len(slots), "depth": depth}
+            if links:
+                span_attrs["links"] = links
             span = (self.telemetry.span(
                         f"tick/{bucket.task}", lane="host:batcher",
-                        annotate=True, requests=len(slots), depth=depth)
+                        annotate=True, **span_attrs)
                     if self.telemetry is not None
                     else contextlib.nullcontext())
             t0 = time.perf_counter()
@@ -503,11 +519,13 @@ class Batcher:
                 # the tick: tick minus step is host-side build/fan-out
                 t_end = time.perf_counter()
                 s0 = t_end - timing["step_s"]
+                step_attrs = {"requests": len(slots),
+                              "source": "aot" if bucket.is_warm else "jit"}
+                if links:
+                    step_attrs["links"] = links
                 self.telemetry.spans.record(
                     f"step/{bucket.task}", lane="host:batcher",
-                    t_start=s0, t_end=t_end,
-                    attrs={"requests": len(slots),
-                           "source": "aot" if bucket.is_warm else "jit"})
+                    t_start=s0, t_end=t_end, attrs=step_attrs)
             now = time.perf_counter()
             for slot, t in slots.items():
                 r = results[slot]
@@ -535,7 +553,7 @@ class Batcher:
                     if t.session.pending.get(t.request_id) is t:
                         t.session.pending.pop(t.request_id, None)
                 if self.recorder is not None:
-                    self.recorder.append(t.session.sid, {
+                    row = {
                         "n_labeled": t.session.n_labeled,
                         "do_update": t.do_update,
                         "labeled_idx": t.idx if t.do_update else None,
@@ -548,10 +566,20 @@ class Batcher:
                         "stochastic": r["stochastic"],
                         "pbest_max": r.get("pbest_max"),
                         "pbest_entropy": r.get("pbest_entropy"),
-                    })
+                    }
+                    if t.trace is not None:
+                        # additive optional field: a decision row joins to
+                        # its serving trace; absent (not null) when
+                        # untraced, so tracing-off streams stay bitwise
+                        # identical to pre-tracing streams
+                        row["trace_id"] = t.trace.trace_id
+                    self.recorder.append(t.session.sid, row)
                 if self.metrics is not None:
-                    self.metrics.record_request_latency(now - t.submitted)
-                    self.metrics.record_queue_wait(t.collected - t.submitted)
+                    tid = t.trace.trace_id if t.trace is not None else None
+                    self.metrics.record_request_latency(
+                        now - t.submitted, trace_id=tid)
+                    self.metrics.record_queue_wait(
+                        t.collected - t.submitted, trace_id=tid)
                 t.complete(r, collector=deliveries)
             for loop, items in deliveries.items():
                 try:

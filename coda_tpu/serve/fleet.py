@@ -70,7 +70,10 @@ class Fleet:
                  peer_paging: bool = True, auto_rebalance: bool = True,
                  journal_path: Optional[str] = None,
                  fault_spec: Optional[str] = None,
-                 health_hysteresis: int = 2):
+                 health_hysteresis: int = 2,
+                 tracing: bool = True,
+                 slo_fast_s: float = 300.0, slo_slow_s: float = 3600.0,
+                 slo_store=None):
         from coda_tpu.serve.faults import FaultInjector
 
         self.app_factory = app_factory
@@ -81,7 +84,9 @@ class Fleet:
             telemetry=telemetry, auto_rebalance=auto_rebalance,
             journal_path=journal_path,
             faults=FaultInjector(fault_spec) if fault_spec else None,
-            health_hysteresis=health_hysteresis)
+            health_hysteresis=health_hysteresis,
+            tracing=tracing, slo_fast_s=slo_fast_s, slo_slow_s=slo_slow_s,
+            slo_store=slo_store)
         self.router.kill_hook = self.kill_replica
         self.peer_paging = peer_paging
         self.kills: dict[str, int] = {}
@@ -298,6 +303,16 @@ class Fleet:
                     f"migrated off ({out_report.get('errors')}); the "
                     "replica rejoined with its sessions intact")
         old = self.apps[rid]
+        # span hand-off: the rebuild below discards the old app's
+        # in-memory trace retention, so the router adopts every retained
+        # per-trace payload first — a trace that crossed this replica
+        # stays complete through the rolling restart (a CRASH-killed
+        # replica hands off nothing; that loss is honest)
+        spans = getattr(getattr(old, "telemetry", None), "spans", None)
+        if spans is not None:
+            self.router.adopt_trace_payloads(
+                [spans.trace_payload(tid, process=rid)
+                 for tid in spans.trace_ids()])
         old.drain(timeout=30.0)
         new_app = self.app_factory(rid)
         if self.peer_paging and getattr(new_app, "tiers", None) is not None:
@@ -372,5 +387,17 @@ def build_fleet(args, n_replicas: int, record_dir: Optional[str] = None,
 
     journal_path = (os.path.join(base_record, "router_migrations.log")
                     if base_record else None)
+    # SLO alert flushes happen on the router's poll thread; hand the
+    # sweeper a factory (not a live store) because TrackingStore's sqlite
+    # connection is bound to the thread that creates it
+    slo_store = None
+    tracking_db = getattr(args, "tracking_db", None)
+    if tracking_db:
+        from coda_tpu.tracking.store import TrackingStore
+        slo_store = (lambda db=tracking_db: TrackingStore(db))
     return Fleet(factory, n_replicas=n_replicas,
-                 journal_path=journal_path, fault_spec=fault_spec)
+                 journal_path=journal_path, fault_spec=fault_spec,
+                 tracing=not getattr(args, "no_trace", False),
+                 slo_fast_s=getattr(args, "slo_fast_s", 300.0),
+                 slo_slow_s=getattr(args, "slo_slow_s", 3600.0),
+                 slo_store=slo_store)

@@ -122,6 +122,23 @@ class ServeMetrics:
         # () -> dict of the v3 store's segment/index/compaction gauges,
         # surfaced under snapshot["spill"]. None when no spill dir.
         self.spill_provider = None
+        # OpenMetrics exemplars: per-ring, the most recent TRACED sample
+        # whose latency cleared the ring's p99 (gate lazily refreshed from
+        # the percentile reduction each snapshot — the record path stays a
+        # compare + tuple store, no reduction). A slow request on /metrics
+        # is then one hop from its stitched trace.
+        self._exemplars: dict[str, tuple] = {}   # ring -> (seconds, trace_id)
+        self._exemplar_gate: dict[str, float] = {}  # ring -> p99 seconds
+
+    def _note_exemplar(self, ring: str, seconds: float,
+                       trace_id) -> None:
+        """Keep (seconds, trace_id) if it clears the ring's last-known p99
+        (or no gate exists yet). Caller holds the lock."""
+        if not trace_id:
+            return
+        gate = self._exemplar_gate.get(ring)
+        if gate is None or seconds >= gate:
+            self._exemplars[ring] = (float(seconds), str(trace_id))
 
     # -- recording (request path: O(1), no reductions) ---------------------
     def record_dispatch(self, n_requests: int, queue_depth: int,
@@ -142,13 +159,16 @@ class ServeMetrics:
                 else:
                     self.warm_misses += 1
 
-    def record_request_latency(self, seconds: float) -> None:
+    def record_request_latency(self, seconds: float,
+                               trace_id=None) -> None:
         with self._lock:
             self._request_s.append(seconds)
+            self._note_exemplar("request_latency", seconds, trace_id)
 
-    def record_queue_wait(self, seconds: float) -> None:
+    def record_queue_wait(self, seconds: float, trace_id=None) -> None:
         with self._lock:
             self._queue_wait_s.append(seconds)
+            self._note_exemplar("queue_wait", seconds, trace_id)
 
     def record_warm_pool(self, size: int, seconds: float) -> None:
         """One warm-up pass finished: pool size + wall time it took."""
@@ -283,6 +303,12 @@ class ServeMetrics:
                 # ring fill: how much recent-window evidence backs the
                 # percentiles above (fill == capacity -> the ring has
                 # wrapped and older events have been evicted)
+                # traced p99 outliers per latency ring (OpenMetrics
+                # exemplar source; absent ring -> no traced outlier yet)
+                "exemplars": {
+                    ring: {"value_s": v, "trace_id": tid}
+                    for ring, (v, tid) in self._exemplars.items()
+                },
                 "ring_capacity": _RING,
                 "ring_fill": {
                     "occupancy": len(self._occupancy),
@@ -294,6 +320,12 @@ class ServeMetrics:
                     "wake_latency": len(self._wake_s),
                 },
             }
+            # refresh the exemplar gates from the reduction just paid: the
+            # NEXT traced samples are compared against the current p99
+            for ring in ("request_latency", "queue_wait"):
+                p99 = snap[ring]["p99_ms"]
+                if p99 is not None:
+                    self._exemplar_gate[ring] = p99 / 1e3
         # outside the lock: the provider takes bucket dispatch locks of
         # its own, and a lock inversion against record_dispatch (batcher
         # thread holding a bucket lock while recording) must be impossible

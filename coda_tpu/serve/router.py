@@ -47,6 +47,7 @@ paging); this module owns addressing, health, and migration mechanics.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import re
 import threading
@@ -125,32 +126,45 @@ class InprocReplica:
         self.transport = transport or ReplicaTransport(replica_id)
 
     # -- verbs (the router forwards these; exceptions flow through) --------
-    def open(self, task=None, seed=None, sid=None):
+    def open(self, task=None, seed=None, sid=None, trace=None):
         return self.transport.call(
             "open", lambda t: self.app.open_session(task=task, seed=seed,
-                                                    sid=sid))
+                                                    sid=sid,
+                                                    trace_ctx=trace))
 
-    def label(self, sid, label, idx=None, request_id=None, epoch=None):
+    def label(self, sid, label, idx=None, request_id=None, epoch=None,
+              trace=None):
         return self.transport.call(
             "label",
             lambda t: self.app.label(sid, label, idx=idx,
-                                     request_id=request_id, epoch=epoch),
+                                     request_id=request_id, epoch=epoch,
+                                     trace_ctx=trace),
             idempotent=request_id is not None)
 
-    def labels(self, sid, labels, idx=None, request_id=None, epoch=None):
+    def labels(self, sid, labels, idx=None, request_id=None, epoch=None,
+               trace=None):
         return self.transport.call(
             "labels",
             lambda t: self.app.labels(sid, labels, idx=idx,
-                                      request_id=request_id, epoch=epoch),
+                                      request_id=request_id, epoch=epoch,
+                                      trace_ctx=trace),
             idempotent=request_id is not None)
 
-    def best(self, sid, epoch=None):
+    def best(self, sid, epoch=None, trace=None):
         return self.transport.call(
-            "best", lambda t: self.app.best(sid, epoch=epoch))
+            "best", lambda t: self.app.best(sid, epoch=epoch,
+                                            trace_ctx=trace))
 
     def trace(self, sid, epoch=None):
         return self.transport.call(
             "trace", lambda t: self.app.trace(sid, epoch=epoch))
+
+    def trace_by_id(self, trace_id):
+        # this replica's retained spans for one distributed trace (the
+        # router's stitcher fans this out across the fleet)
+        return self.transport.call(
+            "trace_by_id", lambda t: self.app.trace_by_id(trace_id),
+            idempotent=True)
 
     def close(self, sid, epoch=None):
         return self.transport.call(
@@ -231,7 +245,7 @@ class DeadReplica:
 
     open = label = labels = best = trace = close = _dead
     export = fence = import_payload = stats = healthz = _dead
-    export_for_migration = sync_prior = _dead
+    export_for_migration = sync_prior = trace_by_id = _dead
 
     def has_session(self, sid) -> bool:
         raise ConnectionError(
@@ -282,16 +296,23 @@ class HttpReplica:
         self.transport = transport or ReplicaTransport(
             replica_id, deadlines=dl, **transport_kw)
 
-    def _req(self, method, path, body=None, timeout=60.0):
+    def _req(self, method, path, body=None, timeout=60.0, trace=None):
         import json as _json
         import socket
         import urllib.error
         import urllib.request
 
         data = None if body is None else _json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            # the wire form of the trace context: the replica's serve
+            # span parents to OUR span, so cross-process stitching gets
+            # one causal chain (same header both handle types speak)
+            from coda_tpu.telemetry.trace import TRACE_HEADER
+
+            headers[TRACE_HEADER] = trace.header()
         req = urllib.request.Request(
-            self.url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            self.url + path, data=data, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 return _json.loads(r.read())
@@ -326,9 +347,11 @@ class HttpReplica:
                 raise reason
             raise ConnectionError(str(e))
 
-    def _call(self, verb, method, path, body=None, idempotent=False):
+    def _call(self, verb, method, path, body=None, idempotent=False,
+              trace=None):
         return self.transport.call(
-            verb, lambda t: self._req(method, path, body, timeout=t),
+            verb, lambda t: self._req(method, path, body, timeout=t,
+                                      trace=trace),
             idempotent=idempotent)
 
     @staticmethod
@@ -337,7 +360,7 @@ class HttpReplica:
             body["epoch"] = int(epoch)
         return body
 
-    def open(self, task=None, seed=None, sid=None):
+    def open(self, task=None, seed=None, sid=None, trace=None):
         body = {}
         if task is not None:
             body["task"] = task
@@ -345,33 +368,40 @@ class HttpReplica:
             body["seed"] = seed
         if sid is not None:
             body["session"] = sid
-        return self._call("open", "POST", "/session", body)
+        return self._call("open", "POST", "/session", body, trace=trace)
 
-    def label(self, sid, label, idx=None, request_id=None, epoch=None):
+    def label(self, sid, label, idx=None, request_id=None, epoch=None,
+              trace=None):
         body = self._stamp({"label": label}, epoch)
         if idx is not None:
             body["idx"] = idx
         if request_id is not None:
             body["request_id"] = request_id
         return self._call("label", "POST", f"/session/{sid}/label", body,
-                          idempotent=request_id is not None)
+                          idempotent=request_id is not None, trace=trace)
 
-    def labels(self, sid, labels, idx=None, request_id=None, epoch=None):
+    def labels(self, sid, labels, idx=None, request_id=None, epoch=None,
+               trace=None):
         body = self._stamp({"labels": list(labels)}, epoch)
         if idx is not None:
             body["idx"] = idx
         if request_id is not None:
             body["request_id"] = request_id
         return self._call("labels", "POST", f"/session/{sid}/labels", body,
-                          idempotent=request_id is not None)
+                          idempotent=request_id is not None, trace=trace)
 
-    def best(self, sid, epoch=None):
+    def best(self, sid, epoch=None, trace=None):
         q = f"?epoch={int(epoch)}" if epoch is not None else ""
-        return self._call("best", "GET", f"/session/{sid}/best{q}")
+        return self._call("best", "GET", f"/session/{sid}/best{q}",
+                          trace=trace)
 
     def trace(self, sid, epoch=None):
         q = f"?epoch={int(epoch)}" if epoch is not None else ""
         return self._call("trace", "GET", f"/session/{sid}/trace{q}")
+
+    def trace_by_id(self, trace_id):
+        return self._call("trace_by_id", "GET", f"/trace/id/{trace_id}",
+                          idempotent=True)
 
     def close(self, sid, epoch=None):
         return self._call("close", "DELETE", f"/session/{sid}",
@@ -458,11 +488,15 @@ class SessionRouter:
     def __init__(self, replicas: Optional[dict] = None, telemetry=None,
                  auto_rebalance: bool = True,
                  journal_path: Optional[str] = None,
-                 faults=None, health_hysteresis: int = 2):
+                 faults=None, health_hysteresis: int = 2,
+                 tracing: bool = True,
+                 slo_fast_s: float = 300.0, slo_slow_s: float = 3600.0,
+                 slo_store=None):
         from concurrent.futures import ThreadPoolExecutor
 
         from coda_tpu.serve.metrics import ServeMetrics
         from coda_tpu.telemetry import Telemetry
+        from coda_tpu.telemetry.slo import SloSweeper, default_fleet_slos
 
         self._lock = threading.RLock()
         self.replicas: dict[str, object] = dict(replicas or {})
@@ -522,6 +556,27 @@ class SessionRouter:
             self.ready.set()
         # the span vocabulary the trace-based attribution keys on
         self._spans = self.telemetry.spans
+        # distributed tracing: the router is the fleet's front door, so
+        # it MINTS the trace context when the client didn't send one —
+        # every label decision gets exactly one causal trace. Purely
+        # observational (spans + retention), never read by routing.
+        self.tracing = bool(tracing)
+        # adopted trace payloads: a replica about to be rebuilt (rolling
+        # restart) hands its retained per-trace spans to the router so
+        # traces survive the restart — trace_id -> [wire payloads].
+        # Bounded FIFO like the recorders' own retention; a crash-killed
+        # replica hands off nothing (its spans are honestly lost).
+        self._adopted_traces: "collections.OrderedDict[str, list]" = \
+            collections.OrderedDict()
+        self._adopted_capacity = 4096
+        # the SLO watchtower: declarative objectives evaluated against
+        # the aggregated fleet snapshot on the health-poll thread;
+        # multi-window burn rates, typed fire/clear alerts (flushed to
+        # ``slo_store`` when given), coda_slo_* gauges on /metrics
+        self.slo = SloSweeper(default_fleet_slos(),
+                              registry=self.telemetry.registry,
+                              store=slo_store,
+                              fast_s=slo_fast_s, slow_s=slo_slow_s)
         # the migration journal: crash-consistent move records (intent/
         # exported/imported/committed), replayed by recover_from_journal
         self.journal = None
@@ -720,11 +775,21 @@ class SessionRouter:
         self._running = True
 
         def _loop():
+            ticks = 0
             while self._running:
                 try:
                     self.check_health()
                 except Exception:
                     pass
+                ticks += 1
+                if ticks % 4 == 0:
+                    # SLO sweep at 1/4 the health cadence: stats() fans
+                    # out to every replica, so it rides a slower beat
+                    # than the cheap healthz probes
+                    try:
+                        self.slo.observe(self.stats())
+                    except Exception:
+                        pass  # the poller survives a mid-sweep hiccup
                 self._wakeup.wait(poll_s)
                 self._wakeup.clear()
 
@@ -785,14 +850,32 @@ class SessionRouter:
                 continue
         return None, unreachable
 
-    def _forward(self, verb: str, sid: str, fn):
+    def _trace_root(self, trace_ctx):
+        """The fleet front door's trace context: continue the client's,
+        or MINT one (tracing on) so every label decision has a causal
+        trace even from untraced clients. None when tracing is off."""
+        if not self.tracing:
+            return None
+        if trace_ctx is not None:
+            return trace_ctx.child()
+        from coda_tpu.telemetry.trace import mint
+
+        return mint()
+
+    def _forward(self, verb: str, sid: str, fn, trace_ctx=None):
         """Route one verb: locate -> dispatch (with the route span
         nesting the replica dispatch span, the router's epoch stamped on
         the call) -> on UnknownSession, search the fleet and re-route
         once; on a StaleOwner fencing rejection, the answering replica
         holds a pre-migration copy — exclude it and re-locate; on a dead
-        replica (or an open breaker), evict and fail over."""
-        with self._spans.span(f"route/{verb}", lane="host:router"):
+        replica (or an open breaker), evict and fail over.
+
+        ``fn(handle, epoch, trace)`` gets a per-dispatch child context —
+        each failover attempt carries its own span, so a request retried
+        across a migration leaves BOTH replicas' lanes in one trace."""
+        ctx = self._trace_root(trace_ctx)
+        with self._spans.span(f"route/{verb}", lane="host:router",
+                              **(ctx.attrs() if ctx is not None else {})):
             last_err: Optional[BaseException] = None
             stale: set = set()
             for attempt in range(4):
@@ -802,10 +885,13 @@ class SessionRouter:
                     epoch = self._epochs.get(sid)
                 if handle is None:
                     continue
+                dctx = ctx.child() if ctx is not None else None
+                dattrs = dict(dctx.attrs(), replica=rid) \
+                    if dctx is not None else {}
                 try:
                     with self._spans.span(f"dispatch/{rid}",
-                                          lane="host:router"):
-                        out = fn(handle, epoch)
+                                          lane="host:router", **dattrs):
+                        out = fn(handle, epoch, dctx)
                     with self._lock:
                         self.counters["requests_routed"] += 1
                         self.routed_to[rid] = \
@@ -886,7 +972,7 @@ class SessionRouter:
 
     # -- the front-door verb surface (ServeApp-compatible) -----------------
     def open_session(self, task: Optional[str] = None,
-                     seed: Optional[int] = None) -> dict:
+                     seed: Optional[int] = None, trace_ctx=None) -> dict:
         if self.draining:
             from coda_tpu.serve.server import Draining
 
@@ -894,7 +980,9 @@ class SessionRouter:
         # the router mints the sid so placement is HRW on the id BEFORE
         # the replica admits it (the replica honors the pinned id)
         sid = uuid.uuid4().hex
-        with self._spans.span("route/open", lane="host:router"):
+        ctx = self._trace_root(trace_ctx)
+        with self._spans.span("route/open", lane="host:router",
+                              **(ctx.attrs() if ctx is not None else {})):
             last_err: Optional[BaseException] = None
             for _ in range(3):
                 owner = rendezvous_owner(sid, self.routable())
@@ -902,10 +990,14 @@ class SessionRouter:
                     handle = self.replicas.get(owner)
                 if handle is None:
                     continue  # removed between routable() and lookup
+                dctx = ctx.child() if ctx is not None else None
+                dattrs = dict(dctx.attrs(), replica=owner) \
+                    if dctx is not None else {}
                 try:
                     with self._spans.span(f"dispatch/{owner}",
-                                          lane="host:router"):
-                        out = handle.open(task=task, seed=seed, sid=sid)
+                                          lane="host:router", **dattrs):
+                        out = handle.open(task=task, seed=seed, sid=sid,
+                                          trace=dctx)
                 except (ConnectionError, OSError) as e:
                     # dead owner inside the health-poll window: evict it
                     # (like every _forward verb does) and re-own the sid
@@ -920,60 +1012,71 @@ class SessionRouter:
                 return out
             raise (last_err or SlabFull("no routable replica answered"))
 
-    async def open_session_async(self, task=None, seed=None) -> dict:
-        import asyncio
-
-        loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
-            self._executor, lambda: self.open_session(task, seed))
-
-    def label(self, sid: str, label, idx=None, request_id=None,
-              epoch=None) -> dict:
-        # ``epoch`` is accepted for surface parity with ServeApp (the
-        # shared front door); the ROUTER's own epoch map is what gets
-        # stamped on the replica call — that map is the fence.
-        return self._forward(
-            "label", sid,
-            lambda h, e: h.label(sid, label, idx=idx,
-                                 request_id=request_id, epoch=e))
-
-    async def label_async(self, sid, label, idx=None,
-                          request_id=None, epoch=None) -> dict:
+    async def open_session_async(self, task=None, seed=None,
+                                 trace_ctx=None) -> dict:
         import asyncio
 
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self._executor,
-            lambda: self.label(sid, label, idx=idx, request_id=request_id))
+            lambda: self.open_session(task, seed, trace_ctx=trace_ctx))
+
+    def label(self, sid: str, label, idx=None, request_id=None,
+              epoch=None, trace_ctx=None) -> dict:
+        # ``epoch`` is accepted for surface parity with ServeApp (the
+        # shared front door); the ROUTER's own epoch map is what gets
+        # stamped on the replica call — that map is the fence.
+        return self._forward(
+            "label", sid,
+            lambda h, e, t: h.label(sid, label, idx=idx,
+                                    request_id=request_id, epoch=e,
+                                    trace=t),
+            trace_ctx=trace_ctx)
+
+    async def label_async(self, sid, label, idx=None,
+                          request_id=None, epoch=None,
+                          trace_ctx=None) -> dict:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor,
+            lambda: self.label(sid, label, idx=idx, request_id=request_id,
+                               trace_ctx=trace_ctx))
 
     def labels(self, sid: str, labels, idx=None, request_id=None,
-               epoch=None) -> dict:
+               epoch=None, trace_ctx=None) -> dict:
         return self._forward(
             "labels", sid,
-            lambda h, e: h.labels(sid, labels, idx=idx,
-                                  request_id=request_id, epoch=e))
+            lambda h, e, t: h.labels(sid, labels, idx=idx,
+                                     request_id=request_id, epoch=e,
+                                     trace=t),
+            trace_ctx=trace_ctx)
 
     async def labels_async(self, sid, labels, idx=None,
-                           request_id=None, epoch=None) -> dict:
+                           request_id=None, epoch=None,
+                           trace_ctx=None) -> dict:
         import asyncio
 
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self._executor,
             lambda: self.labels(sid, labels, idx=idx,
-                                request_id=request_id))
+                                request_id=request_id,
+                                trace_ctx=trace_ctx))
 
-    def best(self, sid: str, epoch=None) -> dict:
+    def best(self, sid: str, epoch=None, trace_ctx=None) -> dict:
         return self._forward("best", sid,
-                             lambda h, e: h.best(sid, epoch=e))
+                             lambda h, e, t: h.best(sid, epoch=e, trace=t),
+                             trace_ctx=trace_ctx)
 
     def trace(self, sid: str, epoch=None) -> dict:
         return self._forward("trace", sid,
-                             lambda h, e: h.trace(sid, epoch=e))
+                             lambda h, e, t: h.trace(sid, epoch=e))
 
     def close_session(self, sid: str, epoch=None) -> dict:
         out = self._forward("close", sid,
-                            lambda h, e: h.close(sid, epoch=e))
+                            lambda h, e, t: h.close(sid, epoch=e))
         with self._lock:
             self._placed.pop(sid, None)
             self._epochs.pop(sid, None)
@@ -982,8 +1085,8 @@ class SessionRouter:
     def export_session(self, sid: str, close: bool = False,
                        hold: bool = False) -> dict:
         out = self._forward("export", sid,
-                            lambda h, e: h.export(sid, close=close,
-                                                  hold=hold))
+                            lambda h, e, t: h.export(sid, close=close,
+                                                     hold=hold))
         if close:
             with self._lock:
                 self._placed.pop(sid, None)
@@ -993,7 +1096,7 @@ class SessionRouter:
     def end_migration(self, sid: str, drop: bool = False) -> dict:
         # router-mediated fence (surface parity with ServeApp)
         return self._forward("fence", sid,
-                             lambda h, e: h.fence(sid, drop=drop))
+                             lambda h, e, t: h.fence(sid, drop=drop))
 
     def session_epoch(self, sid: str) -> dict:
         """Front-door twin of ``ServeApp.session_epoch``: the router's
@@ -1434,3 +1537,104 @@ class SessionRouter:
         return render_fleet(st["replicas"],
                             registry=self.telemetry.registry,
                             router_stats=st["router"])
+
+    def slo_snapshot(self) -> dict:
+        """``GET /fleet/slo``: objectives, burn rates, firing state,
+        recent alerts (the SLO watchtower's JSON face)."""
+        return self.slo.snapshot()
+
+    def adopt_trace_payloads(self, payloads: list) -> int:
+        """Take custody of per-trace span payloads from a replica that is
+        about to lose its recorder (rolling restart rebuilds the app):
+        :meth:`collect_trace` keeps stitching these into the trace after
+        the donor's in-memory rings are gone. Bounded FIFO per trace_id,
+        same shape as :meth:`SpanRecorder.trace_payload`."""
+        kept = 0
+        with self._lock:
+            for p in payloads or ():
+                tid = (p or {}).get("trace_id")
+                if not tid or not p.get("events"):
+                    continue
+                bucket = self._adopted_traces.get(tid)
+                if bucket is None:
+                    while len(self._adopted_traces) >= \
+                            self._adopted_capacity:
+                        self._adopted_traces.popitem(last=False)
+                    bucket = []
+                    self._adopted_traces[tid] = bucket
+                bucket.append(p)
+                kept += 1
+        return kept
+
+    @staticmethod
+    def _merge_process_payloads(payloads: list) -> list:
+        """Coalesce payloads sharing a process name (an adopted pre-restart
+        payload plus the live replica's post-restart one) into ONE lane
+        group, rebasing events onto the earliest payload's clock anchor so
+        the stitched timeline stays aligned."""
+        by_proc: dict = {}
+        order = []
+        for p in payloads:
+            key = p.get("process") or ""
+            if key not in by_proc:
+                by_proc[key] = []
+                order.append(key)
+            by_proc[key].append(p)
+        merged = []
+        for key in order:
+            group = by_proc[key]
+            if len(group) == 1:
+                merged.append(group[0])
+                continue
+            anchor = min(g["t0_unix"] for g in group)
+            events = []
+            for g in group:
+                off = g["t0_unix"] - anchor
+                for e in g["events"]:
+                    events.append(dict(e, t0=e["t0"] + off,
+                                       t1=e["t1"] + off))
+            events.sort(key=lambda e: e["t0"])
+            merged.append({"trace_id": group[0].get("trace_id"),
+                           "process": key, "t0_unix": anchor,
+                           "events": events})
+        return merged
+
+    def collect_trace(self, trace_id: str) -> dict:
+        """``GET /trace/id/{id}`` at the fleet front door: stitch the
+        router's own retained spans for one trace with every replica's
+        (fetched over the normal verb transport — in-process or HTTP)
+        plus any payloads adopted from restarted replicas, into ONE
+        Chrome/Perfetto file with a process lane per member. A replica
+        that can't answer contributes nothing rather than failing the
+        stitch — a partial trace beats no trace."""
+        from coda_tpu.telemetry.spans import stitch_traces
+
+        tid = str(trace_id)
+        payloads = [self.telemetry.spans.trace_payload(tid,
+                                                       process="router")]
+        with self._lock:
+            items = sorted(self.replicas.items())
+            payloads += [dict(p) for p in
+                         self._adopted_traces.get(tid, ())]
+        for rid, handle in items:
+            fetch = getattr(handle, "trace_by_id", None)
+            if fetch is None:
+                continue
+            try:
+                p = fetch(tid)
+            except Exception:
+                continue
+            if p and p.get("events"):
+                p = dict(p)
+                p["process"] = p.get("process") or str(rid)
+                payloads.append(p)
+        payloads = self._merge_process_payloads(
+            [p for p in payloads if p.get("events")])
+        out = stitch_traces(payloads)
+        out["trace_id"] = tid
+        # the per-process payload census: which fleet members retained
+        # spans for this trace (the loadgen's completeness check reads
+        # this instead of re-deriving it from Chrome pid metadata)
+        out["processes"] = [p["process"] for p in payloads
+                            if p.get("events")]
+        return out

@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
 import os
 import re
@@ -95,7 +96,8 @@ class ServeApp:
                  tiering: bool = True,
                  tier_spill_dir: Optional[str] = None,
                  idle_warm_s: float = 30.0, idle_cold_s: float = 120.0,
-                 max_warm: int = 8192, tier_free_fraction: float = 0.0):
+                 max_warm: int = 8192, tier_free_fraction: float = 0.0,
+                 tracing: bool = True):
         from coda_tpu.serve.faults import FaultInjector
         from coda_tpu.serve.recovery import BucketHealer
         from coda_tpu.serve.tiering import TierManager
@@ -104,6 +106,12 @@ class ServeApp:
         # deterministic fault injection (--fault-spec); inert when unset —
         # every site checks `faults is not None` first
         self.faults = FaultInjector(fault_spec) if fault_spec else None
+        # distributed tracing (telemetry/trace.py): when on, session verbs
+        # accept a trace context, record a serve span under it, and hand
+        # it to their ticket (tick/step span links, recorder rows, metric
+        # exemplars). NEVER read by dispatch math — `--no-trace` and
+        # tracing-on produce bitwise-identical session trajectories.
+        self.tracing = bool(tracing)
         self.store = SessionStore(capacity=capacity, bucket_n=bucket_n,
                                   step_impl=step_impl, donate=donate,
                                   faults=self.faults)
@@ -311,6 +319,46 @@ class ServeApp:
             self._next_seed += 1
             return s
 
+    # -- distributed tracing glue ------------------------------------------
+    def _trace_child(self, trace_ctx):
+        """Continue the caller's trace on this replica: a fresh span under
+        the same trace, parented to the caller's span. None when untraced
+        or tracing is off — every downstream consumer checks for None."""
+        if trace_ctx is None or not self.tracing:
+            return None
+        return trace_ctx.child()
+
+    @contextlib.contextmanager
+    def _serve_span(self, verb: str, ctx):
+        """Record ``serve/<verb>`` on the ``host:serve`` lane under ``ctx``.
+
+        Records on EVERY exit — a fenced/held attempt (StaleOwner, hold
+        window) still leaves this replica's lane in the trace, which is
+        exactly how a request retried across a migration shows both
+        replicas' lanes in one stitched file. No-op when untraced."""
+        if ctx is None:
+            yield
+            return
+        t0 = time.perf_counter()
+        attrs = ctx.attrs()
+        try:
+            yield
+        except BaseException as e:
+            attrs["error"] = type(e).__name__
+            raise
+        finally:
+            self.telemetry.spans.record(f"serve/{verb}", lane="host:serve",
+                                        t_start=t0,
+                                        t_end=time.perf_counter(),
+                                        attrs=attrs)
+
+    def trace_by_id(self, trace_id: str) -> dict:
+        """This replica's retained spans for one trace — the
+        ``GET /trace/id/{trace_id}`` payload the router's collector
+        stitches (empty events when unknown/evicted, never a 404: an
+        evicted trace is a fact, not an error)."""
+        return self.telemetry.spans.trace_payload(str(trace_id))
+
     # -- fencing + migration holds -----------------------------------------
     def held(self, sid: str) -> bool:
         with self.store.lock:
@@ -462,7 +510,7 @@ class ServeApp:
     #    callers; *_begin/_abort split out so the asyncio path can run the
     #    blocking host half on an executor and await only the ticket) ------
     def _open_begin(self, task: Optional[str], seed: Optional[int],
-                    sid: Optional[str] = None):
+                    sid: Optional[str] = None, trace=None):
         from coda_tpu.serve.batcher import Ticket
         from coda_tpu.serve.recovery import _SID_RE
 
@@ -516,7 +564,7 @@ class ServeApp:
         # is still in flight); resolution — result, error, or timeout
         # cancel — releases it exactly once
         self.store.pin(sess)
-        ticket = Ticket(session=sess, do_update=False)
+        ticket = Ticket(session=sess, do_update=False, trace=trace)
         ticket.on_resolve = lambda: self.store.unpin(sess)
         return sess, self.batcher.submit(ticket)
 
@@ -531,48 +579,56 @@ class ServeApp:
 
     def open_session(self, task: Optional[str] = None,
                      seed: Optional[int] = None,
-                     sid: Optional[str] = None) -> dict:
-        sess, ticket = self._open_begin(task, seed, sid=sid)
-        try:
-            res = ticket.wait(REQUEST_TIMEOUT_S)
-        except BaseException:
-            self._open_abort(sess)
-            raise
-        return self._payload(sess, res)
+                     sid: Optional[str] = None, trace_ctx=None) -> dict:
+        my = self._trace_child(trace_ctx)
+        with self._serve_span("open", my):
+            sess, ticket = self._open_begin(task, seed, sid=sid, trace=my)
+            try:
+                res = ticket.wait(REQUEST_TIMEOUT_S)
+            except BaseException:
+                self._open_abort(sess)
+                raise
+            return self._payload(sess, res)
 
     async def open_session_async(self, task: Optional[str] = None,
                                  seed: Optional[int] = None,
-                                 sid: Optional[str] = None) -> dict:
+                                 sid: Optional[str] = None,
+                                 trace_ctx=None) -> dict:
         loop = asyncio.get_running_loop()
-        if (self.recorder.out_dir is None
-                and self.store.has_fast_admission(
-                    task or self.default_task or "", self.spec)):
-            # warm-pool fast path: admission is sub-ms host work (free-slot
-            # pop + staged cached-init write), so run it inline — a
-            # thundering herd of opens then queues in one burst instead of
-            # trickling through executor threads and stretching the first
-            # tick's formation window to its cap. A file-backed recorder
-            # disqualifies the fast path: recorder.open() would do disk
-            # I/O (and contend on the recorder lock with the batcher's
-            # per-row flushes) on the event loop.
-            sess, ticket = self._open_begin(task, seed, sid=sid)
-        else:
-            # unseen (task, spec) or cold bucket: bucket construction /
-            # per-admission init compute runs for real — never on the
-            # event loop
-            sess, ticket = await loop.run_in_executor(
-                self._executor, self._open_begin, task, seed, sid)
-        try:
-            res = await ticket.wait_async(REQUEST_TIMEOUT_S)
-        except BaseException:
-            await loop.run_in_executor(self._executor, self._open_abort,
-                                       sess)
-            raise
-        return self._payload(sess, res)
+        my = self._trace_child(trace_ctx)
+        with self._serve_span("open", my):
+            if (self.recorder.out_dir is None
+                    and self.store.has_fast_admission(
+                        task or self.default_task or "", self.spec)):
+                # warm-pool fast path: admission is sub-ms host work
+                # (free-slot pop + staged cached-init write), so run it
+                # inline — a thundering herd of opens then queues in one
+                # burst instead of trickling through executor threads and
+                # stretching the first tick's formation window to its cap.
+                # A file-backed recorder disqualifies the fast path:
+                # recorder.open() would do disk I/O (and contend on the
+                # recorder lock with the batcher's per-row flushes) on
+                # the event loop.
+                sess, ticket = self._open_begin(task, seed, sid=sid,
+                                                trace=my)
+            else:
+                # unseen (task, spec) or cold bucket: bucket construction /
+                # per-admission init compute runs for real — never on the
+                # event loop
+                sess, ticket = await loop.run_in_executor(
+                    self._executor,
+                    lambda: self._open_begin(task, seed, sid=sid, trace=my))
+            try:
+                res = await ticket.wait_async(REQUEST_TIMEOUT_S)
+            except BaseException:
+                await loop.run_in_executor(self._executor, self._open_abort,
+                                           sess)
+                raise
+            return self._payload(sess, res)
 
     def _label_begin(self, sid: str, label: int, idx: Optional[int],
                      request_id: Optional[str] = None, wake: bool = True,
-                     epoch: Optional[int] = None):
+                     epoch: Optional[int] = None, trace=None):
         from coda_tpu.serve.batcher import Ticket
 
         if self.faults is not None and self.tiers is not None and \
@@ -675,7 +731,7 @@ class ServeApp:
             ticket = Ticket(session=sess, do_update=True,
                             idx=cur["next_idx"],
                             label=label, prob=cur["next_prob"],
-                            request_id=request_id)
+                            request_id=request_id, trace=trace)
             if request_id is not None:
                 # registration is atomic with a re-check, so two
                 # concurrent retries of the same request_id can never
@@ -702,40 +758,49 @@ class ServeApp:
 
     def label(self, sid: str, label: int, idx: Optional[int] = None,
               request_id: Optional[str] = None,
-              epoch: Optional[int] = None) -> dict:
-        sess, ticket = self._label_begin(sid, label, idx, request_id,
-                                         epoch=epoch)
-        return self._payload(sess, ticket.wait(REQUEST_TIMEOUT_S))
+              epoch: Optional[int] = None, trace_ctx=None) -> dict:
+        my = self._trace_child(trace_ctx)
+        with self._serve_span("label", my):
+            sess, ticket = self._label_begin(sid, label, idx, request_id,
+                                             epoch=epoch, trace=my)
+            return self._payload(sess, ticket.wait(REQUEST_TIMEOUT_S))
 
     async def label_async(self, sid: str, label: int,
                           idx: Optional[int] = None,
                           request_id: Optional[str] = None,
-                          epoch: Optional[int] = None) -> dict:
-        try:
-            # inline fast path with waking DISABLED: for a resident
-            # session _label_begin is pure host-dict work (lookup, bounds
-            # checks, queue.put) — microseconds on the loop. wake=False
-            # (not a pre-check) closes the race where a demotion lands
-            # between an aliveness probe and the lookup: the wake's disk
-            # read / stream replay must never run on the event loop.
-            sess, ticket = self._label_begin(sid, label, idx, request_id,
-                                             wake=False, epoch=epoch)
-        except UnknownSession:
-            if self.tiers is None:
-                raise
-            # non-resident (or mid-demotion): the full wake-through path
-            # on the executor — it retries through the demotion window
-            # and re-raises UnknownSession only for truly dead sids
-            loop = asyncio.get_running_loop()
-            sess, ticket = await loop.run_in_executor(
-                self._executor,
-                lambda: self._label_begin(sid, label, idx, request_id,
-                                          epoch=epoch))
-        return self._payload(sess, await ticket.wait_async(REQUEST_TIMEOUT_S))
+                          epoch: Optional[int] = None,
+                          trace_ctx=None) -> dict:
+        my = self._trace_child(trace_ctx)
+        with self._serve_span("label", my):
+            try:
+                # inline fast path with waking DISABLED: for a resident
+                # session _label_begin is pure host-dict work (lookup,
+                # bounds checks, queue.put) — microseconds on the loop.
+                # wake=False (not a pre-check) closes the race where a
+                # demotion lands between an aliveness probe and the
+                # lookup: the wake's disk read / stream replay must never
+                # run on the event loop.
+                sess, ticket = self._label_begin(sid, label, idx,
+                                                 request_id, wake=False,
+                                                 epoch=epoch, trace=my)
+            except UnknownSession:
+                if self.tiers is None:
+                    raise
+                # non-resident (or mid-demotion): the full wake-through
+                # path on the executor — it retries through the demotion
+                # window and re-raises UnknownSession only for truly
+                # dead sids
+                loop = asyncio.get_running_loop()
+                sess, ticket = await loop.run_in_executor(
+                    self._executor,
+                    lambda: self._label_begin(sid, label, idx, request_id,
+                                              epoch=epoch, trace=my))
+            return self._payload(
+                sess, await ticket.wait_async(REQUEST_TIMEOUT_S))
 
     def labels(self, sid: str, labels, idx=None,
                request_id: Optional[str] = None,
-               epoch: Optional[int] = None) -> dict:
+               epoch: Optional[int] = None, trace_ctx=None) -> dict:
         """The batch-label verb behind ``POST /session/{id}/labels``: all
         q oracle answers of one round, resolved through ONE ticket and
         ONE fused dispatch (the q-wide bucket's compiled step applies
@@ -749,17 +814,31 @@ class ServeApp:
         verbs with a list payload — no second copy of the pin/dedupe/
         wake choreography to keep in lockstep."""
         return self.label(sid, list(labels), idx=idx,
-                          request_id=request_id, epoch=epoch)
+                          request_id=request_id, epoch=epoch,
+                          trace_ctx=trace_ctx)
 
     async def labels_async(self, sid: str, labels, idx=None,
                            request_id: Optional[str] = None,
-                           epoch: Optional[int] = None) -> dict:
+                           epoch: Optional[int] = None,
+                           trace_ctx=None) -> dict:
         return await self.label_async(sid, list(labels), idx=idx,
-                                      request_id=request_id, epoch=epoch)
+                                      request_id=request_id, epoch=epoch,
+                                      trace_ctx=trace_ctx)
 
     def answer(self, sid: str, slot, label=None,
                request_id: Optional[str] = None,
-               epoch: Optional[int] = None, abstain: bool = False) -> dict:
+               epoch: Optional[int] = None, abstain: bool = False,
+               trace_ctx=None) -> dict:
+        my = self._trace_child(trace_ctx)
+        with self._serve_span("answer", my):
+            return self._answer_impl(sid, slot, label=label,
+                                     request_id=request_id, epoch=epoch,
+                                     abstain=abstain, trace=my)
+
+    def _answer_impl(self, sid: str, slot, label=None,
+                     request_id: Optional[str] = None,
+                     epoch: Optional[int] = None, abstain: bool = False,
+                     trace=None) -> dict:
         """The asynchronous oracle verb (``POST /session/{id}/answer``):
         ONE per-slot crowd answer of the current round, in ANY order.
 
@@ -874,7 +953,8 @@ class ServeApp:
         rid = f"answer:{sid}:{round_idx}"
         try:
             payload = self.label(sid, ordered if q > 1 else ordered[0],
-                                 request_id=rid, epoch=epoch)
+                                 request_id=rid, epoch=epoch,
+                                 trace_ctx=trace)
         except BaseException:
             # failed drain: re-park so the answers survive for a retry
             # (the park rows are still in the stream; nothing is lost)
@@ -899,7 +979,7 @@ class ServeApp:
     async def answer_async(self, sid: str, slot, label=None,
                            request_id: Optional[str] = None,
                            epoch: Optional[int] = None,
-                           abstain: bool = False) -> dict:
+                           abstain: bool = False, trace_ctx=None) -> dict:
         # parking is host-dict work but the drain dispatch blocks on the
         # batcher — always off the event loop (like the wake-through path)
         loop = asyncio.get_running_loop()
@@ -907,9 +987,15 @@ class ServeApp:
             self._executor,
             lambda: self.answer(sid, slot, label=label,
                                 request_id=request_id, epoch=epoch,
-                                abstain=abstain))
+                                abstain=abstain, trace_ctx=trace_ctx))
 
-    def best(self, sid: str, epoch: Optional[int] = None) -> dict:
+    def best(self, sid: str, epoch: Optional[int] = None,
+             trace_ctx=None) -> dict:
+        my = self._trace_child(trace_ctx)
+        with self._serve_span("best", my):
+            return self._best_impl(sid, epoch=epoch)
+
+    def _best_impl(self, sid: str, epoch: Optional[int] = None) -> dict:
         self._check_hold(sid)
         sess = self._resolve_pinned(sid)  # wakes a parked session
         try:
@@ -1364,6 +1450,10 @@ _SESSION_RE = re.compile(
     r"^/session/([0-9a-f]+)"
     r"(/(label|labels|answer|best|trace|export|fence|epoch))?$")
 
+# GET /trace/id/{trace_id}: retained distributed-trace spans (distinct
+# from GET /session/{id}/trace, the per-round DECISION history)
+_TRACE_ID_RE = re.compile(r"^/trace/id/([0-9a-f]+)$")
+
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             409: "Conflict", 500: "Internal Server Error",
             503: "Service Unavailable", 504: "Gateway Timeout"}
@@ -1473,7 +1563,7 @@ class AsyncHTTPServer:
                 if 0 <= n <= _MAX_BODY_BYTES:
                     body = await reader.readexactly(n) if n > 0 else b""
                     status, payload, ctype = await self._handle(
-                        method, target, body)
+                        method, target, body, headers)
                 else:
                     # malformed or oversized Content-Length: answer a JSON
                     # error (never a dropped connection) and close — the
@@ -1505,13 +1595,25 @@ class AsyncHTTPServer:
                 pass
 
     # -- routing (same error envelope as the session verbs raise) ----------
-    async def _handle(self, method: str, target: str, body: bytes):
+    async def _handle(self, method: str, target: str, body: bytes,
+                      headers: Optional[dict] = None):
         app = self.app
         path, _, query = target.partition("?")
         params = {}
         for kv in filter(None, query.split("&")):
             k, _, v = kv.partition("=")
             params[k] = v
+        # trace context: continue the caller's (`coda-trace` header), or
+        # mint fresh at this front door for session verbs so every label
+        # decision has ONE causal trace even from untraced clients. Never
+        # touches session state — purely observational.
+        trace_ctx = None
+        if getattr(app, "tracing", False):
+            from coda_tpu.telemetry.trace import TRACE_HEADER, mint, parse
+
+            trace_ctx = parse((headers or {}).get(TRACE_HEADER, ""))
+            if trace_ctx is None and path.startswith("/session"):
+                trace_ctx = mint()
         if method == "GET" and path == "/healthz":
             # the readiness gate: 503 until the warm pool is compiled, so
             # a restarting replica takes no traffic while executables are
@@ -1545,7 +1647,8 @@ class AsyncHTTPServer:
                 return 500, {"error": f"internal: {e}"}, _JSON
             return 200, text, _PROM
         try:
-            out = await self._route(method, path, body, params)
+            out = await self._route(method, path, body, params,
+                                    trace_ctx=trace_ctx)
         except Draining:
             return (503, {"error": "draining: not admitting new sessions"},
                     _JSON)
@@ -1581,7 +1684,7 @@ class AsyncHTTPServer:
         return 200, out, _JSON
 
     async def _route(self, method: str, path: str, raw: bytes,
-                     params: Optional[dict] = None):
+                     params: Optional[dict] = None, trace_ctx=None):
         app = self.app
         loop = asyncio.get_running_loop()
         m = _SESSION_RE.match(path)
@@ -1607,7 +1710,8 @@ class AsyncHTTPServer:
                 # a fleet router pins the id (rendezvous placement)
                 kw["sid"] = str(req["session"])
             return await app.open_session_async(task=req.get("task"),
-                                                seed=req.get("seed"), **kw)
+                                                seed=req.get("seed"),
+                                                trace_ctx=trace_ctx, **kw)
         if m and method == "POST" and m.group(3) == "label":
             req = json.loads(raw or b"{}")
             if "label" not in req:
@@ -1615,7 +1719,8 @@ class AsyncHTTPServer:
             return await app.label_async(m.group(1), req["label"],
                                          idx=req.get("idx"),
                                          request_id=req.get("request_id"),
-                                         epoch=_epoch(req))
+                                         epoch=_epoch(req),
+                                         trace_ctx=trace_ctx)
         if m and method == "POST" and m.group(3) == "labels":
             # batch of oracle answers, one dispatch (see ServeApp.labels)
             req = json.loads(raw or b"{}")
@@ -1624,7 +1729,8 @@ class AsyncHTTPServer:
             return await app.labels_async(m.group(1), req["labels"],
                                           idx=req.get("idx"),
                                           request_id=req.get("request_id"),
-                                          epoch=_epoch(req))
+                                          epoch=_epoch(req),
+                                          trace_ctx=trace_ctx)
         if m and method == "POST" and m.group(3) == "answer":
             # one per-slot crowd answer, any order (see ServeApp.answer)
             req = json.loads(raw or b"{}")
@@ -1636,7 +1742,8 @@ class AsyncHTTPServer:
                                           label=req.get("label"),
                                           request_id=req.get("request_id"),
                                           epoch=_epoch(req),
-                                          abstain=bool(req.get("abstain")))
+                                          abstain=bool(req.get("abstain")),
+                                          trace_ctx=trace_ctx)
         if m and method == "POST" and m.group(3) == "export":
             req = json.loads(raw or b"{}")
             return await loop.run_in_executor(
@@ -1657,7 +1764,8 @@ class AsyncHTTPServer:
         if m and method == "GET" and m.group(3) == "best":
             return await loop.run_in_executor(
                 app._executor,
-                lambda: app.best(m.group(1), epoch=_epoch()))
+                lambda: app.best(m.group(1), epoch=_epoch(),
+                                 trace_ctx=trace_ctx))
         if m and method == "GET" and m.group(3) == "trace":
             return await loop.run_in_executor(
                 app._executor,
@@ -1678,6 +1786,23 @@ class AsyncHTTPServer:
         if method == "GET" and path == "/sessions":
             return await loop.run_in_executor(app._executor,
                                               app.list_sessions)
+        tm = _TRACE_ID_RE.match(path)
+        if tm and method == "GET":
+            # one causal trace by id: a fleet router stitches every
+            # process's retained spans into one Chrome/Perfetto file
+            # (collect_trace); a single replica serves its own raw
+            # payload for such a collector to stitch
+            if hasattr(app, "collect_trace"):
+                return await loop.run_in_executor(
+                    app._executor, app.collect_trace, tm.group(1))
+            return await loop.run_in_executor(
+                app._executor, app.trace_by_id, tm.group(1))
+        if method == "GET" and path == "/fleet/slo" and \
+                hasattr(app, "slo_snapshot"):
+            # the SLO watchtower (router only): objectives, burn rates,
+            # firing state, recent alerts
+            return await loop.run_in_executor(app._executor,
+                                              app.slo_snapshot)
         return None
 
 
@@ -1817,6 +1942,19 @@ def parse_args(argv=None):
                         "stream found in --record-dir by bitwise replay "
                         "(the crash-restart path: a SIGKILLed server "
                         "restarted with --restore resumes its sessions)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable distributed tracing (trace-context "
+                        "propagation, serve/tick/step trace spans, "
+                        "latency exemplars, GET /trace/id/{trace_id}). "
+                        "Tracing never perturbs session math — on and "
+                        "off produce bitwise-identical trajectories — "
+                        "so this is purely an overhead lever")
+    p.add_argument("--slo-fast-s", type=float, default=300.0,
+                   help="SLO watchtower fast burn-rate window (seconds); "
+                        "fleet router only")
+    p.add_argument("--slo-slow-s", type=float, default=3600.0,
+                   help="SLO watchtower slow burn-rate window (seconds); "
+                        "fleet router only")
     p.add_argument("--fault-spec", default=None, metavar="SPEC",
                    help="deterministic fault injection (serve/faults.py): "
                         "'name:param=v,...[;name:...]' with names "
@@ -1876,6 +2014,7 @@ def build_app(args) -> ServeApp:
         idle_cold_s=getattr(args, "idle_cold_s", 120.0),
         max_warm=getattr(args, "max_warm", 8192),
         tier_free_fraction=getattr(args, "tier_free_frac", 0.0),
+        tracing=not getattr(args, "no_trace", False),
     )
     if args.task or args.synthetic:
         ds = load_dataset(args)

@@ -69,6 +69,9 @@ VERB_DEADLINES = {
     "healthz": 5.0,
     "sessions": 60.0,
     "epoch": 10.0,
+    # distributed-trace span fetch (GET /trace/id/{id}): a small read
+    # the router's stitcher fans out per replica
+    "trace_by_id": 10.0,
     # the prior-pool exchange rides the health cadence but moves a
     # payload (the merged pool), so it gets stats-class headroom
     "prior_sync": 30.0,
@@ -77,7 +80,8 @@ VERB_DEADLINES = {
 #: verbs that are idempotent at the replica regardless of payload: a
 #: duplicate delivery (retry after a lost response) changes nothing
 _IDEMPOTENT_VERBS = frozenset(
-    {"best", "trace", "stats", "healthz", "sessions", "export", "epoch"})
+    {"best", "trace", "stats", "healthz", "sessions", "export", "epoch",
+     "trace_by_id"})
 
 #: verbs retried only when the caller proves idempotency (request_id
 #: dedupe for labels); otherwise only not-sent failures retry

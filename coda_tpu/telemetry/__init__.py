@@ -57,7 +57,11 @@ from coda_tpu.telemetry.recorder import (
     knobs_from_args,
     stream_dir,
 )
-from coda_tpu.telemetry.spans import SpanRecorder, annotation
+from coda_tpu.telemetry.slo import SLObjective, SloSweeper, default_fleet_slos
+from coda_tpu.telemetry.spans import SpanRecorder, annotation, stitch_traces
+from coda_tpu.telemetry.trace import TRACE_HEADER, TraceContext
+from coda_tpu.telemetry.trace import mint as mint_trace
+from coda_tpu.telemetry.trace import parse as parse_trace
 
 __all__ = [
     "COSTS",
@@ -69,13 +73,18 @@ __all__ = [
     "RECORD_SCHEMA_VERSION",
     "Registry",
     "RunRecord",
+    "SLObjective",
     "SessionRecorder",
+    "SloSweeper",
     "SpanRecorder",
+    "TRACE_HEADER",
     "Telemetry",
+    "TraceContext",
     "analyze_compiled",
     "annotation",
     "aot_call",
     "dataset_digest",
+    "default_fleet_slos",
     "environment_fingerprint",
     "get_registry",
     "harvest_executable_cost",
@@ -83,10 +92,13 @@ __all__ = [
     "jax_hooks_installed",
     "knobs_from_args",
     "lint_prometheus",
+    "mint_trace",
+    "parse_trace",
     "registry_hooked",
     "render_prometheus",
     "roofline",
     "sample_device_memory",
+    "stitch_traces",
     "stream_dir",
 ]
 

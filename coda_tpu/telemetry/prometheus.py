@@ -63,6 +63,22 @@ def _family(out: list, name: str, kind: str, help: str,
         out.append(_line(name, labels, value))
 
 
+def _exemplar_family(out: list, name: str, help: str,
+                     samples: list) -> None:
+    """A gauge family whose samples carry OpenMetrics exemplars:
+    ``name{labels} value # {trace_id="..."} value`` — the one-hop join
+    from a latency outlier on ``/metrics`` to its distributed trace.
+    ``samples`` is ``[(labels, {"value_s": float, "trace_id": str})]``."""
+    if help:
+        out.append(f"# HELP {name} {_escape(help)}")
+    out.append(f"# TYPE {name} gauge")
+    for labels, ex in samples:
+        v = float(ex["value_s"])
+        out.append(_line(name, labels, v)
+                   + f' # {{trace_id="{_escape(ex["trace_id"])}"}} '
+                   + _fmt(v))
+
+
 def render(registry: Optional[Registry] = None, serve_metrics=None,
            prefix: str = "coda") -> str:
     """The registry (+ optional ServeMetrics snapshot) as exposition text."""
@@ -213,11 +229,18 @@ _SERVE_WARM = [
 
 _METRIC_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 # one sample line: name{labels} value — labels quoted, escapes resolved by
-# the tokenizer below, value a float or NaN/+Inf/-Inf
+# the tokenizer below, value a float or NaN/+Inf/-Inf; optionally followed
+# by an OpenMetrics exemplar ``# {labels} value [timestamp]``. The labels
+# group is non-greedy so a greedy match cannot swallow the exemplar's
+# braces into the sample's label body (backtracking still recovers label
+# values that legitimately contain ``}`` or ``# {``).
 _SAMPLE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>.*)\})?"
-    r" (?P<value>NaN|[+-]Inf|[+-]?[0-9][0-9.eE+-]*)$")
+    r"(?:\{(?P<labels>.*?)\})?"
+    r" (?P<value>NaN|[+-]Inf|[+-]?[0-9][0-9.eE+-]*)"
+    r"(?P<exemplar> # \{(?P<elabels>.*)\}"
+    r" (?P<evalue>NaN|[+-]Inf|[+-]?[0-9][0-9.eE+-]*)"
+    r"(?: (?P<ets>[0-9][0-9.eE+-]*))?)?$")
 _LABEL_PAIR = re.compile(
     r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\\n]|\\["\\n])*)"')
 # the WHOLE label body must be comma-separated pairs (an optional trailing
@@ -238,7 +261,11 @@ def lint(text: str) -> list[str]:
     interleaved (duplicate TYPE), samples with no TYPE, bad metric/label
     names, and values that are not valid floats (NaN/±Inf must use the
     canonical spellings). Summary ``_count``/``_sum`` suffixed samples
-    belong to their base family.
+    belong to their base family. OpenMetrics exemplars
+    (``# {trace_id="..."} value``) are validated like sample labels and
+    are only legal on gauge and histogram families — a counter or summary
+    exemplar is how a hand-rolled renderer silently breaks OpenMetrics
+    parsers, so it lints.
     """
     out: list[str] = []
     typed: dict[str, str] = {}       # family -> kind
@@ -317,6 +344,16 @@ def lint(text: str) -> list[str]:
                                    f"{lm.group('k')!r}")
                     seen.append(lm.group("k"))
                     pairs.append((lm.group("k"), lm.group("v")))
+        if m.group("exemplar"):
+            kind = typed.get(fam)
+            if kind not in ("gauge", "histogram"):
+                out.append(f"line {i}: exemplar on {kind or 'untyped'} "
+                           f"family {fam} (exemplars are only legal on "
+                           "gauge/histogram samples)")
+            elabels = m.group("elabels")
+            if elabels and not _LABELS_BODY.match(elabels):
+                out.append(f"line {i}: malformed exemplar labels "
+                           f"{elabels!r}")
         key = (name, tuple(sorted(pairs)))
         if key in series:
             out.append(f"line {i}: duplicate series {name}"
@@ -441,6 +478,16 @@ def render_fleet(replica_snaps: dict, registry: Optional[Registry] = None,
                    if (s.get("spill") or {}).get(key) is not None]
         if samples:
             _family(out, _name(prefix, suffix), kind, help, samples)
+    samples = [({"replica": rid, "ring": ring}, ex)
+               for rid, s in snaps.items()
+               for ring, ex in sorted((s.get("exemplars") or {}).items())
+               if ex and ex.get("trace_id")]
+    if samples:
+        _exemplar_family(
+            out, _name(prefix, "serve_latency_outlier_seconds"),
+            "Latest p99-bucket latency outlier per replica and ring; the "
+            "exemplar's trace_id joins it to its stitched distributed "
+            "trace", samples)
     for key, suffix, count_key, help in _SERVE_SUMMARIES:
         name = _name(prefix, suffix)
         samples = []
@@ -487,6 +534,16 @@ def _render_serve(out: list, snap: dict, prefix: str) -> None:
         _family(out, _name(prefix, "serve_ring_fill"), "gauge",
                 "Events currently held in a metrics ring",
                 [({"ring": k}, n) for k, n in sorted(fills.items())])
+    exemplars = snap.get("exemplars") or {}
+    samples = [({"ring": ring}, ex)
+               for ring, ex in sorted(exemplars.items())
+               if ex and ex.get("trace_id")]
+    if samples:
+        _exemplar_family(
+            out, _name(prefix, "serve_latency_outlier_seconds"),
+            "Latest p99-bucket latency outlier per ring; the exemplar's "
+            "trace_id joins it to its stitched distributed trace",
+            samples)
     for key, suffix, count_key, help in _SERVE_SUMMARIES:
         q = snap.get(key) or {}
         name = _name(prefix, suffix)

@@ -34,6 +34,15 @@ from typing import Optional
 # small enough that a trace.json export stays a few MB
 _CAPACITY = 65536
 
+# per-trace retention ring: distinct traces kept (FIFO eviction) and spans
+# kept per trace. The front door mints a context for EVERY session verb,
+# so a loadgen capture run generates thousands of traces — the cap must
+# outlast a full capture pass or sampled traces are evicted before the
+# stitcher fetches them. Both caps bound memory independently of the main
+# ring (4096 traces x 256 spans x ~100 B is a few-MB worst case).
+_TRACE_CAPACITY = 4096
+_TRACE_SPAN_CAPACITY = 256
+
 
 @contextlib.contextmanager
 def annotation(name: str):
@@ -61,23 +70,59 @@ class SpanRecorder:
     bounded ring — recording is O(1) and never blocks on a reduction.
     """
 
-    def __init__(self, capacity: int = _CAPACITY):
+    def __init__(self, capacity: int = _CAPACITY,
+                 trace_capacity: int = _TRACE_CAPACITY,
+                 trace_span_capacity: int = _TRACE_SPAN_CAPACITY):
         self._lock = threading.Lock()
         self._events: collections.deque = collections.deque(maxlen=capacity)
         self._lanes: dict[str, int] = {}
         self._t0 = time.perf_counter()
+        # wall-clock: one-shot anchor pairing _t0 with an epoch instant so a
+        # router can line up spans from recorders in different processes;
+        # never used for durations (those stay perf_counter-relative)
+        self._t0_unix = time.time()  # wall-clock: cross-process anchor
         self.capacity = capacity
         self.recorded = 0  # total ever recorded (ring evicts past capacity)
+        # trace_id -> deque of event tuples; FIFO eviction past capacity
+        self._traces: "collections.OrderedDict[str, collections.deque]" = \
+            collections.OrderedDict()
+        self._trace_capacity = trace_capacity
+        self._trace_span_capacity = trace_span_capacity
 
     # -- recording (hot path: O(1)) ----------------------------------------
     def record(self, name: str, lane: str = "host", t_start: float = 0.0,
                t_end: float = 0.0, attrs: Optional[dict] = None) -> None:
-        """Record one completed span (perf_counter begin/end seconds)."""
+        """Record one completed span (perf_counter begin/end seconds).
+
+        ``attrs["trace"]`` indexes the span under that trace for
+        :meth:`trace_events`; ``attrs["links"]`` (a list of trace_ids)
+        additionally files it under every linked trace — the OTel span-link
+        fan-in a coalesced batcher tick uses, so a tick serving 32 requests
+        appears in all 32 traces while being recorded exactly once.
+        """
         with self._lock:
             if lane not in self._lanes:
                 self._lanes[lane] = len(self._lanes)
-            self._events.append((name, lane, t_start, t_end, attrs))
+            ev = (name, lane, t_start, t_end, attrs)
+            self._events.append(ev)
             self.recorded += 1
+            if attrs:
+                tid = attrs.get("trace")
+                if tid is not None:
+                    self._index_trace(tid, ev)
+                for linked in attrs.get("links") or ():
+                    if linked != tid:
+                        self._index_trace(linked, ev)
+
+    def _index_trace(self, trace_id: str, ev: tuple) -> None:
+        """File one event under a trace id (caller holds the lock)."""
+        ring = self._traces.get(trace_id)
+        if ring is None:
+            while len(self._traces) >= self._trace_capacity:
+                self._traces.popitem(last=False)
+            ring = collections.deque(maxlen=self._trace_span_capacity)
+            self._traces[trace_id] = ring
+        ring.append(ev)
 
     def instant(self, name: str, lane: str = "host",
                 attrs: Optional[dict] = None) -> None:
@@ -120,6 +165,31 @@ class SpanRecorder:
                 "capacity": self.capacity,
                 "lanes": sorted(self._lanes, key=self._lanes.get),
             }
+
+    def trace_ids(self) -> list[str]:
+        """Retained trace ids, oldest first."""
+        with self._lock:
+            return list(self._traces)
+
+    def trace_events(self, trace_id: str) -> list:
+        """Retained event tuples for one trace (empty if unknown/evicted)."""
+        with self._lock:
+            ring = self._traces.get(trace_id)
+            return list(ring) if ring is not None else []
+
+    def trace_payload(self, trace_id: str, process: str = "") -> dict:
+        """Wire payload for ``GET /trace/id/{trace_id}``: this recorder's
+        retained spans for one trace, timestamps rebased to seconds since
+        recorder creation plus a wall-clock anchor (``t0_unix``) so a
+        stitcher can line up recorders from different processes."""
+        events = [
+            {"name": name, "lane": lane,
+             "t0": t0 - self._t0, "t1": t1 - self._t0,
+             **({"attrs": attrs} if attrs else {})}
+            for name, lane, t0, t1, attrs in self.trace_events(trace_id)
+        ]
+        return {"trace_id": trace_id, "process": process,
+                "t0_unix": self._t0_unix, "events": events}
 
     def lane_busy_s(self, lane: str) -> float:
         """Union-of-intervals busy seconds of one lane (overlapping spans
@@ -169,3 +239,46 @@ class SpanRecorder:
         with open(path, "w") as f:
             json.dump(self.to_chrome(), f)
         return path
+
+
+def stitch_traces(payloads: list[dict]) -> dict:
+    """Stitch per-process :meth:`SpanRecorder.trace_payload` dicts into one
+    Chrome ``trace_event`` file with one *process lane* per payload.
+
+    Each payload becomes a Chrome ``pid`` named after its ``process``
+    (router, replica id, ...); lanes within a payload keep their tids.
+    Timestamps are aligned across processes via each payload's wall-clock
+    anchor, rebased so the earliest span in the stitched trace is t=0 —
+    Perfetto then shows the router verb, both replicas' serve spans, and
+    the linked tick/step spans on one shared timeline.
+    """
+    payloads = [p for p in payloads if p and p.get("events")]
+    if not payloads:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    # absolute (epoch) start of the earliest span across all processes
+    base = min(p["t0_unix"] + e["t0"] for p in payloads for e in p["events"])
+    out = []
+    for pid, p in enumerate(payloads):
+        name = p.get("process") or f"process-{pid}"
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"name": name}})
+        out.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"sort_index": pid}})
+        lanes: dict[str, int] = {}
+        for e in p["events"]:
+            lane = e.get("lane", "host")
+            if lane not in lanes:
+                lanes[lane] = len(lanes)
+                out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                            "tid": lanes[lane], "args": {"name": lane}})
+            off = p["t0_unix"] - base
+            ev = {
+                "name": e["name"], "ph": "X", "pid": pid,
+                "tid": lanes[lane],
+                "ts": round((e["t0"] + off) * 1e6, 3),
+                "dur": round(max(0.0, e["t1"] - e["t0"]) * 1e6, 3),
+            }
+            if e.get("attrs"):
+                ev["args"] = e["attrs"]
+            out.append(ev)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

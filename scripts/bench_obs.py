@@ -1,0 +1,425 @@
+"""Observability bench -> OBS_FLEET_CPU_*.json (the ISSUE 19 evidence).
+
+Four passes, one artifact, every claim mechanical:
+
+  1. **Fleet tracing under chaos** — a 3-replica fleet behind the session
+     router, driven through ``serve_loadgen``'s free-run loop with
+     ``--fleet-chaos`` transport faults and ``--trace-sample``d label
+     requests. The claim: 0 errors AND every sampled trace fetched back
+     COMPLETE through the router's stitcher (route -> dispatch -> serve
+     -> tick -> step, cross-process), AND every /metrics latency
+     exemplar joins to retained spans. The full run repeats the pass
+     with a mid-load ``--rolling-restart-at`` (completeness held by the
+     router's span adoption; exemplars not claimed there — latency
+     rings rebuild with the restarted apps).
+  2. **Migration-spanning trace** — one session, one trace context,
+     labels before AND after a forced ``migrate_session``: the stitched
+     trace must show BOTH replicas' process lanes (plus the router's) —
+     the "one causal trace per label decision survives failover" proof.
+  3. **Non-perturbation** — the same deterministic single-worker workload
+     run with tracing on (every label traced) and with ``--no-trace``:
+     the recorder's session-stream decision rows must be IDENTICAL once
+     the additive ``trace_id`` field is dropped — tracing reads the
+     serving path, it never steers it. The traced pass must also show
+     ``trace_id`` on every row (the join the recorder claim is made of).
+     Overhead: min-of-N wall times, traced vs untraced, bounded ≤ 5%.
+  4. **SLO fire/clear** — a router with second-scale burn windows over a
+     replica with an injected ``slow_step`` tail: the ``label_p99``
+     objective must FIRE (both windows burning) while the tail lasts and
+     RESOLVE (fast-window hysteresis) once fast labels wash the ring,
+     with both alert transitions persisted to the tracking store.
+
+Run::
+
+    JAX_PLATFORMS=cpu python scripts/bench_obs.py --out OBS_FLEET_CPU_r19.json
+    python scripts/bench_obs.py --quick   # 2-replica smoke (not committed)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _loadgen_args(extra: list) -> object:
+    from serve_loadgen import parse_args as lg_parse
+
+    return lg_parse(["--synthetic", "4,64,4"] + extra)
+
+
+# ---------------------------------------------------------------------------
+# pass 1: fleet tracing under chaos (+ rolling restart)
+# ---------------------------------------------------------------------------
+
+def _one_fleet_run(extra: list) -> dict:
+    from serve_loadgen import run_loadgen
+
+    report = run_loadgen(_loadgen_args(extra))
+    t = report.get("tracing") or {}
+    return {
+        "n_errors": report["n_errors"],
+        "errors": report["errors"][:10],
+        "n_retries": report["n_retries"],
+        "requests_per_s": report["requests_per_s"],
+        "rolling_restart": (report["fleet"] or {}).get("rolling_restart"),
+        "chaos": ((report["fleet"] or {}).get("chaos") or {}).get("spec"),
+        "dropped_sessions": (report["fleet"] or {}).get("dropped_sessions"),
+        "tracing": {k: t.get(k) for k in (
+            "sample_rate", "sampled", "complete", "fetch_errors",
+            "completeness", "required_spans", "exemplars",
+            "exemplars_joinable", "exemplar_joinability")},
+        "sample_traces": (t.get("traces") or [])[:5],
+    }
+
+
+def fleet_pass(quick: bool) -> dict:
+    n = 2 if quick else 3
+    base = ["--fleet", str(n), "--workers", "4",
+            "--sessions", "8" if quick else "24",
+            "--labels", "4" if quick else "6",
+            "--retries", "10", "--trace-sample", "0.25"]
+    # sub-pass A (chaos, steady fleet): every sampled trace complete AND
+    # the /metrics latency exemplars join back to retained spans
+    chaos = _one_fleet_run(base + [
+        "--fleet-chaos",
+        "net_delay:every=11,ms=3" if quick else
+        "partition:edge=r0,after=30,times=10;net_delay:every=11,ms=3"])
+    out = {"replicas": n, "chaos_pass": chaos,
+           "n_errors": chaos["n_errors"]}
+    if quick:
+        return out
+    # sub-pass B (chaos + rolling restart): every replica is torn down
+    # and rebuilt mid-load — completeness holds because restart_replica
+    # hands each dying app's retained trace spans to the router's
+    # collector. Exemplars are NOT claimed here: the latency rings are
+    # rebuilt with the apps, so post-restart outliers are scarce by
+    # construction (the exemplar claim lives in sub-pass A).
+    restart = _one_fleet_run(base + [
+        "--rolling-restart-at", "0.5",
+        "--fleet-chaos", "net_delay:every=11,ms=3"])
+    out["restart_pass"] = restart
+    out["n_errors"] += restart["n_errors"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 2: one trace across a forced mid-session migration
+# ---------------------------------------------------------------------------
+
+def migration_trace_pass() -> dict:
+    from coda_tpu.serve.fleet import build_fleet
+    from coda_tpu.telemetry.trace import mint
+
+    args = _loadgen_args(["--workers", "2", "--sessions", "2"])
+    fleet = build_fleet(args, 2)
+    fleet.start(warm=True)
+    try:
+        router = fleet.router
+        out = router.open_session(seed=0)
+        sid = out["session"]
+        # placement is rendezvous-based until a migration pins it
+        src = router.owner_of(sid)
+        # ONE root context for the whole session's decision trace: every
+        # label below parents into the same trace_id
+        ctx = mint()
+        out = router.label(sid, int(out["idx"]) % 4, trace_ctx=ctx)
+        dst = next(r for r in fleet.replica_ids if r != src)
+        router.migrate_session(sid, src, dst)
+        out = router.label(sid, int(out["idx"]) % 4, trace_ctx=ctx)
+        stitched = router.collect_trace(ctx.trace_id)
+        names = [e["name"] for e in stitched["traceEvents"]
+                 if e.get("ph") == "X"]
+        procs = stitched["processes"]
+        return {
+            "trace_id": ctx.trace_id,
+            "src": src, "dst": dst,
+            "processes": procs,
+            "n_spans": len(names),
+            "replica_lanes": sorted(p for p in procs if p != "router"),
+            # the claim: the router's lane plus BOTH replicas' lanes hold
+            # spans of this one trace — the migration happened INSIDE it
+            "spans_both_replicas": (src in procs and dst in procs),
+            "router_lane": "router" in procs,
+            "migration_verified":
+                router.stats()["router"]["migration_verified"],
+        }
+    finally:
+        fleet.drain()
+
+
+# ---------------------------------------------------------------------------
+# pass 3: non-perturbation (bitwise rows) + overhead
+# ---------------------------------------------------------------------------
+
+def _traced_workload(app, n_labels: int, traced: bool) -> tuple:
+    """One deterministic single-stream session; returns (wall_s, sid)."""
+    from coda_tpu.telemetry.trace import mint
+
+    t0 = time.perf_counter()
+    out = app.open_session(seed=0)
+    sid = out["session"]
+    for _ in range(n_labels):
+        ctx = mint() if traced else None
+        out = app.label(sid, int(out["idx"]) % 4, trace_ctx=ctx)
+    app.close_session(sid)
+    return time.perf_counter() - t0, sid
+
+
+def _stream_rows(record_dir: str, sid: str) -> list:
+    import glob
+    import os
+
+    rows = []
+    for path in sorted(glob.glob(os.path.join(record_dir, "**", f"*{sid}*"),
+                                 recursive=True)):
+        with open(path) as f:
+            for line in f:
+                row = json.loads(line)
+                # only decision rows: meta/close markers carry wall-clock
+                # provenance that legitimately differs between runs
+                if "next_idx" in row:
+                    rows.append(row)
+    return rows
+
+
+def bitwise_pass(n_labels: int = 24) -> dict:
+    import os
+    import tempfile
+
+    from coda_tpu.serve.server import build_app
+
+    runs = {}
+    walls = {}
+    with tempfile.TemporaryDirectory() as td:
+        for mode, traced in (("traced", True), ("untraced", False)):
+            rd = os.path.join(td, mode)
+            args = _loadgen_args(["--workers", "1", "--sessions", "1"])
+            args.record_dir = rd
+            args.no_trace = not traced
+            app = build_app(args)
+            app.start(warm=True)
+            try:
+                wall, sid = _traced_workload(app, n_labels, traced)
+            finally:
+                app.drain()
+            runs[mode] = _stream_rows(rd, sid)
+            walls[mode] = wall
+    traced_rows = runs["traced"]
+    untraced_rows = runs["untraced"]
+    rows_traced = all("trace_id" in r and r["trace_id"]
+                      for r in traced_rows if r.get("do_update"))
+    stripped = [{k: v for k, v in r.items() if k != "trace_id"}
+                for r in traced_rows]
+    identical = (json.dumps(stripped, sort_keys=True)
+                 == json.dumps(untraced_rows, sort_keys=True))
+    first_diff = None
+    if not identical:
+        for i, (a, b) in enumerate(zip(stripped, untraced_rows)):
+            if a != b:
+                first_diff = {"row": i, "traced": a, "untraced": b}
+                break
+        if first_diff is None:
+            first_diff = {"row_counts": [len(stripped),
+                                         len(untraced_rows)]}
+    return {
+        "labels": n_labels,
+        "rows": [len(traced_rows), len(untraced_rows)],
+        "rows_carry_trace_id": rows_traced,
+        "identical": identical,
+        "first_diff": first_diff,
+        "wall_s": walls,
+    }
+
+
+def overhead_pass(n_labels: int = 200, reps: int = 4) -> dict:
+    """min-of-``reps`` wall time of the identical serial workload, every
+    label traced vs tracing disabled. Both apps stay alive and the reps
+    ALTERNATE modes, so slow container drift hits both sides equally; min
+    (not mean) because noise only ever ADDS time — the minima are the
+    honest comparison."""
+    from coda_tpu.serve.server import build_app
+
+    apps = {}
+    for mode, traced in (("untraced", False), ("traced", True)):
+        args = _loadgen_args(["--workers", "1", "--sessions", "1"])
+        args.no_trace = not traced
+        apps[mode] = build_app(args)
+        apps[mode].start(warm=True)
+    walls: dict = {"traced": [], "untraced": []}
+    try:
+        for mode, traced in (("untraced", False), ("traced", True)):
+            _traced_workload(apps[mode], 20, traced)  # page everything in
+        for _ in range(reps):
+            for mode, traced in (("untraced", False), ("traced", True)):
+                wall, _sid = _traced_workload(apps[mode], n_labels, traced)
+                walls[mode].append(wall)
+    finally:
+        for app in apps.values():
+            app.drain()
+    t, u = min(walls["traced"]), min(walls["untraced"])
+    return {
+        "labels": n_labels, "reps": reps,
+        "traced_s": walls["traced"], "untraced_s": walls["untraced"],
+        "traced_min_s": t, "untraced_min_s": u,
+        "per_label_us": {"traced": t / n_labels * 1e6,
+                         "untraced": u / n_labels * 1e6},
+        # clamped at 0: a negative delta is container noise, not a
+        # time-travelling tracer
+        "overhead_frac": max(0.0, (t - u) / u),
+    }
+
+
+# ---------------------------------------------------------------------------
+# pass 4: SLO fire + clear on an injected slow_step tail
+# ---------------------------------------------------------------------------
+
+def slo_pass() -> dict:
+    import os
+    import tempfile
+
+    from coda_tpu.serve.router import InprocReplica, SessionRouter
+    from coda_tpu.serve.server import build_app
+    from coda_tpu.tracking.store import TrackingStore
+
+    with tempfile.TemporaryDirectory() as td:
+        db = os.path.join(td, "slo.sqlite")
+        args = _loadgen_args(["--workers", "1", "--sessions", "1"])
+        # the tail: the first 5 dispatches each sleep 400 ms — far past
+        # the 250 ms label-p99 objective, gone once `times` is spent
+        args.fault_spec = "slow_step:every=1,times=5,ms=400"
+        app = build_app(args)
+        app.start(warm=True)
+        router = SessionRouter(
+            slo_fast_s=2.0, slo_slow_s=6.0,
+            slo_store=(lambda: TrackingStore(db)))
+        router.add_replica("r0", InprocReplica("r0", app))
+        router.start(poll_s=0.1)   # SLO sweep every 4th tick = 0.4 s
+        fired_at = cleared_at = None
+        try:
+            out = router.open_session(seed=0)
+            sid = out["session"]
+            t0 = time.perf_counter()
+            # phase 1: ride out the slow tail, then hold a slow-heavy
+            # ring until the sweeper fires (p99 > bound -> bad=1 -> both
+            # windows burn at 1/0.05 = 20x >= the fire threshold 8)
+            for _ in range(12):
+                out = router.label(sid, int(out["idx"]) % 4)
+            deadline = time.perf_counter() + 30
+            while time.perf_counter() < deadline:
+                snap = router.slo_snapshot()
+                if snap["objectives"]["label_p99"]["firing"]:
+                    fired_at = time.perf_counter() - t0
+                    break
+                time.sleep(0.1)
+            fired_snap = router.slo_snapshot()
+            # phase 2: wash the ring with fast labels until the 5 slow
+            # samples sink below the p99 cut (5/600 < 1%), then wait out
+            # the fast window's hysteresis for the resolve
+            deadline = time.perf_counter() + 120
+            while time.perf_counter() < deadline:
+                for _ in range(50):
+                    out = router.label(sid, int(out["idx"]) % 4)
+                snap = router.slo_snapshot()
+                st = snap["objectives"]["label_p99"]
+                if not st["firing"] and st["cleared_total"] >= 1:
+                    cleared_at = time.perf_counter() - t0
+                    break
+            final = router.slo_snapshot()
+            router.close_session(sid)
+        finally:
+            router.drain()
+            app.drain()
+        # read the alerts BACK from the tracking store, on this thread's
+        # own connection — the persistence half of the claim
+        store = TrackingStore(db)
+        persisted = {
+            state: store.is_finished("serve_slo", f"alert-label_p99-{state}")
+            for state in ("firing", "resolved")
+        }
+        store.close()
+    st = final["objectives"]["label_p99"]
+    return {
+        "objective": "label_p99",
+        "fault_spec": "slow_step:every=1,times=5,ms=400",
+        "windows_s": final["windows_s"],
+        "fired": st["fired_total"],
+        "cleared": st["cleared_total"],
+        "fired_at_s": fired_at,
+        "cleared_at_s": cleared_at,
+        "burn_fast_at_fire":
+            fired_snap["objectives"]["label_p99"]["burn_fast"],
+        "alerts": final["alerts"][-4:],
+        "store_flushed": final["store"]["flushed"],
+        "store_errors": final["store"]["errors"],
+        "persisted": persisted,
+        "persisted_both": all(persisted.values()),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--quick", action="store_true",
+                   help="2-replica smoke pass (smaller workload; do not "
+                        "commit the artifact)")
+    p.add_argument("--out", default=None,
+                   help="artifact path (default OBS_FLEET_CPU.json)")
+    args = p.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(None)
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    t0 = time.perf_counter()
+    print("== pass 1/4: fleet tracing under chaos ==", flush=True)
+    fleet = fleet_pass(args.quick)
+    print(json.dumps(fleet["chaos_pass"]["tracing"]), flush=True)
+    if "restart_pass" in fleet:
+        print(json.dumps(fleet["restart_pass"]["tracing"]), flush=True)
+    print("== pass 2/4: migration-spanning trace ==", flush=True)
+    migration = migration_trace_pass()
+    print(json.dumps({k: migration[k] for k in
+                      ("processes", "spans_both_replicas")}), flush=True)
+    print("== pass 3/4: non-perturbation + overhead ==", flush=True)
+    bitwise = bitwise_pass()
+    overhead = overhead_pass(n_labels=60 if args.quick else 200)
+    print(json.dumps({"identical": bitwise["identical"],
+                      "overhead_frac": overhead["overhead_frac"]}),
+          flush=True)
+    print("== pass 4/4: SLO fire/clear ==", flush=True)
+    slo = slo_pass()
+    print(json.dumps({k: slo[k] for k in
+                      ("fired", "cleared", "persisted_both")}), flush=True)
+
+    report = {
+        "bench": "bench_obs",
+        "quick": bool(args.quick),
+        "fingerprint": environment_fingerprint(knobs={
+            "bench": "bench_obs", "quick": bool(args.quick),
+            "replicas": fleet["replicas"],
+            "trace_sample": fleet["chaos_pass"]["tracing"]["sample_rate"],
+            "task": "synthetic-4,64,4"}),
+        "wall_s": time.perf_counter() - t0,
+        "n_errors": fleet["n_errors"],
+        "fleet": fleet,
+        "migration_trace": migration,
+        "bitwise": bitwise,
+        "overhead": overhead,
+        "slo": slo,
+    }
+    out = args.out or ("OBS_FLEET_CPU_quick.json" if args.quick
+                       else "OBS_FLEET_CPU.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"wrote {out} in {report['wall_s']:.1f}s")
+    return report
+
+
+if __name__ == "__main__":
+    main()

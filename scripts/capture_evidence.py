@@ -227,6 +227,14 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                  "--records-dir",
                  os.path.join(tmpdir, "robustness_records")],
                 os.path.join(tmpdir, "robustness.json"), 900),
+            # observability at proof scale: 2-replica chaos tracing,
+            # migration-spanning trace, bitwise on-vs-off, SLO
+            # fire/clear (the committed 3-replica + rolling-restart
+            # claim is OBS_FLEET_*)
+            "serve_obs": (
+                [py, "scripts/bench_obs.py", "--quick",
+                 "--out", os.path.join(tmpdir, "obs.json")],
+                os.path.join(tmpdir, "obs.json"), 900),
         }
     return {
         # the r09 evidence set the ROADMAP asks for, in one run
@@ -322,6 +330,14 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
              "--records-dir",
              os.path.join(tmpdir, "robustness_records")],
             os.path.join(tmpdir, "robustness.json"), 3600),
+        # observability in full (the OBS_FLEET_* configuration): the
+        # 3-replica chaos + rolling-restart tracing passes, the
+        # migration-spanning trace, bitwise non-perturbation + the
+        # <= 5% overhead bound, SLO fire/clear persisted to the store
+        "serve_obs": (
+            [py, "scripts/bench_obs.py",
+             "--out", os.path.join(tmpdir, "obs.json")],
+            os.path.join(tmpdir, "obs.json"), 3600),
     }
 
 

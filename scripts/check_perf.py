@@ -803,6 +803,95 @@ def legacy_matrix_check(report: dict) -> list[str]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# the observability contract (ISSUE 19 acceptance: OBS_FLEET_* holds the
+# complete-traces / non-perturbation / SLO fire+clear claims)
+# ---------------------------------------------------------------------------
+
+OBS_MAX_OVERHEAD = 0.05         # tracing wall-time overhead ceiling
+OBS_MIN_SAMPLED = 8             # sampled traces per fleet sub-pass
+
+
+def _obs_tracing_check(tag: str, sub: dict,
+                       want_exemplars: bool) -> list[str]:
+    """One fleet sub-pass's tracing claim: 0 errors, every sampled trace
+    fetched back complete through the router's stitcher."""
+    out: list[str] = []
+    if not isinstance(sub, dict):
+        return [f"{tag} missing"]
+    if sub.get("n_errors") != 0:
+        out.append(f"{tag}.n_errors {sub.get('n_errors')} != 0")
+    if sub.get("dropped_sessions"):
+        out.append(f"{tag}.dropped_sessions != 0")
+    t = sub.get("tracing") or {}
+    if (t.get("sampled") or 0) < OBS_MIN_SAMPLED:
+        out.append(f"{tag}.tracing.sampled {t.get('sampled')} < "
+                   f"{OBS_MIN_SAMPLED}")
+    if t.get("completeness") != 1.0:
+        out.append(f"{tag}.tracing.completeness {t.get('completeness')} "
+                   "!= 1.0 (a sampled trace lost spans)")
+    if t.get("fetch_errors"):
+        out.append(f"{tag}.tracing.fetch_errors != 0")
+    if want_exemplars:
+        if not (t.get("exemplars") or 0):
+            out.append(f"{tag}.tracing.exemplars is 0 (no /metrics "
+                       "latency exemplar to join)")
+        elif t.get("exemplar_joinability") != 1.0:
+            out.append(f"{tag}.tracing.exemplar_joinability "
+                       f"{t.get('exemplar_joinability')} != 1.0")
+    return out
+
+
+def obs_check_report(report: dict) -> list[str]:
+    """Violations of one observability report (scripts/bench_obs.py)."""
+    out: list[str] = []
+    fleet = report.get("fleet") or {}
+    out += _obs_tracing_check("fleet.chaos_pass",
+                              fleet.get("chaos_pass"),
+                              want_exemplars=True)
+    # the committed (non-quick) artifact must also prove traces survive
+    # a rolling restart via the router's span adoption
+    if not report.get("quick"):
+        sub = fleet.get("restart_pass")
+        out += _obs_tracing_check("fleet.restart_pass", sub,
+                                  want_exemplars=False)
+        rr = (sub or {}).get("rolling_restart") or {}
+        if rr.get("replicas_restarted") != fleet.get("replicas"):
+            out.append("fleet.restart_pass: rolling restart did not "
+                       "cycle every replica")
+    mig = report.get("migration_trace") or {}
+    if mig.get("spans_both_replicas") is not True:
+        out.append("migration_trace.spans_both_replicas is not true "
+                   "(the trace lost one side of the migration)")
+    if mig.get("router_lane") is not True:
+        out.append("migration_trace.router_lane is not true")
+    if len(mig.get("processes") or ()) < 3:
+        out.append(f"migration_trace.processes {mig.get('processes')} "
+                   "has < 3 lanes (router + both replicas)")
+    bit = report.get("bitwise") or {}
+    if bit.get("identical") is not True:
+        out.append("bitwise.identical is not true (tracing perturbed "
+                   f"the decision stream: {bit.get('first_diff')})")
+    if bit.get("rows_carry_trace_id") is not True:
+        out.append("bitwise.rows_carry_trace_id is not true (traced "
+                   "rows lost the recorder join)")
+    ov = report.get("overhead") or {}
+    frac = ov.get("overhead_frac")
+    if not (isinstance(frac, (int, float)) and frac <= OBS_MAX_OVERHEAD):
+        out.append(f"overhead.overhead_frac {frac} > {OBS_MAX_OVERHEAD}")
+    slo = report.get("slo") or {}
+    if not (slo.get("fired") or 0) >= 1:
+        out.append("slo.fired < 1 (the burn-rate alert never fired)")
+    if not (slo.get("cleared") or 0) >= 1:
+        out.append("slo.cleared < 1 (the alert never resolved)")
+    if slo.get("persisted_both") is not True:
+        out.append("slo.persisted_both is not true (alert transitions "
+                   "missing from the tracking store)")
+    if slo.get("store_errors"):
+        out.append(f"slo.store_errors {slo.get('store_errors')} != 0")
+    return out
+
+
 EVIDENCE_SCHEMA_VERSION = 1
 EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
                        "multichip_replay")
@@ -812,7 +901,8 @@ EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
 EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered",
                                 "bench_batchq", "serve_fleet",
                                 "serve_fleet_chaos", "bench_surrogate",
-                                "oracle_noise", "bench_prior")
+                                "oracle_noise", "bench_prior",
+                                "serve_obs")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -912,6 +1002,9 @@ def _evidence_check(report: dict) -> list[str]:
         if asyn.get("lost") or asyn.get("double_applied"):
             out.append("oracle_noise.report.async lost/double-applied "
                        "labels != 0")
+    rep = (arts.get("serve_obs") or {}).get("report") or {}
+    if rep:
+        out += [f"serve_obs: {v}" for v in obs_check_report(rep)]
     rep = (arts.get("bench") or {}).get("report") or {}
     if rep and not (isinstance(rep.get("value"), (int, float))
                     and rep["value"] > 0):
@@ -1155,6 +1248,27 @@ CONTRACTS: tuple = (
         checker=legacy_matrix_check, fingerprint="grandfathered",
         note="single-replica recovery matrix (r10/r13 layout: "
              "{scenario: violations}, committed clean)"),
+    # -- fleet observability (distributed tracing + SLO watchtower) --
+    Contract(
+        pattern="OBS_FLEET_*.json", kind="serve_obs",
+        required=("bench", "fingerprint.backend", "n_errors",
+                  "fleet.chaos_pass.tracing.completeness",
+                  "migration_trace.processes", "bitwise.identical",
+                  "overhead.overhead_frac", "slo.fired", "slo.cleared",
+                  "slo.persisted_both"),
+        bounds=(("bench", "==", "bench_obs"), ("n_errors", "==", 0)),
+        checker=obs_check_report, fingerprint="required",
+        group="obs",
+        regress=("overhead.overhead_frac", "lower", 1.0),
+        note="fleet tracing + SLO watchtower (ISSUE 19): every sampled "
+             "trace complete through the cross-process stitcher under "
+             "chaos AND through a rolling restart (span adoption), one "
+             "trace spanning a mid-session migration across both "
+             "replicas' lanes, /metrics exemplars joinable, decision "
+             "stream bitwise-identical with tracing on vs off, <= 5% "
+             "overhead, burn-rate alert fired AND cleared on an "
+             "injected slow_step tail with both transitions persisted "
+             "to the tracking store"),
     # -- one-run evidence manifests --
     Contract(
         pattern="EVIDENCE_*.json", kind="evidence_manifest",
@@ -1317,7 +1431,7 @@ def discover(root: str) -> list[str]:
     """The gated artifact set at one repo root."""
     paths = []
     for pat in ("BENCH_*.json", "EVIDENCE_*.json", "IMAGENET_*.json",
-                "FAULT_MATRIX_*.json"):
+                "FAULT_MATRIX_*.json", "OBS_*.json", "ROBUSTNESS_*.json"):
         paths += glob.glob(os.path.join(root, pat))
     return sorted(paths)
 

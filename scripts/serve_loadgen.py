@@ -76,8 +76,15 @@ class InprocClient:
     def open(self, seed):
         return self.app.open_session(seed=seed)
 
-    def label(self, sid, label, request_id=None):
-        return self.app.label(sid, label, request_id=request_id)
+    def label(self, sid, label, request_id=None, trace=None):
+        return self.app.label(sid, label, request_id=request_id,
+                              trace_ctx=trace)
+
+    def fetch_trace(self, trace_id):
+        """(span names, contributing processes) for one trace id."""
+        p = self.app.trace_by_id(trace_id)
+        names = [e["name"] for e in p.get("events") or ()]
+        return names, (["server"] if names else [])
 
     def labels(self, sid, labels, request_id=None):
         return self.app.labels(sid, labels, request_id=request_id)
@@ -116,8 +123,16 @@ class RouterClient:
     def open(self, seed):
         return self.router.open_session(seed=seed)
 
-    def label(self, sid, label, request_id=None):
-        return self.router.label(sid, label, request_id=request_id)
+    def label(self, sid, label, request_id=None, trace=None):
+        return self.router.label(sid, label, request_id=request_id,
+                                 trace_ctx=trace)
+
+    def fetch_trace(self, trace_id):
+        """(span names, process lanes) from the router's stitched trace."""
+        out = self.router.collect_trace(trace_id)
+        names = [e["name"] for e in out.get("traceEvents") or ()
+                 if e.get("ph") == "X"]
+        return names, list(out.get("processes") or [])
 
     def labels(self, sid, labels, request_id=None):
         return self.router.labels(sid, labels, request_id=request_id)
@@ -133,24 +148,43 @@ class HttpClient:
     def __init__(self, url):
         self.url = url.rstrip("/")
 
-    def _req(self, method, path, body=None):
+    def _req(self, method, path, body=None, headers=None):
         import urllib.request
 
         data = None if body is None else json.dumps(body).encode()
+        h = {"Content-Type": "application/json"}
+        if headers:
+            h.update(headers)
         req = urllib.request.Request(
-            self.url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            self.url + path, data=data, method=method, headers=h)
         with urllib.request.urlopen(req, timeout=60) as r:
             return json.loads(r.read())
 
     def open(self, seed):
         return self._req("POST", "/session", {"seed": seed})
 
-    def label(self, sid, label, request_id=None):
+    def label(self, sid, label, request_id=None, trace=None):
         body = {"label": label}
         if request_id is not None:
             body["request_id"] = request_id
-        return self._req("POST", f"/session/{sid}/label", body)
+        headers = None
+        if trace is not None:
+            from coda_tpu.telemetry.trace import TRACE_HEADER
+
+            headers = {TRACE_HEADER: trace.header()}
+        return self._req("POST", f"/session/{sid}/label", body,
+                         headers=headers)
+
+    def fetch_trace(self, trace_id):
+        """(span names, processes): a router front door answers the
+        stitched Chrome file, a bare replica its own wire payload."""
+        out = self._req("GET", f"/trace/id/{trace_id}")
+        if "traceEvents" in out:
+            names = [e["name"] for e in out["traceEvents"]
+                     if e.get("ph") == "X"]
+            return names, list(out.get("processes") or [])
+        names = [e["name"] for e in out.get("events") or ()]
+        return names, (["server"] if names else [])
 
     def labels(self, sid, labels, request_id=None):
         body = {"labels": list(labels)}
@@ -220,6 +254,91 @@ def with_retries(fn, retries: int, backoff_s: float, counter=None):
             attempt += 1
 
 
+class TraceSampler:
+    """``--trace-sample RATE``: deterministic stride sampling of label
+    requests for client-minted trace contexts. The sampled trace_ids are
+    fetched back through the front door after the run (stitched across
+    every process lane by a router) and scored for completeness — the
+    end-to-end proof that context propagation survived transport, batcher
+    coalescing, and any mid-run failover."""
+
+    def __init__(self, rate: float):
+        self.rate = float(rate or 0.0)
+        self.stride = max(1, round(1.0 / self.rate)) if self.rate > 0 else 0
+        self._n = 0
+        self._lock = threading.Lock()
+        self.sampled: list = []
+
+    def next_ctx(self):
+        """A fresh root context for this label, or None (unsampled)."""
+        if not self.stride:
+            return None
+        from coda_tpu.telemetry.trace import mint
+
+        with self._lock:
+            self._n += 1
+            if self._n % self.stride:
+                return None
+            ctx = mint()
+            self.sampled.append(ctx.trace_id)
+            return ctx
+
+
+def _trace_report(client, tracer, exemplar_tids, expect_router):
+    """The report's ``tracing`` section: per-sampled-trace completeness
+    (did the route/dispatch/serve/tick/step causal chain survive?) and
+    exemplar joinability (does every /metrics outlier's trace_id resolve
+    to retained spans?)."""
+    required = ["serve/", "tick/", "step/"]
+    if expect_router:
+        required += ["route/", "dispatch/"]
+    traces = []
+    complete = fetch_errors = 0
+    for tid in tracer.sampled:
+        try:
+            names, procs = client.fetch_trace(tid)
+        except Exception as e:
+            fetch_errors += 1
+            traces.append({"trace_id": tid, "error": repr(e)})
+            continue
+        missing = [p for p in required
+                   if not any(n.startswith(p) for n in names)]
+        ok = not missing
+        complete += ok
+        entry = {"trace_id": tid, "spans": len(names),
+                 "processes": procs, "complete": ok}
+        if missing:
+            entry["missing"] = missing
+        traces.append(entry)
+    joinable = 0
+    ex_tids = sorted(set(exemplar_tids))
+    for tid in ex_tids:
+        try:
+            names, _ = client.fetch_trace(tid)
+            joinable += bool(names)
+        except Exception:
+            pass
+    n = len(tracer.sampled)
+    return {
+        "sample_rate": tracer.rate,
+        "sampled": n,
+        "complete": complete,
+        "fetch_errors": fetch_errors,
+        "completeness": (complete / n) if n else None,
+        "required_spans": required,
+        "traces": traces[:32],
+        "exemplars": len(ex_tids),
+        "exemplars_joinable": joinable,
+        "exemplar_joinability": (joinable / len(ex_tids)) if ex_tids
+        else None,
+    }
+
+
+def _exemplar_tids(snap: dict) -> list:
+    return [ex["trace_id"] for ex in (snap.get("exemplars") or {}).values()
+            if ex and ex.get("trace_id")]
+
+
 class AsyncConn:
     """One persistent keep-alive connection to the asyncio front door —
     each mux session coroutine holds its own, so 256 concurrent sessions
@@ -262,7 +381,8 @@ class AsyncConn:
 # ---------------------------------------------------------------------------
 
 def _free_run(client, n_classes, workers, sessions, labels_per_session,
-              latencies, errors, retries=0, backoff_s=0.05, retried=None):
+              latencies, errors, retries=0, backoff_s=0.05, retried=None,
+              tracer=None):
     """Default arrival model: W workers race through the session budget."""
     counter = {"next": 0}
     lock = threading.Lock()
@@ -293,8 +413,14 @@ def _free_run(client, n_classes, workers, sessions, labels_per_session,
                     # retries: the server dedupes, so a retried label is
                     # applied to the posterior exactly once
                     lab, rid = int(out["idx"]) % n_classes, uuid.uuid4().hex
+                    # the sampled context is minted ONCE per logical label
+                    # (stable across retries, like the request_id): a
+                    # retried label's attempts all land in one trace, so a
+                    # mid-trace failover shows both replicas' lanes
+                    tctx = tracer.next_ctx() if tracer is not None else None
                     out = with_retries(
-                        lambda: client.label(sid, lab, request_id=rid),
+                        lambda: client.label(sid, lab, request_id=rid,
+                                             trace=tctx),
                         retries, backoff_s, retried)
                     latencies.append(time.perf_counter() - t0)
                 # the double-apply sentinel: the server-side label count
@@ -878,7 +1004,7 @@ def _router_span_breakdown(router) -> dict:
 
 
 def _fleet_workload(args, n_replicas, latencies, errors, retried,
-                    migration):
+                    migration, tracer=None):
     """One fleet pass: build N replicas + router, drive the free-run
     workload through the router, optionally rolling-restart every replica
     mid-run. Returns (fleet, wall_s, rolling_report)."""
@@ -929,7 +1055,7 @@ def _fleet_workload(args, n_replicas, latencies, errors, retried,
     t0 = time.perf_counter()
     _free_run(client, n_classes, args.workers, args.sessions, args.labels,
               latencies, errors, retries=args.retries, backoff_s=backoff_s,
-              retried=retried)
+              retried=retried, tracer=tracer)
     if restarter is not None:
         restarter.join(timeout=120)
     wall = time.perf_counter() - t0
@@ -988,9 +1114,17 @@ def _run_fleet_loadgen(args) -> dict:
     errors: list = []
     retried: list = []
     migration: dict = {}
+    tracer = TraceSampler(getattr(args, "trace_sample", 0.0))
     fleet, wall, rolling = _fleet_workload(args, n, latencies, errors,
-                                           retried, migration)
+                                           retried, migration,
+                                           tracer=tracer)
     stats = fleet.router.stats()
+    tracing = None
+    if tracer.stride:
+        ex_tids = [t for snap in stats["replicas"].values()
+                   if "error" not in snap for t in _exemplar_tids(snap)]
+        tracing = _trace_report(RouterClient(fleet.router), tracer,
+                                ex_tids, expect_router=True)
     spans = _router_span_breakdown(fleet.router)
     per_replica: dict = {}
     total_req = 0
@@ -1046,6 +1180,9 @@ def _run_fleet_loadgen(args) -> dict:
         "n_retries": len(retried),
         "retried": retried[:20],
         "migration": migration or None,
+        # --trace-sample evidence: per-sampled-trace completeness through
+        # the stitched router collector + exemplar -> trace joinability
+        "tracing": tracing,
         "fleet": {
             "replicas": n,
             "capacity_per_replica": max(2, -(-args.capacity // n)),
@@ -1218,6 +1355,14 @@ def run_loadgen(args) -> dict:
             target=_rolling_restart,
             args=(client, args, migration, errors),
             daemon=True, name="loadgen-migrate").start()
+    tracer = TraceSampler(getattr(args, "trace_sample", 0.0))
+    if tracer.stride and (args.lockstep or args.mux or
+                          getattr(args, "zipf", None) is not None or
+                          oracle_cfg is not None or
+                          (lpr is not None and lpr > 1)):
+        raise SystemExit("--trace-sample rides the free-run label loop; "
+                         "drop --lockstep/--mux/--zipf/--oracle-noise/"
+                         "--labels-per-round")
     t_start = time.perf_counter()
     zipf_info: dict = {}
     if args.lockstep:
@@ -1274,13 +1419,19 @@ def run_loadgen(args) -> dict:
         _free_run(client, n_classes, args.workers, args.sessions,
                   args.labels, latencies, errors,
                   retries=args.retries, backoff_s=backoff_s,
-                  retried=retried)
+                  retried=retried, tracer=tracer)
         mode = "free_run"
     wall = time.perf_counter() - t_start
 
     if migration and isinstance(client, InprocClient):
         app = client.app   # stats/drain target the post-migration server
     stats = client.stats() if app is None else app.stats()
+    tracing = None
+    if tracer.stride:
+        # fetch BEFORE shutdown/drain: traces live in the server's span
+        # recorder, and --url fetches ride the live HTTP front door
+        tracing = _trace_report(client, tracer, _exemplar_tids(stats),
+                                expect_router=False)
     spans = _span_breakdown(app)
     # tiered-store evidence (the --zipf workload's whole point): open
     # sessions across all three tiers vs slab occupancy, paging counters,
@@ -1416,6 +1567,10 @@ def run_loadgen(args) -> dict:
         # ran): exported == imported == replay_verified means zero dropped
         # sessions and every migrated stream bitwise-verified
         "migration": migration or None,
+        # --trace-sample evidence: sampled label traces fetched back from
+        # the front door, scored for serve/tick/step completeness, plus
+        # exemplar -> trace joinability
+        "tracing": tracing,
         # tiered-store evidence (--zipf mode): open sessions vs slab
         # occupancy, paging counters, hot-set residency hit rate, wake
         # latency vs one tick, and peak RSS
@@ -1607,6 +1762,15 @@ def parse_args(argv=None):
                         "abstention rate, and reorder depth next to the "
                         "latency rings (with --labels-per-round Q the "
                         "rounds are Q wide)")
+    p.add_argument("--trace-sample", type=float, default=0.0,
+                   metavar="RATE",
+                   help="sample this fraction of label requests with a "
+                        "client-minted trace context (deterministic "
+                        "stride, free-run / --fleet modes); after the run "
+                        "every sampled trace is fetched back from the "
+                        "front door (stitched across process lanes by a "
+                        "router) and the report's tracing section scores "
+                        "completeness + exemplar->trace joinability")
     p.add_argument("--http", action="store_true",
                    help="drive the in-process app over real HTTP instead "
                         "of direct calls")

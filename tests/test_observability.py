@@ -1,0 +1,433 @@
+"""Observability tests: distributed tracing + the SLO watchtower
+(``telemetry/trace.py`` / ``telemetry/spans.py`` / ``telemetry/slo.py``
+and their serve-layer plumbing).
+
+The load-bearing claims: (1) burn-rate alerting follows the multi-window
+state machine — fire only when BOTH windows burn, clear on fast-window
+hysteresis, never before ``min_samples`` — against an injected clock, no
+sleeping; (2) tracing is purely observational: the same seeded session
+with tracing on vs off yields BITWISE-identical decision rows (trace_id
+is additive-optional); (3) one trace that crosses a forced mid-session
+migration stitches into one file holding the router's AND both replicas'
+process lanes, and a rolling-restarted replica's spans survive via the
+router's span adoption; (4) /metrics latency exemplars are joinable —
+their trace_id fetches retained spans — and the exemplar syntax is
+lint-legal exactly on gauge/histogram families; (5) the HTTP fleet front
+door serves /metrics (per-replica-labeled families + slo_*), /fleet/slo
+and /trace/id/{id} over real HTTP against subprocess replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+H, N, C = 4, 48, 4
+_ROW_KEYS = ("next_idx", "next_prob", "best", "pbest_max", "pbest_entropy")
+
+
+@pytest.fixture(scope="module")
+def task():
+    from coda_tpu.data import make_synthetic_task
+
+    return make_synthetic_task(seed=0, H=H, N=N, C=C)
+
+
+def _app(task, tracing=True, **kw):
+    from coda_tpu.serve import SelectorSpec, ServeApp
+
+    app = ServeApp(capacity=4, max_wait=0.001,
+                   spec=SelectorSpec.create("coda", n_parallel=4),
+                   tracing=tracing, **kw)
+    app.add_task(task.name, task.preds)
+    return app
+
+
+def _fleet(task, n=2, warm=False):
+    from coda_tpu.serve import Fleet
+
+    def make(rid):
+        return _app(task)
+
+    return Fleet(make, n_replicas=n).start(warm=warm)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate window math (injected clock — no sleeping)
+# ---------------------------------------------------------------------------
+
+def _sweeper(**kw):
+    from coda_tpu.telemetry.slo import SLObjective, SloSweeper
+
+    obj = SLObjective("unit", "synthetic bad fraction",
+                      lambda snap: snap.get("bad"), budget=0.05)
+    defaults = dict(fast_s=10.0, slow_s=60.0, min_samples=3,
+                    clock=lambda: 0.0)
+    defaults.update(kw)
+    return SloSweeper([obj], **defaults)
+
+
+def test_burn_rate_fires_only_when_both_windows_burn():
+    sw = _sweeper()
+    # two bad samples: under min_samples, must NOT fire
+    assert sw.observe({"bad": 1.0}, t=0.0) == []
+    assert sw.observe({"bad": 1.0}, t=1.0) == []
+    st = sw.snapshot()["objectives"]["unit"]
+    assert not st["firing"] and st["burn_fast"] == pytest.approx(20.0)
+    # third sample crosses min_samples with both windows at 20x: fires
+    evs = sw.observe({"bad": 1.0}, t=2.0)
+    assert [e["state"] for e in evs] == ["firing"]
+    st = sw.snapshot()["objectives"]["unit"]
+    assert st["firing"] and st["fired_total"] == 1
+    # refire is not a new alert while still firing
+    assert sw.observe({"bad": 1.0}, t=3.0) == []
+
+
+def test_burn_rate_slow_window_vetoes_a_fast_blip():
+    # a long good history keeps the SLOW window cold: a fast burst alone
+    # must not page (the multi-window point)
+    sw = _sweeper()
+    for i in range(55):
+        assert sw.observe({"bad": 0.0}, t=float(i)) == []
+    for t in (55.0, 56.0, 57.0, 58.0, 59.0):
+        assert sw.observe({"bad": 1.0}, t=t) == []
+    st = sw.snapshot()["objectives"]["unit"]
+    assert st["burn_fast"] >= sw.fire_threshold   # fast IS burning
+    assert st["burn_slow"] < sw.fire_threshold    # slow veto
+    assert not st["firing"]
+
+
+def test_burn_rate_clear_hysteresis():
+    sw = _sweeper()
+    for t in (0.0, 1.0, 2.0):
+        sw.observe({"bad": 1.0}, t=t)
+    assert sw.snapshot()["objectives"]["unit"]["firing"]
+    # good samples, but the bad ones are still inside the fast window:
+    # burn stays >= clear_threshold, the alert must NOT flap
+    evs = []
+    for t in (3.0, 4.0, 5.0):
+        evs += sw.observe({"bad": 0.0}, t=t)
+    assert evs == []
+    assert sw.snapshot()["objectives"]["unit"]["firing"]
+    # once the window slides past the bad burst, fast burn -> 0: resolve
+    evs = sw.observe({"bad": 0.0}, t=13.5)
+    assert [e["state"] for e in evs] == ["resolved"]
+    st = sw.snapshot()["objectives"]["unit"]
+    assert not st["firing"] and st["cleared_total"] == 1
+    assert [a["state"] for a in sw.snapshot()["alerts"]] == \
+        ["firing", "resolved"]
+
+
+def test_burn_rate_no_data_probe_never_burns():
+    sw = _sweeper()
+    for t in (0.0, 1.0, 2.0, 3.0):
+        assert sw.observe({}, t=t) == []      # probe returns None
+    st = sw.snapshot()["objectives"]["unit"]
+    assert st["no_data"] and not st["firing"]
+    assert st["window_samples"] == [0, 0]
+
+
+def test_slo_alerts_flush_via_store_factory_from_worker_thread(tmp_path):
+    """The store may be a zero-arg factory, resolved lazily on whichever
+    thread flushes first — the sqlite thread-affinity contract."""
+    from coda_tpu.tracking.store import TrackingStore
+
+    db = str(tmp_path / "slo.sqlite")
+    sw = _sweeper(store=lambda: TrackingStore(db))
+
+    def drive():
+        for t in (0.0, 1.0, 2.0):
+            sw.observe({"bad": 1.0}, t=t)
+        for t in (11.0, 12.0, 13.0):
+            sw.observe({"bad": 0.0}, t=t)
+
+    th = threading.Thread(target=drive)
+    th.start()
+    th.join()
+    snap = sw.snapshot()
+    assert snap["store"] == {"flushed": 2, "errors": 0}
+    store = TrackingStore(db)
+    try:
+        assert store.is_finished("serve_slo", "alert-unit-firing")
+        assert store.is_finished("serve_slo", "alert-unit-resolved")
+    finally:
+        store.close()
+
+
+def test_default_fleet_slos_probe_router_snapshot(task):
+    """The shipped objective set evaluates a real fleet snapshot without
+    error, and the label_p99 probe flags a p99 beyond its bound."""
+    from coda_tpu.telemetry.slo import default_fleet_slos
+
+    objs = {o.name: o for o in default_fleet_slos(label_p99_ms=250.0)}
+    fleet = _fleet(task)
+    try:
+        out = fleet.router.open_session(seed=0)
+        for _ in range(2):
+            out = fleet.router.label(out["session"], int(out["idx"]) % C)
+        snap = fleet.stats()
+        vals = {name: o.probe(snap) for name, o in objs.items()}
+        assert vals["label_p99"] in (0.0, 1.0)
+        assert vals["error_ratio"] == 0.0
+        # the bound is a knob: an absurdly tight one must read as bad
+        tight = {o.name: o
+                 for o in default_fleet_slos(label_p99_ms=1e-6)}
+        assert tight["label_p99"].probe(snap) == 1.0
+    finally:
+        fleet.drain()
+
+
+# ---------------------------------------------------------------------------
+# tracing: non-perturbation + cross-process stitching
+# ---------------------------------------------------------------------------
+
+def _run_session(app, n_labels, traced):
+    from coda_tpu.telemetry.trace import mint
+
+    out = app.open_session(seed=5)
+    sid = out["session"]
+    for _ in range(n_labels):
+        ctx = mint() if traced else None
+        out = app.label(sid, int(out["idx"]) % C, trace_ctx=ctx)
+    return sid
+
+
+def test_tracing_on_vs_off_bitwise_rows(task):
+    on, off = _app(task, tracing=True), _app(task, tracing=False)
+    on.start(warm=False)
+    off.start(warm=False)
+    try:
+        sid_on = _run_session(on, 6, traced=True)
+        sid_off = _run_session(off, 6, traced=False)
+        rows_on = on.recorder.history(sid_on)
+        rows_off = off.recorder.history(sid_off)
+        assert len(rows_on) == len(rows_off) == 7
+        for a, b in zip(rows_on, rows_off):
+            for k in _ROW_KEYS:
+                va, vb = a[k], b[k]
+                if isinstance(va, float):
+                    assert np.float32(va).tobytes() == \
+                        np.float32(vb).tobytes(), (k, va, vb)
+                else:
+                    assert va == vb, (k, va, vb)
+        # the join is additive-optional: present on traced LABEL rows,
+        # absent (not null) everywhere in the untraced stream
+        assert all(r.get("trace_id") for r in rows_on if r["do_update"])
+        assert all("trace_id" not in r for r in rows_off)
+    finally:
+        on.drain(timeout=10)
+        off.drain(timeout=10)
+
+
+def test_trace_spans_forced_migration_across_both_lanes(task):
+    from coda_tpu.telemetry.trace import mint
+
+    fleet = _fleet(task)
+    try:
+        router = fleet.router
+        out = router.open_session(seed=3)
+        sid = out["session"]
+        src = router.owner_of(sid)
+        ctx = mint()
+        out = router.label(sid, int(out["idx"]) % C, trace_ctx=ctx)
+        dst = next(r for r in fleet.replica_ids if r != src)
+        info = router.migrate_session(sid, src, dst)
+        assert info.get("migrated") == sid
+        router.label(sid, int(out["idx"]) % C, trace_ctx=ctx)
+        stitched = router.collect_trace(ctx.trace_id)
+        assert stitched["trace_id"] == ctx.trace_id
+        assert set(stitched["processes"]) >= {"router", src, dst}
+        names = [e["name"] for e in stitched["traceEvents"]
+                 if e.get("ph") == "X"]
+        for prefix in ("route/", "dispatch/", "serve/", "tick/"):
+            assert any(n.startswith(prefix) for n in names), (prefix,
+                                                              names)
+    finally:
+        fleet.drain()
+
+
+def test_restart_adopts_spans_so_traces_survive(task):
+    """restart_replica rebuilds the app (fresh SpanRecorder) — the
+    router must adopt the dying replica's retained spans so the trace
+    keeps that replica's lane afterwards."""
+    from coda_tpu.telemetry.trace import mint
+
+    fleet = _fleet(task)
+    try:
+        router = fleet.router
+        out = router.open_session(seed=1)
+        sid = out["session"]
+        rid = router.owner_of(sid)
+        ctx = mint()
+        router.label(sid, int(out["idx"]) % C, trace_ctx=ctx)
+        before = set(router.collect_trace(ctx.trace_id)["processes"])
+        assert rid in before
+        fleet.restart_replica(rid, warm=False)
+        after = router.collect_trace(ctx.trace_id)
+        assert rid in after["processes"], after["processes"]
+        # adoption + the live (empty) post-restart recorder must not
+        # duplicate the lane
+        assert after["processes"].count(rid) == 1
+    finally:
+        fleet.drain()
+
+
+# ---------------------------------------------------------------------------
+# exemplars: /metrics -> trace join + lint legality
+# ---------------------------------------------------------------------------
+
+def test_latency_exemplars_join_to_retained_spans(task):
+    from coda_tpu.telemetry.prometheus import lint, render
+
+    app = _app(task, tracing=True)
+    app.start(warm=False)
+    try:
+        _run_session(app, 6, traced=True)
+        exemplars = {ring: ex
+                     for ring, ex in (app.metrics.snapshot()
+                                      .get("exemplars") or {}).items()
+                     if ex and ex.get("trace_id")}
+        assert "request_latency" in exemplars
+        for ex in exemplars.values():
+            payload = app.trace_by_id(ex["trace_id"])
+            assert payload["events"], ex   # the join lands on real spans
+        text = render(registry=app.telemetry.registry,
+                      serve_metrics=app.metrics)
+        assert " # {trace_id=\"" in text
+        assert lint(text) == []
+    finally:
+        app.drain(timeout=10)
+
+
+def test_lint_exemplar_rules():
+    from coda_tpu.telemetry.prometheus import lint
+
+    good = ('# TYPE g gauge\n'
+            'g{ring="request_latency"} 0.25 # {trace_id="abc"} 0.25\n')
+    assert lint(good) == []
+    on_counter = ('# TYPE c counter\n'
+                  'c_total 3 # {trace_id="abc"} 3\n')
+    assert any("only legal on" in v for v in lint(on_counter))
+    malformed = ('# TYPE g gauge\n'
+                 'g 0.25 # {trace_id=abc} 0.25\n')
+    assert any("malformed exemplar labels" in v for v in lint(malformed))
+
+
+# ---------------------------------------------------------------------------
+# the HTTP fleet front door (subprocess replicas — satellite: the
+# HTTP-fleet metrics gap)
+# ---------------------------------------------------------------------------
+
+def test_http_fleet_metrics_slo_and_trace_endpoints(task):
+    import os
+    import re
+    import subprocess
+    import sys
+    import time as _time
+    import urllib.request
+
+    from coda_tpu.serve import HttpReplica, SessionRouter, make_server
+    from coda_tpu.telemetry.prometheus import lint
+    from coda_tpu.telemetry.trace import TRACE_HEADER, mint
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs, urls = [], {}
+    router = None
+    try:
+        for rid in ("h0", "h1"):
+            p = subprocess.Popen(
+                [sys.executable, "-u", "-m", "coda_tpu.cli", "serve",
+                 "--synthetic", f"{H},{N},{C}", "--port", "0",
+                 "--capacity", "4", "--no-warm"],
+                cwd=repo, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            procs.append(p)
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline:
+                line = p.stdout.readline()
+                m = re.search(r"http://127\.0\.0\.1:(\d+)/", line or "")
+                if m:
+                    urls[rid] = f"http://127.0.0.1:{m.group(1)}"
+                    break
+                if p.poll() is not None:
+                    raise RuntimeError(f"replica {rid} died at startup")
+            assert rid in urls, "replica never announced its port"
+        for url in urls.values():
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(url + "/healthz",
+                                                timeout=2):
+                        break
+                except Exception:
+                    _time.sleep(0.2)
+        router = SessionRouter({rid: HttpReplica(rid, url)
+                                for rid, url in urls.items()},
+                               slo_fast_s=5.0, slo_slow_s=30.0)
+        srv = make_server(router, 0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+
+        def req(method, path, body=None, headers=None):
+            data = None if body is None else json.dumps(body).encode()
+            rq = urllib.request.Request(base + path, data=data,
+                                        method=method,
+                                        headers=headers or {})
+            with urllib.request.urlopen(rq, timeout=60) as resp:
+                return resp.status, resp.read()
+
+        ctx = mint()
+        code, body = req("POST", "/session", {"seed": 2},
+                         headers={TRACE_HEADER: ctx.header()})
+        out = json.loads(body)
+        sid = out["session"]
+        code, body = req("POST", f"/session/{sid}/label",
+                         {"label": int(out["idx"]) % C},
+                         headers={TRACE_HEADER: ctx.header()})
+        assert code == 200 and json.loads(body)["n_labeled"] == 1
+
+        # /metrics: per-replica-labeled serve families over real HTTP,
+        # plus the slo_* families once the sweeper has observed, and the
+        # whole exposition lint-clean
+        router.slo.observe(router.stats())
+        code, body = req("GET", "/metrics")
+        text = body.decode()
+        assert code == 200
+        assert re.search(r'coda_serve_requests_total\{replica="h0"\} ',
+                         text)
+        assert re.search(r'coda_serve_requests_total\{replica="h1"\} ',
+                         text)
+        assert 'coda_slo_firing{slo="label_p99"}' in text
+        assert lint(text) == []
+
+        # /fleet/slo: the watchtower's JSON face at the front door
+        code, body = req("GET", "/fleet/slo")
+        slo = json.loads(body)
+        assert code == 200
+        assert set(slo["objectives"]) >= {"label_p99", "error_ratio"}
+        assert slo["windows_s"] == {"fast": 5.0, "slow": 30.0}
+
+        # /trace/id/{id}: the stitched cross-process trace — the
+        # router's lane plus the serving replica's, fetched over the
+        # same HTTP transport the verbs ride
+        code, body = req("GET", f"/trace/id/{ctx.trace_id}")
+        stitched = json.loads(body)
+        assert code == 200 and stitched["trace_id"] == ctx.trace_id
+        procs_seen = set(stitched["processes"])
+        assert "router" in procs_seen
+        assert procs_seen & {"h0", "h1"}, stitched["processes"]
+        names = [e["name"] for e in stitched["traceEvents"]
+                 if e.get("ph") == "X"]
+        assert any(n.startswith("route/") for n in names)
+        assert any(n.startswith("serve/") for n in names)
+    finally:
+        if router is not None:
+            router.drain()
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=10)

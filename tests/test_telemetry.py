@@ -91,7 +91,8 @@ def test_span_lane_busy_folds_overlaps():
 # ---------------------------------------------------------------------------
 
 _SAMPLE_RE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (-?[0-9.eE+]+|NaN|[+-]Inf)$")
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (-?[0-9.eE+]+|NaN|[+-]Inf)"
+    r"( # \{[^{}]*\} (-?[0-9.eE+]+|NaN|[+-]Inf))?$")  # optional exemplar
 
 
 def _validate_exposition(text: str) -> dict:
