@@ -70,6 +70,20 @@ def annotator_accuracy(rel: ReliabilityState) -> jnp.ndarray:
     return jnp.diagonal(conf, axis1=-2, axis2=-1).mean(-1)
 
 
+def accuracy_movement(prev_acc, acc) -> float:
+    """Mean |Δ posterior-mean accuracy| per annotator between two reads
+    of :func:`annotator_accuracy` — the drift observable the decision-
+    quality plane's ``crowd_reliability`` detector consumes
+    (``telemetry/quality.py``): a converged crowd holds this near 0;
+    a sustained shift means the annotator pool changed under the fleet
+    (churn, degradation, or an attack ramping up)."""
+    import numpy as np
+
+    prev_acc = np.asarray(prev_acc, np.float64)
+    acc = np.asarray(acc, np.float64)
+    return float(np.abs(acc - prev_acc).mean())
+
+
 def aggregate_votes(rel: ReliabilityState, ann_ids, responses, answered,
                     cfg: CrowdConfig):
     """One round's E-step + trust gate + M-step.

@@ -538,6 +538,28 @@ def verify_replay(record: RunRecord, selector_factory, preds, labels,
     return report
 
 
+def record_calibration(record: RunRecord) -> dict:
+    """P(best)-vs-realized-best calibration of one ground-truth record.
+
+    A flight record carries both sides of the question the online
+    monitor cannot answer in production: per round, ``pbest_max`` is the
+    posterior mass the method put on its current argmax model, and
+    ``regret`` is that pick's accuracy gap to the true best — the argmax
+    WAS (one of) the realized best exactly when the regret is 0. The
+    reliability curve over the two (``telemetry/quality.py``'s binning)
+    is the suite/bench calibration verdict for the amortized-gate and
+    surrogate rungs — an online curve, not just the 2.34e-4 static
+    bound. Per-seed curves plus the pooled verdict."""
+    from coda_tpu.telemetry.quality import pbest_calibration
+
+    out = {"seeds": [], "pooled": pbest_calibration(
+        record.arrays["pbest_max"], record.arrays["regret"])}
+    for s in range(record.seeds):
+        out["seeds"].append(pbest_calibration(
+            record.arrays["pbest_max"][s], record.arrays["regret"][s]))
+    return out
+
+
 def format_triage(report: ReplayReport) -> str:
     """Human-readable verdict block (the CLI's stdout)."""
     lines = []

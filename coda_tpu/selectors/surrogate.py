@@ -162,6 +162,23 @@ def parse_scorer(spec: str) -> Optional[int]:
         "integer k >= 1, e.g. 'surrogate:64')")
 
 
+def gate_pressure(margin, tol: float = SURROGATE_SCORE_TOL) -> float:
+    """Map the live escape-gate margin (``surrogate_stats``'s
+    ``contract_margin``) into a [0, ∞) drift observable for the
+    decision-quality plane (``telemetry/quality.py``): 0 when the exact
+    shortlist dominates every unrefreshed prediction by ≥ ``tol``
+    (plenty of headroom), 1.0 exactly at a zero margin (the escape gate
+    about to trip), > 1 once the gate is actively forcing fallbacks. A
+    pre-warmup / absent margin (None or non-finite) reads as 0 — no
+    surrogate round has been gated yet, so there is nothing drifting."""
+    if margin is None:
+        return 0.0
+    m = float(margin)
+    if not np.isfinite(m):
+        return 0.0
+    return max(0.0, 1.0 - m / float(tol))
+
+
 class SurrogateFit(NamedTuple):
     """The carried surrogate state: normal equations + solved weights +
     per-class Beta summaries + the gate's evidence counters.

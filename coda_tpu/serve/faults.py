@@ -123,6 +123,13 @@ FAULT_SITES = {
     # parking + dedupe layer keeps labels exactly-once regardless.
     "oracle_poison": "oracle_answer",
     "oracle_abstain": "oracle_answer",
+    # decision-quality plane (telemetry/quality.py): fired by the shadow
+    # auditor just before it replays a sampled session's stream, applied
+    # OUT-OF-BAND — the auditor ulp-tampers its in-memory COPY of the
+    # rows (the session's real stream is untouched), so the bench can
+    # prove a single-ulp stream corruption is caught and attributed to
+    # the exact session + round
+    "stream_tamper": "audit_pre",
 }
 
 _CRASH_EXIT_CODE = 17  # distinguishable from python tracebacks (1) in tests

@@ -122,6 +122,11 @@ class ServeMetrics:
         # () -> dict of the v3 store's segment/index/compaction gauges,
         # surfaced under snapshot["spill"]. None when no spill dir.
         self.spill_provider = None
+        # decision-quality plane provider (telemetry/quality.py): a
+        # () -> dict of calibration / drift / shadow-audit evidence,
+        # surfaced under snapshot["quality"]. None when --no-quality —
+        # the families are then ABSENT, not zero (spill's contract).
+        self.quality_provider = None
         # OpenMetrics exemplars: per-ring, the most recent TRACED sample
         # whose latency cleared the ring's p99 (gate lazily refreshed from
         # the percentile reduction each snapshot — the record path stays a
@@ -347,6 +352,14 @@ class ServeMetrics:
                 spill = provider()
                 if spill:
                     snap["spill"] = spill
+            except Exception:
+                pass
+        provider = self.quality_provider
+        if provider is not None:
+            try:
+                quality = provider()
+                if quality:
+                    snap["quality"] = quality
             except Exception:
                 pass
         return snap

@@ -37,6 +37,14 @@ method + spec kwargs MINUS the knobs that do not change the feature
 space (the scorer's ``k``, ``surrogate_prior`` itself, ``acq_batch`` —
 a q=8 session's fit statistics live in the same 16-feature space as a
 q=1 session's and transfer across).
+
+Staleness evidence (r20): the pool timestamps every per-key touch
+(contribute / merged delta / adopted snapshot) so ``/stats`` and
+``/metrics`` carry ``prior_pool_staleness_seconds`` (the age of the
+LEAST recently refreshed pool) and per-pool contribution ages — one half
+of the learned-decay sensor the ROADMAP asks for; the decision-quality
+plane's ``prior_staleness`` drift detector and the shadow auditor's
+seeded-vs-cold gap (``telemetry/quality.py``) are the other half.
 """
 
 from __future__ import annotations
@@ -44,7 +52,8 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 from coda_tpu.selectors.surrogate import (
     SURROGATE_PRIOR_DECAY,
@@ -84,15 +93,23 @@ def bucket_pool_key(app, bucket) -> str:
 
 class PriorPool:
     """Thread-safe map of pool key -> merged :class:`PriorStats`, plus
-    the since-last-drain delta the router exchange ships."""
+    the since-last-drain delta the router exchange ships.
+
+    ``clock`` is injectable (wall-clock seconds) so staleness tests
+    drive synthetic ages without sleeping."""
 
     def __init__(self, decay: float = SURROGATE_PRIOR_DECAY,
-                 min_rounds: float = SURROGATE_PRIOR_MIN_ROUNDS):
+                 min_rounds: float = SURROGATE_PRIOR_MIN_ROUNDS,
+                 clock: Callable[[], float] = time.time):
         self.decay = float(decay)
         self.min_rounds = float(min_rounds)
+        self._clock = clock
         self._lock = threading.Lock()
         self._pools: dict[str, PriorStats] = {}
         self._delta: dict[str, PriorStats] = {}
+        # key -> wall-clock second of the last statistic fold (the
+        # staleness axis a learned decay schedule regresses against)
+        self._touched: dict[str, float] = {}
         self.sessions_contributed = 0   # accepted contributions
         self.contributions_skipped = 0  # below min_rounds / degenerate
 
@@ -124,6 +141,7 @@ class PriorPool:
             # it merges, so decay is never applied twice to one statistic
             self._delta[key] = merge_fits(
                 self._delta.get(key, empty_prior()), contrib)
+            self._touched[key] = self._clock()
             self.sessions_contributed += 1
         return True
 
@@ -167,6 +185,7 @@ class PriorPool:
                 self._pools[key] = fold_prior(
                     self._pools.get(key, empty_prior()), contrib,
                     decay=self.decay)
+                self._touched[key] = self._clock()
                 if count:
                     self.sessions_contributed += max(
                         1, int(contrib.sessions))
@@ -176,10 +195,14 @@ class PriorPool:
     # -- persistence / replacement ----------------------------------------
     def snapshot(self) -> dict:
         """JSON-safe full-pool snapshot (tracking-store persistence and
-        the router's push half of the exchange)."""
+        the router's push half of the exchange). ``touched`` carries the
+        per-key contribution timestamps so staleness survives the
+        exchange/restart round-trip — a pool that comes back from the
+        router is as old as its statistics, not reborn at adoption."""
         with self._lock:
             return {"v": 1,
                     "sessions_contributed": self.sessions_contributed,
+                    "touched": dict(self._touched),
                     "pools": {k: prior_to_dict(p)
                               for k, p in self._pools.items()}}
 
@@ -194,8 +217,18 @@ class PriorPool:
                 pools[key] = prior_from_dict(d)
             except (KeyError, TypeError, ValueError):
                 continue
+        touched_in = (snap or {}).get("touched") or {}
+        now = self._clock()
         with self._lock:
             self._pools = pools
+            # keep the snapshot's ages where it has them; a key the
+            # snapshot never timestamped (pre-r20 snapshot) reads as
+            # touched now — fresh-by-assumption beats infinitely-stale
+            self._touched = {
+                key: float(touched_in[key])
+                if isinstance(touched_in.get(key), (int, float)) else now
+                for key in pools
+            }
             n = len(pools)
             sc = (snap or {}).get("sessions_contributed")
             if isinstance(sc, (int, float)):
@@ -203,7 +236,23 @@ class PriorPool:
                     self.sessions_contributed, int(sc))
         return n
 
+    # -- staleness ---------------------------------------------------------
+    def pool_ages(self) -> dict:
+        """Per-pool seconds since the last statistic fold."""
+        now = self._clock()
+        with self._lock:
+            return {key: max(0.0, now - t)
+                    for key, t in self._touched.items()}
+
+    def staleness_seconds(self) -> Optional[float]:
+        """Age of the LEAST recently refreshed pool (None when empty) —
+        the scalar ``prior_pool_staleness_seconds`` gauge: the worst-case
+        decay target a learned schedule has to answer for."""
+        ages = self.pool_ages()
+        return max(ages.values()) if ages else None
+
     def stats(self) -> dict:
+        ages = self.pool_ages()
         with self._lock:
             return {
                 "pools": len(self._pools),
@@ -212,4 +261,7 @@ class PriorPool:
                 "pending_delta": len(self._delta),
                 "rounds_pooled": float(sum(p.rounds
                                            for p in self._pools.values())),
+                "staleness_seconds": (max(ages.values()) if ages else None),
+                "pool_ages_seconds": {k: round(v, 3)
+                                      for k, v in sorted(ages.items())},
             }

@@ -573,7 +573,13 @@ class SessionRouter:
         # the aggregated fleet snapshot on the health-poll thread;
         # multi-window burn rates, typed fire/clear alerts (flushed to
         # ``slo_store`` when given), coda_slo_* gauges on /metrics
-        self.slo = SloSweeper(default_fleet_slos(),
+        # availability/latency/recovery objectives PLUS the decision-
+        # quality plane's (shadow-audit divergence, calibration ECE,
+        # drift firing) — quality probes read each replica snapshot's
+        # "quality" section and report no-data when --no-quality hid it
+        from coda_tpu.telemetry.quality import quality_slos
+
+        self.slo = SloSweeper(default_fleet_slos() + quality_slos(),
                               registry=self.telemetry.registry,
                               store=slo_store,
                               fast_s=slo_fast_s, slow_s=slo_slow_s)
@@ -1542,6 +1548,59 @@ class SessionRouter:
         """``GET /fleet/slo``: objectives, burn rates, firing state,
         recent alerts (the SLO watchtower's JSON face)."""
         return self.slo.snapshot()
+
+    def quality_scorecard(self) -> dict:
+        """``GET /fleet/quality`` at the fleet front door: each replica's
+        decision-quality scorecard plus one fleet-level verdict (worst
+        replica wins per organ — one diverged auditor grades the fleet
+        diverged). Replicas running ``--no-quality`` are listed as
+        disabled rather than silently dropped."""
+        from coda_tpu.telemetry.quality import CALIBRATION_MIN_SAMPLES
+
+        st = self.stats()
+        per: dict[str, dict] = {}
+        worst_ece = None
+        any_audit = False
+        diverged = firing = False
+        for rid, snap in st["replicas"].items():
+            if "error" in snap:
+                per[rid] = {"error": snap["error"]}
+                continue
+            q = snap.get("quality")
+            if not isinstance(q, dict):
+                per[rid] = {"enabled": False}
+                continue
+            per[rid] = q
+            audit = q.get("audit") or {}
+            if audit.get("audits_total"):
+                any_audit = True
+                if (audit.get("divergences_recent") or 0) > 0:
+                    diverged = True
+            for cal in (q.get("calibration") or {}).values():
+                ece = cal.get("ece")
+                # same evidence floor as CalibrationMonitor.worst_ece:
+                # thin per-replica buckets must not grade the fleet
+                if (cal.get("n") or 0) < CALIBRATION_MIN_SAMPLES:
+                    continue
+                if ece is not None and (worst_ece is None
+                                        or ece > worst_ece):
+                    worst_ece = ece
+            if any(d.get("firing")
+                   for d in (q.get("drift") or {}).values()):
+                firing = True
+        return {
+            "role": "router",
+            "replicas": per,
+            "verdict": {
+                "calibration": ("no_data" if worst_ece is None else
+                                ("ok" if worst_ece <= 0.25
+                                 else "miscalibrated")),
+                "worst_ece": worst_ece,
+                "audit": ("diverged" if diverged
+                          else ("ok" if any_audit else "no_data")),
+                "drift": "firing" if firing else "ok",
+            },
+        }
 
     def adopt_trace_payloads(self, payloads: list) -> int:
         """Take custody of per-trace span payloads from a replica that is

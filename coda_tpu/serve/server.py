@@ -97,7 +97,8 @@ class ServeApp:
                  tier_spill_dir: Optional[str] = None,
                  idle_warm_s: float = 30.0, idle_cold_s: float = 120.0,
                  max_warm: int = 8192, tier_free_fraction: float = 0.0,
-                 tracing: bool = True):
+                 tracing: bool = True, quality: bool = True,
+                 quality_audit_frac: float = 0.25):
         from coda_tpu.serve.faults import FaultInjector
         from coda_tpu.serve.recovery import BucketHealer
         from coda_tpu.serve.tiering import TierManager
@@ -132,12 +133,28 @@ class ServeApp:
                 getattr(self.recorder, "faults", None) is None:
             # an injected recorder joins the fault domain too (record_eio)
             self.recorder.faults = self.faults
+        # decision-quality plane (telemetry/quality.py): live calibration
+        # of the served posterior, drift detectors, and the shadow auditor
+        # that bitwise-replays a sample of closed sessions off the batcher
+        # thread. NEVER read by dispatch math — `--no-quality` and
+        # quality-on produce bitwise-identical decision rows (the only
+        # stream delta is the additive-optional `pred_label_prob` field).
+        self.quality = None
+        if quality:
+            from coda_tpu.telemetry.quality import QualityPlane
+
+            self.quality = QualityPlane(
+                preds_fn=self.store.task_preds, faults=self.faults,
+                registry=self.telemetry.registry,
+                audit_frac=quality_audit_frac)
+            self.metrics.quality_provider = self.quality.snapshot
         self.batcher = Batcher(self.store, self.metrics,
                                max_batch=max_batch, max_wait=max_wait,
                                max_linger=max_linger,
                                telemetry=self.telemetry,
                                recorder=self.recorder,
-                               faults=self.faults)
+                               faults=self.faults,
+                               quality=self.quality)
         # surrogate-scorer evidence (--eig-scorer surrogate:k buckets):
         # /stats and /metrics read the slab-carried fit counters on
         # demand through the snapshot provider — never a per-tick sync
@@ -311,6 +328,8 @@ class ServeApp:
         """Graceful shutdown: refuse new sessions, finish queued requests."""
         self.quiesce(timeout=timeout)
         self.recorder.close_all()
+        if self.quality is not None:
+            self.quality.stop()
         self._executor.shutdown(wait=False)
 
     def _auto_seed(self) -> int:
@@ -1048,6 +1067,19 @@ class ServeApp:
                 self.contribute_prior(sess, sess.bucket.slot_fit(sess.slot))
             except Exception:
                 pass  # a close must never fail on pool bookkeeping
+        if self.quality is not None and not sess.parked \
+                and self.quality.should_audit(sid):
+            # shadow audit: capture the stream and the session's seeding
+            # facts BEFORE close tears them down; the replay itself runs
+            # on the audit worker thread against a scratch slot
+            try:
+                rows = self.recorder.history(sid)
+                if rows:
+                    self.quality.maybe_enqueue_audit(
+                        sess.bucket, sid, sess.seed, rows,
+                        prior=sess.prior_fit, task=sess.task)
+            except Exception:
+                pass  # a close must never fail on audit bookkeeping
         self.store.close(sid)
         self.recorder.close(sid)
         if self.tiers is not None:
@@ -1282,6 +1314,11 @@ class ServeApp:
                 s.get("prior_rejects", 0) for s in per.values()),
             "prior_pools": pool["pools"],
             "prior_rounds_pooled": pool["rounds_pooled"],
+            # r20 staleness satellite: age of the least recently refreshed
+            # pool (None until the first contribution lands) + per-pool
+            # contribution ages — /metrics renders both
+            "prior_pool_staleness_seconds": pool["staleness_seconds"],
+            "prior_pool_ages_seconds": pool["pool_ages_seconds"],
         }
 
     def sync_prior(self, pool_snap: Optional[dict] = None) -> dict:
@@ -1414,6 +1451,13 @@ class ServeApp:
         ]
         if self.prior_pool is not None:
             snap["prior_pool"] = self.prior_pool.stats()
+        if self.quality is not None:
+            # fold THIS pass's live signals (surrogate gate pressure,
+            # prior staleness-regret) into the drift detectors, then
+            # re-read the plane so the snapshot reflects the fold it
+            # just caused rather than lagging one /stats pass behind
+            self.quality.feed_serve_stats(snap["buckets"], snap)
+            snap["quality"] = self.quality.snapshot()
         snap["warm_error"] = self.warm_error
         snap["recorder_degraded_streams"] = int(
             getattr(self.recorder, "degraded_streams", 0))
@@ -1421,6 +1465,14 @@ class ServeApp:
         if self.faults is not None:
             snap["faults"] = self.faults.snapshot()
         return snap
+
+    def quality_scorecard(self) -> Optional[dict]:
+        """``GET /fleet/quality`` on a single replica: this plane's
+        scorecard (the fleet router overrides this with the per-replica
+        aggregate). None with ``--no-quality`` — the route 404s."""
+        if self.quality is None:
+            return None
+        return self.quality.scorecard()
 
     def _payload(self, sess, res: Optional[dict]) -> dict:
         out = {
@@ -1803,6 +1855,12 @@ class AsyncHTTPServer:
             # firing state, recent alerts
             return await loop.run_in_executor(app._executor,
                                               app.slo_snapshot)
+        if method == "GET" and path == "/fleet/quality":
+            # the decision-quality scorecard: a router aggregates its
+            # replicas' planes; a single replica grades its own
+            scorecard = getattr(app, "quality_scorecard", None)
+            if scorecard is not None:
+                return await loop.run_in_executor(app._executor, scorecard)
         return None
 
 
@@ -1949,6 +2007,19 @@ def parse_args(argv=None):
                         "Tracing never perturbs session math — on and "
                         "off produce bitwise-identical trajectories — "
                         "so this is purely an overhead lever")
+    p.add_argument("--no-quality", action="store_true",
+                   help="disable the decision-quality plane "
+                        "(telemetry/quality.py): streaming calibration of "
+                        "the served posterior, drift detectors, and the "
+                        "shadow auditor that bitwise-replays a sample of "
+                        "closed sessions. The plane never perturbs "
+                        "session math — on and off produce "
+                        "bitwise-identical decision rows — so this is "
+                        "purely an overhead lever")
+    p.add_argument("--quality-audit-frac", type=float, default=0.25,
+                   help="fraction of closing sessions the shadow auditor "
+                        "re-replays (deterministic per-sid hash sample; "
+                        "0 disables auditing but keeps calibration/drift)")
     p.add_argument("--slo-fast-s", type=float, default=300.0,
                    help="SLO watchtower fast burn-rate window (seconds); "
                         "fleet router only")
@@ -2015,6 +2086,8 @@ def build_app(args) -> ServeApp:
         max_warm=getattr(args, "max_warm", 8192),
         tier_free_fraction=getattr(args, "tier_free_frac", 0.0),
         tracing=not getattr(args, "no_trace", False),
+        quality=not getattr(args, "no_quality", False),
+        quality_audit_frac=getattr(args, "quality_audit_frac", 0.25),
     )
     if args.task or args.synthetic:
         ds = load_dataset(args)
@@ -2083,6 +2156,11 @@ def main(argv=None):
             app.metrics.log_to_store(store, params={
                 "method": app.spec.method,
                 "capacity": app.store.capacity})
+            if app.quality is not None:
+                # the shutdown quality scorecard next to the metrics
+                # rows (experiment serve_quality)
+                app.quality.log_to_store(store, params={
+                    "method": app.spec.method})
             if app.prior_pool is not None:
                 app.save_prior_pool(store)  # the restart-survival half
             store.close()

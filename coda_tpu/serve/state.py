@@ -382,6 +382,7 @@ class Bucket:
         self._step_exec = None
         self._init_exec = None
         self._pbest_exec = None
+        self._pbest_at = None
         self._write_exec = None
         # warm() probes whether init is key-independent (true for every
         # selector in this framework: priors/caches are deterministic
@@ -884,6 +885,29 @@ class Bucket:
             else self._get_pbest
         return np.asarray(fn(self.slot_state(slot)))
 
+    def pbest_at(self, slot: int):
+        """:meth:`pbest` without the per-leaf host indexing: ONE jitted
+        call gathers the slot's state inside the executable and folds it
+        straight into ``get_pbest``. Same values as :meth:`pbest`; this
+        is the quality plane's per-tick read (``slot_state``'s
+        ``tree.map`` of host-side index ops was measurable at serving
+        rates). The slot index is a traced argument, so every slot
+        shares one compile."""
+        import jax
+
+        if self._get_pbest is None:
+            return None
+        self._check_available()
+        self._apply_staged()
+        if self._pbest_at is None:
+            gp = self._get_pbest
+
+            def _at(states, s):
+                return gp(jax.tree.map(lambda x: x[s], states))
+
+            self._pbest_at = jax.jit(_at)
+        return np.asarray(self._pbest_at(self.states, slot))
+
     # -- checkpoint / heal support (serve/recovery.py drives these) --------
     def _ensure_digest_fn(self):
         import jax
@@ -1203,6 +1227,14 @@ class SessionStore:
     def task_meta(self, name: str) -> dict:
         with self.lock:
             return dict(self._meta[name])
+
+    def task_preds(self, name: str):
+        """The task's registered (H, N, C) prediction tensor, or None —
+        the quality plane's consensus-pi_hat read (the array is written
+        once at registration and never mutated, so callers may read it
+        without holding the store lock afterwards)."""
+        with self.lock:
+            return self._tasks.get(name)
 
     def has_fast_admission(self, task: str, spec: SelectorSpec) -> bool:
         """Whether admission for this (task, spec) is pure sub-ms host

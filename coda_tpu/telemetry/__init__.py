@@ -37,6 +37,17 @@ from coda_tpu.telemetry.costs import (
 )
 from coda_tpu.telemetry.prometheus import lint as lint_prometheus
 from coda_tpu.telemetry.prometheus import render as render_prometheus
+from coda_tpu.telemetry.quality import (
+    CalibrationMonitor,
+    CusumDetector,
+    DriftBank,
+    PageHinkley,
+    QualityPlane,
+    ShadowAuditor,
+    pbest_calibration,
+    quality_slos,
+    reliability_curve,
+)
 from coda_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -66,15 +77,21 @@ from coda_tpu.telemetry.trace import parse as parse_trace
 __all__ = [
     "COSTS",
     "CROSS_BACKEND_SCORE_TOL",
+    "CalibrationMonitor",
     "CostBook",
     "CostTracked",
     "Counter",
+    "CusumDetector",
+    "DriftBank",
     "Gauge",
+    "PageHinkley",
+    "QualityPlane",
     "RECORD_SCHEMA_VERSION",
     "Registry",
     "RunRecord",
     "SLObjective",
     "SessionRecorder",
+    "ShadowAuditor",
     "SloSweeper",
     "SpanRecorder",
     "TRACE_HEADER",
@@ -94,7 +111,10 @@ __all__ = [
     "lint_prometheus",
     "mint_trace",
     "parse_trace",
+    "pbest_calibration",
+    "quality_slos",
     "registry_hooked",
+    "reliability_curve",
     "render_prometheus",
     "roofline",
     "sample_device_memory",

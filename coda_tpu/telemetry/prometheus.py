@@ -173,6 +173,10 @@ _SERVE_SCALARS = [
      "Distinct (task, pool-fingerprint) priors this replica holds"),
     ("prior_rounds_pooled", "serve_prior_rounds_pooled", "gauge",
      "Decay-weighted audited rounds aggregated across all pool priors"),
+    ("prior_pool_staleness_seconds", "serve_prior_pool_staleness_seconds",
+     "gauge",
+     "Age of the LEAST recently refreshed prior pool (seconds since its "
+     "last statistic fold) — the learned-decay sensor's staleness axis"),
 ]
 
 # spill store v3 evidence (serve/spill.py, nested under snapshot["spill"]):
@@ -198,6 +202,108 @@ _SERVE_SPILL = [
      "Frames the last startup had to scan past the persisted index "
      "(0 = pure O(index) startup)"),
 ]
+
+# decision-quality plane (telemetry/quality.py, nested under
+# snapshot["quality"]): absent (not zero) with --no-quality. Each entry
+# is (suffix, kind, help, extract) where extract(quality_snapshot)
+# returns [(extra_labels, value)] — shared by the single-replica and
+# fleet render paths (the fleet path merges a replica label in).
+
+def _q_audit(key):
+    def extract(q):
+        v = (q.get("audit") or {}).get(key)
+        return [] if v is None else [({}, v)]
+    return extract
+
+
+def _q_scalar(key):
+    def extract(q):
+        v = q.get(key)
+        return [] if v is None else [({}, v)]
+    return extract
+
+
+def _q_calibration(key):
+    def extract(q):
+        return [({"task": task}, cal[key])
+                for task, cal in sorted((q.get("calibration") or {}).items())
+                if cal.get(key) is not None]
+    return extract
+
+
+def _q_drift(key):
+    def extract(q):
+        out = []
+        for name, det in sorted((q.get("drift") or {}).items()):
+            # absent, not zero: a detector whose signal never fed (e.g.
+            # surrogate gate pressure on an exact-scorer server) exports
+            # no series — families only exist where the signal runs
+            if not det.get("observations"):
+                continue
+            v = det.get(key)
+            if v is not None:
+                out.append(({"detector": name},
+                            float(v) if not isinstance(v, bool)
+                            else (1.0 if v else 0.0)))
+        return out
+    return extract
+
+
+_SERVE_QUALITY = [
+    ("quality_audits_total", "counter",
+     "Closed sessions the shadow auditor bitwise-re-replayed",
+     _q_audit("audits_total")),
+    ("quality_audits_skipped_total", "counter",
+     "Shadow audits skipped (scratch slab full / replay setup failure)",
+     _q_audit("audits_skipped")),
+    ("quality_audit_rounds_verified_total", "counter",
+     "Recorded decision rounds bitwise-verified by shadow replays",
+     _q_audit("rounds_verified")),
+    ("quality_audit_divergences_total", "counter",
+     "Shadow replays that bitwise-diverged from the recorded stream "
+     "(must stay 0 on a healthy fleet)",
+     _q_audit("divergences_total")),
+    ("quality_audit_divergences_recent", "gauge",
+     "Divergences inside the recent attribution window",
+     _q_audit("divergences_recent")),
+    ("quality_audit_tampered_total", "counter",
+     "Audits whose stream copy was deliberately ulp-tampered by fault "
+     "injection (each must show up as a divergence)",
+     _q_audit("tampered_total")),
+    ("quality_audit_prior_gap", "gauge",
+     "Seeded-vs-cold shadow-replay decision gap (EWMA fraction of "
+     "warmup rounds where the pool prior changed the pick; a healthy "
+     "prior keeps this HIGH — it is actually steering)",
+     _q_audit("prior_gap")),
+    ("quality_audit_queue_drops_total", "counter",
+     "Audit candidates dropped because the audit queue was full",
+     _q_scalar("audit_queue_drops")),
+    ("quality_pre_dispatch_errors_total", "counter",
+     "Calibration pre-dispatch reads that raised (decision math is "
+     "never affected; the round just goes unobserved)",
+     _q_scalar("pre_dispatch_errors")),
+    ("quality_calibration_rounds", "gauge",
+     "Labeled rounds folded into the task's calibration accumulators",
+     _q_calibration("n")),
+    ("quality_calibration_ece", "gauge",
+     "Streaming expected calibration error of the served posterior's "
+     "predicted-label confidence, per task",
+     _q_calibration("ece")),
+    ("quality_calibration_brier", "gauge",
+     "Streaming Brier score of the served posterior's predicted-label "
+     "confidence, per task",
+     _q_calibration("brier")),
+    ("quality_drift_statistic", "gauge",
+     "Current drift-detector statistic (CUSUM s / Page-Hinkley m-min)",
+     _q_drift("statistic")),
+    ("quality_drift_firing", "gauge",
+     "Whether the drift detector is currently firing (0/1)",
+     _q_drift("firing")),
+    ("quality_drift_fired_total", "counter",
+     "Drift-detector fire transitions since start",
+     _q_drift("fired_total")),
+]
+
 
 _SERVE_SUMMARIES = [
     ("dispatch_latency", "serve_dispatch_latency_seconds", "dispatches",
@@ -478,6 +584,30 @@ def render_fleet(replica_snaps: dict, registry: Optional[Registry] = None,
                    if (s.get("spill") or {}).get(key) is not None]
         if samples:
             _family(out, _name(prefix, suffix), kind, help, samples)
+    for suffix, kind, help, extract in _SERVE_QUALITY:
+        samples = []
+        for rid, s in snaps.items():
+            quality = s.get("quality")
+            if not isinstance(quality, dict):
+                continue
+            for extra, v in extract(quality):
+                labels = {"replica": rid}
+                labels.update(extra)
+                samples.append((labels, v))
+        if samples:
+            _family(out, _name(prefix, suffix), kind, help, samples)
+    samples = []
+    for rid, s in snaps.items():
+        ages = (s.get("prior_pool_ages_seconds")
+                or (s.get("prior_pool") or {}).get("pool_ages_seconds")
+                or {})
+        samples.extend(({"pool": k, "replica": rid}, v)
+                       for k, v in sorted(ages.items()))
+    if samples:
+        _family(out, _name(prefix, "serve_prior_pool_age_seconds"),
+                "gauge",
+                "Seconds since each prior pool's last statistic fold",
+                samples)
     samples = [({"replica": rid, "ring": ring}, ex)
                for rid, s in snaps.items()
                for ring, ex in sorted((s.get("exemplars") or {}).items())
@@ -529,6 +659,20 @@ def _render_serve(out: list, snap: dict, prefix: str) -> None:
         v = spill.get(key)
         if v is not None:
             _family(out, _name(prefix, suffix), kind, help, [({}, v)])
+    quality = snap.get("quality")
+    if isinstance(quality, dict):
+        for suffix, kind, help, extract in _SERVE_QUALITY:
+            samples = extract(quality)
+            if samples:
+                _family(out, _name(prefix, suffix), kind, help, samples)
+    ages = (snap.get("prior_pool_ages_seconds")
+            or (snap.get("prior_pool") or {}).get("pool_ages_seconds")
+            or {})
+    if ages:
+        _family(out, _name(prefix, "serve_prior_pool_age_seconds"),
+                "gauge",
+                "Seconds since each prior pool's last statistic fold",
+                [({"pool": k}, v) for k, v in sorted(ages.items())])
     fills = snap.get("ring_fill") or {}
     if fills:
         _family(out, _name(prefix, "serve_ring_fill"), "gauge",

@@ -99,6 +99,16 @@ SUPPORTED_RECORD_VERSIONS = (1, 2, 3, 4)
 # round's dispatch replays with 0 lost labels. v3 readers would drop the
 # parked answers on restore, so v4 streams gate them out; v2/v3 streams
 # (no park rows possible) still restore here unchanged.
+# Additive-optional row fields (NO version bump — replay compares only
+# the decision quantities, and every reader tolerates extra keys, so the
+# bitwise pin on existing keys is preserved):
+#   * ``trace_id`` (r19) — the serving trace the row's request rode;
+#     absent (not null) when untraced.
+#   * ``pred_label_prob`` (r20) — the probability the session's consensus
+#     posterior pi_hat assigned to the realized oracle label, read
+#     pre-update by the decision-quality plane (telemetry/quality.py);
+#     absent with ``--no-quality``, so quality-off streams stay bitwise
+#     identical to pre-quality streams.
 SESSION_SCHEMA_VERSION = 4
 SUPPORTED_SESSION_VERSIONS = (2, 3, 4)
 

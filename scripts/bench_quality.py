@@ -1,0 +1,482 @@
+"""Decision-quality bench -> QUALITY_FLEET_CPU_*.json (the ISSUE 20 evidence).
+
+Five passes, one artifact, every claim mechanical:
+
+  1. **Clean fleet shadow audit** — a multi-replica fleet behind the
+     session router under transport chaos, every closed session shadow-
+     audited (``audit_frac=1``). The claim: every audited replay is
+     bitwise identical to its recorder stream (0 divergences), and the
+     streaming calibration monitor accumulated per-task ECE/Brier on
+     every replica.
+  2. **Tamper attribution** — the same auditor over a server whose
+     ``stream_tamper`` fault flips a SINGLE float32 ulp in one recorded
+     round: the audit must DIVERGE and attribute the divergence to the
+     exact session id and round index.
+  3. **Ground-truth calibration** — a recorded suite run of the paper
+     method, folded through ``record_calibration``: P(best)-vs-
+     realized-best reliability with a finite ECE over every round.
+  4. **Quality SLO fire/clear** — the ``quality_drift`` objective driven
+     through a second-scale :class:`SloSweeper`: it must FIRE while a
+     drift detector reports firing and RESOLVE once clean samples wash
+     the burn windows, with BOTH alert transitions read back from the
+     tracking store.
+  5. **Non-perturbation** — the identical deterministic single-worker
+     workload with the quality plane on and off (``--no-quality``): the
+     recorder's decision rows must be IDENTICAL once the additive
+     ``pred_label_prob`` field is dropped — the plane observes the
+     serving path, it never steers it. Overhead: min-of-N wall times,
+     on vs off, bounded <= 5%.
+
+Run::
+
+    JAX_PLATFORMS=cpu python scripts/bench_quality.py \
+        --out QUALITY_FLEET_CPU_r20.json
+    python scripts/bench_quality.py --quick   # smoke (not committed)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _loadgen_args(extra: list) -> object:
+    from serve_loadgen import parse_args as lg_parse
+
+    return lg_parse(["--synthetic", "4,64,4"] + extra)
+
+
+def _drain_quality(apps, timeout: float = 60.0) -> bool:
+    """Block until every replica's audit queue is empty (audits are
+    background work; the claims below read their counters)."""
+    ok = True
+    for app in apps:
+        q = getattr(app, "quality", None)
+        if q is not None:
+            ok = q.drain(timeout) and ok
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# pass 1: clean fleet, every close shadow-audited, zero divergences
+# ---------------------------------------------------------------------------
+
+def clean_fleet_pass(quick: bool) -> dict:
+    import numpy as np
+
+    from coda_tpu.serve.fleet import build_fleet
+
+    n = 2 if quick else 3
+    sessions = 6 if quick else 12
+    rounds = 4 if quick else 6
+    args = _loadgen_args(["--workers", "4"])
+    args.quality_audit_frac = 1.0  # audit EVERY close: the 0-divergence
+    # claim must not ride on a lucky sample
+    fleet = build_fleet(args, n,
+                        fault_spec="net_delay:every=11,ms=3")
+    fleet.start(warm=False)
+    try:
+        router = fleet.router
+        rng = np.random.default_rng(11)
+        sids = [router.open_session(seed=s)["session"]
+                for s in range(sessions)]
+        for _ in range(rounds):
+            for sid in sids:
+                router.label(sid, int(rng.integers(0, 4)))
+        for sid in sids:
+            router.close_session(sid)
+        drained = _drain_quality(fleet.apps.values())
+        card = router.quality_scorecard()
+    finally:
+        fleet.drain()
+    per = {}
+    audits = divergences = tampered = verified = 0
+    calibration = {}
+    for rid, snap in card["replicas"].items():
+        audit = (snap.get("audit") or {}) if isinstance(snap, dict) else {}
+        per[rid] = {
+            "audits_total": audit.get("audits_total", 0),
+            "rounds_verified": audit.get("rounds_verified", 0),
+            "divergences_total": audit.get("divergences_total", 0),
+            "calibration": snap.get("calibration") if isinstance(snap, dict)
+            else None,
+        }
+        audits += audit.get("audits_total", 0) or 0
+        divergences += audit.get("divergences_total", 0) or 0
+        tampered += audit.get("tampered_total", 0) or 0
+        verified += audit.get("rounds_verified", 0) or 0
+        for task, cal in (snap.get("calibration") or {}).items():
+            agg = calibration.setdefault(task, {"n": 0, "ece": []})
+            agg["n"] += cal.get("n", 0) or 0
+            if cal.get("ece") is not None:
+                agg["ece"].append(cal["ece"])
+    for task, agg in calibration.items():
+        agg["ece_max"] = max(agg.pop("ece"), default=None)
+    return {
+        "replicas": n, "sessions": sessions, "rounds": rounds,
+        "chaos": "net_delay:every=11,ms=3",
+        "drained": drained,
+        "audits_total": audits,
+        "rounds_verified": verified,
+        "divergences_total": divergences,
+        "tampered_total": tampered,
+        "per_replica": per,
+        "calibration": calibration,
+        "verdict": card["verdict"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# pass 2: single-ulp tamper detected with exact attribution
+# ---------------------------------------------------------------------------
+
+def tamper_pass() -> dict:
+    import numpy as np
+
+    from coda_tpu.serve.server import build_app
+
+    args = _loadgen_args(["--workers", "1"])
+    args.quality_audit_frac = 1.0
+    args.fault_spec = "stream_tamper:every=1"
+    app = build_app(args)
+    app.start(warm=False)
+    try:
+        rng = np.random.default_rng(13)
+        out = app.open_session(seed=3)
+        sid = out["session"]
+        for _ in range(6):
+            out = app.label(sid, int(rng.integers(0, 4)))
+        n_rows = len([r for r in app.recorder.history(sid)
+                      if "kind" not in r])
+        app.close_session(sid)
+        assert app.quality is not None
+        app.quality.drain(60)
+        audit = app.quality.snapshot()["audit"]
+        verdict = app.quality_scorecard()["verdict"]
+    finally:
+        app.drain()
+    divs = audit.get("last_divergences") or []
+    div = divs[-1] if divs else {}
+    return {
+        "fault_spec": "stream_tamper:every=1",
+        "session": sid,
+        "decision_rows": n_rows,
+        "tampered_total": audit["tampered_total"],
+        "divergences_total": audit["divergences_total"],
+        "divergence": div,
+        # the attribution claim: the flagged replay names the tampered
+        # session AND the tampered round (tamper_rows_ulp hits the
+        # middle decision row)
+        "attributed_session": div.get("session") == sid,
+        "attributed_round": div.get("round") == n_rows // 2,
+        "verdict_audit": verdict["audit"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# pass 3: P(best)-vs-realized-best calibration of a ground-truth record
+# ---------------------------------------------------------------------------
+
+def calibration_pass(quick: bool) -> dict:
+    import os
+    import tempfile
+
+    from coda_tpu.data import make_synthetic_task
+    from coda_tpu.engine.replay import record_calibration
+    from coda_tpu.engine.suite import SuiteRunner
+    from coda_tpu.telemetry.recorder import RunRecord
+
+    task = make_synthetic_task(seed=0, H=6, N=64, C=4, name="calib_0")
+    iters = 12 if quick else 24
+    seeds = 2 if quick else 4
+    with tempfile.TemporaryDirectory() as td:
+        runner = SuiteRunner(iters=iters, seeds=seeds, record_dir=td,
+                             record_topk=3)
+        runner.run_batched([[task]], ["coda"], progress=lambda s: None)
+        rec_dir = os.path.join(td, "calib__coda", "calib_0")
+        record = RunRecord.load(rec_dir)
+        cal = record_calibration(record)
+    pooled = cal["pooled"]
+    return {
+        "method": "coda", "task": "synthetic-6,64,4",
+        "iters": iters, "seeds": seeds,
+        "pooled": pooled,
+        "per_seed_n": [s["n"] for s in cal["seeds"]],
+        "finite_ece": (pooled["ece"] is not None
+                       and 0.0 <= pooled["ece"] <= 1.0),
+        "rounds_scored": pooled["n"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# pass 4: quality SLO fire + clear, both transitions read back from store
+# ---------------------------------------------------------------------------
+
+def slo_pass() -> dict:
+    import os
+    import tempfile
+
+    from coda_tpu.telemetry.quality import quality_slos
+    from coda_tpu.telemetry.slo import SloSweeper
+    from coda_tpu.tracking.store import TrackingStore
+
+    drift = {"statistic": 9.0, "fired_total": 1, "cleared_total": 0,
+             "observations": 9, "kind": "cusum", "last_value": 1.0}
+
+    def fleet(firing):
+        return {"replicas": {"r0": {"quality": {
+            "audit": {"audits_total": 4, "divergences_recent": 0},
+            "calibration": {},
+            "drift": {"prior_staleness": dict(drift, firing=firing)}}}}}
+
+    with tempfile.TemporaryDirectory() as td:
+        db = os.path.join(td, "quality_slo.sqlite")
+        t = [0.0]
+        sweeper = SloSweeper(quality_slos(), fast_s=10.0, slow_s=20.0,
+                             clock=lambda: t[0],
+                             store=(lambda: TrackingStore(db)))
+        events = []
+        fired_at = cleared_at = None
+        # phase 1: a drift detector firing on the replica burns the
+        # quality_drift budget at 1/0.01 = 100x >= the fire threshold
+        for _ in range(5):
+            t[0] += 1.0
+            for ev in sweeper.observe(fleet(True)):
+                events.append(ev)
+                if ev["state"] == "firing" and fired_at is None:
+                    fired_at = t[0]
+        # phase 2: clean samples wash both burn windows -> resolve
+        for _ in range(40):
+            t[0] += 1.0
+            for ev in sweeper.observe(fleet(False)):
+                events.append(ev)
+                if ev["state"] == "resolved" and cleared_at is None:
+                    cleared_at = t[0]
+            if cleared_at is not None:
+                break
+        snap = sweeper.snapshot()
+        # the persistence half of the claim: both transitions read BACK
+        # from the tracking store on a fresh connection
+        store = TrackingStore(db)
+        persisted = {
+            state: store.is_finished(
+                "serve_slo", f"alert-quality_drift-{state}")
+            for state in ("firing", "resolved")
+        }
+        store.close()
+    st = snap["objectives"]["quality_drift"]
+    return {
+        "objective": "quality_drift",
+        "windows_s": snap["windows_s"],
+        "fired": st["fired_total"],
+        "cleared": st["cleared_total"],
+        "fired_at_s": fired_at,
+        "cleared_at_s": cleared_at,
+        "transitions": [{k: e[k] for k in ("slo", "state", "burn_fast")}
+                        for e in events],
+        "store_flushed": snap["store"]["flushed"],
+        "store_errors": snap["store"]["errors"],
+        "persisted": persisted,
+        "persisted_both": all(persisted.values()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# pass 5: non-perturbation (bitwise rows) + overhead
+# ---------------------------------------------------------------------------
+
+def _quality_workload(app, n_labels: int) -> tuple:
+    """One deterministic single-stream session; returns (wall_s, sid)."""
+    t0 = time.perf_counter()
+    out = app.open_session(seed=0)
+    sid = out["session"]
+    for _ in range(n_labels):
+        out = app.label(sid, int(out["idx"]) % 4)
+    app.close_session(sid)
+    return time.perf_counter() - t0, sid
+
+
+def _stream_rows(record_dir: str, sid: str) -> list:
+    import glob
+    import os
+
+    rows = []
+    for path in sorted(glob.glob(os.path.join(record_dir, "**", f"*{sid}*"),
+                                 recursive=True)):
+        with open(path) as f:
+            for line in f:
+                row = json.loads(line)
+                # only decision rows: meta/close markers carry wall-clock
+                # provenance that legitimately differs between runs
+                if "next_idx" in row:
+                    rows.append(row)
+    return rows
+
+
+def bitwise_pass(n_labels: int = 24) -> dict:
+    import os
+    import tempfile
+
+    from coda_tpu.serve.server import build_app
+
+    runs = {}
+    with tempfile.TemporaryDirectory() as td:
+        for mode, on in (("quality_on", True), ("quality_off", False)):
+            rd = os.path.join(td, mode)
+            args = _loadgen_args(["--workers", "1"])
+            args.record_dir = rd
+            args.no_quality = not on
+            args.quality_audit_frac = 1.0
+            app = build_app(args)
+            app.start(warm=False)
+            try:
+                _wall, sid = _quality_workload(app, n_labels)
+                if app.quality is not None:
+                    app.quality.drain(60)
+            finally:
+                app.drain()
+            runs[mode] = _stream_rows(rd, sid)
+    on_rows = runs["quality_on"]
+    off_rows = runs["quality_off"]
+    update_rows = [r for r in on_rows if r.get("do_update")]
+    rows_carry_prob = bool(update_rows) and all(
+        "pred_label_prob" in r
+        and 0.0 <= float(r["pred_label_prob"]) <= 1.0
+        for r in update_rows)
+    off_clean = not any("pred_label_prob" in r for r in off_rows)
+    stripped = [{k: v for k, v in r.items() if k != "pred_label_prob"}
+                for r in on_rows]
+    identical = (json.dumps(stripped, sort_keys=True)
+                 == json.dumps(off_rows, sort_keys=True))
+    first_diff = None
+    if not identical:
+        for i, (a, b) in enumerate(zip(stripped, off_rows)):
+            if a != b:
+                first_diff = {"row": i, "on": a, "off": b}
+                break
+        if first_diff is None:
+            first_diff = {"row_counts": [len(stripped), len(off_rows)]}
+    return {
+        "labels": n_labels,
+        "rows": [len(on_rows), len(off_rows)],
+        "update_rows_carry_pred_label_prob": rows_carry_prob,
+        "off_rows_field_free": off_clean,
+        "identical": identical,
+        "first_diff": first_diff,
+    }
+
+
+def overhead_pass(n_labels: int = 200, reps: int = 8) -> dict:
+    """min-of-``reps`` wall time of the identical serial workload, quality
+    plane on vs off. Both apps stay alive and the reps ALTERNATE modes,
+    so slow container drift hits both sides equally; min (not mean)
+    because noise only ever ADDS time — the minima are the honest
+    comparison."""
+    from coda_tpu.serve.server import build_app
+
+    apps = {}
+    for mode, on in (("off", False), ("on", True)):
+        args = _loadgen_args(["--workers", "1"])
+        args.no_quality = not on
+        # overhead measures the HOT path (pre-dispatch consensus fold +
+        # calibration row): audits are close-time background work
+        args.quality_audit_frac = 0.0
+        apps[mode] = build_app(args)
+        apps[mode].start(warm=False)
+    walls: dict = {"on": [], "off": []}
+    try:
+        for mode in ("off", "on"):
+            _quality_workload(apps[mode], 20)  # page everything in
+        for _ in range(reps):
+            for mode in ("off", "on"):
+                wall, _sid = _quality_workload(apps[mode], n_labels)
+                walls[mode].append(wall)
+    finally:
+        for app in apps.values():
+            app.drain()
+    on, off = min(walls["on"]), min(walls["off"])
+    return {
+        "labels": n_labels, "reps": reps,
+        "on_s": walls["on"], "off_s": walls["off"],
+        "on_min_s": on, "off_min_s": off,
+        "per_label_us": {"on": on / n_labels * 1e6,
+                         "off": off / n_labels * 1e6},
+        # clamped at 0: a negative delta is container noise, not a
+        # time-travelling monitor
+        "overhead_frac": max(0.0, (on - off) / off),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--quick", action="store_true",
+                   help="2-replica smoke pass (smaller workload; do not "
+                        "commit the artifact)")
+    p.add_argument("--out", default=None,
+                   help="artifact path (default QUALITY_FLEET_CPU.json)")
+    args = p.parse_args(argv)
+
+    from coda_tpu.utils.platform import pin_platform
+
+    pin_platform(None)
+    from coda_tpu.telemetry.recorder import environment_fingerprint
+
+    t0 = time.perf_counter()
+    print("== pass 1/5: clean fleet shadow audit ==", flush=True)
+    clean = clean_fleet_pass(args.quick)
+    print(json.dumps({k: clean[k] for k in
+                      ("audits_total", "divergences_total", "verdict")}),
+          flush=True)
+    print("== pass 2/5: tamper attribution ==", flush=True)
+    tamper = tamper_pass()
+    print(json.dumps({k: tamper[k] for k in
+                      ("tampered_total", "divergences_total",
+                       "attributed_session", "attributed_round")}),
+          flush=True)
+    print("== pass 3/5: ground-truth calibration ==", flush=True)
+    calibration = calibration_pass(args.quick)
+    print(json.dumps({"pooled": calibration["pooled"]}), flush=True)
+    print("== pass 4/5: quality SLO fire/clear ==", flush=True)
+    slo = slo_pass()
+    print(json.dumps({k: slo[k] for k in
+                      ("fired", "cleared", "persisted_both")}), flush=True)
+    print("== pass 5/5: non-perturbation + overhead ==", flush=True)
+    bitwise = bitwise_pass()
+    overhead = overhead_pass(n_labels=60 if args.quick else 200,
+                             reps=3 if args.quick else 8)
+    print(json.dumps({"identical": bitwise["identical"],
+                      "overhead_frac": overhead["overhead_frac"]}),
+          flush=True)
+
+    report = {
+        "bench": "bench_quality",
+        "quick": bool(args.quick),
+        "fingerprint": environment_fingerprint(knobs={
+            "bench": "bench_quality", "quick": bool(args.quick),
+            "replicas": clean["replicas"],
+            "audit_frac": 1.0,
+            "task": "synthetic-4,64,4"}),
+        "wall_s": time.perf_counter() - t0,
+        "clean_fleet": clean,
+        "tamper": tamper,
+        "calibration": calibration,
+        "slo": slo,
+        "bitwise": bitwise,
+        "overhead": overhead,
+    }
+    out = args.out or ("QUALITY_FLEET_CPU_quick.json" if args.quick
+                       else "QUALITY_FLEET_CPU.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"wrote {out} in {report['wall_s']:.1f}s")
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -235,6 +235,13 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
                 [py, "scripts/bench_obs.py", "--quick",
                  "--out", os.path.join(tmpdir, "obs.json")],
                 os.path.join(tmpdir, "obs.json"), 900),
+            # decision quality at proof scale: 2-replica shadow audit,
+            # tamper attribution, quality SLO fire/clear, bitwise
+            # on-vs-off (the committed 3-replica claim is QUALITY_FLEET_*)
+            "serve_quality": (
+                [py, "scripts/bench_quality.py", "--quick",
+                 "--out", os.path.join(tmpdir, "quality.json")],
+                os.path.join(tmpdir, "quality.json"), 900),
         }
     return {
         # the r09 evidence set the ROADMAP asks for, in one run
@@ -338,6 +345,15 @@ def component_commands(quick: bool, tmpdir: str, platform: str = None
             [py, "scripts/bench_obs.py",
              "--out", os.path.join(tmpdir, "obs.json")],
             os.path.join(tmpdir, "obs.json"), 3600),
+        # decision quality in full (the QUALITY_FLEET_* configuration):
+        # every close shadow-audited on a 3-replica chaos fleet with 0
+        # divergences, single-ulp tamper attribution, ground-truth
+        # P(best) calibration, quality SLO fire/clear persisted to the
+        # store, bitwise non-perturbation + the <= 5% overhead bound
+        "serve_quality": (
+            [py, "scripts/bench_quality.py",
+             "--out", os.path.join(tmpdir, "quality.json")],
+            os.path.join(tmpdir, "quality.json"), 3600),
     }
 
 

@@ -892,6 +892,100 @@ def obs_check_report(report: dict) -> list[str]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# the decision-quality contract (ISSUE 20 acceptance: QUALITY_FLEET_* holds
+# the shadow-audit / calibration / drift-SLO / non-perturbation claims)
+# ---------------------------------------------------------------------------
+
+QUALITY_MAX_OVERHEAD = 0.05     # quality-plane wall-time overhead ceiling
+QUALITY_MIN_AUDITS = 4          # shadow audits the clean fleet must land
+
+
+def quality_check_report(report: dict) -> list[str]:
+    """Violations of one decision-quality report (bench_quality.py)."""
+    out: list[str] = []
+    clean = report.get("clean_fleet") or {}
+    if (clean.get("audits_total") or 0) < QUALITY_MIN_AUDITS:
+        out.append(f"clean_fleet.audits_total {clean.get('audits_total')} "
+                   f"< {QUALITY_MIN_AUDITS}")
+    if clean.get("drained") is not True:
+        out.append("clean_fleet.drained is not true (audit queue still "
+                   "held work when the counters were read)")
+    if clean.get("divergences_total") != 0:
+        out.append(f"clean_fleet.divergences_total "
+                   f"{clean.get('divergences_total')} != 0 (a clean "
+                   "replay diverged from its recorder stream)")
+    if clean.get("tampered_total") != 0:
+        out.append("clean_fleet.tampered_total != 0 (the clean pass must "
+                   "run without the tamper fault armed)")
+    if not (clean.get("rounds_verified") or 0):
+        out.append("clean_fleet.rounds_verified is 0 (no replayed round "
+                   "was actually compared)")
+    if (clean.get("verdict") or {}).get("audit") != "ok":
+        out.append(f"clean_fleet.verdict.audit "
+                   f"{(clean.get('verdict') or {}).get('audit')!r} "
+                   "!= 'ok'")
+    cal_fleet = clean.get("calibration") or {}
+    if not cal_fleet:
+        out.append("clean_fleet.calibration is empty (the streaming "
+                   "monitor accumulated nothing)")
+    for task, agg in cal_fleet.items():
+        if not (agg.get("n") or 0):
+            out.append(f"clean_fleet.calibration[{task}].n is 0")
+        ece = agg.get("ece_max")
+        if not (isinstance(ece, (int, float)) and 0.0 <= ece <= 1.0):
+            out.append(f"clean_fleet.calibration[{task}].ece_max {ece} "
+                       "not a finite ECE in [0, 1]")
+    tamper = report.get("tamper") or {}
+    if not (tamper.get("tampered_total") or 0) >= 1:
+        out.append("tamper.tampered_total < 1 (the fault never fired)")
+    if not (tamper.get("divergences_total") or 0) >= 1:
+        out.append("tamper.divergences_total < 1 (a single-ulp tamper "
+                   "slipped past the shadow audit)")
+    if tamper.get("attributed_session") is not True:
+        out.append("tamper.attributed_session is not true (divergence "
+                   "not pinned to the tampered session)")
+    if tamper.get("attributed_round") is not True:
+        out.append("tamper.attributed_round is not true (divergence "
+                   "not pinned to the tampered round)")
+    if tamper.get("verdict_audit") != "diverged":
+        out.append(f"tamper.verdict_audit {tamper.get('verdict_audit')!r} "
+                   "!= 'diverged'")
+    cal = report.get("calibration") or {}
+    if cal.get("finite_ece") is not True:
+        out.append("calibration.finite_ece is not true (ground-truth "
+                   "P(best) calibration did not produce an ECE)")
+    if not (cal.get("rounds_scored") or 0):
+        out.append("calibration.rounds_scored is 0")
+    slo = report.get("slo") or {}
+    if not (slo.get("fired") or 0) >= 1:
+        out.append("slo.fired < 1 (quality_drift never fired)")
+    if not (slo.get("cleared") or 0) >= 1:
+        out.append("slo.cleared < 1 (quality_drift never resolved)")
+    if slo.get("persisted_both") is not True:
+        out.append("slo.persisted_both is not true (alert transitions "
+                   "missing from the tracking store)")
+    if slo.get("store_errors"):
+        out.append(f"slo.store_errors {slo.get('store_errors')} != 0")
+    bit = report.get("bitwise") or {}
+    if bit.get("identical") is not True:
+        out.append("bitwise.identical is not true (the quality plane "
+                   f"perturbed the decision stream: {bit.get('first_diff')})")
+    if bit.get("update_rows_carry_pred_label_prob") is not True:
+        out.append("bitwise.update_rows_carry_pred_label_prob is not "
+                   "true (quality-on rows lost the calibration field)")
+    if bit.get("off_rows_field_free") is not True:
+        out.append("bitwise.off_rows_field_free is not true (quality-off "
+                   "rows carry pred_label_prob — the additive-field "
+                   "contract is broken)")
+    ov = report.get("overhead") or {}
+    frac = ov.get("overhead_frac")
+    if not (isinstance(frac, (int, float)) and frac <= QUALITY_MAX_OVERHEAD):
+        out.append(f"overhead.overhead_frac {frac} > "
+                   f"{QUALITY_MAX_OVERHEAD}")
+    return out
+
+
 EVIDENCE_SCHEMA_VERSION = 1
 EVIDENCE_COMPONENTS = ("bench", "bench_suite", "serve_loadgen",
                        "multichip_replay")
@@ -902,7 +996,7 @@ EVIDENCE_OPTIONAL_COMPONENTS = ("bench_imagenet", "serve_tiered",
                                 "bench_batchq", "serve_fleet",
                                 "serve_fleet_chaos", "bench_surrogate",
                                 "oracle_noise", "bench_prior",
-                                "serve_obs")
+                                "serve_obs", "serve_quality")
 
 
 def _evidence_check(report: dict) -> list[str]:
@@ -1269,6 +1363,30 @@ CONTRACTS: tuple = (
              "overhead, burn-rate alert fired AND cleared on an "
              "injected slow_step tail with both transitions persisted "
              "to the tracking store"),
+    # -- decision-quality plane (shadow audit + calibration + drift SLO) --
+    Contract(
+        pattern="QUALITY_*.json", kind="serve_quality",
+        required=("bench", "fingerprint.backend",
+                  "clean_fleet.audits_total",
+                  "clean_fleet.divergences_total",
+                  "tamper.attributed_session", "tamper.attributed_round",
+                  "calibration.pooled.ece", "slo.fired", "slo.cleared",
+                  "slo.persisted_both", "bitwise.identical",
+                  "overhead.overhead_frac"),
+        bounds=(("bench", "==", "bench_quality"),
+                ("clean_fleet.divergences_total", "==", 0)),
+        checker=quality_check_report, fingerprint="required",
+        group="quality",
+        regress=("overhead.overhead_frac", "lower", 1.0),
+        note="decision-quality plane (ISSUE 20): every shadow-audited "
+             "session replay bitwise-identical on a clean chaos fleet "
+             "(0 divergences), an injected single-ulp stream tamper "
+             "detected and attributed to the exact session and round, "
+             "ground-truth P(best) calibration with a finite ECE, the "
+             "quality_drift burn-rate alert fired AND cleared with both "
+             "transitions read back from the tracking store, decision "
+             "rows bitwise-identical with the plane on vs off, <= 5% "
+             "overhead"),
     # -- one-run evidence manifests --
     Contract(
         pattern="EVIDENCE_*.json", kind="evidence_manifest",
@@ -1431,7 +1549,8 @@ def discover(root: str) -> list[str]:
     """The gated artifact set at one repo root."""
     paths = []
     for pat in ("BENCH_*.json", "EVIDENCE_*.json", "IMAGENET_*.json",
-                "FAULT_MATRIX_*.json", "OBS_*.json", "ROBUSTNESS_*.json"):
+                "FAULT_MATRIX_*.json", "OBS_*.json", "QUALITY_*.json",
+                "ROBUSTNESS_*.json"):
         paths += glob.glob(os.path.join(root, pat))
     return sorted(paths)
 

@@ -41,6 +41,24 @@ _ROW_FIELDS = ("n_labeled", "do_update", "next_idx", "next_prob", "best",
                "pbest_max", "pbest_entropy")
 
 
+def _check_pred_label_prob(v) -> str:
+    """Violation (or "") for the ADDITIVE-OPTIONAL ``pred_label_prob``
+    row field (trace_id's contract: absent — not null — when the
+    decision-quality plane is off, so off-streams stay bitwise identical;
+    no version bump). When present it is the pre-update consensus
+    posterior probability of the applied label: a [0, 1] float, or a
+    q-wide list of them on a batch row."""
+    vals = v if isinstance(v, list) else [v]
+    if not vals:
+        return "pred_label_prob: empty list"
+    for x in vals:
+        if not isinstance(x, (int, float)) or isinstance(x, bool):
+            return f"pred_label_prob: non-numeric entry {x!r}"
+        if not (0.0 <= float(x) <= 1.0):
+            return f"pred_label_prob: {x!r} outside [0, 1]"
+    return ""
+
+
 def check_record(dir_path: str) -> list[str]:
     """Violations of one record.json + rounds.npz pair (empty = clean)."""
     import numpy as np
@@ -181,6 +199,10 @@ def check_session_stream(fp: str) -> list[str]:
         missing = [k for k in _ROW_FIELDS if k not in row]
         if missing:
             out.append(f"line {i}: row missing fields {missing}")
+        if "pred_label_prob" in row:
+            bad = _check_pred_label_prob(row["pred_label_prob"])
+            if bad:
+                out.append(f"line {i}: {bad}")
     return out
 
 

@@ -1,0 +1,602 @@
+"""Decision-quality plane (telemetry/quality.py): streaming calibration,
+drift detectors, the shadow auditor, and their serve wiring.
+
+The load-bearing guarantees:
+
+  * calibration accumulators match hand-computed ECE/Brier on known traces;
+  * CUSUM / Page-Hinkley fire and clear deterministically (injectable
+    clock, no sleeps);
+  * the shadow auditor reports ZERO divergences on a clean server and
+    catches a single-ulp stream tamper (faults.py ``stream_tamper``) with
+    exact session/round attribution;
+  * quality-on vs quality-off produce BITWISE-identical decision rows —
+    the only stream delta is the additive-optional ``pred_label_prob``;
+  * every quality_* metrics family renders prometheus-lint-clean, single
+    replica and fleet-merged;
+  * the prior pool's staleness clock survives the snapshot/replace
+    round-trip (the router exchange).
+"""
+
+import json
+import math
+import types
+
+import numpy as np
+import pytest
+
+from coda_tpu.telemetry.quality import (
+    CALIBRATION_MIN_SAMPLES,
+    CalibrationBuckets,
+    CalibrationMonitor,
+    CusumDetector,
+    PageHinkley,
+    QualityPlane,
+    default_drift_bank,
+    pbest_calibration,
+    quality_slos,
+    reliability_curve,
+    tamper_rows_ulp,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+# ---------------------------------------------------------------------------
+# calibration accumulators
+# ---------------------------------------------------------------------------
+
+def test_calibration_buckets_match_hand_computed_trace():
+    # 4 observations, bins of width .1: (conf, hit)
+    obs = [(0.95, True), (0.95, False), (0.55, True), (0.15, False)]
+    bk = CalibrationBuckets(bins=10)
+    for conf, hit in obs:
+        bk.observe(conf, hit)
+    # bin 9 holds two obs: conf .95, acc .5 -> |.5-.95|*2; bin 5 one obs
+    # conf .55 acc 1 -> .45; bin 1 one obs conf .15 acc 0 -> .15
+    expect_ece = (2 * abs(0.5 - 0.95) + 1 * abs(1.0 - 0.55)
+                  + 1 * abs(0.0 - 0.15)) / 4
+    assert bk.ece() == pytest.approx(expect_ece)
+    expect_brier = np.mean([(0.95 - 1) ** 2, (0.95 - 0) ** 2,
+                            (0.55 - 1) ** 2, (0.15 - 0) ** 2])
+    assert bk.brier() == pytest.approx(expect_brier)
+    snap = bk.snapshot()
+    assert snap["n"] == 4
+    assert snap["bins"][9]["n"] == 2
+    assert snap["bins"][9]["accuracy"] == pytest.approx(0.5)
+    assert snap["bins"][9]["confidence"] == pytest.approx(0.95)
+    # perfectly calibrated stream -> ECE 0
+    perfect = CalibrationBuckets(bins=1)
+    for hit in [True, True, False, False]:
+        perfect.observe(0.5, hit)
+    assert perfect.ece() == pytest.approx(0.0)
+
+
+def test_calibration_buckets_conf_one_lands_in_top_bin():
+    bk = CalibrationBuckets(bins=10)
+    bk.observe(1.0, True)  # must not index past the last bucket
+    assert bk.snapshot()["bins"][9]["n"] == 1
+
+
+def test_calibration_monitor_per_task_and_worst_ece():
+    mon = CalibrationMonitor()
+    for _ in range(CALIBRATION_MIN_SAMPLES):
+        mon.observe("well", 0.5, True)   # acc 1 @ conf .5 -> ECE .5
+        mon.observe("off", 0.9, False)   # acc 0 @ conf .9 -> ECE .9
+    snap = mon.snapshot()
+    assert set(snap) == {"off", "well"}
+    assert snap["off"]["ece"] == pytest.approx(0.9)
+    assert mon.worst_ece() == pytest.approx(0.9)
+    # below the evidence floor no task may grade
+    mon2 = CalibrationMonitor()
+    mon2.observe("thin", 0.9, False)
+    assert mon2.worst_ece() is None
+
+
+def test_pbest_calibration_regret_zero_is_hit():
+    pbest = np.array([[0.9, 0.8, 0.4, 0.6]])
+    regret = np.array([[0.0, 0.1, 0.0, 0.0]])
+    out = pbest_calibration(pbest, regret)
+    assert out["n"] == 4
+    # hits: rounds with regret 0 -> 3/4 accuracy overall
+    acc = sum(b["n"] * (b["accuracy"] or 0) for b in out["bins"])
+    assert acc == pytest.approx(3.0)
+    # NaN pbest rounds (pre-warmup) are dropped, not counted
+    out2 = pbest_calibration(np.array([np.nan, 0.7]), np.array([0.0, 0.0]))
+    assert out2["n"] == 1
+
+
+def test_record_calibration_adapts_run_records():
+    from coda_tpu.engine.replay import record_calibration
+
+    rec = types.SimpleNamespace(
+        seeds=2,
+        arrays={"pbest_max": np.array([[0.9, 0.8], [0.7, 0.6]]),
+                "regret": np.array([[0.0, 0.2], [0.0, 0.0]])})
+    out = record_calibration(rec)
+    assert out["pooled"]["n"] == 4
+    assert len(out["seeds"]) == 2
+    assert out["seeds"][1]["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# drift detectors
+# ---------------------------------------------------------------------------
+
+def test_cusum_fires_and_clears_with_injectable_clock():
+    t = [100.0]
+    det = CusumDetector("d", mu0=0.1, k=0.05, h=0.5, clear=0.1,
+                        clock=lambda: t[0])
+    events = []
+    for _ in range(3):  # s grows by 0.4 - 0.15 = 0.25 per sample
+        t[0] += 1.0
+        events.append(det.observe(0.4))
+    assert events == [None, "fired", None]  # fires once crossing h, once
+    assert det.firing and det.fired_total == 1
+    assert det.last_transition_t == 102.0  # stamped at the crossing sample
+    fired_at = det.last_transition_t
+    for _ in range(10):  # in-control samples drain s by 0.15 each
+        t[0] += 1.0
+        ev = det.observe(0.0)
+        if ev == "cleared":
+            break
+    assert not det.firing and det.cleared_total == 1
+    assert det.last_transition_t > fired_at
+    snap = det.snapshot()
+    assert snap["kind"] == "cusum" and snap["fired_total"] == 1
+
+
+def test_page_hinkley_fires_on_mean_shift_and_rebaselines():
+    det = PageHinkley("ph", delta=0.005, lam=0.1, clock=lambda: 0.0)
+    for _ in range(20):
+        assert det.observe(0.1) is None  # stationary stream never fires
+    fired = None
+    for _ in range(50):
+        fired = det.observe(0.5) or fired  # sustained shift
+        if fired:
+            break
+    assert fired == "fired" and det.firing
+    cleared = None
+    for _ in range(20):
+        # the stream reverts below the running mean: m drains, m_min
+        # tracks it, ph collapses to 0 <= lam/2 -> clear + re-baseline
+        cleared = det.observe(0.0) or cleared
+        if cleared:
+            break
+    assert cleared == "cleared" and not det.firing
+    assert det.cleared_total == 1
+    # re-baselined on the clearing sample: a stationary stream at the
+    # new level never fires again
+    for _ in range(20):
+        assert det.observe(0.0) is None
+
+
+def test_default_drift_bank_names_and_feed():
+    bank = default_drift_bank()
+    assert set(bank.snapshot()) == {"surrogate_residual", "prior_staleness",
+                                    "crowd_reliability"}
+    assert bank.observe("unknown_detector", 1.0) is None
+    assert not bank.any_firing()
+    for _ in range(50):
+        bank.observe("surrogate_residual", 1.0)
+    assert bank.any_firing()
+
+
+def test_gate_pressure_maps_margin_to_drift_observable():
+    from coda_tpu.selectors.surrogate import SURROGATE_SCORE_TOL, gate_pressure
+
+    assert gate_pressure(None) == 0.0
+    assert gate_pressure(float("nan")) == 0.0
+    assert gate_pressure(SURROGATE_SCORE_TOL) == 0.0  # full headroom
+    assert gate_pressure(0.0) == pytest.approx(1.0)   # gate about to trip
+    assert gate_pressure(-SURROGATE_SCORE_TOL) == pytest.approx(2.0)
+    assert gate_pressure(10.0) == 0.0                 # clamped at 0
+
+
+def test_crowd_accuracy_movement():
+    from coda_tpu.crowd.reliability import accuracy_movement
+
+    assert accuracy_movement([0.9, 0.5], [0.9, 0.5]) == 0.0
+    assert accuracy_movement([0.9, 0.5], [0.7, 0.5]) == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# stream tampering
+# ---------------------------------------------------------------------------
+
+def test_tamper_rows_ulp_flips_exactly_one_quantity():
+    rows = [{"next_idx": 3, "next_prob": 0.25, "pbest_max": 0.5,
+             "pbest_entropy": 1.0, "do_update": True} for _ in range(5)]
+    out = tamper_rows_ulp(rows)
+    assert rows[2]["next_prob"] == 0.25  # caller's rows untouched
+    changed = [i for i, (a, b) in enumerate(zip(rows, out)) if a != b]
+    assert changed == [2]  # the middle row, one row only
+    delta = abs(out[2]["next_prob"] - 0.25)
+    assert 0 < delta < 1e-6  # a single float32 ulp
+    # q-wide list rows tamper their first entry
+    rows_q = [{"next_idx": [1, 2], "next_prob": [0.25, 0.5]}]
+    out_q = tamper_rows_ulp(rows_q)
+    assert out_q[0]["next_prob"][0] != 0.25
+    assert out_q[0]["next_prob"][1] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# serve integration: clean audits, tamper detection, bitwise pin
+# ---------------------------------------------------------------------------
+
+def _make_app(fault_spec=None, quality=True, audit_frac=1.0, capacity=4):
+    from coda_tpu.serve import SelectorSpec, ServeApp
+
+    app = ServeApp(capacity=capacity, max_wait=0.001, tiering=False,
+                   spec=SelectorSpec.create("coda", n_parallel=capacity),
+                   fault_spec=fault_spec, quality=quality,
+                   quality_audit_frac=audit_frac)
+    from coda_tpu.data import make_synthetic_task
+
+    task = make_synthetic_task(seed=0, H=4, N=48, C=4)
+    app.add_task(task.name, task.preds)
+    app.start(warm=False)
+    return app, task.name
+
+
+def _drive(app, task, seeds=(0, 1), rounds=6):
+    """Deterministic traffic; returns {sid: rows-captured-before-close}."""
+    rng = np.random.default_rng(7)
+    sids = [app.open_session(task=task, seed=s)["session"] for s in seeds]
+    for _ in range(rounds):
+        for sid in sids:
+            app.label(sid, int(rng.integers(0, 4)))
+    streams = {sid: [dict(r) for r in app.recorder.history(sid)]
+               for sid in sids}
+    for sid in sids:
+        app.close_session(sid)
+    return streams
+
+
+def test_shadow_auditor_clean_server_zero_divergences():
+    app, task = _make_app()
+    try:
+        _drive(app, task)
+        assert app.quality.drain(30)
+        snap = app.quality.snapshot()
+        audit = snap["audit"]
+        assert audit["audits_total"] == 2
+        assert audit["divergences_total"] == 0
+        assert audit["tampered_total"] == 0
+        assert audit["rounds_verified"] > 0
+        cal = snap["calibration"][task]
+        assert cal["n"] == 12  # 6 rounds x 2 sessions
+        assert 0.0 <= cal["ece"] <= 1.0
+        assert 0.0 <= (cal["mean_pred_label_prob"] or 0.0) <= 1.0
+        card = app.quality_scorecard()
+        assert card["verdict"]["audit"] == "ok"
+        assert card["verdict"]["drift"] == "ok"
+    finally:
+        app.drain(timeout=5)
+
+
+def test_shadow_auditor_catches_single_ulp_tamper():
+    app, task = _make_app(fault_spec="stream_tamper:every=1")
+    try:
+        streams = _drive(app, task, seeds=(3,), rounds=6)
+        (sid,) = streams
+        assert app.quality.drain(30)
+        audit = app.quality.snapshot()["audit"]
+        assert audit["tampered_total"] == 1
+        assert audit["divergences_total"] == 1
+        (div,) = audit["last_divergences"]
+        # exact attribution: the tampered session, the tampered round
+        assert div["session"] == sid
+        n_rows = len([r for r in streams[sid] if "kind" not in r])
+        assert div["round"] == n_rows // 2
+        assert "recorded" in div["detail"]
+        assert app.quality_scorecard()["verdict"]["audit"] == "diverged"
+    finally:
+        app.drain(timeout=5)
+
+
+def test_quality_on_off_rows_bitwise_identical():
+    app_on, task = _make_app(quality=True)
+    try:
+        rows_on = _drive(app_on, task)
+    finally:
+        app_on.drain(timeout=5)
+    app_off, _ = _make_app(quality=False)
+    try:
+        assert app_off.quality is None
+        rows_off = _drive(app_off, task)
+    finally:
+        app_off.drain(timeout=5)
+
+    def canon(streams, strip):
+        # session ids are random per server; compare streams in OPEN
+        # order (dict preserves _drive's seed order), sid-free
+        return [json.dumps([{k: v for k, v in r.items() if k not in strip}
+                            for r in rows], sort_keys=True)
+                for rows in streams.values()]
+
+    # quality-off streams carry NO pred_label_prob key at all (absent,
+    # not null — the trace_id contract)
+    assert not any("pred_label_prob" in r
+                   for rows in rows_off.values() for r in rows)
+    on_update_rows = [r for rows in rows_on.values() for r in rows
+                      if r.get("do_update")]
+    assert on_update_rows and all("pred_label_prob" in r
+                                  for r in on_update_rows)
+    assert all(0.0 <= r["pred_label_prob"] <= 1.0 for r in on_update_rows)
+    # and with the additive field stripped the streams are BITWISE equal
+    assert canon(rows_on, {"pred_label_prob"}) \
+        == canon(rows_off, {"pred_label_prob"})
+
+
+def test_quality_stream_passes_schema_checker(tmp_path):
+    import importlib.util
+    import os
+
+    fp = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "check_record_schema.py")
+    spec = importlib.util.spec_from_file_location("check_record_schema", fp)
+    crs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(crs)
+
+    from coda_tpu.telemetry import SessionRecorder
+
+    from coda_tpu.serve import SelectorSpec, ServeApp
+
+    app = ServeApp(capacity=2, max_wait=0.001, tiering=False,
+                   spec=SelectorSpec.create("coda", n_parallel=2),
+                   recorder=SessionRecorder(out_dir=str(tmp_path)),
+                   quality=True, quality_audit_frac=0.0)
+    from coda_tpu.data import make_synthetic_task
+
+    task = make_synthetic_task(seed=0, H=4, N=48, C=4)
+    app.add_task(task.name, task.preds)
+    app.start(warm=False)
+    try:
+        sid = app.open_session(task=task.name, seed=0)["session"]
+        for lab in (0, 1, 2):
+            app.label(sid, lab)
+        app.close_session(sid)
+    finally:
+        app.drain(timeout=5)
+    bad = crs.check_tree(str(tmp_path))
+    assert bad == {}
+    assert crs.check_tree.last_checked >= 1
+    # and the checker does reject an out-of-range pred_label_prob
+    assert crs._check_pred_label_prob(1.5)
+    assert crs._check_pred_label_prob([0.5, "x"])
+    assert crs._check_pred_label_prob(0.5) == ""
+    assert crs._check_pred_label_prob([0.5, 1.0]) == ""
+
+
+def test_quality_audit_sampling_is_deterministic():
+    plane = QualityPlane(preds_fn=lambda name: None, audit_frac=0.5)
+    picks = {sid: plane.should_audit(sid)
+             for sid in (f"s{i:04x}" for i in range(64))}
+    plane2 = QualityPlane(preds_fn=lambda name: None, audit_frac=0.5)
+    assert picks == {sid: plane2.should_audit(sid) for sid in picks}
+    assert 0 < sum(picks.values()) < len(picks)
+    none = QualityPlane(preds_fn=lambda name: None, audit_frac=0.0)
+    assert not any(none.should_audit(sid) for sid in picks)
+
+
+# ---------------------------------------------------------------------------
+# metrics exposition + SLO wiring
+# ---------------------------------------------------------------------------
+
+def test_quality_metric_families_lint_clean():
+    from coda_tpu.telemetry.prometheus import lint, render, render_fleet
+
+    app, task = _make_app()
+    try:
+        _drive(app, task, seeds=(0,), rounds=4)
+        assert app.quality.drain(30)
+        # drift families export only for detectors whose signal has fed
+        # (absent-not-zero; an exact server has no surrogate pressure) —
+        # feed one observation so the families exist to lint
+        app.quality.observe_drift("crowd_reliability", 0.01)
+        snap = app.stats()
+        assert "quality" in snap
+        text = render(app.telemetry.registry, serve_metrics=app.metrics)
+        assert lint(text) == []
+        assert "coda_quality_audits_total" in text
+        assert "coda_quality_calibration_ece" in text
+        assert "coda_quality_drift_firing" in text
+        fleet = render_fleet({"r0": snap, "r1": dict(snap)},
+                             registry=app.telemetry.registry)
+        assert lint(fleet) == []
+        assert 'coda_quality_audits_total{replica="r0"}' in fleet
+        assert 'coda_quality_drift_statistic{detector=' in fleet
+    finally:
+        app.drain(timeout=5)
+
+
+def test_quality_slos_fire_and_clear_through_sweeper():
+    from coda_tpu.telemetry.slo import SloSweeper
+
+    t = [0.0]
+    sweeper = SloSweeper(quality_slos(), fast_s=10.0, slow_s=20.0,
+                         clock=lambda: t[0])
+    drift_snap = {"statistic": 9.0, "firing": True, "fired_total": 1,
+                  "cleared_total": 0, "observations": 9, "kind": "cusum",
+                  "last_value": 1.0}
+
+    def fleet(firing):
+        d = dict(drift_snap, firing=firing)
+        return {"replicas": {"r0": {"quality": {
+            "audit": {"audits_total": 4, "divergences_recent": 0},
+            "calibration": {}, "drift": {"prior_staleness": d}}}}}
+
+    events = []
+    for _ in range(5):
+        t[0] += 1.0
+        events += sweeper.observe(fleet(True))
+    assert [e["state"] for e in events] == ["firing"]
+    assert events[0]["slo"] == "quality_drift"
+    for _ in range(30):  # good samples push the bad ones out of the window
+        t[0] += 1.0
+        events += sweeper.observe(fleet(False))
+    assert [e["state"] for e in events] == ["firing", "resolved"]
+    snap = sweeper.snapshot()
+    assert not snap["objectives"]["quality_drift"]["firing"]
+    # objectives with no quality sections anywhere report no data
+    s2 = SloSweeper(quality_slos(), clock=lambda: t[0])
+    s2.observe({"replicas": {"r0": {"dispatches": 3}}})
+    obj = s2.snapshot()["objectives"]["quality_audit_divergence"]
+    assert obj["burn_fast"] is None
+
+
+def test_quality_slo_divergence_probe_reads_recent_window():
+    slos = {o.name: o for o in quality_slos()}
+    div = slos["quality_audit_divergence"]
+    clean = {"replicas": {"r0": {"quality": {
+        "audit": {"audits_total": 3, "divergences_recent": 0}}}}}
+    dirty = {"replicas": {"r0": {"quality": {
+        "audit": {"audits_total": 3, "divergences_recent": 1}}}}}
+    no_audits = {"replicas": {"r0": {"quality": {
+        "audit": {"audits_total": 0, "divergences_recent": 0}}}}}
+    assert div.probe(clean) == 0.0
+    assert div.probe(dirty) == 1.0
+    assert div.probe(no_audits) is None
+    ece = slos["quality_calibration_ece"]
+    good = {"replicas": {"r0": {"quality": {"calibration": {
+        "t": {"n": CALIBRATION_MIN_SAMPLES, "ece": 0.05}}}}}}
+    bad = {"replicas": {"r0": {"quality": {"calibration": {
+        "t": {"n": CALIBRATION_MIN_SAMPLES, "ece": 0.6}}}}}}
+    thin = {"replicas": {"r0": {"quality": {"calibration": {
+        "t": {"n": 3, "ece": 0.9}}}}}}
+    assert ece.probe(good) == 0.0
+    assert ece.probe(bad) == 1.0
+    assert ece.probe(thin) is None
+
+
+def test_router_quality_scorecard_aggregates_replicas():
+    from coda_tpu.serve.router import SessionRouter
+
+    app, task = _make_app()
+    try:
+        _drive(app, task, seeds=(0,), rounds=4)
+        assert app.quality.drain(30)
+        router = SessionRouter({"a": app})
+        card = router.quality_scorecard()
+        assert card["role"] == "router"
+        assert card["replicas"]["a"]["audit"]["audits_total"] == 1
+        assert card["verdict"]["audit"] == "ok"
+        # a replica without the plane is listed as disabled, not dropped
+        app2, _ = _make_app(quality=False, capacity=2)
+        try:
+            router2 = SessionRouter({"a": app, "b": app2})
+            card2 = router2.quality_scorecard()
+            assert card2["replicas"]["b"] == {"enabled": False}
+            assert card2["verdict"]["audit"] == "ok"
+        finally:
+            app2.drain(timeout=5)
+    finally:
+        app.drain(timeout=5)
+
+
+def test_cli_quality_report_over_http(capsys):
+    import threading
+
+    from coda_tpu import cli
+    from coda_tpu.serve import make_server
+
+    app, task = _make_app(capacity=2)
+    srv = make_server(app, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        _drive(app, task, seeds=(0,), rounds=4)
+        assert app.quality.drain(30)
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        # --json: the raw scorecard (replica-shaped: its own plane)
+        assert cli.main(["quality", "--url", url, "--json"]) == 0
+        card = json.loads(capsys.readouterr().out)
+        assert card["audit"]["audits_total"] == 1
+        assert card["verdict"]["audit"] == "ok"
+        # human report: healthy plane exits 0 and names the organs
+        assert cli.main(["quality", "--url", url]) == 0
+        text = capsys.readouterr().out
+        assert "audit" in text and "calibration" in text
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        app.drain(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# prior pool staleness (r20 satellite)
+# ---------------------------------------------------------------------------
+
+def test_prior_pool_staleness_clock_and_snapshot_roundtrip():
+    from coda_tpu.selectors.surrogate import empty_prior, prior_to_dict
+    from coda_tpu.serve.priors import PriorPool
+
+    t = [1000.0]
+    pool = PriorPool(min_rounds=0.0, clock=lambda: t[0])
+    assert pool.staleness_seconds() is None
+    assert pool.stats()["staleness_seconds"] is None
+    from coda_tpu.selectors.surrogate import N_FEATURES
+
+    fit = {"A": np.eye(N_FEATURES), "b": np.ones(N_FEATURES),
+           "n": 50.0, "rounds": 40.0}
+    assert pool.contribute("k1", fit)
+    t[0] += 30.0
+    assert pool.contribute("k2", fit)
+    t[0] += 70.0
+    ages = pool.pool_ages()
+    assert ages["k1"] == pytest.approx(100.0)
+    assert ages["k2"] == pytest.approx(70.0)
+    assert pool.staleness_seconds() == pytest.approx(100.0)
+    stats = pool.stats()
+    assert stats["staleness_seconds"] == pytest.approx(100.0)
+    assert stats["pool_ages_seconds"]["k2"] == pytest.approx(70.0)
+    # ages survive the snapshot -> replace round-trip (router exchange)
+    snap = pool.snapshot()
+    pool2 = PriorPool(min_rounds=0.0, clock=lambda: t[0])
+    pool2.replace(snap)
+    assert pool2.staleness_seconds() == pytest.approx(100.0)
+    # a pre-r20 snapshot (no touched map) reads as touched-now
+    legacy = {"pools": {"k3": prior_to_dict(
+        pool._pools["k1"])}, "sessions_contributed": 1}
+    pool3 = PriorPool(min_rounds=0.0, clock=lambda: t[0])
+    pool3.replace(legacy)
+    assert pool3.pool_ages()["k3"] == pytest.approx(0.0)
+    # merge_delta refreshes the key's clock too
+    t[0] += 10.0
+    pool2.merge_delta({"k1": prior_to_dict(pool._pools["k1"])})
+    assert pool2.pool_ages()["k1"] == pytest.approx(0.0)
+    assert pool2.pool_ages()["k2"] == pytest.approx(80.0)
+    assert empty_prior().n == 0  # import sanity
+
+
+def test_prior_staleness_surfaces_on_metrics():
+    from coda_tpu.telemetry.prometheus import lint, render_fleet
+
+    snap = {"prior_pool_staleness_seconds": 42.5,
+            "prior_pool_ages_seconds": {"t:abc": 42.5, "t:def": 1.25}}
+    text = render_fleet({"r0": snap})
+    assert lint(text) == []
+    assert 'coda_serve_prior_pool_staleness_seconds{replica="r0"} 42.5' \
+        in text
+    assert 'coda_serve_prior_pool_age_seconds{pool="t:def",replica="r0"}' \
+        in text
+
+
+# ---------------------------------------------------------------------------
+# plane snapshot / store flush
+# ---------------------------------------------------------------------------
+
+def test_quality_plane_log_to_store(tmp_path):
+    from coda_tpu.tracking import TrackingStore
+
+    plane = QualityPlane(preds_fn=lambda name: None)
+    plane.calibration.observe("t", 0.9, True, p_label=0.9)
+    plane.observe_drift("surrogate_residual", 0.2)
+    store = TrackingStore(str(tmp_path / "db.sqlite"))
+    plane.log_to_store(store)
+    found = store.find_run("serve_quality", "quality-snapshot")
+    assert found
+    uuid = found[0]
+    assert store.metric_series(uuid, "calibration_n.t") == [(0, 1.0)]
+    assert store.metric_series(
+        uuid, "drift_firing.surrogate_residual") == [(0, 0.0)]
